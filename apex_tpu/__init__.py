@@ -1,0 +1,80 @@
+"""apex_tpu — a TPU-native framework with the capabilities of ROCm/apex.
+
+Built from scratch on JAX/XLA/Pallas. The reference (ROCm/apex, see SURVEY.md)
+is a library of (a) an automatic mixed-precision engine, (b) fused kernels
+exposed as drop-in modules/optimizers, (c) data-parallel wrappers + SyncBN, and
+(d) a Megatron-style tensor/pipeline-parallel toolkit. apex_tpu provides the
+same capability surface, re-designed TPU-first:
+
+- ``apex_tpu.amp``         — mixed-precision policies O0–O5 (fp16/bf16), fp32
+  master weights, *device-side* dynamic loss scaling (no host syncs).
+  (reference: apex/amp/frontend.py, apex/amp/scaler.py)
+- ``apex_tpu.optimizers``  — fused multi-tensor optimizers (Adam, LAMB, SGD,
+  NovoGrad, Adagrad, LARS, MixedPrecisionLamb) as jit-fused updates.
+  (reference: apex/optimizers/*, csrc/multi_tensor_*.cu)
+- ``apex_tpu.ops``         — the fused op library (LayerNorm/RMSNorm, scaled
+  masked softmax family, RoPE, bias+SwiGLU, xentropy, dense/MLP, attention)
+  with Pallas TPU kernels + custom_vjp and pure-XLA references.
+  (reference: csrc/, apex/contrib/csrc/)
+- ``apex_tpu.parallel``    — data parallelism (grad psum over a mesh axis),
+  SyncBatchNorm, LARC, fused grad clipping.
+  (reference: apex/parallel/)
+- ``apex_tpu.transformer`` — tensor/sequence/pipeline parallelism over
+  ``jax.sharding.Mesh`` axes with XLA collectives.
+  (reference: apex/transformer/)
+- ``apex_tpu.contrib``     — xentropy, focal loss, transducer, index_mul_2d,
+  sparsity (ASP), ZeRO-style distributed optimizers, peer halo exchange.
+  (reference: apex/contrib/)
+- ``apex_tpu.models``      — standalone GPT/BERT/ResNet used by tests+bench.
+  (reference: apex/transformer/testing/standalone_transformer_lm.py,
+  examples/imagenet)
+"""
+
+__version__ = "0.1.0"
+
+from apex_tpu.utils.logging import get_logger  # noqa: F401
+
+# Light-weight subpackages are imported eagerly; heavyweight ones lazily via
+# attribute access (mirrors the reference's compatibility/ lazy-import shims,
+# compatibility/amp_C.py:4-37, without the JIT-build machinery TPUs don't need).
+_LAZY_SUBMODULES = (
+    "amp",
+    "optimizers",
+    "ops",
+    "parallel",
+    "transformer",
+    "contrib",
+    "models",
+    "multi_tensor",
+    "fp16_utils",
+    "normalization",
+    "fused_dense",
+    "mlp",
+    "RNN",
+    "testing",
+    "utils",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        try:
+            mod = importlib.import_module(f"apex_tpu.{name}")
+        except ModuleNotFoundError as e:
+            # Translate only "this submodule doesn't exist (yet)" so
+            # hasattr()/dir() probes don't crash; missing *dependencies*
+            # inside an existing submodule still surface as-is.
+            if e.name == f"apex_tpu.{name}":
+                raise AttributeError(
+                    f"module 'apex_tpu' has no attribute {name!r}"
+                ) from None
+            raise
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_LAZY_SUBMODULES))

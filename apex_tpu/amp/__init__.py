@@ -1,0 +1,46 @@
+"""apex_tpu.amp — automatic mixed precision for TPU.
+
+Capability parity with the reference ``apex.amp`` (apex/amp/frontend.py,
+_initialize.py, scaler.py, handle.py), redesigned for JAX:
+
+- Opt levels O0–O5 with the same meanings (O0 fp32; O1 function-boundary
+  fp16 casts; O2 fp16 params + fp32 master weights + dynamic scale; O3 pure
+  fp16; O4/O5 the bf16 analogs of O1/O2 — frontend.py:119-255).
+- Instead of monkey-patching torch functions (wrap.py), a ``Policy`` object is
+  applied *functionally*: params/inputs are cast at the train-step boundary
+  and (for O1/O4) op-level casts are expressed through the cast-list helpers
+  in ``apex_tpu.amp.lists``.
+- Dynamic loss scaling is carried as a pure jittable state; the reference's
+  D2H sync point (scaler.py:209 ``overflow_buf.item()``) becomes a device-side
+  ``jnp.where`` select so the step never blocks on the host.
+"""
+
+from apex_tpu.amp.policy import (  # noqa: F401
+    O0,
+    O1,
+    O2,
+    O3,
+    O4,
+    O5,
+    Policy,
+    Properties,
+    opt_levels,
+    policy_for_opt_level,
+)
+from apex_tpu.amp.scaler import (  # noqa: F401
+    LossScaleConfig,
+    LossScaleState,
+    all_finite,
+    init_loss_scale,
+    scale_loss,
+    unscale_grads,
+    update_loss_scale,
+)
+from apex_tpu.amp.frontend import (  # noqa: F401
+    AmpState,
+    initialize,
+    load_state_dict,
+    make_train_step,
+    state_dict,
+)
+from apex_tpu.amp import lists  # noqa: F401

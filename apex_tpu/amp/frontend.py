@@ -1,0 +1,219 @@
+"""amp.initialize / train-step construction.
+
+Reference flow (apex/amp/frontend.py:259 → _initialize.py:147): cast the
+model, patch ``forward`` to cast inputs, build fp32 master weights, patch
+``optimizer.step`` to run master→model copies, create per-loss ``LossScaler``s,
+and expose ``amp.scale_loss`` as a context manager (handle.py:17).
+
+Under jit the same responsibilities become *construction* of a pure train
+step: ``make_train_step(loss_fn, optimizer, policy)`` returns ``init``/``step``
+functions where
+
+- params live in ``policy.param_dtype`` (model weights), master weights in
+  fp32 inside the train state when ``policy.master_weights``,
+- the loss is scaled before grad, grads unscaled + finite-checked after,
+- the optimizer update is *selected against* (not branched over) on overflow,
+  keeping the whole step host-sync-free — the reference's skip-step patch
+  (handle.py:128-154) becomes a ``jnp.where``,
+- the scaler state update follows scaler.py:206-226 window doubling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import scaler as scaler_lib
+from apex_tpu.amp.policy import Policy, policy_for_opt_level
+
+__all__ = [
+    "AmpState",
+    "initialize",
+    "make_train_step",
+    "state_dict",
+    "load_state_dict",
+]
+
+
+class AmpState(NamedTuple):
+    """What ``amp.initialize`` hands back (policy + scaler)."""
+
+    policy: Policy
+    loss_scale_config: scaler_lib.LossScaleConfig
+    loss_scale_state: scaler_lib.LossScaleState
+
+
+def initialize(
+    opt_level: Union[str, Policy] = "O1",
+    num_losses: int = 1,
+    **overrides,
+):
+    """Resolve an opt level into an :class:`AmpState`.
+
+    ``num_losses`` mirrors the reference's per-loss scaler list
+    (_initialize.py:229-233): with ``num_losses > 1`` a *list* of
+    independent :class:`AmpState` objects is returned, one per loss, each
+    usable with :func:`make_train_step`.
+    """
+    policy = policy_for_opt_level(opt_level, **overrides)
+
+    def one():
+        cfg, state = scaler_lib.init_loss_scale(policy.loss_scale)
+        return AmpState(policy, cfg, state)
+
+    if num_losses > 1:
+        return [one() for _ in range(num_losses)]
+    return one()
+
+
+class TrainState(NamedTuple):
+    step: jax.Array
+    params: Any                       # model-dtype params
+    master_params: Any                # fp32 masters (== params when disabled)
+    opt_state: Any
+    loss_scale_state: scaler_lib.LossScaleState
+
+
+def make_train_step(
+    loss_fn: Callable,
+    optimizer: Any,
+    policy_or_amp: Union[str, Policy, AmpState] = "O1",
+    *,
+    axis_name: Optional[str] = None,
+    has_aux: bool = False,
+    grad_postprocess: Optional[Callable[[Any], Any]] = None,
+) -> Tuple[Callable, Callable]:
+    """Build ``(init_fn, step_fn)`` implementing the full AMP training step.
+
+    Args:
+      loss_fn: ``loss_fn(params, *batch) -> loss`` (or ``(loss, aux)`` with
+        ``has_aux``). Receives params already cast to the compute dtype.
+      optimizer: an optax-style ``GradientTransformation`` (e.g.
+        ``apex_tpu.optimizers.fused_adam(...)``).
+      policy_or_amp: opt level name, Policy, or AmpState.
+      axis_name: if set, grads are ``lax.pmean``-ed and the overflow flag
+        ``lax.pmax``-ed over this mesh axis — the fusion of apex DDP's grad
+        allreduce (apex/parallel/distributed.py:426) with the transformer
+        GradScaler's found-inf allreduce (apex/transformer/amp/grad_scaler.py:21).
+      grad_postprocess: optional hook applied to unscaled fp32 grads
+        (e.g. clipping).
+
+    The returned ``step_fn(state, *batch) -> (state, metrics)`` is pure and
+    jittable; metrics carry ``loss``, ``overflow``, ``loss_scale``.
+    """
+    if isinstance(policy_or_amp, AmpState):
+        amp_state = policy_or_amp
+    else:
+        amp_state = initialize(policy_or_amp)
+    policy, ls_cfg = amp_state.policy, amp_state.loss_scale_config
+
+    def init_fn(params) -> TrainState:
+        model_params = policy.cast_params(params)
+        master = (
+            policy.cast_master(params) if policy.master_weights else model_params
+        )
+        opt_state = optimizer.init(master)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=model_params,
+            master_params=master,
+            opt_state=opt_state,
+            loss_scale_state=amp_state.loss_scale_state,
+        )
+
+    def step_fn(state: TrainState, *batch):
+        ls_state = state.loss_scale_state
+
+        def scaled_loss_fn(master_params):
+            # Forward runs on compute-dtype params derived from the masters
+            # (reference O2: model holds fp16 copies of fp32 masters).
+            compute_params = policy.cast_params(master_params)
+            if policy.per_op_casts:
+                compute_params = policy.cast_to_compute(
+                    compute_params, respect_norms=True
+                )
+            out = loss_fn(compute_params, *batch)
+            loss, aux = (out if has_aux else (out, None))
+            return scaler_lib.scale_loss(loss, ls_state), (loss, aux)
+
+        grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(
+            state.master_params
+        )
+        grads, finite = scaler_lib.unscale_grads(grads, ls_state)
+
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+            finite = jax.lax.pmin(finite.astype(jnp.int32), axis_name) > 0
+
+        if grad_postprocess is not None:
+            grads = grad_postprocess(grads)
+
+        new_ls_state, overflow = scaler_lib.update_loss_scale(
+            ls_cfg, ls_state, ~finite
+        )
+
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.master_params
+        )
+        new_master = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), state.master_params, updates
+        )
+
+        # Overflow ⇒ keep old params & opt state (skip-step, handle.py:128-154)
+        def select(new, old):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(overflow, o, n), new, old
+            )
+
+        new_master = select(new_master, state.master_params)
+        new_opt_state = select(new_opt_state, state.opt_state)
+        new_params = policy.cast_params(new_master)
+
+        new_state = TrainState(
+            step=state.step + jnp.where(overflow, 0, 1),
+            params=new_params,
+            master_params=new_master if policy.master_weights else new_params,
+            opt_state=new_opt_state,
+            loss_scale_state=new_ls_state,
+        )
+        metrics = {
+            "loss": loss,
+            "overflow": overflow,
+            "loss_scale": new_ls_state.loss_scale,
+        }
+        if aux is not None:
+            metrics["aux"] = aux
+        return new_state, metrics
+
+    return init_fn, step_fn
+
+
+# ---- checkpointing (reference amp.state_dict / load_state_dict,
+# apex/amp/frontend.py:399-437) ------------------------------------------------
+
+
+def state_dict(amp_or_train_state) -> dict:
+    """Serialize scaler state; mirrors amp.state_dict()'s
+    {loss_scalerN: {loss_scale, unskipped}} layout (frontend.py:399-419)."""
+    ls = (
+        amp_or_train_state.loss_scale_state
+        if hasattr(amp_or_train_state, "loss_scale_state")
+        else amp_or_train_state
+    )
+    return {
+        "loss_scaler0": {
+            "loss_scale": jax.device_get(ls.loss_scale),
+            "unskipped": jax.device_get(ls.unskipped),
+        }
+    }
+
+
+def load_state_dict(d: dict) -> scaler_lib.LossScaleState:
+    entry = d["loss_scaler0"]
+    return scaler_lib.LossScaleState(
+        loss_scale=jnp.asarray(entry["loss_scale"], jnp.float32),
+        unskipped=jnp.asarray(entry["unskipped"], jnp.int32),
+    )

@@ -1,0 +1,111 @@
+"""Cast lists + per-function cast decorators (O1/O4 semantics).
+
+The reference implements O1 by monkey-patching torch functions according to
+three lists (apex/amp/lists/functional_overrides.py:18,40,81,
+torch_overrides.py:7, tensor_overrides.py): FP16_FUNCS run with inputs cast to
+fp16, FP32_FUNCS with inputs cast to fp32, CASTS promote mixed inputs to the
+widest type. Monkey-patching is impossible (and unnecessary) under jit; the
+same semantics are exposed as:
+
+- the list constants below, documenting which op families the policy treats
+  as matmul-class (compute dtype) vs. reduction-class (fp32) — used by this
+  package's own fused ops to pick their internal compute dtype, and
+- decorators ``half_function`` / ``bfloat16_function`` / ``float_function`` /
+  ``promote_function`` (reference apex/amp/amp.py:29-46 registration
+  decorators) that wrap *user* functions with boundary casts.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "FP16_FUNCS",
+    "FP32_FUNCS",
+    "CASTS",
+    "half_function",
+    "bfloat16_function",
+    "float_function",
+    "promote_function",
+]
+
+# Matmul/conv-class ops: run in the low-precision compute dtype (MXU food).
+# (reference lists/functional_overrides.py:40-78, torch_overrides.py FP16)
+FP16_FUNCS = [
+    "conv1d", "conv2d", "conv3d", "conv_transpose1d", "conv_transpose2d",
+    "conv_transpose3d", "linear", "matmul", "dot", "dot_general", "bmm",
+    "mm", "mv", "addmm", "addbmm", "baddbmm", "conv_general_dilated",
+    "prelu", "einsum",
+]
+
+# Reduction/transcendental-class ops: numerically sensitive, keep fp32.
+# (reference lists/functional_overrides.py:81-117, torch_overrides.py FP32)
+FP32_FUNCS = [
+    "softmax", "log_softmax", "layer_norm", "group_norm", "batch_norm",
+    "instance_norm", "normalize", "cross_entropy", "nll_loss", "l1_loss",
+    "mse_loss", "kl_div", "exp", "expm1", "log", "log10", "log1p", "log2",
+    "pow", "erf", "erfc", "erfinv", "cosh", "sinh", "tan", "acos", "asin",
+    "atan", "reciprocal", "rsqrt", "cumprod", "cumsum", "prod", "sum",
+    "norm", "mean", "var", "std", "logsumexp", "sigmoid", "softplus",
+    "gelu",
+]
+
+# Promote-to-widest ops (reference lists/torch_overrides.py CASTS).
+CASTS = [
+    "add", "sub", "mul", "div", "addcdiv", "addcmul", "atan2", "cat",
+    "stack", "equal", "cross", "bilinear", "dist", "where",
+]
+
+
+def _cast_floats(tree, dtype):
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        tree,
+    )
+
+
+def _cast_wrapper(fn, dtype):
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        args, kwargs = _cast_floats((args, kwargs), dtype)
+        return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def half_function(fn):
+    """Run ``fn`` with float inputs cast to fp16 (reference amp.py:29)."""
+    return _cast_wrapper(fn, jnp.float16)
+
+
+def bfloat16_function(fn):
+    """Run ``fn`` with float inputs cast to bf16 (reference amp.py:33)."""
+    return _cast_wrapper(fn, jnp.bfloat16)
+
+
+def float_function(fn):
+    """Run ``fn`` with float inputs cast to fp32 (reference amp.py:41)."""
+    return _cast_wrapper(fn, jnp.float32)
+
+
+def promote_function(fn):
+    """Promote mixed float inputs to the widest dtype among them
+    (reference wrap.py promote wrapper)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        leaves = [
+            x for x in jax.tree_util.tree_leaves((args, kwargs))
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        ]
+        if leaves:
+            widest = jnp.result_type(*[x.dtype for x in leaves])
+            args, kwargs = _cast_floats((args, kwargs), widest)
+        return fn(*args, **kwargs)
+
+    return wrapped

@@ -1,0 +1,146 @@
+"""Dynamic loss scaling as pure, jittable state.
+
+Reference: ``LossScaler`` (apex/amp/scaler.py:42) — scale grads up before
+backward, unscale + inf/nan-check after (``multi_tensor_scale`` with a
+``noop_flag``, csrc/multi_tensor_scale_kernel.cu), then ``update_scale``
+(scaler.py:206-226): on overflow halve the scale and skip the step; after
+``scale_window`` consecutive clean steps double it.
+
+The reference pays a D2H sync per step (``overflow_buf.item()``,
+scaler.py:209). Here everything — the finite check, the window bookkeeping,
+the skip decision — is device-side arithmetic carried in ``LossScaleState``,
+so a jitted train step never blocks; "skip the step" becomes a ``jnp.where``
+select between old and new params (see ``apex_tpu.amp.frontend``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "LossScaleConfig",
+    "LossScaleState",
+    "init_loss_scale",
+    "all_finite",
+    "scale_loss",
+    "unscale_grads",
+    "update_loss_scale",
+]
+
+
+class LossScaleConfig(NamedTuple):
+    """Static (trace-time) scaler configuration.
+
+    Defaults match the reference (scaler.py:47-54): init 2**16, factor 2,
+    window 2000, max 2**24, no min.
+    """
+
+    dynamic: bool = True
+    init_scale: float = 2.0**16
+    scale_factor: float = 2.0
+    scale_window: int = 2000
+    min_loss_scale: float = 0.0   # 0 → unbounded below (reference: None)
+    max_loss_scale: float = 2.0**24
+
+
+class LossScaleState(NamedTuple):
+    """Device-side scaler state (a pytree; safe to donate/checkpoint)."""
+
+    loss_scale: jax.Array   # f32 scalar
+    unskipped: jax.Array    # i32 scalar — clean steps since last scale change
+
+
+def init_loss_scale(
+    loss_scale: Union[str, float] = "dynamic", **kwargs
+) -> Tuple[LossScaleConfig, LossScaleState]:
+    """Build (config, state). ``loss_scale`` is 'dynamic' or a static number."""
+    if loss_scale == "dynamic":
+        cfg = LossScaleConfig(dynamic=True, **kwargs)
+        init = min(cfg.max_loss_scale, cfg.init_scale)
+    else:
+        cfg = LossScaleConfig(dynamic=False, **kwargs)
+        init = float(loss_scale)
+    state = LossScaleState(
+        loss_scale=jnp.asarray(init, jnp.float32),
+        unskipped=jnp.asarray(0, jnp.int32),
+    )
+    return cfg, state
+
+
+def all_finite(tree: Any) -> jax.Array:
+    """Device-side bool: every float leaf is finite.
+
+    The analog of the fused kernels' shared ``noop_flag`` overflow buffer
+    (csrc/multi_tensor_apply.cuh:19-26): one flag for the whole param list.
+    """
+    leaves = [
+        x for x in jax.tree_util.tree_leaves(tree)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
+    ]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack(
+        [jnp.all(jnp.isfinite(x)) for x in leaves]
+    ).all()
+
+
+def scale_loss(loss: jax.Array, state: LossScaleState) -> jax.Array:
+    """``loss * loss_scale`` in fp32 (reference handle.py:113)."""
+    return loss.astype(jnp.float32) * state.loss_scale
+
+
+def unscale_grads(grads: Any, state: LossScaleState) -> Tuple[Any, jax.Array]:
+    """Divide grads by the scale; also report whether they were all finite.
+
+    Mirrors ``LossScaler.unscale`` (scaler.py:114-126): a single fused
+    multiply by ``1/scale`` plus the overflow flag. Grads are returned in
+    fp32 (the reference unscales model grads *into* fp32 master grads).
+    """
+    inv = 1.0 / state.loss_scale
+    finite = all_finite(grads)
+    unscaled = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * inv)
+        if hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.inexact)
+        else g,
+        grads,
+    )
+    return unscaled, finite
+
+
+def update_loss_scale(
+    cfg: LossScaleConfig, state: LossScaleState, found_inf: jax.Array
+) -> Tuple[LossScaleState, jax.Array]:
+    """Window-doubling update (reference ``update_scale``, scaler.py:206-226).
+
+    Returns ``(new_state, should_skip)``. Pure arithmetic — no host sync:
+
+    - overflow & dynamic: scale = max(min_scale, scale/factor); unskipped = 0;
+      skip = True.
+    - clean: unskipped += 1; if unskipped == window:
+      scale = min(max_scale, scale*factor); unskipped = 0.
+    - static scale: never skip, never change (reference returns
+      should_skip=False unless dynamic).
+    """
+    if not cfg.dynamic:
+        return state, jnp.asarray(False)
+
+    overflow = found_inf.astype(jnp.bool_)
+
+    shrunk = state.loss_scale / cfg.scale_factor
+    if cfg.min_loss_scale > 0.0:
+        shrunk = jnp.maximum(cfg.min_loss_scale, shrunk)
+
+    unskipped_clean = state.unskipped + 1
+    window_hit = unskipped_clean >= cfg.scale_window
+    grown = jnp.minimum(cfg.max_loss_scale, state.loss_scale * cfg.scale_factor)
+
+    new_scale = jnp.where(
+        overflow, shrunk, jnp.where(window_hit, grown, state.loss_scale)
+    )
+    new_unskipped = jnp.where(
+        overflow | window_hit, jnp.asarray(0, jnp.int32), unskipped_clean
+    )
+    return LossScaleState(new_scale, new_unskipped), overflow

@@ -1,0 +1,8 @@
+from apex_tpu.utils.logging import get_logger, set_logging_level  # noqa: F401
+from apex_tpu.utils.registry import (  # noqa: F401
+    OpImpl,
+    OpRegistry,
+    get_op,
+    registry,
+    register_op,
+)

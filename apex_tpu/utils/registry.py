@@ -1,0 +1,169 @@
+"""Op registry — the TPU analog of the reference's extension build/loader layer.
+
+The reference discovers 31 per-op builders into an ``ALL_OPS`` dict
+(op_builder/all_ops.py:87), JIT-compiles CUDA/HIP on first use with capability
+checks (op_builder/builder.py:527,614-660), and routes ``import amp_C``-style
+modules through lazy shims. On TPU no ninja/nvcc step exists — Pallas kernels
+and XLA graphs compile through jit — so the layer collapses into this registry:
+
+- named ops, each with one or more *implementations* per backend
+  (``pallas`` — Mosaic TPU kernel; ``xla`` — pure jnp/lax composition that XLA
+  fuses; ``ref`` — unfused numpy-like reference used in tests),
+- capability predicates per implementation (platform, dtype, shape
+  constraints) replacing compute-capability probing,
+- environment overrides (``APEX_TPU_BACKEND``, ``APEX_TPU_DISABLE_<OP>``)
+  replacing the reference's ``APEX_BUILD_<OP>`` gates (setup.py:166-181),
+- the jax persistent compilation cache standing in for the AOT build cache.
+
+Usage::
+
+    @register_op("fused_layer_norm", backend="pallas",
+                 is_available=lambda: default_backend() == "tpu")
+    def _ln_pallas(...): ...
+
+    @register_op("fused_layer_norm", backend="xla")
+    def _ln_xla(...): ...
+
+    fn = get_op("fused_layer_norm")   # best available implementation
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "OpImpl",
+    "OpRegistry",
+    "registry",
+    "register_op",
+    "get_op",
+    "available_ops",
+    "default_backend",
+]
+
+# Preference order when the user does not force a backend.
+_BACKEND_PRIORITY = {"pallas": 0, "xla": 1, "ref": 2}
+
+
+@functools.lru_cache(maxsize=None)
+def default_backend() -> str:
+    """The active jax platform ('tpu', 'cpu', 'gpu')."""
+    import jax
+
+    return jax.default_backend()
+
+
+def on_tpu() -> bool:
+    return default_backend() == "tpu"
+
+
+@dataclasses.dataclass
+class OpImpl:
+    name: str
+    backend: str
+    fn: Callable
+    is_available: Callable[[], bool]
+
+    def available(self) -> bool:
+        if os.environ.get(f"APEX_TPU_DISABLE_{self.name.upper()}", "0") == "1":
+            return False
+        try:
+            return bool(self.is_available())
+        except Exception:
+            return False
+
+
+class OpRegistry:
+    def __init__(self) -> None:
+        self._ops: Dict[str, List[OpImpl]] = {}
+
+    def register(
+        self,
+        name: str,
+        backend: str,
+        fn: Callable,
+        is_available: Optional[Callable[[], bool]] = None,
+    ) -> None:
+        if backend not in _BACKEND_PRIORITY:
+            raise ValueError(
+                f"unknown backend {backend!r}; expected one of "
+                f"{sorted(_BACKEND_PRIORITY)}"
+            )
+        if is_available is None:
+            # Pallas kernels need a real TPU unless interpret mode is forced.
+            if backend == "pallas":
+                is_available = lambda: (  # noqa: E731
+                    on_tpu()
+                    or os.environ.get("APEX_TPU_PALLAS_INTERPRET", "0") == "1"
+                )
+            else:
+                is_available = lambda: True  # noqa: E731
+        impls = self._ops.setdefault(name, [])
+        impls[:] = [i for i in impls if i.backend != backend]
+        impls.append(OpImpl(name, backend, fn, is_available))
+        impls.sort(key=lambda i: _BACKEND_PRIORITY[i.backend])
+
+    def get(self, name: str, backend: Optional[str] = None) -> Callable:
+        """Resolve the best available implementation of ``name``.
+
+        ``backend`` (or the ``APEX_TPU_BACKEND`` env var) forces a specific
+        implementation; otherwise the highest-priority available one wins.
+        """
+        if name not in self._ops:
+            raise KeyError(
+                f"op {name!r} is not registered; known ops: "
+                f"{sorted(self._ops)}"
+            )
+        forced = backend or os.environ.get("APEX_TPU_BACKEND") or None
+        for impl in self._ops[name]:
+            if forced is not None and impl.backend != forced:
+                continue
+            if impl.available():
+                return impl.fn
+        raise RuntimeError(
+            f"no available implementation for op {name!r}"
+            + (f" with backend={forced!r}" if forced else "")
+            + f"; registered: {[i.backend for i in self._ops[name]]}"
+        )
+
+    def impls(self, name: str) -> List[OpImpl]:
+        return list(self._ops.get(name, []))
+
+    def names(self) -> List[str]:
+        return sorted(self._ops)
+
+
+registry = OpRegistry()
+
+
+def register_op(
+    name: str,
+    backend: str = "xla",
+    is_available: Optional[Callable[[], bool]] = None,
+):
+    """Decorator form of ``registry.register``."""
+
+    def deco(fn: Callable) -> Callable:
+        registry.register(name, backend, fn, is_available)
+        return fn
+
+    return deco
+
+
+def get_op(name: str, backend: Optional[str] = None) -> Callable:
+    return registry.get(name, backend)
+
+
+def available_ops() -> Dict[str, List[str]]:
+    """Report, per op, which backends are currently usable.
+
+    Plays the role of the reference's installed-ops report
+    (apex/git_version_info.py:11-27).
+    """
+    return {
+        name: [i.backend for i in registry.impls(name) if i.available()]
+        for name in registry.names()
+    }

@@ -1,0 +1,27 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's multi-process-without-a-cluster strategy
+(apex/transformer/testing/distributed_test_base.py:30 spawns world_size
+processes on one host). On the JAX side one process with 8 virtual CPU
+devices exercises the same mesh/collective code paths.
+
+Must set env vars before jax is imported anywhere.
+"""
+
+import os
+
+# Force CPU: the driver environment presets a real-TPU platform (and its
+# sitecustomize overrides the JAX_PLATFORMS env var via jax config), so unit
+# tests must both set the env var and update the config after import.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep x64 off (TPU-realistic numerics).
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

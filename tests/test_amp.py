@@ -1,0 +1,200 @@
+"""AMP policy + loss scaler tests.
+
+Reference analogs: tests/L0/run_amp/test_basic_casts.py (per-level dtype
+behavior), test_multi_tensor_scale.py (overflow flag semantics), the dynamic
+scaler window behavior of apex/amp/scaler.py:206-226, and
+test_checkpointing.py (amp state_dict round-trip).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp
+
+
+class TestPolicy:
+    def test_opt_level_tables(self):
+        assert amp.O0.param_dtype == jnp.float32
+        assert amp.O1.compute_dtype == jnp.float16
+        assert amp.O1.loss_scale == "dynamic"
+        assert amp.O2.param_dtype == jnp.float16
+        assert amp.O2.master_weights
+        assert amp.O2.keep_norm_fp32
+        assert amp.O3.param_dtype == jnp.float16
+        assert not amp.O3.master_weights and amp.O3.loss_scale == 1.0
+        assert amp.O4.compute_dtype == jnp.bfloat16
+        assert amp.O4.loss_scale == 1.0
+        assert amp.O5.param_dtype == jnp.bfloat16
+        assert amp.O5.master_weights and amp.O5.loss_scale == 1.0
+
+    def test_unknown_level_raises(self):
+        with pytest.raises(ValueError):
+            amp.policy_for_opt_level("O9")
+
+    def test_cast_params_keeps_norms_fp32(self):
+        params = {
+            "dense": {"kernel": jnp.ones((4, 4))},
+            "layer_norm_0": {"scale": jnp.ones((4,)), "bias": jnp.zeros((4,))},
+        }
+        cast = amp.O2.cast_params(params)
+        assert cast["dense"]["kernel"].dtype == jnp.float16
+        assert cast["layer_norm_0"]["scale"].dtype == jnp.float32
+
+    def test_cast_skips_integers(self):
+        tree = {"x": jnp.ones((2,)), "i": jnp.arange(3)}
+        out = amp.O2.cast_to_compute(tree)
+        assert out["x"].dtype == jnp.float16
+        assert out["i"].dtype == jnp.int32
+
+    def test_o1_compute_cast_keeps_norms_fp32(self):
+        params = {
+            "dense": {"kernel": jnp.ones((4, 4))},
+            "layer_norm_0": {"scale": jnp.ones((4,))},
+        }
+        cast = amp.O1.cast_to_compute(params, respect_norms=True)
+        assert cast["dense"]["kernel"].dtype == jnp.float16
+        assert cast["layer_norm_0"]["scale"].dtype == jnp.float32
+
+    def test_num_losses_returns_list(self):
+        states = amp.initialize("O1", num_losses=3)
+        assert isinstance(states, list) and len(states) == 3
+        assert states[0].loss_scale_state.loss_scale.shape == ()
+
+    def test_properties_rejects_unknown(self):
+        props = amp.Properties()
+        with pytest.raises(AttributeError):
+            props.not_an_option = 1
+        with pytest.raises(ValueError):
+            props.loss_scale = "bogus"
+
+
+class TestLossScaler:
+    def test_overflow_halves_and_skips(self):
+        cfg, state = amp.init_loss_scale("dynamic")
+        assert float(state.loss_scale) == 2.0**16
+        new, skip = amp.update_loss_scale(cfg, state, jnp.asarray(True))
+        assert bool(skip)
+        assert float(new.loss_scale) == 2.0**15
+        assert int(new.unskipped) == 0
+
+    def test_window_doubling(self):
+        cfg, state = amp.init_loss_scale("dynamic", scale_window=3,
+                                         init_scale=2.0**10)
+        no = jnp.asarray(False)
+        for i in range(3):
+            state, skip = amp.update_loss_scale(cfg, state, no)
+            assert not bool(skip)
+        assert float(state.loss_scale) == 2.0**11
+        assert int(state.unskipped) == 0
+
+    def test_max_scale_clamped(self):
+        cfg, state = amp.init_loss_scale("dynamic", scale_window=1,
+                                         init_scale=2.0**24)
+        state, _ = amp.update_loss_scale(cfg, state, jnp.asarray(False))
+        assert float(state.loss_scale) == 2.0**24
+
+    def test_static_scale_never_skips(self):
+        cfg, state = amp.init_loss_scale(128.0)
+        new, skip = amp.update_loss_scale(cfg, state, jnp.asarray(True))
+        assert not bool(skip)
+        assert float(new.loss_scale) == 128.0
+
+    def test_unscale_and_finite_flag(self):
+        cfg, state = amp.init_loss_scale(4.0)
+        grads = {"w": jnp.asarray([8.0, 4.0])}
+        out, finite = amp.unscale_grads(grads, state)
+        np.testing.assert_allclose(out["w"], [2.0, 1.0])
+        assert bool(finite)
+        bad = {"w": jnp.asarray([jnp.inf, 1.0])}
+        _, finite = amp.unscale_grads(bad, state)
+        assert not bool(finite)
+
+    def test_all_finite_nan(self):
+        assert not bool(amp.all_finite({"a": jnp.asarray([jnp.nan])}))
+        assert bool(amp.all_finite({"a": jnp.ones(3), "b": jnp.arange(3)}))
+
+    def test_state_dict_roundtrip(self):
+        cfg, state = amp.init_loss_scale("dynamic")
+        state, _ = amp.update_loss_scale(cfg, state, jnp.asarray(True))
+        d = amp.state_dict(state)
+        restored = amp.load_state_dict(d)
+        assert float(restored.loss_scale) == float(state.loss_scale)
+        assert int(restored.unskipped) == int(state.unskipped)
+
+
+def _toy_loss(params, x, y):
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+
+
+class TestTrainStep:
+    def _data(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(8, 4), jnp.float32)
+        y = jnp.asarray(rng.randn(8, 2), jnp.float32)
+        params = {
+            "w": jnp.asarray(rng.randn(4, 2), jnp.float32),
+            "b": jnp.zeros((2,), jnp.float32),
+        }
+        return params, x, y
+
+    @pytest.mark.parametrize("level", ["O0", "O1", "O2", "O3", "O4", "O5"])
+    def test_loss_decreases_all_levels(self, level):
+        params, x, y = self._data()
+        init, step = amp.make_train_step(
+            _toy_loss, optax.sgd(0.05), level
+        )
+        state = init(params)
+        step = jax.jit(step)
+        _, m0 = step(state, x, y)
+        for _ in range(40):
+            state, metrics = step(state, x, y)
+        assert float(metrics["loss"]) < float(m0["loss"])
+
+    def test_o2_param_dtypes(self):
+        params, x, y = self._data()
+        init, step = amp.make_train_step(_toy_loss, optax.sgd(0.05), "O2")
+        state = init(params)
+        assert state.params["w"].dtype == jnp.float16
+        assert state.master_params["w"].dtype == jnp.float32
+        state, _ = jax.jit(step)(state, x, y)
+        assert state.params["w"].dtype == jnp.float16
+        assert state.master_params["w"].dtype == jnp.float32
+
+    def test_overflow_skips_step(self):
+        params, x, y = self._data()
+        init, step = amp.make_train_step(_toy_loss, optax.sgd(0.05), "O2")
+        state = init(params)
+        bad_x = x.at[0, 0].set(jnp.inf)
+        new_state, metrics = jax.jit(step)(state, bad_x, y)
+        assert bool(metrics["overflow"])
+        np.testing.assert_array_equal(
+            np.asarray(new_state.master_params["w"]),
+            np.asarray(state.master_params["w"]),
+        )
+        assert int(new_state.step) == 0
+        assert float(new_state.loss_scale_state.loss_scale) == 2.0**15
+
+
+class TestCastLists:
+    def test_decorators(self):
+        from apex_tpu.amp import lists
+
+        @lists.float_function
+        def f32_fn(x):
+            return x.dtype
+
+        @lists.half_function
+        def f16_fn(x):
+            return x.dtype
+
+        @lists.promote_function
+        def promo(x, y):
+            return jnp.result_type(x, y)
+
+        assert f32_fn(jnp.ones(2, jnp.float16)) == jnp.float32
+        assert f16_fn(jnp.ones(2, jnp.float32)) == jnp.float16
+        assert promo(jnp.ones(2, jnp.float16), jnp.ones(2, jnp.float32)) == jnp.float32

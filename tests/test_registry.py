@@ -1,0 +1,53 @@
+"""Op registry tests (reference analog: tests/test_extension_import.py —
+every compatibility shim imports; here: every registered op resolves)."""
+
+import os
+
+import pytest
+
+from apex_tpu.utils.registry import OpRegistry
+
+
+def test_register_and_get():
+    reg = OpRegistry()
+    reg.register("myop", "xla", lambda x: x + 1)
+    assert reg.get("myop")(1) == 2
+
+
+def test_backend_priority_and_availability():
+    reg = OpRegistry()
+    reg.register("op", "xla", lambda: "xla")
+    reg.register("op", "pallas", lambda: "pallas", is_available=lambda: False)
+    assert reg.get("op")() == "xla"
+    reg.register("op", "pallas", lambda: "pallas", is_available=lambda: True)
+    assert reg.get("op")() == "pallas"
+
+
+def test_forced_backend():
+    reg = OpRegistry()
+    reg.register("op", "xla", lambda: "xla")
+    reg.register("op", "ref", lambda: "ref")
+    assert reg.get("op", backend="ref")() == "ref"
+    with pytest.raises(RuntimeError):
+        reg.get("op", backend="pallas")
+
+
+def test_unknown_op():
+    reg = OpRegistry()
+    with pytest.raises(KeyError):
+        reg.get("nope")
+
+
+def test_env_disable(monkeypatch):
+    reg = OpRegistry()
+    reg.register("op", "xla", lambda: "xla")
+    reg.register("op", "ref", lambda: "ref")
+    monkeypatch.setenv("APEX_TPU_DISABLE_OP", "1")
+    with pytest.raises(RuntimeError):
+        reg.get("op")
+
+
+def test_bad_backend_rejected():
+    reg = OpRegistry()
+    with pytest.raises(ValueError):
+        reg.register("op", "cuda", lambda: None)
