@@ -111,9 +111,22 @@ def make_train_step(
     policy, ls_cfg = amp_state.policy, amp_state.loss_scale_config
 
     def init_fn(params) -> TrainState:
-        model_params = policy.cast_params(params)
+        # Copy even when the cast is an identity: astype-to-same-dtype
+        # aliases, and aliasing the caller's arrays means a later
+        # donate_argnums on the train state would delete the caller's own
+        # params out from under them.
+        def own(tree):
+            return jax.tree_util.tree_map(
+                lambda x: jnp.array(x, copy=True)
+                if isinstance(x, jax.Array) else x,
+                tree,
+            )
+
+        model_params = own(policy.cast_params(params))
         master = (
-            policy.cast_master(params) if policy.master_weights else model_params
+            own(policy.cast_master(params))
+            if policy.master_weights
+            else model_params
         )
         opt_state = optimizer.init(master)
         return TrainState(

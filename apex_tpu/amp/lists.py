@@ -72,14 +72,17 @@ def _cast_floats(tree, dtype):
 def _cast_wrapper(fn, dtype):
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
-        args, kwargs = _cast_floats((args, kwargs), dtype)
+        from apex_tpu.amp.policy import _effective
+
+        args, kwargs = _cast_floats((args, kwargs), _effective(dtype))
         return fn(*args, **kwargs)
 
     return wrapped
 
 
 def half_function(fn):
-    """Run ``fn`` with float inputs cast to fp16 (reference amp.py:29)."""
+    """Run ``fn`` with float inputs cast to fp16 (reference amp.py:29;
+    realized as bf16 on TPU — see policy._effective)."""
     return _cast_wrapper(fn, jnp.float16)
 
 

@@ -239,11 +239,22 @@ O5 = _mk(
 opt_levels = {"O0": O0, "O1": O1, "O2": O2, "O3": O3, "O4": O4, "O5": O5}
 
 
+# Reference amp.initialize kwarg names → Policy field names, so calls written
+# against the reference API (frontend.py:259 signature) work unchanged.
+_REFERENCE_KEY_ALIASES = {
+    "keep_batchnorm_fp32": "keep_norm_fp32",
+    "cast_model_type": "param_dtype",
+    "patch_torch_functions": "per_op_casts",
+}
+
+
 def policy_for_opt_level(opt_level: Union[str, Policy], **overrides) -> Policy:
     """Look up an opt level and apply user overrides.
 
     Mirrors ``amp.initialize``'s override handling — explicit kwargs win over
-    the opt-level preset (reference frontend.py:374-397).
+    the opt-level preset (reference frontend.py:374-397). Reference kwarg
+    names (``keep_batchnorm_fp32``, ``cast_model_type``,
+    ``patch_torch_functions``) are accepted as aliases.
     """
     if isinstance(opt_level, Policy):
         policy = opt_level
@@ -255,5 +266,15 @@ def policy_for_opt_level(opt_level: Union[str, Policy], **overrides) -> Policy:
             )
         policy = opt_levels[opt_level]
     if overrides:
+        overrides = {
+            _REFERENCE_KEY_ALIASES.get(k, k): v for k, v in overrides.items()
+        }
+        fields = {f.name for f in dataclasses.fields(Policy)}
+        unknown = set(overrides) - fields
+        if unknown:
+            raise ValueError(
+                f"Unknown amp option(s) {sorted(unknown)}; valid options: "
+                f"{sorted(fields | set(_REFERENCE_KEY_ALIASES))}"
+            )
         policy = dataclasses.replace(policy, **overrides)
     return policy

@@ -1,0 +1,8 @@
+from apex_tpu.multi_tensor.multi_tensor_apply import (  # noqa: F401
+    MultiTensorApply,
+    amp_C,
+    multi_tensor_applier,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+)

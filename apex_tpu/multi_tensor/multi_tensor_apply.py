@@ -1,0 +1,173 @@
+"""Batched elementwise ops over whole tensor lists.
+
+Reference: ``multi_tensor_applier`` (apex/multi_tensor_apply/
+multi_tensor_apply.py:3-30) dispatching CUDA kernels that pack up to 110
+tensor pointers per launch (csrc/multi_tensor_apply.cuh:19-26,44-136) with a
+shared ``noop_flag`` that aborts the whole launch when any value is
+non-finite.
+
+On TPU there are no kernel launches to batch: everything lives in one jitted
+graph and XLA fuses elementwise chains across the whole list. What survives
+is the *semantics*:
+
+- one call covers an arbitrary list/pytree of tensors,
+- a single device-side overflow flag covers the whole list
+  (``noop_flag``-compatible: 1 ⇒ at least one non-finite value seen),
+- ``multi_tensor_scale`` honors an incoming flag by no-op'ing (the CUDA
+  kernel stops copying once the flag is set).
+
+These are the building blocks of the LossScaler and every fused optimizer,
+exactly as ``amp_C`` is in the reference (csrc/amp_C_frontend.cpp:193-226).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "multi_tensor_scale",
+    "multi_tensor_axpby",
+    "multi_tensor_l2norm",
+    "MultiTensorApply",
+    "multi_tensor_applier",
+]
+
+
+def _nonfinite_flag(tensors: Sequence[jax.Array]) -> jax.Array:
+    """int32 0/1 flag — 1 iff any element of any tensor is non-finite."""
+    if not tensors:
+        return jnp.zeros((), jnp.int32)
+    flags = [jnp.any(~jnp.isfinite(t.astype(jnp.float32))) for t in tensors]
+    return jnp.stack(flags).any().astype(jnp.int32)
+
+
+def multi_tensor_scale(
+    srcs: Sequence[jax.Array],
+    scale,
+    noop_flag: Optional[jax.Array] = None,
+    out_dtypes: Optional[Sequence[Any]] = None,
+) -> Tuple[List[jax.Array], jax.Array]:
+    """``out[i] = src[i] * scale`` with overflow detection.
+
+    Reference kernel: csrc/multi_tensor_scale_kernel.cu (used for loss
+    unscaling and fp16↔fp32 master-grad copies). Returns ``(outs, flag)``;
+    when an incoming ``noop_flag`` is already set, outputs pass through
+    unscaled (kernel's early-exit semantics).
+    """
+    srcs = list(srcs)
+    flag = _nonfinite_flag(srcs)
+    if noop_flag is not None:
+        flag = jnp.maximum(flag, noop_flag.astype(jnp.int32))
+    out_dtypes = out_dtypes or [t.dtype for t in srcs]
+    outs = []
+    for t, dt in zip(srcs, out_dtypes):
+        scaled = (t.astype(jnp.float32) * scale).astype(dt)
+        if noop_flag is not None:
+            scaled = jnp.where(noop_flag.astype(bool), t.astype(dt), scaled)
+        outs.append(scaled)
+    return outs, flag
+
+
+def multi_tensor_axpby(
+    xs: Sequence[jax.Array],
+    ys: Sequence[jax.Array],
+    a,
+    b,
+    out_dtypes: Optional[Sequence[Any]] = None,
+) -> Tuple[List[jax.Array], jax.Array]:
+    """``out[i] = a*x[i] + b*y[i]`` (csrc/multi_tensor_axpby_kernel.cu).
+
+    Used by apex DDP's fp32 allreduce path and scaler add-with-scale.
+    """
+    xs, ys = list(xs), list(ys)
+    flag = jnp.maximum(_nonfinite_flag(xs), _nonfinite_flag(ys))
+    out_dtypes = out_dtypes or [t.dtype for t in xs]
+    outs = [
+        (a * x.astype(jnp.float32) + b * y.astype(jnp.float32)).astype(dt)
+        for x, y, dt in zip(xs, ys, out_dtypes)
+    ]
+    return outs, flag
+
+
+def multi_tensor_l2norm(
+    tensors: Sequence[jax.Array], per_tensor: bool = False
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Global (and optionally per-tensor) L2 norm over a tensor list.
+
+    Reference kernel: csrc/multi_tensor_l2norm_kernel.cu — feeds FusedLAMB's
+    two-phase update and fused ``clip_grad_norm_``.
+    """
+    tensors = list(tensors)
+    if not tensors:
+        z = jnp.zeros((), jnp.float32)
+        return z, (jnp.zeros((0,), jnp.float32) if per_tensor else None)
+    sq = jnp.stack(
+        [jnp.sum(jnp.square(t.astype(jnp.float32))) for t in tensors]
+    )
+    total = jnp.sqrt(jnp.sum(sq))
+    return total, (jnp.sqrt(sq) if per_tensor else None)
+
+
+class MultiTensorApply:
+    """API-parity shim for ``multi_tensor_applier(op, noop_flag, lists, *args)``.
+
+    The reference signature (apex/multi_tensor_apply/multi_tensor_apply.py:3)
+    takes a kernel, an int overflow buffer, and a list of tensor lists.
+    ``op`` must follow the convention
+    ``op(noop_flag, tensor_lists, *args) -> (out_lists, flag)`` — the
+    conventional-signature kernels live on the :data:`amp_C` namespace below
+    (e.g. ``multi_tensor_applier(amp_C.multi_tensor_scale, buf,
+    [srcs, outs], scale)``), matching the reference's ``amp_C`` module names
+    one-to-one. Being functional, results are *returned* rather than written
+    into the out-list tensors; the out list contributes only output dtypes.
+    """
+
+    available = True
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        # chunk_size is meaningless on TPU (no launch batching); kept for
+        # signature parity.
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag, tensor_lists, *args):
+        return op(noop_flag, tensor_lists, *args)
+
+
+multi_tensor_applier = MultiTensorApply()
+
+
+class _AmpC:
+    """Conventional-signature kernels named after the reference ``amp_C``
+    module (csrc/amp_C_frontend.cpp:193-226), for one-to-one porting of
+    reference call sites through :data:`multi_tensor_applier`."""
+
+    @staticmethod
+    def multi_tensor_scale(noop_flag, tensor_lists, scale):
+        # reference: [srcs, outs]; outs give the output dtypes.
+        srcs = tensor_lists[0]
+        outs = tensor_lists[1] if len(tensor_lists) > 1 else srcs
+        return multi_tensor_scale(
+            srcs, scale, noop_flag, out_dtypes=[t.dtype for t in outs]
+        )
+
+    @staticmethod
+    def multi_tensor_axpby(noop_flag, tensor_lists, a, b, arg_to_check=-1):
+        # reference: [xs, ys, outs]; arg_to_check kept for signature parity.
+        xs, ys = tensor_lists[0], tensor_lists[1]
+        outs = tensor_lists[2] if len(tensor_lists) > 2 else xs
+        out_lists, flag = multi_tensor_axpby(
+            xs, ys, a, b, out_dtypes=[t.dtype for t in outs]
+        )
+        if noop_flag is not None:
+            flag = jnp.maximum(flag, jnp.asarray(noop_flag, jnp.int32))
+        return out_lists, flag
+
+    @staticmethod
+    def multi_tensor_l2norm(noop_flag, tensor_lists, per_tensor=False):
+        return multi_tensor_l2norm(tensor_lists[0], per_tensor=per_tensor)
+
+
+amp_C = _AmpC()

@@ -1,0 +1,8 @@
+"""apex_tpu.ops — the fused op library (Pallas TPU kernels + XLA references).
+
+Reference equivalents live in csrc/ and apex/contrib/csrc/ (see SURVEY.md
+§2.2-2.3). Every op has a pure-jnp/lax implementation (always available,
+XLA-fused) and, where profitable, a Pallas TPU kernel behind the op registry.
+"""
+
+from apex_tpu.ops.pallas_adam import flat_adam_update  # noqa: F401

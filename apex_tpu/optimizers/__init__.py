@@ -1,0 +1,30 @@
+"""apex_tpu.optimizers — fused optimizers (reference: apex/optimizers/).
+
+All are optax-compatible ``GradientTransformation``s whose whole update fuses
+into the surrounding jitted train step; ``FusedAdam`` additionally offers a
+single-pass Pallas flat-buffer kernel (``use_pallas=True``).
+"""
+
+from apex_tpu.optimizers._common import (  # noqa: F401
+    GradientTransformation,
+    apply_updates,
+    global_norm,
+)
+from apex_tpu.optimizers.fused_adam import AdamState, FusedAdam, fused_adam  # noqa: F401
+from apex_tpu.optimizers.fused_adagrad import (  # noqa: F401
+    AdagradState,
+    FusedAdagrad,
+    fused_adagrad,
+)
+from apex_tpu.optimizers.fused_lamb import FusedLAMB, LambState, fused_lamb  # noqa: F401
+from apex_tpu.optimizers.fused_lars import FusedLARS, LARSState, fused_lars  # noqa: F401
+from apex_tpu.optimizers.fused_mixed_precision_lamb import (  # noqa: F401
+    FusedMixedPrecisionLamb,
+    fused_mixed_precision_lamb,
+)
+from apex_tpu.optimizers.fused_novograd import (  # noqa: F401
+    FusedNovoGrad,
+    NovoGradState,
+    fused_novograd,
+)
+from apex_tpu.optimizers.fused_sgd import FusedSGD, SGDState, fused_sgd  # noqa: F401

@@ -1,0 +1,94 @@
+"""Shared plumbing for the fused optimizers.
+
+The reference optimizers are torch.optim.Optimizer subclasses whose ``step``
+groups params by dtype and fires one ``multi_tensor_applier`` launch per
+group (e.g. apex/optimizers/fused_adam.py:127,264-303). Under jit the whole
+update is one fused XLA computation already, so each optimizer here is an
+optax-style ``GradientTransformation``:
+
+    tx = fused_adam(lr=1e-3)
+    state = tx.init(params)
+    updates, state = tx.update(grads, state, params)
+    params = apply_updates(params, updates)      # p + u
+
+"Capturable" mode (CUDA-graph-safe tensor lr/step, fused_adam.py capturable
+arg) is the default and only mode: hyperparameters may be Python floats
+(baked into the graph) or jax scalars (donated each step), and ``step`` lives
+in device memory.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+Scalar = Union[float, jax.Array]
+
+__all__ = [
+    "Scalar",
+    "GradientTransformation",
+    "apply_updates",
+    "tree_map_float",
+    "tree_zeros_like_f32",
+    "global_norm",
+    "ScheduleOrScalar",
+    "resolve_lr",
+]
+
+
+class GradientTransformation(NamedTuple):
+    """Minimal optax-compatible pair (works anywhere optax transforms do)."""
+
+    init: Callable[[Any], Any]
+    update: Callable[..., Any]
+
+
+def apply_updates(params, updates):
+    return jax.tree_util.tree_map(
+        lambda p, u: (p + u.astype(p.dtype)) if u is not None else p,
+        params,
+        updates,
+    )
+
+
+def _is_float(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def tree_map_float(fn, *trees):
+    """Map over float leaves; pass non-float leaves through unchanged."""
+    return jax.tree_util.tree_map(
+        lambda x, *rest: fn(x, *rest) if _is_float(x) else x, *trees
+    )
+
+
+def tree_zeros_like_f32(params):
+    """fp32 optimizer-state slots regardless of param dtype (the reference
+    keeps exp_avg in param dtype, but with master weights those are fp32;
+    fp32 slots are strictly more accurate and free on TPU)."""
+    return tree_map_float(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params
+    )
+
+
+def global_norm(tree) -> jax.Array:
+    leaves = [
+        x for x in jax.tree_util.tree_leaves(tree) if _is_float(x)
+    ]
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    )
+
+
+ScheduleOrScalar = Union[float, jax.Array, Callable[[jax.Array], jax.Array]]
+
+
+def resolve_lr(lr: ScheduleOrScalar, step: jax.Array) -> jax.Array:
+    """Accept a constant or an optax-style schedule ``lr(step)``."""
+    if callable(lr):
+        return jnp.asarray(lr(step), jnp.float32)
+    return jnp.asarray(lr, jnp.float32)

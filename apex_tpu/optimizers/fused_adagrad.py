@@ -1,0 +1,77 @@
+"""FusedAdagrad (reference: apex/optimizers/fused_adagrad.py,
+csrc/multi_tensor_adagrad.cu ``AdagradFunctor``):
+
+    h += g^2
+    p -= lr * g / (sqrt(h) + eps)          (+ decoupled ``adagrad_w_mode``
+    weight decay: p -= lr * wd * p)
+
+The reference kernel applies L2-style weight decay *into the gradient*
+(mode 0) or decoupled (mode 1, default 0).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import (
+    GradientTransformation,
+    ScheduleOrScalar,
+    resolve_lr,
+    tree_map_float,
+    tree_zeros_like_f32,
+)
+
+__all__ = ["FusedAdagrad", "fused_adagrad", "AdagradState"]
+
+
+class AdagradState(NamedTuple):
+    step: jax.Array
+    sum_sq: Any
+
+
+def fused_adagrad(
+    lr: ScheduleOrScalar = 1e-2,
+    eps: float = 1e-10,
+    weight_decay: float = 0.0,
+    adagrad_w_mode: bool = False,
+) -> GradientTransformation:
+    def init(params) -> AdagradState:
+        return AdagradState(
+            step=jnp.zeros((), jnp.int32),
+            sum_sq=tree_zeros_like_f32(params),
+        )
+
+    def update(grads, state: AdagradState, params=None):
+        if params is None:
+            raise ValueError("fused_adagrad requires params")
+        step = state.step + 1
+        lr_t = resolve_lr(lr, step)
+
+        def h_leaf(g, p, h):
+            g32 = g.astype(jnp.float32)
+            if not adagrad_w_mode and weight_decay != 0.0:
+                g32 = g32 + weight_decay * p.astype(jnp.float32)
+            return h + jnp.square(g32)
+
+        h_tree = tree_map_float(h_leaf, grads, params, state.sum_sq)
+
+        def upd_leaf(g, p, h):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if not adagrad_w_mode and weight_decay != 0.0:
+                g32 = g32 + weight_decay * p32
+            u = -lr_t * g32 / (jnp.sqrt(h) + eps)
+            if adagrad_w_mode and weight_decay != 0.0:
+                u = u - lr_t * weight_decay * p32
+            return u
+
+        updates = tree_map_float(upd_leaf, grads, params, h_tree)
+        return updates, AdagradState(step, h_tree)
+
+    return GradientTransformation(init, update)
+
+
+FusedAdagrad = fused_adagrad

@@ -1,0 +1,107 @@
+"""FusedLARS — layer-wise adaptive rate scaling on top of momentum SGD.
+
+Reference: apex/optimizers/fused_lars.py + csrc/multi_tensor_lars.cu:79-140:
+
+    trust = tc * ||p|| / (||g|| + wd*||p|| + eps)    (1 if either norm is 0)
+    scaled_lr = lr * trust                           (plain lr for skipped
+                                                      groups, e.g. BN/bias)
+    d    = g + wd * p
+    mom  = momentum * mom - scaled_lr * d
+    p   += momentum * mom - scaled_lr * d            if nesterov
+    p   += mom                                       otherwise
+
+The reference marks whole param groups ``is_skipped``; here a
+``skip_predicate(path) -> bool`` selects params that bypass the trust ratio
+(conventionally biases and norm params).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import (
+    GradientTransformation,
+    ScheduleOrScalar,
+    resolve_lr,
+    tree_zeros_like_f32,
+)
+
+__all__ = ["FusedLARS", "fused_lars", "LARSState"]
+
+
+class LARSState(NamedTuple):
+    step: jax.Array
+    momentum_buffer: Any
+
+
+def fused_lars(
+    lr: ScheduleOrScalar = 1e-2,
+    momentum: float = 0.9,
+    dampening: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+    trust_coefficient: float = 0.001,
+    eps: float = 0.0,
+    skip_predicate: Optional[Callable[[tuple], bool]] = None,
+) -> GradientTransformation:
+    def init(params) -> LARSState:
+        return LARSState(
+            step=jnp.zeros((), jnp.int32),
+            momentum_buffer=tree_zeros_like_f32(params),
+        )
+
+    def update(grads, state: LARSState, params=None):
+        if params is None:
+            raise ValueError("fused_lars requires params")
+        step = state.step + 1
+        lr_t = resolve_lr(lr, step)
+
+        def scaled_lr_and_d(path, g, p):
+            g32, p32 = g.astype(jnp.float32), p.astype(jnp.float32)
+            if skip_predicate is not None and skip_predicate(path):
+                scaled_lr = lr_t
+            else:
+                p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+                g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+                trust = jnp.where(
+                    (g_norm > 0.0) & (p_norm > 0.0),
+                    trust_coefficient * p_norm
+                    / (g_norm + p_norm * weight_decay + eps),
+                    1.0,
+                )
+                scaled_lr = lr_t * trust
+            return scaled_lr, g32 + weight_decay * p32
+
+        def _float(x):
+            return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+        def mom_leaf(path, g, p, mom):
+            if not _float(g):
+                return mom
+            scaled_lr, d = scaled_lr_and_d(path, g, p)
+            return momentum * mom - scaled_lr * d
+
+        new_mom = jax.tree_util.tree_map_with_path(
+            mom_leaf, grads, params, state.momentum_buffer
+        )
+
+        def upd_leaf(path, g, p, m_new):
+            if not _float(g):
+                return g
+            scaled_lr, d = scaled_lr_and_d(path, g, p)
+            if nesterov:
+                return momentum * m_new - scaled_lr * d
+            return m_new
+
+        updates = jax.tree_util.tree_map_with_path(
+            upd_leaf, grads, params, new_mom
+        )
+        return updates, LARSState(step, new_mom)
+
+    return GradientTransformation(init, update)
+
+
+FusedLARS = fused_lars

@@ -1,0 +1,22 @@
+"""FusedMixedPrecisionLamb.
+
+Reference: apex/optimizers/fused_mixed_precision_lamb.py — LAMB operating on
+low-precision model weights with fp32 master copies held inside the
+optimizer, fully capturable (tensor lr/step).
+
+In apex_tpu the master-weight machinery is the AMP layer's job
+(``amp.make_train_step`` keeps fp32 masters and re-casts model params each
+step), so the optimizer itself is exactly :func:`fused_lamb` applied to the
+fp32 masters; this module exists for name parity and wires the recommended
+pairing::
+
+    tx = FusedMixedPrecisionLamb(lr=1e-3)
+    init, step = amp.make_train_step(loss_fn, tx, "O5")   # bf16 + masters
+"""
+
+from apex_tpu.optimizers.fused_lamb import fused_lamb
+
+__all__ = ["FusedMixedPrecisionLamb", "fused_mixed_precision_lamb"]
+
+fused_mixed_precision_lamb = fused_lamb
+FusedMixedPrecisionLamb = fused_lamb

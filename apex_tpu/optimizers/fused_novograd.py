@@ -1,0 +1,122 @@
+"""FusedNovoGrad — NovoGrad with per-tensor second-moment norms.
+
+Reference: apex/optimizers/fused_novograd.py + csrc/multi_tensor_novograd.cu.
+The second moment is a *per-tensor scalar*: an EMA of the grad norm (stored
+as a norm, not its square — fused_novograd.py:159 comment), blended as
+``v = beta2*v + (1-beta2)*||g||`` (multi_tensor_novograd.cu:164) with bias
+correction ``sqrt(1-beta2^t)`` (:151). Knobs preserved: ``reg_inside_moment``
+(kernel MOMENT_MODE_0 vs 1, :98-113), ``grad_averaging`` (beta3),
+``norm_type`` (2 or 0=inf), ``init_zero`` (start EMA at 0 vs first norm so
+the first blend is a no-op, fused_novograd.py:162-176).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import (
+    GradientTransformation,
+    ScheduleOrScalar,
+    resolve_lr,
+    tree_map_float,
+    tree_zeros_like_f32,
+)
+
+__all__ = ["FusedNovoGrad", "fused_novograd", "NovoGradState"]
+
+
+class NovoGradState(NamedTuple):
+    step: jax.Array
+    exp_avg: Any
+    exp_avg_norm: Any   # per-tensor scalar norms
+
+
+def fused_novograd(
+    lr: ScheduleOrScalar = 1e-3,
+    betas: Tuple[float, float] = (0.95, 0.98),
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    bias_correction: bool = True,
+    reg_inside_moment: bool = False,
+    grad_averaging: bool = True,
+    norm_type: int = 2,
+    init_zero: bool = False,
+) -> GradientTransformation:
+    if norm_type not in (0, 2):
+        raise RuntimeError("FusedNovoGrad only supports l2/inf norm now.")
+    beta1, beta2 = betas
+    beta3 = (1.0 - beta1) if grad_averaging else 1.0
+
+    def _norm(g32):
+        if norm_type == 0:
+            return jnp.max(jnp.abs(g32))
+        return jnp.sqrt(jnp.sum(jnp.square(g32)))
+
+    def init(params) -> NovoGradState:
+        return NovoGradState(
+            step=jnp.zeros((), jnp.int32),
+            exp_avg=tree_zeros_like_f32(params),
+            exp_avg_norm=tree_map_float(
+                lambda p: jnp.zeros((), jnp.float32), params
+            ),
+        )
+
+    def update(grads, state: NovoGradState, params=None):
+        if params is None:
+            raise ValueError("fused_novograd requires params")
+        step = state.step + 1
+        lr_t = resolve_lr(lr, step)
+        first = state.step == 0
+        if bias_correction:
+            bc1 = 1.0 - beta1 ** step.astype(jnp.float32)
+            bc2 = jnp.sqrt(1.0 - beta2 ** step.astype(jnp.float32))
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+
+        def v_leaf(g, v):
+            n = _norm(g.astype(jnp.float32))
+            if init_zero:
+                v_prev = v
+            else:
+                # init with first-step norm so the first blend is a no-op
+                v_prev = jnp.where(first, n, v)
+            if norm_type == 2:
+                # Reference blends L2 norms in quadrature
+                # (multi_tensor_novograd.cu multi_tensor_norm_out_cuda:
+                # gn = sqrt(beta2*gn^2 + (1-beta2)*n^2)).
+                return jnp.sqrt(
+                    beta2 * jnp.square(v_prev) + (1.0 - beta2) * jnp.square(n)
+                )
+            return beta2 * v_prev + (1.0 - beta2) * n
+
+        v_tree = tree_map_float(v_leaf, grads, state.exp_avg_norm)
+
+        def m_leaf(g, p, m, v):
+            g32, p32 = g.astype(jnp.float32), p.astype(jnp.float32)
+            if reg_inside_moment:  # MOMENT_MODE_0
+                denom = v / bc2 + eps
+                d = g32 / denom + weight_decay * p32
+                return beta1 * m + beta3 * d
+            return beta1 * m + beta3 * g32
+
+        m_tree = tree_map_float(
+            m_leaf, grads, params, state.exp_avg, v_tree
+        )
+
+        def upd_leaf(m, v, p):
+            if reg_inside_moment:
+                return -lr_t * (m / bc1)
+            denom = v / bc2 + eps
+            u = (m / bc1) / denom + weight_decay * p.astype(jnp.float32)
+            return -lr_t * u
+
+        updates = tree_map_float(upd_leaf, m_tree, v_tree, params)
+        return updates, NovoGradState(step, m_tree, v_tree)
+
+    return GradientTransformation(init, update)
+
+
+FusedNovoGrad = fused_novograd

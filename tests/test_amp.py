@@ -58,6 +58,12 @@ class TestPolicy:
         assert cast["dense"]["kernel"].dtype == jnp.float16
         assert cast["layer_norm_0"]["scale"].dtype == jnp.float32
 
+    def test_reference_style_override_kwargs(self):
+        st = amp.initialize("O2", keep_batchnorm_fp32=False)
+        assert not st.policy.keep_norm_fp32
+        with pytest.raises(ValueError):
+            amp.initialize("O2", not_an_option=True)
+
     def test_num_losses_returns_list(self):
         states = amp.initialize("O1", num_losses=3)
         assert isinstance(states, list) and len(states) == 3

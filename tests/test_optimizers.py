@@ -1,0 +1,332 @@
+"""Fused optimizer parity tests.
+
+Reference analog: tests/L0/run_optimizers/test_fused_optimizer.py — FusedAdam
+vs torch.optim.Adam step-for-step. Here torch (CPU) is the oracle for
+Adam/AdamW/SGD/Adagrad; LAMB/NovoGrad/LARS check against hand-rolled numpy
+of the documented kernel formulas.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu import optimizers as opt
+
+
+def _tree_from(np_tree):
+    return {k: jnp.asarray(v) for k, v in np_tree.items()}
+
+
+def _rand_params_grads(seed=0, shapes=((4, 8), (8,), (3, 5, 2))):
+    rng = np.random.RandomState(seed)
+    params = {f"p{i}": rng.randn(*s).astype(np.float32)
+              for i, s in enumerate(shapes)}
+    grads = [
+        {f"p{i}": rng.randn(*s).astype(np.float32)
+         for i, s in enumerate(shapes)}
+        for _ in range(5)
+    ]
+    return params, grads
+
+
+def _run_jax(tx, params_np, grads_np):
+    params = _tree_from(params_np)
+    state = tx.init(params)
+    step = jax.jit(lambda g, s, p: tx.update(g, s, p))
+    for g_np in grads_np:
+        updates, state = step(_tree_from(g_np), state, params)
+        params = opt.apply_updates(params, updates)
+    return {k: np.asarray(v) for k, v in params.items()}
+
+
+def _run_torch(optim_cls, params_np, grads_np, **kwargs):
+    tparams = {k: torch.nn.Parameter(torch.tensor(v))
+               for k, v in params_np.items()}
+    optim = optim_cls(list(tparams.values()), **kwargs)
+    for g_np in grads_np:
+        for k, p in tparams.items():
+            p.grad = torch.tensor(g_np[k])
+        optim.step()
+    return {k: p.detach().numpy() for k, p in tparams.items()}
+
+
+class TestFusedAdam:
+    @pytest.mark.parametrize("wd", [0.0, 0.1])
+    def test_matches_torch_adamw(self, wd):
+        params, grads = _rand_params_grads()
+        ours = _run_jax(
+            opt.fused_adam(lr=1e-2, weight_decay=wd, adam_w_mode=True),
+            params, grads,
+        )
+        ref = _run_torch(torch.optim.AdamW, params, grads,
+                         lr=1e-2, weight_decay=wd)
+        for k in params:
+            np.testing.assert_allclose(ours[k], ref[k], atol=1e-6, rtol=1e-5)
+
+    def test_matches_torch_adam_l2_mode(self):
+        params, grads = _rand_params_grads(1)
+        ours = _run_jax(
+            opt.fused_adam(lr=1e-2, weight_decay=0.1, adam_w_mode=False),
+            params, grads,
+        )
+        ref = _run_torch(torch.optim.Adam, params, grads,
+                         lr=1e-2, weight_decay=0.1)
+        for k in params:
+            np.testing.assert_allclose(ours[k], ref[k], atol=1e-6, rtol=1e-5)
+
+    def test_no_bias_correction(self):
+        params, grads = _rand_params_grads(2, shapes=((4,),))
+        ours = _run_jax(opt.fused_adam(lr=1e-2, bias_correction=False),
+                        params, grads[:1])
+        # hand formula, one step
+        g = grads[0]["p0"]
+        m = 0.1 * g
+        v = 0.001 * g * g
+        expect = params["p0"] - 1e-2 * m / (np.sqrt(v) + 1e-8)
+        np.testing.assert_allclose(ours["p0"], expect, atol=1e-6)
+
+    def test_amsgrad_rejected(self):
+        with pytest.raises(RuntimeError):
+            opt.fused_adam(amsgrad=True)
+
+    def test_lr_schedule(self):
+        params, grads = _rand_params_grads(3, shapes=((4,),))
+        sched = lambda step: 1e-2 / step.astype(jnp.float32)  # noqa: E731
+        ours = _run_jax(opt.fused_adam(lr=sched), params, grads)
+        assert np.isfinite(ours["p0"]).all()
+
+    def test_pallas_path_matches_xla_path(self):
+        params, grads = _rand_params_grads(4)
+        base = _run_jax(
+            opt.fused_adam(lr=1e-2, weight_decay=0.05), params, grads
+        )
+        pallas = _run_jax(
+            opt.fused_adam(lr=1e-2, weight_decay=0.05, use_pallas=True),
+            params, grads,
+        )
+        for k in params:
+            np.testing.assert_allclose(pallas[k], base[k], atol=1e-6,
+                                       rtol=1e-6)
+
+
+class TestFusedSGD:
+    @pytest.mark.parametrize(
+        "kwargs",
+        [
+            dict(momentum=0.0, weight_decay=0.0),
+            dict(momentum=0.9, weight_decay=0.0),
+            dict(momentum=0.9, weight_decay=0.01),
+            dict(momentum=0.9, dampening=0.1, weight_decay=0.01),
+            dict(momentum=0.9, nesterov=True),
+        ],
+    )
+    def test_matches_torch_sgd(self, kwargs):
+        params, grads = _rand_params_grads(5)
+        ours = _run_jax(opt.fused_sgd(lr=0.05, **kwargs), params, grads)
+        ref = _run_torch(torch.optim.SGD, params, grads, lr=0.05, **kwargs)
+        for k in params:
+            np.testing.assert_allclose(ours[k], ref[k], atol=1e-6, rtol=1e-5)
+
+    def test_nesterov_validation(self):
+        with pytest.raises(ValueError):
+            opt.fused_sgd(momentum=0.0, nesterov=True)
+
+
+class TestFusedAdagrad:
+    @pytest.mark.parametrize("wd", [0.0, 0.05])
+    def test_matches_torch_adagrad(self, wd):
+        params, grads = _rand_params_grads(6)
+        ours = _run_jax(opt.fused_adagrad(lr=0.05, weight_decay=wd),
+                        params, grads)
+        ref = _run_torch(torch.optim.Adagrad, params, grads, lr=0.05,
+                         weight_decay=wd, eps=1e-10)
+        for k in params:
+            np.testing.assert_allclose(ours[k], ref[k], atol=1e-6, rtol=1e-5)
+
+
+def _numpy_lamb(params, grads, lr, b1, b2, eps, wd, max_gn, nvlamb=False,
+                steps=None):
+    m = {k: np.zeros_like(v) for k, v in params.items()}
+    v = {k: np.zeros_like(p) for k, p in params.items()}
+    p = {k: x.copy() for k, x in params.items()}
+    t = 0
+    for g in grads:
+        t += 1
+        gnorm = np.sqrt(sum(np.sum(x ** 2) for x in g.values()))
+        clip = max(gnorm / max_gn, 1.0) if max_gn else 1.0
+        bc1 = 1 - b1 ** t
+        bc2 = 1 - b2 ** t
+        for k in p:
+            gg = g[k] / clip
+            m[k] = b1 * m[k] + (1 - b1) * gg
+            v[k] = b2 * v[k] + (1 - b2) * gg * gg
+            u = (m[k] / bc1) / (np.sqrt(v[k] / bc2) + eps)
+            if wd:
+                u = u + wd * p[k]
+            wn = np.sqrt(np.sum(p[k] ** 2))
+            un = np.sqrt(np.sum(u ** 2))
+            if wd == 0.0 and not nvlamb:
+                ratio = 1.0
+            else:
+                ratio = wn / un if (wn > 0 and un > 0) else 1.0
+            p[k] = p[k] - lr * ratio * u
+    return p
+
+
+class TestFusedLAMB:
+    @pytest.mark.parametrize("wd,nvlamb", [(0.01, False), (0.0, False),
+                                           (0.0, True)])
+    def test_matches_numpy_reference(self, wd, nvlamb):
+        params, grads = _rand_params_grads(7)
+        ours = _run_jax(
+            opt.fused_lamb(lr=1e-2, weight_decay=wd, max_grad_norm=1.0,
+                           use_nvlamb=nvlamb),
+            params, grads,
+        )
+        ref = _numpy_lamb(params, grads, 1e-2, 0.9, 0.999, 1e-6, wd, 1.0,
+                          nvlamb)
+        for k in params:
+            np.testing.assert_allclose(ours[k], ref[k], atol=1e-5, rtol=1e-4)
+
+
+class TestFusedNovoGrad:
+    def test_one_step_hand_formula(self):
+        g0 = np.array([3.0, 4.0], np.float32)   # ||g|| = 5
+        params = {"p0": np.array([1.0, 2.0], np.float32)}
+        ours = _run_jax(
+            opt.fused_novograd(lr=0.1, betas=(0.95, 0.98), eps=1e-8,
+                               weight_decay=0.0),
+            params, [{"p0": g0}],
+        )
+        # v init = ||g|| = 5 (init-with-first-norm), bc2 = sqrt(1-0.98)
+        v = 5.0
+        bc1, bc2 = 1 - 0.95, np.sqrt(1 - 0.98)
+        m = 0.05 * g0
+        u = (m / bc1) / (v / bc2 + 1e-8)
+        expect = params["p0"] - 0.1 * u
+        np.testing.assert_allclose(ours["p0"], expect, atol=1e-6)
+
+    def test_l2_quadrature_blend_two_steps(self):
+        # reference multi_tensor_norm_out_cuda: gn = sqrt(b2*gn^2+(1-b2)*n^2)
+        g1 = np.array([3.0, 4.0], np.float32)            # ||g1|| = 5
+        g2 = np.array([6.0, 8.0], np.float32)            # ||g2|| = 10
+        params = {"p0": np.array([1.0, 2.0], np.float32)}
+        b1, b2, lr, eps = 0.95, 0.98, 0.1, 1e-8
+        ours = _run_jax(
+            opt.fused_novograd(lr=lr, betas=(b1, b2), eps=eps),
+            params, [{"p0": g1}, {"p0": g2}],
+        )
+        p = params["p0"].copy()
+        v = 5.0
+        m = np.zeros(2, np.float32)
+        for t, g in enumerate([g1, g2], start=1):
+            n = np.sqrt(np.sum(g ** 2))
+            v = np.sqrt(b2 * v ** 2 + (1 - b2) * n ** 2)
+            bc1, bc2 = 1 - b1 ** t, np.sqrt(1 - b2 ** t)
+            m = b1 * m + (1 - b1) * g
+            p = p - lr * ((m / bc1) / (v / bc2 + eps))
+        np.testing.assert_allclose(ours["p0"], p, atol=1e-6)
+
+    def test_inf_norm_and_init_zero(self):
+        params, grads = _rand_params_grads(8, shapes=((6,),))
+        ours = _run_jax(
+            opt.fused_novograd(lr=0.01, norm_type=0, init_zero=True),
+            params, grads,
+        )
+        assert np.isfinite(ours["p0"]).all()
+
+    def test_bad_norm_type(self):
+        with pytest.raises(RuntimeError):
+            opt.fused_novograd(norm_type=1)
+
+
+class TestFusedLARS:
+    def test_one_step_hand_formula(self):
+        p0 = np.array([3.0, 4.0], np.float32)        # ||p|| = 5
+        g0 = np.array([0.6, 0.8], np.float32)        # ||g|| = 1
+        params = {"p0": p0}
+        tc, wd, lr, mom = 0.001, 0.01, 0.1, 0.9
+        ours = _run_jax(
+            opt.fused_lars(lr=lr, momentum=mom, weight_decay=wd,
+                           trust_coefficient=tc),
+            params, [{"p0": g0}],
+        )
+        trust = tc * 5.0 / (1.0 + 5.0 * wd + 0.0)
+        slr = lr * trust
+        d = g0 + wd * p0
+        m = -slr * d
+        expect = p0 + m
+        np.testing.assert_allclose(ours["p0"], expect, atol=1e-7)
+
+    def test_skip_predicate_uses_plain_lr(self):
+        p0 = np.array([3.0, 4.0], np.float32)
+        g0 = np.array([0.6, 0.8], np.float32)
+        ours = _run_jax(
+            opt.fused_lars(lr=0.1, momentum=0.0, trust_coefficient=0.001,
+                           skip_predicate=lambda path: True),
+            {"p0": p0}, [{"p0": g0}],
+        )
+        np.testing.assert_allclose(ours["p0"], p0 - 0.1 * g0, atol=1e-7)
+
+
+class TestMultiTensor:
+    def test_scale_and_flag(self):
+        from apex_tpu.multi_tensor import multi_tensor_scale
+
+        outs, flag = multi_tensor_scale(
+            [jnp.asarray([2.0, 4.0]), jnp.asarray([6.0])], 0.5
+        )
+        np.testing.assert_allclose(outs[0], [1.0, 2.0])
+        np.testing.assert_allclose(outs[1], [3.0])
+        assert int(flag) == 0
+        _, flag = multi_tensor_scale([jnp.asarray([jnp.inf])], 1.0)
+        assert int(flag) == 1
+
+    def test_axpby(self):
+        from apex_tpu.multi_tensor import multi_tensor_axpby
+
+        outs, flag = multi_tensor_axpby(
+            [jnp.asarray([1.0, 2.0])], [jnp.asarray([10.0, 20.0])], 2.0, 0.5
+        )
+        np.testing.assert_allclose(outs[0], [7.0, 14.0])
+        assert int(flag) == 0
+
+    def test_l2norm(self):
+        from apex_tpu.multi_tensor import multi_tensor_l2norm
+
+        total, per = multi_tensor_l2norm(
+            [jnp.asarray([3.0]), jnp.asarray([4.0])], per_tensor=True
+        )
+        np.testing.assert_allclose(float(total), 5.0)
+        np.testing.assert_allclose(per, [3.0, 4.0])
+
+    def test_applier_reference_pattern(self):
+        # The exact calling pattern of apex/amp/scaler.py:114-126.
+        from apex_tpu.multi_tensor import amp_C, multi_tensor_applier
+
+        model_grads = [jnp.asarray([2.0, 4.0], jnp.float16)]
+        master_grads = [jnp.asarray([0.0, 0.0], jnp.float32)]
+        outs, flag = multi_tensor_applier(
+            amp_C.multi_tensor_scale,
+            jnp.zeros((), jnp.int32),
+            [model_grads, master_grads],
+            0.5,
+        )
+        assert outs[0].dtype == jnp.float32
+        np.testing.assert_allclose(outs[0], [1.0, 2.0])
+        assert int(flag) == 0
+
+    def test_applier_axpby_pattern(self):
+        from apex_tpu.multi_tensor import amp_C, multi_tensor_applier
+
+        xs = [jnp.asarray([1.0, 2.0])]
+        ys = [jnp.asarray([10.0, 20.0])]
+        outs, flag = multi_tensor_applier(
+            amp_C.multi_tensor_axpby,
+            jnp.zeros((), jnp.int32),
+            [xs, ys, xs],
+            2.0, 0.5, -1,
+        )
+        np.testing.assert_allclose(outs[0], [7.0, 14.0])
