@@ -1,0 +1,75 @@
+"""FusedDense / FusedDenseGeluDense modules
+(reference apex/fused_dense/fused_dense.py:8,102)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.dense import (  # noqa: F401  (re-exported API surface)
+    fused_dense_function,
+    fused_dense_gelu_dense_function,
+)
+
+__all__ = [
+    "FusedDense",
+    "FusedDenseGeluDense",
+    "fused_dense_function",
+    "fused_dense_gelu_dense_function",
+]
+
+
+class FusedDense(nn.Module):
+    """Linear + bias in one fused op (reference FusedDense)."""
+
+    in_features: int
+    out_features: int
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (self.in_features, self.out_features),
+            jnp.float32,
+        )
+        b = (
+            self.param("bias", nn.initializers.zeros,
+                       (self.out_features,), jnp.float32)
+            if self.bias
+            else None
+        )
+        return fused_dense_function(
+            x, kernel.astype(x.dtype), None if b is None else b
+        )
+
+
+class FusedDenseGeluDense(nn.Module):
+    """Linear+bias+GELU+Linear+bias (reference FusedDenseGeluDense)."""
+
+    in_features: int
+    intermediate_features: int
+    out_features: int
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        k1 = self.param(
+            "kernel1", nn.initializers.lecun_normal(),
+            (self.in_features, self.intermediate_features), jnp.float32,
+        )
+        k2 = self.param(
+            "kernel2", nn.initializers.lecun_normal(),
+            (self.intermediate_features, self.out_features), jnp.float32,
+        )
+        b1 = b2 = None
+        if self.bias:
+            b1 = self.param("bias1", nn.initializers.zeros,
+                            (self.intermediate_features,), jnp.float32)
+            b2 = self.param("bias2", nn.initializers.zeros,
+                            (self.out_features,), jnp.float32)
+        return fused_dense_gelu_dense_function(
+            x, k1.astype(x.dtype), b1, k2.astype(x.dtype), b2
+        )

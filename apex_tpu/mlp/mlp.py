@@ -1,0 +1,76 @@
+"""Whole-MLP fused module.
+
+Reference: apex/mlp/mlp.py (``MLP`` :11, ``mlp_function`` :33) backed by
+csrc/mlp_cuda.cu — a C++ loop over layers calling GEMM + bias/activation
+epilogues, so the whole MLP is two native calls. Under jit the whole Python
+loop below is one XLA computation with every epilogue fused, which is the
+same end state without the C++.
+
+Activation choices mirror the reference: 'none', 'relu', 'sigmoid'
+(mlp.py activation arg).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.dense import fused_dense_function
+
+__all__ = ["MLP", "mlp_function"]
+
+_ACTS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def mlp_function(x, weights, biases, activation="relu"):
+    """Functional MLP: weights[i] is [in_i, out_i]; biases may be None.
+
+    The final layer gets no activation (matches mlp_cuda fwd loop,
+    csrc/mlp_cuda.cu:63-110).
+    """
+    if activation not in _ACTS:
+        raise ValueError(f"activation must be one of {sorted(_ACTS)}")
+    act = _ACTS[activation]
+    h = x
+    n = len(weights)
+    for i, w in enumerate(weights):
+        b = biases[i] if biases is not None else None
+        h = fused_dense_function(h, w, b)
+        if i < n - 1:
+            h = act(h)
+    return h
+
+
+class MLP(nn.Module):
+    """Drop-in for reference ``apex.mlp.MLP(mlp_sizes, bias, activation)``."""
+
+    mlp_sizes: Sequence[int]   # [in, hidden..., out]
+    bias: bool = True
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        sizes = list(self.mlp_sizes)
+        if len(sizes) < 2:
+            raise ValueError("mlp_sizes needs at least [in, out]")
+        weights, biases = [], []
+        for i, (d_in, d_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+            weights.append(
+                self.param(f"kernel_{i}", nn.initializers.lecun_normal(),
+                           (d_in, d_out), jnp.float32).astype(x.dtype)
+            )
+            biases.append(
+                self.param(f"bias_{i}", nn.initializers.zeros, (d_out,),
+                           jnp.float32)
+                if self.bias else None
+            )
+        return mlp_function(
+            x, weights, biases if self.bias else None, self.activation
+        )

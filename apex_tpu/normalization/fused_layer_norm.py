@@ -1,0 +1,94 @@
+"""Module wrappers for the fused norms.
+
+Reference: apex/normalization/fused_layer_norm.py —
+``FusedLayerNorm``/``FusedRMSNorm`` (:195, :347) and the ``Mixed*`` variants
+(:553+) where params stay fp32 while activations are low-precision (the
+Megatron contract). As flax.linen modules the "mixed" behavior is the
+default — params are created fp32 and the op computes in fp32 — so the
+``Mixed*`` names are aliases kept for API parity.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.layer_norm import fused_layer_norm, fused_rms_norm
+
+__all__ = [
+    "FusedLayerNorm",
+    "FusedRMSNorm",
+    "MixedFusedLayerNorm",
+    "MixedFusedRMSNorm",
+]
+
+
+def _last_dim(shape: Union[int, Sequence[int]]) -> int:
+    if isinstance(shape, int):
+        return shape
+    if len(shape) != 1:
+        raise NotImplementedError(
+            "apex_tpu norms normalize over the last dimension; pass "
+            "normalized_shape as an int (multi-dim normalized_shape from the "
+            "reference maps to flattening those dims first)."
+        )
+    return int(shape[0])
+
+
+class FusedLayerNorm(nn.Module):
+    """Drop-in for reference ``apex.normalization.FusedLayerNorm``."""
+
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        hidden = _last_dim(self.normalized_shape)
+        if x.shape[-1] != hidden:
+            raise ValueError(
+                f"input last dim {x.shape[-1]} != normalized_shape {hidden}"
+            )
+        if self.elementwise_affine:
+            weight = self.param("scale", nn.initializers.ones, (hidden,),
+                                jnp.float32)
+            bias = self.param("bias", nn.initializers.zeros, (hidden,),
+                              jnp.float32)
+        else:
+            weight = bias = None
+        return fused_layer_norm(
+            x, weight, bias, self.eps, self.memory_efficient
+        )
+
+
+class FusedRMSNorm(nn.Module):
+    """Drop-in for reference ``apex.normalization.FusedRMSNorm``."""
+
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        hidden = _last_dim(self.normalized_shape)
+        if x.shape[-1] != hidden:
+            raise ValueError(
+                f"input last dim {x.shape[-1]} != normalized_shape {hidden}"
+            )
+        weight = (
+            self.param("scale", nn.initializers.ones, (hidden,), jnp.float32)
+            if self.elementwise_affine
+            else None
+        )
+        return fused_rms_norm(x, weight, self.eps, self.memory_efficient)
+
+
+# Params are already kept fp32 regardless of activation dtype, which is
+# exactly what the reference's Mixed* variants add.
+MixedFusedLayerNorm = FusedLayerNorm
+MixedFusedRMSNorm = FusedRMSNorm

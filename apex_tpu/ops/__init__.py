@@ -5,4 +5,26 @@ Reference equivalents live in csrc/ and apex/contrib/csrc/ (see SURVEY.md
 XLA-fused) and, where profitable, a Pallas TPU kernel behind the op registry.
 """
 
+from apex_tpu.ops.dense import (  # noqa: F401
+    fused_dense_function,
+    fused_dense_gelu_dense_function,
+)
+from apex_tpu.ops.layer_norm import (  # noqa: F401
+    fused_layer_norm,
+    fused_rms_norm,
+)
 from apex_tpu.ops.pallas_adam import flat_adam_update  # noqa: F401
+from apex_tpu.ops.rope import (  # noqa: F401
+    fused_apply_rotary_pos_emb,
+    fused_apply_rotary_pos_emb_2d,
+    fused_apply_rotary_pos_emb_cached,
+    fused_apply_rotary_pos_emb_thd,
+)
+from apex_tpu.ops.softmax import (  # noqa: F401
+    generic_scaled_masked_softmax,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.ops.swiglu import fused_bias_swiglu  # noqa: F401
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss  # noqa: F401

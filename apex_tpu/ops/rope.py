@@ -1,0 +1,158 @@
+"""Fused rotary positional embeddings — 4 layouts.
+
+Reference: csrc/megatron/fused_rotary_positional_embedding.{cpp,h} (8 entry
+points) wrapped by apex/transformer/functional/fused_rope.py:166,300,424,565.
+
+Rotation is NeoX/Megatron "rotate_half" style with partial rotation: for
+rotary dim ``d2 = freqs.shape[-1] <= d``,
+
+    out[..., :d2] = t[..., :d2]·cos(freqs) + rotate_half(t[..., :d2])·sin(freqs)
+    out[..., d2:] = t[..., d2:]                       (passthrough)
+    rotate_half(x) = concat(-x[..., d2/2:], x[..., :d2/2])
+
+(fused_rotary_positional_embedding.h:35-48). The rotation is orthogonal, so
+each backward is the forward with negated angle — expressed here as a
+custom VJP. Pure-XLA: the op is elementwise×2 + a lane roll, which XLA fuses
+into surrounding matmuls; a Pallas kernel would only add launch overhead.
+
+``transpose_output_memory`` arguments are accepted for signature parity and
+ignored (XLA owns memory layout).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "fused_apply_rotary_pos_emb",
+    "fused_apply_rotary_pos_emb_cached",
+    "fused_apply_rotary_pos_emb_thd",
+    "fused_apply_rotary_pos_emb_2d",
+]
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def _rotate_half_t(x):
+    """Adjoint of _rotate_half (its transpose = its inverse = -itself)."""
+    half = x.shape[-1] // 2
+    return jnp.concatenate([x[..., half:], -x[..., :half]], axis=-1)
+
+
+def _apply(t, cos, sin):
+    """Rotate the first d2 features of t; cos/sin broadcast against t."""
+    d2 = cos.shape[-1]
+    t32 = t[..., :d2].astype(jnp.float32)
+    out = t32 * cos + _rotate_half(t32) * sin
+    out = out.astype(t.dtype)
+    if d2 < t.shape[-1]:
+        out = jnp.concatenate([out, t[..., d2:]], axis=-1)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def _rope(t, cos, sin):
+    return _apply(t, cos, sin)
+
+
+def _rope_fwd(t, cos, sin):
+    return _apply(t, cos, sin), (cos, sin)
+
+
+def _rope_bwd(res, dy):
+    cos, sin = res
+    # True adjoint: dt = dy·cos + rot_halfᵀ(dy·sin). The reference backward
+    # kernel (fused_rotary_positional_embedding.h:74-87) reads sin from the
+    # *other* half — identical math; this stays correct even when the two
+    # freq halves are not duplicates of each other.
+    d2 = cos.shape[-1]
+    dy32 = dy[..., :d2].astype(jnp.float32)
+    dt = dy32 * cos + _rotate_half_t(dy32 * sin)
+    dt = dt.astype(dy.dtype)
+    if d2 < dy.shape[-1]:
+        dt = jnp.concatenate([dt, dy[..., d2:]], axis=-1)
+    return dt, None, None
+
+
+_rope.defvjp(_rope_fwd, _rope_bwd)
+
+
+def fused_apply_rotary_pos_emb(
+    t: jax.Array,
+    freqs: jax.Array,
+    transpose_output_memory: bool = False,
+) -> jax.Array:
+    """`sbhd` layout: t [s, b, h, d], freqs [s, 1, 1, d2] (radians).
+
+    Reference fused_rope.py:166 / kernel fwd (fused_rope::fwd)."""
+    del transpose_output_memory
+    f32 = freqs.astype(jnp.float32)
+    return _rope(t, jnp.cos(f32), jnp.sin(f32))
+
+
+def fused_apply_rotary_pos_emb_cached(
+    t: jax.Array,
+    cos_: jax.Array,
+    sin_: jax.Array,
+    transpose_output_memory: bool = False,
+) -> jax.Array:
+    """`sbhd` layout with precomputed cos/sin [s, 1, 1, d2]
+    (reference fused_rope.py:300, kernel fwd_cached)."""
+    del transpose_output_memory
+    return _rope(t, cos_.astype(jnp.float32), sin_.astype(jnp.float32))
+
+
+def fused_apply_rotary_pos_emb_thd(
+    t: jax.Array,
+    cu_seqlens: jax.Array,
+    freqs: jax.Array,
+) -> jax.Array:
+    """`thd` packed-sequence layout: t [T, h, d], cu_seqlens [b+1] int32,
+    freqs [max_s, 1, 1, d2] (reference fused_rope.py:424, kernel fwd_thd).
+
+    Token i belongs to the sequence whose range contains i; its rotary
+    position is ``i - cu_seqlens[seq(i)]``.
+    """
+    total = t.shape[0]
+    idx = jnp.arange(total, dtype=jnp.int32)
+    seg = jnp.searchsorted(cu_seqlens.astype(jnp.int32), idx, side="right") - 1
+    pos = idx - jnp.take(cu_seqlens.astype(jnp.int32), seg)
+    f32 = freqs.astype(jnp.float32).reshape(freqs.shape[0], -1)   # [max_s,d2]
+    cos = jnp.take(jnp.cos(f32), pos, axis=0)[:, None, :]         # [T,1,d2]
+    sin = jnp.take(jnp.sin(f32), pos, axis=0)[:, None, :]
+    return _rope(t, cos, sin)
+
+
+def fused_apply_rotary_pos_emb_2d(
+    t: jax.Array,
+    img_h: int,
+    img_w: int,
+    cos_h: jax.Array,
+    sin_h: jax.Array,
+    cos_w: jax.Array,
+    sin_w: jax.Array,
+) -> jax.Array:
+    """2D (vision) RoPE: t [b, img_h*img_w, h, d]; the first d/2 features
+    rotate by the row position (cos_h/sin_h [1, H, 1, d/2]) and the second
+    d/2 by the column position (cos_w/sin_w [1, W, 1, d/2])
+    (reference fused_rope.py:565, kernel fwd_2d).
+    """
+    b, s, h, d = t.shape
+    if s != img_h * img_w:
+        raise ValueError(f"t.shape[1]={s} != img_h*img_w={img_h * img_w}")
+    half = d // 2
+    t4 = t.reshape(b, img_h, img_w, h, d)
+    ch = cos_h.astype(jnp.float32).reshape(1, img_h, 1, 1, half)
+    sh = sin_h.astype(jnp.float32).reshape(1, img_h, 1, 1, half)
+    cw = cos_w.astype(jnp.float32).reshape(1, 1, img_w, 1, half)
+    sw = sin_w.astype(jnp.float32).reshape(1, 1, img_w, 1, half)
+    out_h = _rope(t4[..., :half], ch, sh)
+    out_w = _rope(t4[..., half:], cw, sw)
+    return jnp.concatenate([out_h, out_w], axis=-1).reshape(b, s, h, d)

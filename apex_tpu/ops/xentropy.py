@@ -1,0 +1,89 @@
+"""Fused softmax-cross-entropy with label smoothing.
+
+Reference: apex/contrib/csrc/xentropy/xentropy_kernel.cu bound as
+``xentropy_cuda``, wrapped by
+apex/contrib/xentropy/softmax_xentropy.py (``SoftmaxCrossEntropyLoss``).
+The fusion win the reference targets — not materializing the softmax and
+saving only ``max + log Σ exp`` for backward — is the same here: forward
+saves the scalar ``max_log_sum_exp`` per row, backward reconstructs the
+softmax from logits in one fused pass.
+
+Per-row semantics (xentropy_kernel.cu:431-436, 448-452):
+
+    lse      = max(x) + log Σ exp(x - max)
+    loss     = (lse - mean(x)) · smoothing + (lse - x[label]) · (1-smoothing)
+    loss     = 0                         where label == padding_idx
+    dx_j     = g · (softmax_j - smoothing/K - (1-smoothing)·1[j==label])
+    dx       = 0                         where label == padding_idx
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _xentropy(logits, labels, smoothing, padding_idx):
+    loss, _ = _fwd_math(logits, labels, smoothing, padding_idx)
+    return loss
+
+
+def _fwd_math(logits, labels, smoothing, padding_idx):
+    x = logits.astype(jnp.float32)
+    m = jnp.max(x, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m[..., None]), axis=-1))
+    picked = jnp.take_along_axis(
+        x, labels[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    loss = (lse - jnp.mean(x, axis=-1)) * smoothing + (lse - picked) * (
+        1.0 - smoothing
+    )
+    if padding_idx is not None:
+        loss = jnp.where(labels == padding_idx, 0.0, loss)
+    return loss, lse
+
+
+def _xentropy_fwd(logits, labels, smoothing, padding_idx):
+    loss, lse = _fwd_math(logits, labels, smoothing, padding_idx)
+    return loss, (logits, labels, lse)
+
+
+def _xentropy_bwd(smoothing, padding_idx, res, g):
+    logits, labels, lse = res
+    x = logits.astype(jnp.float32)
+    classes = x.shape[-1]
+    probs = jnp.exp(x - lse[..., None])
+    onehot = jax.nn.one_hot(labels, classes, dtype=jnp.float32)
+    dx = probs - smoothing / classes - (1.0 - smoothing) * onehot
+    g32 = g.astype(jnp.float32)
+    if padding_idx is not None:
+        g32 = jnp.where(labels == padding_idx, 0.0, g32)
+    dx = dx * g32[..., None]
+    return dx.astype(logits.dtype), None
+
+
+_xentropy.defvjp(_xentropy_fwd, _xentropy_bwd)
+
+
+def softmax_cross_entropy_loss(
+    logits: jax.Array,
+    labels: jax.Array,
+    smoothing: float = 0.0,
+    padding_idx: int = 0,
+    half_to_float: bool = False,
+) -> jax.Array:
+    """Per-row losses (reference softmax_xentropy.py:6 signature).
+
+    ``half_to_float`` is accepted for parity; losses are always fp32.
+    """
+    del half_to_float
+    return _xentropy(logits, labels, float(smoothing), padding_idx)
+
+
+# Reference exposes a Function-object with .apply; the callable is enough.
+SoftmaxCrossEntropyLoss = softmax_cross_entropy_loss

@@ -53,8 +53,11 @@ def apply_updates(params, updates):
     )
 
 
-def _is_float(x) -> bool:
+def is_float_leaf(x) -> bool:
     return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+_is_float = is_float_leaf
 
 
 def tree_map_float(fn, *trees):

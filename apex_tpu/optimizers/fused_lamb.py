@@ -79,14 +79,22 @@ def fused_lamb(
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
 
+        def scaled_grad(g, p):
+            sg = g.astype(jnp.float32) / clip
+            if not adam_w_mode and weight_decay != 0.0:
+                # L2 mode (kernel MOMENT_MODE_0, multi_tensor_lamb.cu:123-126):
+                # decay*p folds into the scaled gradient before the moments.
+                sg = sg + weight_decay * p.astype(jnp.float32)
+            return sg
+
         m_tree = tree_map_float(
-            lambda g, m: beta1 * m + beta3 * (g.astype(jnp.float32) / clip),
-            grads, state.exp_avg,
+            lambda g, p, m: beta1 * m + beta3 * scaled_grad(g, p),
+            grads, params, state.exp_avg,
         )
         v_tree = tree_map_float(
-            lambda g, v: beta2 * v
-            + (1.0 - beta2) * jnp.square(g.astype(jnp.float32) / clip),
-            grads, state.exp_avg_sq,
+            lambda g, p, v: beta2 * v
+            + (1.0 - beta2) * jnp.square(scaled_grad(g, p)),
+            grads, params, state.exp_avg_sq,
         )
 
         # Phase 2: per-param trust ratio (kernel lamb_stage_2).

@@ -47,6 +47,11 @@ def fused_lars(
     eps: float = 0.0,
     skip_predicate: Optional[Callable[[tuple], bool]] = None,
 ) -> GradientTransformation:
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError(
+            "Nesterov momentum requires a momentum and zero dampening"
+        )
+
     def init(params) -> LARSState:
         return LARSState(
             step=jnp.zeros((), jnp.int32),
@@ -75,8 +80,7 @@ def fused_lars(
                 scaled_lr = lr_t * trust
             return scaled_lr, g32 + weight_decay * p32
 
-        def _float(x):
-            return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        from apex_tpu.optimizers._common import is_float_leaf as _float
 
         def mom_leaf(path, g, p, mom):
             if not _float(g):

@@ -176,6 +176,17 @@ def _numpy_lamb(params, grads, lr, b1, b2, eps, wd, max_gn, nvlamb=False,
 
 
 class TestFusedLAMB:
+    def test_l2_mode_weight_decay_reaches_moments(self):
+        # MOMENT_MODE_0: with zero grads, decay*p drives a nonzero update.
+        params = {"p0": np.array([2.0, -3.0], np.float32)}
+        zeros = [{"p0": np.zeros(2, np.float32)}]
+        out = _run_jax(
+            opt.fused_lamb(lr=0.1, weight_decay=0.5, adam_w_mode=False,
+                           max_grad_norm=0.0),
+            params, zeros,
+        )
+        assert np.abs(out["p0"] - params["p0"]).max() > 1e-3
+
     @pytest.mark.parametrize("wd,nvlamb", [(0.01, False), (0.0, False),
                                            (0.0, True)])
     def test_matches_numpy_reference(self, wd, nvlamb):

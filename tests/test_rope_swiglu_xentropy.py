@@ -1,0 +1,259 @@
+"""RoPE (4 layouts), bias+SwiGLU, fused xentropy numerics.
+
+Reference analogs: tests/L0/run_transformer/test_fused_rope.py,
+test_fused_bias_swiglu.py; apex/contrib/test/xentropy/test_label_smoothing.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu.ops.rope import (
+    fused_apply_rotary_pos_emb,
+    fused_apply_rotary_pos_emb_2d,
+    fused_apply_rotary_pos_emb_cached,
+    fused_apply_rotary_pos_emb_thd,
+)
+from apex_tpu.ops.swiglu import fused_bias_swiglu
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+
+def _np_rotate_half(x):
+    h = x.shape[-1] // 2
+    return np.concatenate([-x[..., h:], x[..., :h]], axis=-1)
+
+
+def _np_rope(t, freqs):
+    d2 = freqs.shape[-1]
+    cos, sin = np.cos(freqs), np.sin(freqs)
+    out = t[..., :d2] * cos + _np_rotate_half(t[..., :d2]) * sin
+    if d2 < t.shape[-1]:
+        out = np.concatenate([out, t[..., d2:]], axis=-1)
+    return out
+
+
+class TestRoPE:
+    @pytest.mark.parametrize("d2", [64, 32])   # full and partial rotation
+    def test_sbhd_matches_numpy(self, d2):
+        rng = np.random.RandomState(0)
+        s, b, h, d = 16, 2, 4, 64
+        t = rng.randn(s, b, h, d).astype(np.float32)
+        freqs = rng.rand(s, 1, 1, d2).astype(np.float32) * 3
+        y = fused_apply_rotary_pos_emb(jnp.asarray(t), jnp.asarray(freqs))
+        np.testing.assert_allclose(np.asarray(y), _np_rope(t, freqs),
+                                   atol=1e-5)
+
+    def test_cached_matches_plain(self):
+        rng = np.random.RandomState(1)
+        t = rng.randn(8, 2, 2, 32).astype(np.float32)
+        freqs = rng.rand(8, 1, 1, 32).astype(np.float32)
+        y1 = fused_apply_rotary_pos_emb(jnp.asarray(t), jnp.asarray(freqs))
+        y2 = fused_apply_rotary_pos_emb_cached(
+            jnp.asarray(t), jnp.cos(jnp.asarray(freqs)),
+            jnp.sin(jnp.asarray(freqs))
+        )
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-6)
+
+    def test_gradient_orthogonal_with_duplicated_freqs(self):
+        # standard RoPE: freqs = concat(θ, θ) — rotation is orthogonal
+        rng = np.random.RandomState(2)
+        t = jnp.asarray(rng.randn(6, 1, 2, 32), jnp.float32)
+        theta = rng.rand(6, 1, 1, 16).astype(np.float32)
+        freqs = jnp.asarray(np.concatenate([theta, theta], -1))
+        dy = jnp.asarray(rng.randn(6, 1, 2, 32), jnp.float32)
+        g = jax.grad(
+            lambda t_: jnp.sum(fused_apply_rotary_pos_emb(t_, freqs) * dy)
+        )(t)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(g)), np.linalg.norm(np.asarray(dy)),
+            rtol=1e-5,
+        )
+        back = fused_apply_rotary_pos_emb(g, freqs)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(dy),
+                                   atol=1e-5)
+
+    def test_gradient_finite_difference_general_freqs(self):
+        # non-duplicated freqs: check the VJP against finite differences
+        rng = np.random.RandomState(5)
+        t = rng.randn(4, 1, 1, 8).astype(np.float32)
+        freqs = (rng.rand(4, 1, 1, 8) * 3).astype(np.float32)
+        dy = rng.randn(4, 1, 1, 8).astype(np.float32)
+
+        def f(t_):
+            return float(jnp.sum(
+                fused_apply_rotary_pos_emb(jnp.asarray(t_),
+                                           jnp.asarray(freqs))
+                * jnp.asarray(dy)
+            ))
+
+        g = jax.grad(
+            lambda t_: jnp.sum(
+                fused_apply_rotary_pos_emb(t_, jnp.asarray(freqs))
+                * jnp.asarray(dy)
+            )
+        )(jnp.asarray(t))
+        eps = 1e-3
+        for idx in [(0, 0, 0, 0), (1, 0, 0, 5), (3, 0, 0, 7)]:
+            tp, tm = t.copy(), t.copy()
+            tp[idx] += eps
+            tm[idx] -= eps
+            num = (f(tp) - f(tm)) / (2 * eps)
+            np.testing.assert_allclose(float(g[idx]), num, rtol=2e-2,
+                                       atol=1e-3)
+
+    def test_thd_packed_positions(self):
+        rng = np.random.RandomState(3)
+        lens = [5, 3, 8]
+        total = sum(lens)
+        cu = np.cumsum([0] + lens).astype(np.int32)
+        t = rng.randn(total, 2, 32).astype(np.float32)
+        freqs = rng.rand(max(lens), 1, 1, 32).astype(np.float32)
+        y = fused_apply_rotary_pos_emb_thd(
+            jnp.asarray(t), jnp.asarray(cu), jnp.asarray(freqs)
+        )
+        # reference: each sequence is rotated from position 0
+        expect = np.concatenate([
+            _np_rope(
+                t[cu[i]:cu[i + 1]],                     # (len, h, d)
+                freqs[:lens[i], 0, :, :],               # (len, 1, d2)
+            )
+            for i in range(len(lens))
+        ])
+        np.testing.assert_allclose(np.asarray(y), expect, atol=1e-5)
+
+    def test_2d_splits_height_width(self):
+        rng = np.random.RandomState(4)
+        b, H, W, h, d = 2, 4, 3, 2, 32
+        t = rng.randn(b, H * W, h, d).astype(np.float32)
+        fh = rng.rand(1, H, 1, d // 2).astype(np.float32)
+        fw = rng.rand(1, W, 1, d // 2).astype(np.float32)
+        y = fused_apply_rotary_pos_emb_2d(
+            jnp.asarray(t), H, W,
+            jnp.cos(jnp.asarray(fh)), jnp.sin(jnp.asarray(fh)),
+            jnp.cos(jnp.asarray(fw)), jnp.sin(jnp.asarray(fw)),
+        )
+        t5 = t.reshape(b, H, W, h, d)
+        exp_h = t5[..., : d // 2] * np.cos(fh[:, :, None, :, :]) + \
+            _np_rotate_half(t5[..., : d // 2]) * np.sin(fh[:, :, None, :, :])
+        exp_w = t5[..., d // 2:] * np.cos(fw[:, None, :, :, :]) + \
+            _np_rotate_half(t5[..., d // 2:]) * np.sin(fw[:, None, :, :, :])
+        expect = np.concatenate([exp_h, exp_w], -1).reshape(b, H * W, h, d)
+        np.testing.assert_allclose(np.asarray(y), expect, atol=1e-5)
+
+
+class TestBiasSwiGLU:
+    def test_matches_torch(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 6, 128).astype(np.float32)
+        b = rng.randn(128).astype(np.float32)
+        y = fused_bias_swiglu(jnp.asarray(x), jnp.asarray(b))
+
+        tx = torch.tensor(x, requires_grad=True)
+        tb = torch.tensor(b, requires_grad=True)
+        ty_in = tx + tb
+        t1, t2 = ty_in.chunk(2, dim=-1)
+        ty = torch.nn.functional.silu(t1) * t2
+        np.testing.assert_allclose(np.asarray(y), ty.detach().numpy(),
+                                   atol=1e-6)
+
+        dy = rng.randn(4, 6, 64).astype(np.float32)
+        gx, gb = jax.grad(
+            lambda x_, b_: jnp.sum(fused_bias_swiglu(x_, b_) * jnp.asarray(dy)),
+            argnums=(0, 1),
+        )(jnp.asarray(x), jnp.asarray(b))
+        ty.backward(torch.tensor(dy))
+        np.testing.assert_allclose(np.asarray(gx), tx.grad.numpy(), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gb), tb.grad.numpy(), atol=1e-4)
+
+    def test_no_bias_and_odd_dim(self):
+        x = jnp.ones((2, 8))
+        y = fused_bias_swiglu(x)
+        assert y.shape == (2, 4)
+        with pytest.raises(ValueError):
+            fused_bias_swiglu(jnp.ones((2, 7)))
+
+
+class TestXentropy:
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_matches_torch_cross_entropy(self, smoothing):
+        rng = np.random.RandomState(0)
+        logits = rng.randn(16, 50).astype(np.float32)
+        labels = rng.randint(1, 50, size=(16,))
+        loss = softmax_cross_entropy_loss(
+            jnp.asarray(logits), jnp.asarray(labels), smoothing=smoothing,
+            padding_idx=-1,
+        )
+        tl = torch.tensor(logits, requires_grad=True)
+        ref = torch.nn.functional.cross_entropy(
+            tl, torch.tensor(labels), reduction="none",
+            label_smoothing=smoothing,
+        )
+        np.testing.assert_allclose(np.asarray(loss), ref.detach().numpy(),
+                                   atol=1e-5, rtol=1e-5)
+
+        g = jax.grad(
+            lambda x_: jnp.sum(
+                softmax_cross_entropy_loss(x_, jnp.asarray(labels),
+                                           smoothing=smoothing,
+                                           padding_idx=-1)
+            )
+        )(jnp.asarray(logits))
+        ref.sum().backward()
+        np.testing.assert_allclose(np.asarray(g), tl.grad.numpy(), atol=1e-5)
+
+    def test_padding_idx_zeroes_loss_and_grad(self):
+        rng = np.random.RandomState(1)
+        logits = rng.randn(8, 20).astype(np.float32)
+        labels = np.array([0, 3, 0, 5, 7, 0, 1, 2])
+        loss = softmax_cross_entropy_loss(
+            jnp.asarray(logits), jnp.asarray(labels), padding_idx=0
+        )
+        ln = np.asarray(loss)
+        assert (ln[labels == 0] == 0).all()
+        assert (ln[labels != 0] > 0).all()
+        g = jax.grad(
+            lambda x_: jnp.sum(
+                softmax_cross_entropy_loss(x_, jnp.asarray(labels),
+                                           padding_idx=0)
+            )
+        )(jnp.asarray(logits))
+        gn = np.asarray(g)
+        assert (gn[labels == 0] == 0).all()
+        assert np.abs(gn[labels != 0]).max() > 0
+
+
+class TestDenseMLP:
+    def test_fused_dense_gelu_dense(self):
+        from apex_tpu.fused_dense import FusedDenseGeluDense
+
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 16), jnp.float32)
+        mod = FusedDenseGeluDense(in_features=16, intermediate_features=32,
+                                  out_features=8)
+        params = mod.init(jax.random.PRNGKey(0), x)
+        y = mod.apply(params, x)
+        assert y.shape == (4, 8)
+
+    def test_mlp_matches_torch(self):
+        from apex_tpu.mlp import MLP
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(6, 10).astype(np.float32)
+        mod = MLP(mlp_sizes=(10, 20, 5), activation="relu")
+        params = mod.init(jax.random.PRNGKey(0), jnp.asarray(x))
+        y = mod.apply(params, jnp.asarray(x))
+
+        w0 = np.asarray(params["params"]["kernel_0"])
+        b0 = np.asarray(params["params"]["bias_0"])
+        w1 = np.asarray(params["params"]["kernel_1"])
+        b1 = np.asarray(params["params"]["bias_1"])
+        expect = np.maximum(x @ w0 + b0, 0) @ w1 + b1
+        np.testing.assert_allclose(np.asarray(y), expect, atol=1e-5)
+
+    def test_mlp_validation(self):
+        from apex_tpu.mlp import mlp_function
+
+        with pytest.raises(ValueError):
+            mlp_function(jnp.ones((2, 4)), [jnp.ones((4, 4))], None,
+                         activation="tanh")
