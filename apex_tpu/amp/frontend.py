@@ -158,8 +158,12 @@ def make_train_step(
         grads, finite = scaler_lib.unscale_grads(grads, ls_state)
 
         if axis_name is not None:
-            grads = jax.lax.pmean(grads, axis_name)
-            finite = jax.lax.pmin(finite.astype(jnp.int32), axis_name) > 0
+            from apex_tpu.utils.collectives import flag_and, grad_mean
+
+            # vma-aware: under shard_map SPMD-AD the grads arrive pre-summed
+            # (see utils/collectives.py) — grad_mean only divides then.
+            grads = grad_mean(grads, axis_name)
+            finite = flag_and(finite, axis_name)
 
         if grad_postprocess is not None:
             grads = grad_postprocess(grads)

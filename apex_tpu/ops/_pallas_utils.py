@@ -1,0 +1,41 @@
+"""Shared helpers for Pallas row-kernel wrappers."""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.utils.registry import on_tpu
+
+LANES = 128
+
+__all__ = ["LANES", "pallas_ok", "pad_rows"]
+
+
+def pallas_ok(op_name: str, last_dim: int, dtype) -> bool:
+    """Common gate: on TPU (or forced interpret), lane-aligned last dim,
+    supported dtype, and not disabled via APEX_TPU_DISABLE_<OP>=1."""
+    if os.environ.get(f"APEX_TPU_DISABLE_{op_name.upper()}", "0") == "1":
+        return False
+    interp = os.environ.get("APEX_TPU_PALLAS_INTERPRET", "0") == "1"
+    return (
+        (on_tpu() or interp)
+        and last_dim % LANES == 0
+        and dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
+    )
+
+
+def pad_rows(x2, block_rows: int):
+    """Zero-pad dim 0 to a multiple of block_rows; returns (padded, rows).
+
+    Padding rows are zeros: reductions over rows (dγ/dβ-style accumulators)
+    see zero contributions, and per-row outputs are sliced off by callers.
+    """
+    rows = x2.shape[0]
+    padded = pl.cdiv(rows, block_rows) * block_rows
+    if padded == rows:
+        return x2, rows
+    pad_width = [(0, padded - rows)] + [(0, 0)] * (x2.ndim - 1)
+    return jnp.pad(x2, pad_width), rows

@@ -155,24 +155,15 @@ def _ln_bwd_kernel(rms: bool, affine: bool, has_bias: bool, *refs):
 
 
 def _pallas_ok(hidden: int, dtype) -> bool:
-    import os
+    from apex_tpu.ops._pallas_utils import pallas_ok
 
-    if os.environ.get("APEX_TPU_DISABLE_FUSED_LAYER_NORM") == "1":
-        return False
-    interp = os.environ.get("APEX_TPU_PALLAS_INTERPRET", "0") == "1"
-    return (
-        (on_tpu() or interp)
-        and hidden % _LANES == 0
-        and dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
-    )
+    return pallas_ok("fused_layer_norm", hidden, dtype)
 
 
 def _pad_rows(x2, br):
-    rows = x2.shape[0]
-    padded = pl.cdiv(rows, br) * br
-    if padded == rows:
-        return x2, rows
-    return jnp.pad(x2, ((0, padded - rows), (0, 0))), rows
+    from apex_tpu.ops._pallas_utils import pad_rows
+
+    return pad_rows(x2, br)
 
 
 def _ln_fwd_pallas(x2, weight, bias, eps, rms):
@@ -225,8 +216,9 @@ def _ln_bwd_pallas(dy2, x2, weight, mu, rs, rms, has_bias):
     dy2, rows = _pad_rows(dy2, br)
     x2, _ = _pad_rows(x2, br)
     mu, _ = _pad_rows(mu, br)
-    # rs must be padded with 1s (not 0) so padded rows yield dx = 0*rs = 0
-    # rather than 0*0 NaN hazards; values are sliced off anyway.
+    # rs is zero-padded like everything else; padded rows are safe because
+    # dy there is zero too (dx = 0·rs = 0, dγ/dβ partial sums get zeros)
+    # and the per-row outputs are sliced off below.
     rs, _ = _pad_rows(rs, br)
     prows = x2.shape[0]
     grid = (prows // br,)
@@ -334,6 +326,11 @@ def _norm_bwd(eps, rms, memory_efficient, res, dy):
         y32 = saved_y.astype(jnp.float32)
         if weight is not None:
             w32 = weight.astype(jnp.float32)
+            # guard zero gammas exactly like the reference's
+            # clamp_by_magnitude (layer_norm_cuda_kernel.cu:540)
+            w32 = jnp.sign(w32) * jnp.maximum(jnp.abs(w32), eps) + jnp.where(
+                w32 == 0.0, eps, 0.0
+            )
             if bias is not None:
                 y32 = y32 - bias.astype(jnp.float32)
             xhat = y32 / w32

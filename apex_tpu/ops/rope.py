@@ -149,10 +149,16 @@ def fused_apply_rotary_pos_emb_2d(
         raise ValueError(f"t.shape[1]={s} != img_h*img_w={img_h * img_w}")
     half = d // 2
     t4 = t.reshape(b, img_h, img_w, h, d)
-    ch = cos_h.astype(jnp.float32).reshape(1, img_h, 1, 1, half)
-    sh = sin_h.astype(jnp.float32).reshape(1, img_h, 1, 1, half)
-    cw = cos_w.astype(jnp.float32).reshape(1, 1, img_w, 1, half)
-    sw = sin_w.astype(jnp.float32).reshape(1, 1, img_w, 1, half)
+    # tables may be precomputed for a max image size (reference allows
+    # H >= img_h / W >= img_w and indexes the first rows)
+    ch = cos_h.astype(jnp.float32).reshape(1, -1, 1, half)[:, :img_h]
+    sh = sin_h.astype(jnp.float32).reshape(1, -1, 1, half)[:, :img_h]
+    cw = cos_w.astype(jnp.float32).reshape(1, -1, 1, half)[:, :img_w]
+    sw = sin_w.astype(jnp.float32).reshape(1, -1, 1, half)[:, :img_w]
+    ch = ch.reshape(1, img_h, 1, 1, half)
+    sh = sh.reshape(1, img_h, 1, 1, half)
+    cw = cw.reshape(1, 1, img_w, 1, half)
+    sw = sw.reshape(1, 1, img_w, 1, half)
     out_h = _rope(t4[..., :half], ch, sh)
     out_w = _rope(t4[..., half:], cw, sw)
     return jnp.concatenate([out_h, out_w], axis=-1).reshape(b, s, h, d)

@@ -58,6 +58,12 @@ def _softmax_fwd_ref(x, scale, mask=None, causal=False):
         col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         x32 = jnp.where(col > row, _MASK_FILL, x32)
     y = jax.nn.softmax(x32, axis=-1)
+    # Fully-masked rows emit zeros, matching the reference kernels'
+    # scale_value=0 when a row's max is the mask fill
+    # (scaled_masked_softmax.h:304, generic_scaled_masked_softmax.h:288).
+    if mask is not None or causal:
+        all_masked = jnp.max(x32, axis=-1, keepdims=True) <= _MASK_FILL
+        y = jnp.where(all_masked, 0.0, y)
     return y.astype(x.dtype)
 
 
@@ -84,18 +90,16 @@ def _softmax_kernel(scale, causal, sq, has_mask, *refs):
     m = jnp.max(x, axis=-1, keepdims=True)
     e = jnp.exp(x - m)
     y = e / jnp.sum(e, axis=-1, keepdims=True)
+    if has_mask or causal:
+        # fully-masked rows → zeros (reference scale_value=0 semantics)
+        y = jnp.where(m <= _MASK_FILL, 0.0, y)
     y_ref[:] = y.astype(y_ref.dtype)
 
 
 def _pallas_ok(sk: int, dtype) -> bool:
-    import os
+    from apex_tpu.ops._pallas_utils import pallas_ok
 
-    interp = os.environ.get("APEX_TPU_PALLAS_INTERPRET", "0") == "1"
-    return (
-        (on_tpu() or interp)
-        and sk % _LANES == 0
-        and dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
-    )
+    return pallas_ok("fused_softmax", sk, dtype)
 
 
 def _softmax_fwd_pallas(x, scale, mask, causal):
@@ -118,7 +122,9 @@ def _softmax_fwd_pallas(x, scale, mask, causal):
     in_specs = [row_tile]
     args = [x2]
     if mask is not None:
-        m2 = jnp.broadcast_to(mask, shape).reshape(rows, sk).astype(jnp.int8)
+        # dispatcher guarantees mask.shape == x.shape here (broadcast masks
+        # take the XLA path, which reads them with broadcast strides)
+        m2 = mask.reshape(rows, sk).astype(jnp.int8)
         if padded_rows != rows:
             m2 = jnp.pad(m2, ((0, padded_rows - rows), (0, 0)))
         in_specs.append(row_tile)
@@ -141,9 +147,20 @@ def _softmax_fwd_pallas(x, scale, mask, causal):
 # --------------------------------------------------------------------------
 
 
+def _use_pallas(x, mask, causal):
+    # Broadcast masks (e.g. (B,1,sq,sk) vs (B,H,sq,sk)) would have to be
+    # materialized at full size in HBM for the kernel; XLA reads them with
+    # broadcast strides instead, so route those to the reference path.
+    if mask is not None and mask.shape != x.shape:
+        return False
+    return _pallas_ok(x.shape[-1], x.dtype) and (
+        not causal or x.shape[-2] == x.shape[-1]
+    )
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
 def _scaled_softmax(x, mask, scale, causal):
-    if _pallas_ok(x.shape[-1], x.dtype) and (not causal or x.shape[-2] == x.shape[-1]):
+    if _use_pallas(x, mask, causal):
         return _softmax_fwd_pallas(x, scale, mask, causal)
     return _softmax_fwd_ref(x, scale, mask, causal)
 

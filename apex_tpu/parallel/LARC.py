@@ -1,0 +1,76 @@
+"""LARC — layer-wise adaptive rate control as an optimizer wrapper.
+
+Reference: apex/parallel/LARC.py:5 — before the inner optimizer steps, each
+param's grad is replaced by ``(grad + wd·p) · adaptive_lr`` where
+
+    adaptive_lr = tc·‖p‖ / (‖g‖ + wd·‖p‖ + eps)
+    adaptive_lr = min(adaptive_lr / lr, 1)        if clip (so lr·alr =
+                                                   min(adaptive_lr, lr))
+
+and the inner optimizer's own weight decay is disabled for the step
+(LARC.py:77-106). Here: an optax-style wrapper transforming the grads fed to
+any inner ``GradientTransformation`` — construct the inner optimizer with
+``weight_decay=0`` and give the decay to LARC, matching how the reference
+absorbs it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import (
+    GradientTransformation,
+    is_float_leaf,
+)
+
+__all__ = ["LARC", "larc"]
+
+
+class LARCState(NamedTuple):
+    inner: Any
+
+
+def larc(
+    inner: GradientTransformation,
+    lr: float,
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> GradientTransformation:
+    """Wrap ``inner``; ``lr`` must be the inner optimizer's lr (used by the
+    clip calculation exactly as the reference reads ``group['lr']``)."""
+
+    def init(params):
+        return LARCState(inner=inner.init(params))
+
+    def update(grads, state: LARCState, params=None):
+        if params is None:
+            raise ValueError("larc requires params")
+
+        def leaf(g, p):
+            if not is_float_leaf(g):
+                return g
+            g32, p32 = g.astype(jnp.float32), p.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+            alr = trust_coefficient * p_norm / (
+                g_norm + p_norm * weight_decay + eps
+            )
+            if clip:
+                alr = jnp.minimum(alr / lr, 1.0)
+            adjusted = (g32 + weight_decay * p32) * alr
+            ok = (p_norm != 0) & (g_norm != 0)
+            return jnp.where(ok, adjusted, g32).astype(g.dtype)
+
+        adj = jax.tree_util.tree_map(leaf, grads, params)
+        updates, inner_state = inner.update(adj, state.inner, params)
+        return updates, LARCState(inner=inner_state)
+
+    return GradientTransformation(init, update)
+
+
+LARC = larc

@@ -1,0 +1,24 @@
+"""apex_tpu.parallel — data parallelism, SyncBatchNorm, LARC, grad clipping.
+
+Reference: apex/parallel/ (DistributedDataParallel, SyncBatchNorm,
+convert_syncbn_model, LARC, Reducer).
+"""
+
+from apex_tpu.parallel.clip_grad import clip_grad_norm, clip_grad_norm_  # noqa: F401
+from apex_tpu.parallel.distributed import (  # noqa: F401
+    DistributedDataParallel,
+    Reducer,
+    allreduce_gradients,
+    make_ddp_train_step,
+)
+from apex_tpu.parallel.LARC import LARC, larc  # noqa: F401
+from apex_tpu.parallel.mesh import (  # noqa: F401
+    create_mesh,
+    data_parallel_mesh,
+    replicate,
+    shard_batch,
+)
+from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm,
+    convert_syncbn_model,
+)

@@ -1,0 +1,192 @@
+"""Data-parallel training — the apex DDP equivalent.
+
+Reference: apex/parallel/distributed.py — per-param backward hooks build
+dtype-segregated greedy buckets on the first backward (:369-390), flatten →
+NCCL allreduce on side streams (:426-470), with options for fp32 allreduce,
+gradient predivision, and delayed/no-op reduction. All of that machinery
+exists to overlap communication with the tail of backward.
+
+Under XLA none of it is user code: grads carry a ``psum`` over the ``dp``
+mesh axis inside the jitted step, and the latency-hiding scheduler overlaps
+the collective with remaining backward compute — bucketing, streams, and
+hooks are the compiler's job. What survives of the reference API:
+
+- ``DistributedDataParallel(loss_fn, ...)``: wraps a loss so its gradients
+  are averaged over ``dp`` when taken inside ``shard_map`` (drop-in for
+  wrapping the model: grads arrive already-reduced, as with apex DDP).
+- ``allreduce_always_fp32`` / ``gradient_predivide_factor`` /
+  ``gradient_average`` keep their reference meanings (distributed.py:129
+  ctor args) as dtype/scale adjustments around the psum.
+- ``Reducer``: the manual "call when you want the allreduce" variant
+  (distributed.py:89).
+- ``make_ddp_train_step``: whole-step convenience — shard_map over the
+  mesh, batch split on dp, params replicated, amp + optimizer inside.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.parallel.mesh import create_mesh
+
+__all__ = [
+    "DistributedDataParallel",
+    "Reducer",
+    "allreduce_gradients",
+    "make_ddp_train_step",
+]
+
+
+def allreduce_gradients(
+    grads: Any,
+    axis_name: str = "dp",
+    *,
+    allreduce_always_fp32: bool = False,
+    gradient_average: bool = True,
+    gradient_predivide_factor: Optional[float] = None,
+) -> Any:
+    """Average (or sum) grads over a mesh axis — apex DDP's
+    ``allreduce_bucket`` semantics (distributed.py:426-470) as one function.
+
+    Must be called inside ``shard_map``/``pmap`` with ``axis_name`` bound.
+
+    SPMD-AD note: under jax≥0.9 shard_map, grads w.r.t. replicated params
+    come back *already summed* over the axis (the broadcast transpose). This
+    function detects that via the value's varying-axes type and only emits a
+    collective when one is still needed — so it is safe on both raw
+    per-shard grads and SPMD-AD pre-summed grads. When grads were pre-summed
+    the reduction already happened in the grad dtype, so
+    ``allreduce_always_fp32`` only affects the post-scaling arithmetic.
+    """
+    from apex_tpu.utils.collectives import is_varying
+
+    n = jax.lax.axis_size(axis_name)
+
+    def red(g):
+        if not (hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.inexact)):
+            return g
+        orig = g.dtype
+        if allreduce_always_fp32:
+            g = g.astype(jnp.float32)
+        if gradient_predivide_factor:
+            g = g / gradient_predivide_factor
+        if is_varying(g, axis_name):
+            g = jax.lax.psum(g, axis_name)
+        if gradient_average:
+            post = (
+                n / gradient_predivide_factor
+                if gradient_predivide_factor
+                else n
+            )
+            g = g / post
+        return g.astype(orig)
+
+    return jax.tree_util.tree_map(red, grads)
+
+
+class DistributedDataParallel:
+    """Wrap a loss/apply function so gradients come back dp-reduced.
+
+    Usage inside a shard_map'd train step::
+
+        ddp_loss = DistributedDataParallel(loss_fn)
+        grads = jax.grad(ddp_loss)(params, batch)   # already averaged
+
+    The wrapper attaches the reduction to the *backward* only (forward is
+    untouched), exactly like the reference's grad hooks.
+    """
+
+    def __init__(
+        self,
+        fn: Callable,
+        axis_name: str = "dp",
+        allreduce_always_fp32: bool = False,
+        gradient_average: bool = True,
+        gradient_predivide_factor: Optional[float] = None,
+    ):
+        self.fn = fn
+        self.axis_name = axis_name
+        self.opts = dict(
+            allreduce_always_fp32=allreduce_always_fp32,
+            gradient_average=gradient_average,
+            gradient_predivide_factor=gradient_predivide_factor,
+        )
+
+        @jax.custom_vjp
+        def wrapped(params, batch):
+            return fn(params, *batch)
+
+        def fwd(params, batch):
+            out, vjp = jax.vjp(lambda p: fn(p, *batch), params)
+            return out, vjp
+
+        def bwd(vjp, g):
+            (dparams,) = vjp(g)
+            dparams = allreduce_gradients(dparams, self.axis_name, **self.opts)
+            return (dparams, None)
+
+        wrapped.defvjp(fwd, bwd)
+        self._wrapped = wrapped
+
+    def __call__(self, params, *batch):
+        return self._wrapped(params, tuple(batch))
+
+
+class Reducer:
+    """Manual-reduction variant (reference ``Reducer``, distributed.py:89):
+    call ``reduce(grads)`` yourself when accumulation is done."""
+
+    def __init__(self, axis_name: str = "dp", **opts):
+        self.axis_name = axis_name
+        self.opts = opts
+
+    def reduce(self, grads):
+        return allreduce_gradients(grads, self.axis_name, **self.opts)
+
+
+def make_ddp_train_step(
+    loss_fn: Callable,
+    optimizer,
+    policy_or_amp="O0",
+    mesh: Optional[Mesh] = None,
+    *,
+    batch_axes: int = 1,
+    **ddp_opts,
+):
+    """Whole-step DDP: amp train step shard_mapped over the dp axis.
+
+    Returns ``(init_fn, step_fn)``; ``step_fn(state, *batch)`` expects each
+    batch array's leading dim divisible by the dp size. Params/state are
+    replicated, the batch is split, grads pmean over 'dp', the found-inf
+    flag combines across shards (transformer/amp/grad_scaler.py analog).
+    """
+    from apex_tpu import amp as amp_lib
+
+    if mesh is None:
+        mesh = create_mesh()
+    init_fn, step = amp_lib.make_train_step(
+        loss_fn, optimizer, policy_or_amp, axis_name="dp"
+    )
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), *([P("dp")] * batch_axes)),
+        out_specs=(P(), P()),
+    )
+    def sharded_step(state, *batch):
+        new_state, metrics = step(state, *batch)
+        metrics = {
+            k: (jax.lax.pmean(v, "dp")
+                if jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact)
+                else v)
+            for k, v in metrics.items()
+        }
+        return new_state, metrics
+
+    return init_fn, jax.jit(sharded_step)

@@ -1,0 +1,83 @@
+"""Device-mesh construction — the process-group layer of the framework.
+
+The reference builds torch.distributed process groups (NCCL/RCCL) for every
+parallelism axis (apex/transformer/parallel_state.py:81-310). On TPU the
+entire layer is a ``jax.sharding.Mesh``: axes are named, collectives ride
+ICI within an axis, and XLA inserts/overlaps the communication.
+
+Axis naming convention used across apex_tpu (outer → inner):
+
+    ('pp', 'dp', 'sp', 'tp')
+
+- ``tp`` innermost so tensor-parallel collectives (every layer!) ride the
+  fastest ICI links between physically adjacent chips,
+- ``dp`` outer — gradient allreduce happens once per step and tolerates
+  longer paths / DCN,
+- ``pp`` outermost — only neighbor ppermute traffic,
+- ``sp`` (sequence/context parallelism for long-context) sits between; it
+  reuses the tp axis in Megatron-SP style (see transformer/tensor_parallel)
+  or is its own axis for ring attention.
+
+(The scaling-book recipe: pick the mesh, name the axes, annotate shardings,
+let XLA insert collectives.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "create_mesh",
+    "data_parallel_mesh",
+    "replicate",
+    "shard_batch",
+]
+
+
+def create_mesh(
+    dp: Optional[int] = None,
+    tp: int = 1,
+    pp: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a ('pp','dp','sp','tp') mesh over the available devices.
+
+    ``dp=None`` absorbs whatever is left after tp/pp/sp. Mirrors
+    ``initialize_model_parallel``'s world-size divisibility checks
+    (parallel_state.py:81-130).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    world = len(devices)
+    denom = tp * pp * sp
+    if world % denom != 0:
+        raise ValueError(
+            f"world size {world} is not divisible by tp*pp*sp = {denom}"
+        )
+    if dp is None:
+        dp = world // denom
+    if dp * denom != world:
+        raise ValueError(
+            f"dp*tp*pp*sp = {dp * denom} != world size {world}"
+        )
+    arr = np.asarray(devices).reshape(pp, dp, sp, tp)
+    return Mesh(arr, axis_names=("pp", "dp", "sp", "tp"))
+
+
+def data_parallel_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """Pure data-parallel mesh (apex DDP's world)."""
+    return create_mesh(tp=1, pp=1, sp=1, devices=devices)
+
+
+def replicate(mesh: Mesh):
+    """Sharding that replicates across every axis (params in plain DDP)."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_batch(mesh: Mesh, *, axis: str = "dp"):
+    """Sharding that splits the leading (batch) dim across ``axis``."""
+    return NamedSharding(mesh, PartitionSpec(axis))

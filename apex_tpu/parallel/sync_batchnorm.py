@@ -1,0 +1,131 @@
+"""SyncBatchNorm — batch statistics reduced over a mesh axis.
+
+Reference: apex/parallel/optimized_sync_batchnorm.py (+ syncbn CUDA kernels,
+csrc/welford.cu): local Welford mean/var → all_gather of per-rank
+(mean, var, count) → Welford merge → normalize; backward allreduces
+(Σdy, Σdy·x̂) (optimized_sync_batchnorm_kernel.py:36-111). The pure-python
+fallback (sync_batchnorm.py:9) has the same math.
+
+SPMD simplification: every shard holds the same per-device batch size, so
+the Welford merge over equal counts collapses to ``pmean`` of the first two
+moments — one fused collective, and backward's reductions are inserted by
+XLA when the stats carry a ``pmean``. Channel-last (NHWC) layout is native
+on TPU; channels are the last dim (reference groupbn's NHWC layout is the
+default here, not a variant).
+
+Use inside ``shard_map``/``pmap`` with ``axis_name`` bound; outside one
+(axis_name=None) it degrades to plain BatchNorm — matching
+``convert_syncbn_model``'s behavior when no process group exists.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SyncBatchNorm", "convert_syncbn_model"]
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in for reference ``apex.parallel.SyncBatchNorm``.
+
+    Channels on the LAST axis (TPU-native NHWC). ``use_running_average``
+    selects eval behavior (torch ``.eval()`` analog).
+    """
+
+    num_features: int
+    eps: float = 1e-5
+    momentum: float = 0.1
+    affine: bool = True
+    track_running_stats: bool = True
+    axis_name: Optional[str] = "dp"
+    # fuse_relu mirrors the contrib groupbn BatchNorm2d_NHWC(fuse_relu=...)
+    fuse_relu: bool = False
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, use_running_average: bool = False
+    ) -> jax.Array:
+        c = self.num_features
+        if x.shape[-1] != c:
+            raise ValueError(
+                f"expected channels-last input with {c} channels, got "
+                f"shape {x.shape}"
+            )
+        reduce_axes = tuple(range(x.ndim - 1))
+        x32 = x.astype(jnp.float32)
+
+        running_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((c,), jnp.float32)
+        )
+        running_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((c,), jnp.float32)
+        )
+
+        if use_running_average and self.track_running_stats:
+            mean, var = running_mean.value, running_var.value
+        else:
+            mean = jnp.mean(x32, axis=reduce_axes)
+            mean_sq = jnp.mean(jnp.square(x32), axis=reduce_axes)
+            # Skip the collective while initializing params outside the
+            # mapped context (axis unbound during .init()).
+            if self.axis_name is not None and not self.is_initializing():
+                # equal per-shard counts ⇒ Welford merge == pmean of moments
+                mean = jax.lax.pmean(mean, self.axis_name)
+                mean_sq = jax.lax.pmean(mean_sq, self.axis_name)
+            var = mean_sq - jnp.square(mean)
+            if self.track_running_stats and not self.is_initializing():
+                # torch-convention EMA: new = (1-m)*old + m*batch
+                n = x32.size // c
+                if self.axis_name is not None:
+                    n = n * jax.lax.axis_size(self.axis_name)
+                unbiased = var * (n / max(n - 1, 1))
+                running_mean.value = (
+                    (1 - self.momentum) * running_mean.value
+                    + self.momentum * mean
+                )
+                running_var.value = (
+                    (1 - self.momentum) * running_var.value
+                    + self.momentum * unbiased
+                )
+
+        y = (x32 - mean) * jax.lax.rsqrt(var + self.eps)
+        if self.affine:
+            weight = self.param("scale", nn.initializers.ones, (c,),
+                                jnp.float32)
+            bias = self.param("bias", nn.initializers.zeros, (c,),
+                              jnp.float32)
+            y = y * weight + bias
+        if self.fuse_relu:
+            y = jax.nn.relu(y)
+        return y.astype(x.dtype)
+
+
+def convert_syncbn_model(module, axis_name: str = "dp"):
+    """Best-effort analog of reference ``convert_syncbn_model``
+    (apex/parallel/__init__.py:21), which walks a torch module tree replacing
+    BatchNorm with SyncBatchNorm.
+
+    Flax modules are immutable dataclasses, so only direct conversion of an
+    ``nn.BatchNorm`` instance is supported; for composite models, construct
+    them with :class:`SyncBatchNorm` (or pass ``axis_name`` to flax's own
+    ``nn.BatchNorm``, which also syncs) from the start.
+    """
+    if isinstance(module, SyncBatchNorm):
+        return module
+    if isinstance(module, nn.BatchNorm):
+        return nn.BatchNorm(
+            use_running_average=module.use_running_average,
+            momentum=module.momentum,
+            epsilon=module.epsilon,
+            axis_name=axis_name,
+        )
+    raise NotImplementedError(
+        "convert_syncbn_model can only convert nn.BatchNorm instances under "
+        "flax's immutable module system; build composite models with "
+        "apex_tpu.parallel.SyncBatchNorm (channels-last) or flax "
+        "nn.BatchNorm(axis_name=...) directly."
+    )

@@ -1,0 +1,15 @@
+"""apex_tpu.transformer — Megatron-style model parallelism on a TPU mesh.
+
+Reference: apex/transformer/ (parallel_state, tensor_parallel,
+pipeline_parallel, functional, layers, microbatches, amp.grad_scaler).
+"""
+
+from apex_tpu.transformer import parallel_state  # noqa: F401
+from apex_tpu.transformer import tensor_parallel  # noqa: F401
+from apex_tpu.transformer import functional  # noqa: F401
+from apex_tpu.transformer.enums import (  # noqa: F401
+    AttnMaskType,
+    AttnType,
+    LayerType,
+    ModelType,
+)

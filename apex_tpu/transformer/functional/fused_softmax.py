@@ -1,0 +1,70 @@
+"""FusedScaleMaskSoftmax — the dispatching softmax module.
+
+Reference: apex/transformer/functional/fused_softmax.py — picks between the
+fused CUDA kernels and a torch fallback based on mask type, dtype, and the
+kernel's seq-len limits (:222-246), with ``scale`` validation and optional
+input-in-fp16/output-in-fp32 handling. Here the Pallas/XLA dispatch lives
+inside the ops themselves, so this module only routes on mask type.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.softmax import (
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.transformer.enums import AttnMaskType
+
+__all__ = ["FusedScaleMaskSoftmax"]
+
+
+class FusedScaleMaskSoftmax:
+    """Callable matching the reference module's constructor surface.
+
+    Args mirror fused_softmax.py ``FusedScaleMaskSoftmax.__init__``:
+    ``input_in_fp16``/``input_in_bf16`` (informational), ``attn_mask_type``
+    (padding|causal), ``scaled_masked_softmax_fusion`` (kept; fusion is
+    always available here), ``mask_func`` (applied when the fused path
+    can't express it), ``softmax_in_fp32``, ``scale``.
+    """
+
+    def __init__(
+        self,
+        input_in_fp16: bool = False,
+        input_in_bf16: bool = False,
+        attn_mask_type: AttnMaskType = AttnMaskType.padding,
+        scaled_masked_softmax_fusion: bool = True,
+        mask_func: Optional[Callable] = None,
+        softmax_in_fp32: bool = True,
+        scale: Optional[float] = None,
+    ):
+        if not softmax_in_fp32 and scale is not None:
+            # reference asserts the same invariant (:210)
+            raise ValueError("softmax should be in fp32 when scaled")
+        self.attn_mask_type = attn_mask_type
+        self.mask_func = mask_func
+        self.scale = 1.0 if scale is None else float(scale)
+        self.fusion = scaled_masked_softmax_fusion
+
+    def __call__(self, x: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
+        if self.attn_mask_type == AttnMaskType.causal:
+            if x.shape[-2] == x.shape[-1]:
+                return scaled_upper_triang_masked_softmax(x, self.scale)
+            # rectangular causal (inference/kv-cache): build explicit mask
+            sq, sk = x.shape[-2], x.shape[-1]
+            row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+            causal = col > row + (sk - sq)
+            return scaled_masked_softmax(x, causal, self.scale)
+        if mask is not None and self.mask_func is not None:
+            x = self.mask_func(x, mask)
+            mask = None
+        if mask is None:
+            return scaled_softmax(x, self.scale)
+        return scaled_masked_softmax(x, mask, self.scale)

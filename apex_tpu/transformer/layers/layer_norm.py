@@ -1,0 +1,38 @@
+"""Sequence-parallel-aware FusedLayerNorm.
+
+Reference: apex/transformer/layers/layer_norm.py:26-54 — a FusedLayerNorm
+subclass that tags its params ``sequence_parallel_enabled`` so the DDP/grad
+sync knows these small replicated params need an extra allreduce over the
+TP group (their grads come from sequence shards).
+
+Under SPMD-AD the extra allreduce is automatic: norm params are replicated
+over 'tp', so their grads from tp-sharded (sequence-parallel) activations
+arrive pre-summed over the axis. The flag is kept for API parity and for
+the manual shard_map path, where ``grad_sum`` does the same.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+
+from apex_tpu.normalization.fused_layer_norm import (
+    FusedLayerNorm as _BaseFusedLayerNorm,
+)
+
+__all__ = ["FusedLayerNorm"]
+
+
+class FusedLayerNorm(_BaseFusedLayerNorm):
+    sequence_parallel_enabled: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if self.sequence_parallel_enabled:
+            from jax.sharding import PartitionSpec as P
+
+            from apex_tpu.transformer.tensor_parallel.layers import constrain
+
+            # activations sharded along sequence (dim 0) over 'tp'
+            x = constrain(x, P("tp", *([None] * (x.ndim - 1))))
+        return super().__call__(x)

@@ -1,0 +1,203 @@
+"""Model-parallel topology state.
+
+Reference: apex/transformer/parallel_state.py — builds NCCL process groups
+for the TP×PP×DP grid (``initialize_model_parallel`` :81, group getters
+:336-644, ``destroy_model_parallel`` :646). On TPU the topology is one
+``jax.sharding.Mesh`` with named axes ('pp','dp','sp','tp'); "groups" are
+axis names, and rank-within-group is ``jax.lax.axis_index`` (meaningful
+only inside a mapped computation — SPMD runs one program on all devices).
+
+World sizes are static (mesh shape) and available everywhere; rank getters
+return traced values inside ``shard_map``/GSPMD contexts, mirroring the
+reference's rank queries at the sites that need them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+from apex_tpu.parallel.mesh import create_mesh
+
+__all__ = [
+    "initialize_model_parallel",
+    "model_parallel_is_initialized",
+    "destroy_model_parallel",
+    "get_mesh",
+    "get_tensor_model_parallel_world_size",
+    "get_pipeline_model_parallel_world_size",
+    "get_data_parallel_world_size",
+    "get_context_parallel_world_size",
+    "get_tensor_model_parallel_rank",
+    "get_pipeline_model_parallel_rank",
+    "get_data_parallel_rank",
+    "get_virtual_pipeline_model_parallel_rank",
+    "set_virtual_pipeline_model_parallel_rank",
+    "get_virtual_pipeline_model_parallel_world_size",
+    "is_pipeline_first_stage",
+    "is_pipeline_last_stage",
+    "get_pipeline_model_parallel_split_rank",
+    "get_rank_info",
+    "TP_AXIS",
+    "PP_AXIS",
+    "DP_AXIS",
+    "SP_AXIS",
+]
+
+TP_AXIS = "tp"
+PP_AXIS = "pp"
+DP_AXIS = "dp"
+SP_AXIS = "sp"
+
+
+class _State:
+    mesh: Optional[Mesh] = None
+    virtual_pipeline_model_parallel_size: Optional[int] = None
+    virtual_pipeline_model_parallel_rank: Optional[int] = None
+    pipeline_model_parallel_split_rank: Optional[int] = None
+
+
+_STATE = _State()
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size_: int = 1,
+    pipeline_model_parallel_size_: int = 1,
+    virtual_pipeline_model_parallel_size_: Optional[int] = None,
+    pipeline_model_parallel_split_rank_: Optional[int] = None,
+    context_parallel_size: int = 1,
+    *,
+    devices=None,
+) -> Mesh:
+    """Build and install the global mesh (reference parallel_state.py:81).
+
+    ``context_parallel_size`` maps to the 'sp' axis — the long-context
+    sequence/ring-attention axis the reference lacks.
+    Returns the mesh (also retrievable via :func:`get_mesh`).
+    """
+    mesh = create_mesh(
+        tp=tensor_model_parallel_size_,
+        pp=pipeline_model_parallel_size_,
+        sp=context_parallel_size,
+        devices=devices,
+    )
+    _STATE.mesh = mesh
+    _STATE.virtual_pipeline_model_parallel_size = (
+        virtual_pipeline_model_parallel_size_
+    )
+    _STATE.virtual_pipeline_model_parallel_rank = (
+        0 if virtual_pipeline_model_parallel_size_ is not None else None
+    )
+    _STATE.pipeline_model_parallel_split_rank = (
+        pipeline_model_parallel_split_rank_
+    )
+    return mesh
+
+
+def model_parallel_is_initialized() -> bool:
+    return _STATE.mesh is not None
+
+
+def destroy_model_parallel() -> None:
+    """reference parallel_state.py:646."""
+    _STATE.mesh = None
+    _STATE.virtual_pipeline_model_parallel_size = None
+    _STATE.virtual_pipeline_model_parallel_rank = None
+    _STATE.pipeline_model_parallel_split_rank = None
+
+
+def get_mesh() -> Mesh:
+    if _STATE.mesh is None:
+        raise RuntimeError(
+            "model parallel is not initialized; call "
+            "initialize_model_parallel() first"
+        )
+    return _STATE.mesh
+
+
+def _axis_size(axis: str) -> int:
+    return get_mesh().shape[axis]
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _axis_size(TP_AXIS)
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _axis_size(PP_AXIS)
+
+
+def get_data_parallel_world_size() -> int:
+    return _axis_size(DP_AXIS)
+
+
+def get_context_parallel_world_size() -> int:
+    return _axis_size(SP_AXIS)
+
+
+def _axis_index(axis: str):
+    """Traced rank — valid inside shard_map/pmap over the mesh."""
+    return jax.lax.axis_index(axis)
+
+
+def get_tensor_model_parallel_rank():
+    return _axis_index(TP_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return _axis_index(PP_AXIS)
+
+
+def get_data_parallel_rank():
+    return _axis_index(DP_AXIS)
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return _STATE.virtual_pipeline_model_parallel_rank
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: Optional[int]) -> None:
+    _STATE.virtual_pipeline_model_parallel_rank = rank
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _STATE.virtual_pipeline_model_parallel_size
+
+
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    return _STATE.pipeline_model_parallel_split_rank
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    """Traced predicate (reference parallel_state.py:560). Inside a mapped
+    context this is a device-varying bool; with pp=1 it is statically True."""
+    if get_pipeline_model_parallel_world_size() == 1:
+        return True
+    if not ignore_virtual and _STATE.virtual_pipeline_model_parallel_size:
+        if _STATE.virtual_pipeline_model_parallel_rank != 0:
+            return False
+    return _axis_index(PP_AXIS) == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    if get_pipeline_model_parallel_world_size() == 1:
+        return True
+    vp = _STATE.virtual_pipeline_model_parallel_size
+    if not ignore_virtual and vp:
+        if _STATE.virtual_pipeline_model_parallel_rank != vp - 1:
+            return False
+    return _axis_index(PP_AXIS) == get_pipeline_model_parallel_world_size() - 1
+
+
+def get_rank_info() -> str:
+    """Compact topology string for log formatting
+    (reference parallel_state.py:313)."""
+    if not model_parallel_is_initialized():
+        return ""
+    m = get_mesh()
+    return (
+        f"[mesh pp={m.shape['pp']} dp={m.shape['dp']} "
+        f"sp={m.shape['sp']} tp={m.shape['tp']}]"
+    )

@@ -1,0 +1,80 @@
+"""Vocab-parallel cross entropy.
+
+Reference: apex/transformer/tensor_parallel/cross_entropy.py:23
+(``_VocabParallelCrossEntropy``): logits arrive vocab-sharded over the TP
+group; the stable CE runs as max-allreduce → masked local gather →
+sum-allreduce, and backward adjusts the local softmax without ever
+materializing the full-vocab logits on one rank.
+
+This is the shard_map (manual) form on the 'tp' axis. Under the GSPMD layer
+path, plain ``apex_tpu.ops.softmax_cross_entropy_loss`` on sharded logits
+partitions to the same collectives automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TP_AXIS
+
+__all__ = ["vocab_parallel_cross_entropy"]
+
+
+def _fwd_math(logits, target, axis):
+    """Returns (loss, residuals). logits: [..., vocab/tp] local shard."""
+    n = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    vocab_local = logits.shape[-1]
+    x = logits.astype(jnp.float32)
+
+    # 1. global max for stability (max-allreduce, reference :31-36)
+    lmax = jax.lax.pmax(jnp.max(x, axis=-1), axis)
+    x = x - lmax[..., None]
+
+    # 2. local masked pick of the target logit (reference :38-55)
+    vocab_start = rank * vocab_local
+    local_idx = target - vocab_start
+    in_range = (local_idx >= 0) & (local_idx < vocab_local)
+    picked = jnp.take_along_axis(
+        x, jnp.clip(local_idx, 0, vocab_local - 1)[..., None].astype(jnp.int32),
+        axis=-1,
+    )[..., 0]
+    picked = jnp.where(in_range, picked, 0.0)
+    picked = jax.lax.psum(picked, axis)          # sum-allreduce
+
+    # 3. global log-sum-exp (sum-allreduce, reference :57-62)
+    sum_exp = jax.lax.psum(jnp.sum(jnp.exp(x), axis=-1), axis)
+    loss = jnp.log(sum_exp) - picked
+    return loss, (x, sum_exp, local_idx, in_range)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def vocab_parallel_cross_entropy(vocab_parallel_logits, target,
+                                 axis=TP_AXIS):
+    loss, _ = _fwd_math(vocab_parallel_logits, target, axis)
+    return loss
+
+
+def _vp_fwd(logits, target, axis):
+    loss, res = _fwd_math(logits, target, axis)
+    # zero-size array carries the original dtype through the residuals
+    # (a raw dtype object is not a valid jax residual type)
+    dtype_token = jnp.zeros((0,), logits.dtype)
+    return loss, (res, dtype_token)
+
+
+def _vp_bwd(axis, carry, g):
+    (x, sum_exp, local_idx, in_range), dtype_token = carry
+    probs = jnp.exp(x) / sum_exp[..., None]
+    onehot = (
+        jax.nn.one_hot(local_idx, x.shape[-1], dtype=jnp.float32)
+        * in_range[..., None]
+    )
+    dx = (probs - onehot) * g.astype(jnp.float32)[..., None]
+    return dx.astype(dtype_token.dtype), None
+
+
+vocab_parallel_cross_entropy.defvjp(_vp_fwd, _vp_bwd)

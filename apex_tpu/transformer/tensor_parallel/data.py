@@ -1,0 +1,35 @@
+"""Data broadcast utilities.
+
+Reference: apex/transformer/tensor_parallel/data.py:80 ``broadcast_data`` —
+rank 0 of each TP group torch-broadcasts the batch so TP peers see
+identical data. Under SPMD every device already receives the same program
+inputs; replication across 'tp' is a sharding fact, not a runtime copy. The
+function survives as a sharding constraint (and a shape/dtype check mirror
+of the reference's ``_check_data_types``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer.tensor_parallel.layers import constrain
+
+__all__ = ["broadcast_data"]
+
+
+def broadcast_data(keys, data: Dict[str, jax.Array], datatype=None):
+    """Constrain each ``data[key]`` replicated over 'tp'
+    (no-op outside a mesh context)."""
+    out = {}
+    for k in keys:
+        v = data[k]
+        if datatype is not None and v.dtype != datatype:
+            raise TypeError(
+                f"broadcast_data: {k} has dtype {v.dtype}, expected {datatype}"
+            )
+        out[k] = constrain(v, P(*([None] * v.ndim)))
+    return out

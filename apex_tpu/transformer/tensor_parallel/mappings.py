@@ -1,0 +1,197 @@
+"""The 8 tensor-parallel collective mappings.
+
+Reference: apex/transformer/tensor_parallel/mappings.py:23-292 — autograd
+Functions pairing a forward collective with its transpose in backward:
+
+| mapping                                   | fwd             | bwd            |
+|-------------------------------------------|-----------------|----------------|
+| copy_to_tensor_model_parallel_region      | identity        | all-reduce     |
+| reduce_from_tensor_model_parallel_region  | all-reduce      | identity       |
+| scatter_to_tensor_model_parallel_region   | split last dim  | all-gather     |
+| gather_from_tensor_model_parallel_region  | all-gather last | split          |
+| scatter_to_sequence_parallel_region       | split first dim | all-gather     |
+| gather_from_sequence_parallel_region      | all-gather first| reduce-scatter*|
+| reduce_scatter_to_sequence_parallel_region| reduce-scatter  | all-gather     |
+| (copy's sequence-parallel dual is the * case: to_model_parallel_region
+|  =False makes the backward a plain split)                                |
+
+Implemented as custom-VJP functions over ``jax.lax`` collectives, usable
+inside ``shard_map`` on the 'tp' axis. jax≥0.9 varying-axes typing is kept
+consistent: identities that move a value into per-shard compute insert
+``pvary``; reductions produce axis-invariant values.
+
+(The GSPMD layer path — apex_tpu.transformer.tensor_parallel.layers — does
+not call these; XLA inserts the same collectives from sharding annotations.
+These exist for manual shard_map programming and 1:1 reference parity.)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TP_AXIS
+
+__all__ = [
+    "copy_to_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+]
+
+
+def _pvary(x, axis):
+    try:
+        return jax.lax.pcast(x, axis, to="varying")
+    except Exception:
+        return x
+
+
+def _split_along(x, dim, axis):
+    """Local shard of x along ``dim`` for this tp rank
+    (reference _split_along_last_dim :40 / _split_along_first_dim :55)."""
+    n = jax.lax.axis_size(axis)
+    rank = jax.lax.axis_index(axis)
+    size = x.shape[dim] // n
+    return jax.lax.dynamic_slice_in_dim(x, rank * size, size, axis=dim)
+
+
+# ---- copy (f): identity fwd, allreduce bwd  (mappings.py:133) -------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis=TP_AXIS):
+    return _pvary(x, axis)
+
+
+def _copy_fwd(x, axis):
+    return _pvary(x, axis), None
+
+
+def _copy_bwd(axis, _, g):
+    return (jax.lax.psum(g, axis),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+# ---- reduce (g): allreduce fwd, identity bwd  (mappings.py:152) -----------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis=TP_AXIS):
+    return jax.lax.psum(x, axis)
+
+
+def _reduce_fwd(x, axis):
+    return jax.lax.psum(x, axis), None
+
+
+def _reduce_bwd(axis, _, g):
+    return (_pvary(g, axis),)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# ---- scatter/gather along the LAST dim (mappings.py:170,196) --------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis=TP_AXIS):
+    return _split_along(_pvary(x, axis), -1, axis)
+
+
+def _scatter_fwd(x, axis):
+    return _split_along(_pvary(x, axis), -1, axis), None
+
+
+def _scatter_bwd(axis, _, g):
+    return (jax.lax.all_gather(g, axis, axis=g.ndim - 1, tiled=True),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis=TP_AXIS):
+    return jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
+
+
+def _gather_fwd(x, axis):
+    return jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True), None
+
+
+def _gather_bwd(axis, _, g):
+    return (_split_along(g, -1, axis),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# ---- sequence-parallel: FIRST dim (mappings.py:55,95,114,223,245) ---------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, axis=TP_AXIS):
+    return _split_along(_pvary(x, axis), 0, axis)
+
+
+def _sp_scatter_fwd(x, axis):
+    return _split_along(_pvary(x, axis), 0, axis), None
+
+
+def _sp_scatter_bwd(axis, _, g):
+    return (jax.lax.all_gather(g, axis, axis=0, tiled=True),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(
+    x, to_model_parallel: bool = True, axis=TP_AXIS
+):
+    """fwd: all-gather along dim 0. bwd: reduce-scatter when the gathered
+    value feeds tensor-parallel compute (reference
+    _GatherFromSequenceParallelRegion :223, to_model_parallel flag), else a
+    plain split."""
+    return jax.lax.all_gather(x, axis, axis=0, tiled=True)
+
+
+def _sp_gather_fwd(x, to_model_parallel, axis):
+    return jax.lax.all_gather(x, axis, axis=0, tiled=True), None
+
+
+def _sp_gather_bwd(to_model_parallel, axis, _, g):
+    if to_model_parallel:
+        return (jax.lax.psum_scatter(g, axis, scatter_dimension=0,
+                                     tiled=True),)
+    return (_split_along(g, 0, axis),)
+
+
+gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis=TP_AXIS):
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+
+
+def _sp_rs_fwd(x, axis):
+    return (
+        jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True),
+        None,
+    )
+
+
+def _sp_rs_bwd(axis, _, g):
+    return (_pvary(jax.lax.all_gather(g, axis, axis=0, tiled=True), axis),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)

@@ -1,0 +1,104 @@
+"""Parallel RNG state tracking + activation checkpointing.
+
+Reference: apex/transformer/tensor_parallel/random.py —
+``CudaRNGStatesTracker`` (:124) forks a named RNG state per region so
+dropout differs across TP ranks where it must ('model-parallel-rng') and
+matches where it must (default state); ``model_parallel_cuda_manual_seed``
+(:204) seeds both; ``CheckpointFunction`` (:237) re-plays RNG states during
+activation recompute.
+
+JAX translation: randomness is explicit keys, so the tracker deals in
+``jax.random.PRNGKey``s — the model-parallel key folds in the tp rank
+(``fold_in(axis_index)``), the default key is shared. Recompute-correctness
+is free: ``jax.checkpoint`` replays the same traced key uses. The tracker
+exists for API parity and for code that wants named streams.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TP_AXIS
+
+__all__ = [
+    "RNGStatesTracker",
+    "get_rng_tracker",
+    "model_parallel_seed",
+    "checkpoint",
+    "CheckpointFunction",
+]
+
+_MODEL_PARALLEL_RNG = "model-parallel-rng"
+
+
+class RNGStatesTracker:
+    """Named PRNG-key streams (reference CudaRNGStatesTracker :124)."""
+
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+
+    def reset(self):
+        self.states_.clear()
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name: str, key):
+        if name in self.states_:
+            raise ValueError(f"rng state {name!r} already exists")
+        self.states_[name] = key
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG):
+        """Yield the stream's key and advance it (the mutable-state analog
+        of the reference's fork context manager :154)."""
+        if name not in self.states_:
+            raise KeyError(f"rng state {name!r} was never seeded")
+        key = self.states_[name]
+        key, sub = jax.random.split(key)
+        self.states_[name] = key
+        yield sub
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    """reference get_cuda_rng_tracker (:183)."""
+    return _TRACKER
+
+
+def model_parallel_seed(seed: int, axis: str = TP_AXIS):
+    """Seed default + model-parallel streams
+    (reference model_parallel_cuda_manual_seed :204).
+
+    Inside a mapped computation the model-parallel key folds in the tp
+    rank (2718 offset mirrors the reference's +2718); outside, it folds a
+    zero (single shard).
+    """
+    _TRACKER.reset()
+    base = jax.random.PRNGKey(seed)
+    try:
+        rank = jax.lax.axis_index(axis)
+    except NameError:
+        rank = jnp.zeros((), jnp.int32)
+    _TRACKER.add("default", base)
+    _TRACKER.add(
+        _MODEL_PARALLEL_RNG, jax.random.fold_in(base, 2718 + rank)
+    )
+    return _TRACKER
+
+
+# Activation checkpointing: jax.checkpoint already saves/replays RNG uses
+# deterministically, which is the entire hard part of the reference's
+# CheckpointFunction (random.py:237-305 — saving CPU+CUDA+tracker states
+# around the recompute). Re-exported under the reference name.
+checkpoint = jax.checkpoint
+CheckpointFunction = jax.checkpoint

@@ -1,0 +1,24 @@
+"""Small helpers (reference apex/transformer/utils.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["ensure_divisibility", "divide", "split_tensor_along_last_dim"]
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    if numerator % denominator != 0:
+        raise ValueError(
+            f"{numerator} is not divisible by {denominator}"
+        )
+
+
+def divide(numerator: int, denominator: int) -> int:
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(tensor, num_partitions: int):
+    """reference utils.py split (contiguity flags are meaningless here)."""
+    return jnp.split(tensor, num_partitions, axis=-1)

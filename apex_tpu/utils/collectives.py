@@ -1,0 +1,71 @@
+"""Collective helpers aware of SPMD autodiff semantics.
+
+Under ``jax.shard_map`` with varying-axes tracking (jax ≥0.9), gradients
+taken w.r.t. *replicated* (axis-invariant) parameters are ALREADY summed
+over the mapped axis — the transpose of the implicit broadcast inserts the
+psum. A DDP layer that blindly psums again double-counts (verified on the
+8-device mesh: explicit psum after jax.grad yields 8× gradients).
+
+These helpers consult ``jax.typeof(x).vma`` (the set of mesh axes a value
+varies over) to apply a collective only when the value is still
+shard-varying, and a plain division when SPMD-AD has pre-summed.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["is_varying", "grad_mean", "grad_sum", "flag_and", "flag_or"]
+
+
+def is_varying(x, axis_name: str) -> bool:
+    """True if ``x`` still differs across shards of ``axis_name``."""
+    try:
+        return axis_name in jax.typeof(x).vma
+    except AttributeError:
+        # Outside shard_map / older tracer: assume varying (legacy pmap
+        # semantics) — callers get an explicit collective.
+        return True
+
+
+def grad_sum(tree: Any, axis_name: str) -> Any:
+    """Sum grads over the axis (no-op when SPMD-AD already summed)."""
+
+    def red(g):
+        if not hasattr(g, "dtype") or not jnp.issubdtype(g.dtype, jnp.inexact):
+            return g
+        if is_varying(g, axis_name):
+            return jax.lax.psum(g, axis_name)
+        return g
+
+    return jax.tree_util.tree_map(red, tree)
+
+
+def grad_mean(tree: Any, axis_name: str) -> Any:
+    """Average grads over the axis, whether or not they were pre-summed."""
+    n = jax.lax.axis_size(axis_name)
+
+    def red(g):
+        if not hasattr(g, "dtype") or not jnp.issubdtype(g.dtype, jnp.inexact):
+            return g
+        if is_varying(g, axis_name):
+            return jax.lax.pmean(g, axis_name)
+        return g / n
+
+    return jax.tree_util.tree_map(red, tree)
+
+
+def flag_and(flag, axis_name: str):
+    """AND a boolean flag across shards (found-inf combining)."""
+    if is_varying(flag, axis_name):
+        return jax.lax.pmin(flag.astype(jnp.int32), axis_name) > 0
+    return flag
+
+
+def flag_or(flag, axis_name: str):
+    if is_varying(flag, axis_name):
+        return jax.lax.pmax(flag.astype(jnp.int32), axis_name) > 0
+    return flag

@@ -92,12 +92,20 @@ def test_causal_requires_square():
 
 
 def test_generic_alias_and_fully_masked_row():
-    # A fully-masked row softmaxes the -10000 fills to a uniform dist —
-    # matching the reference kernel (no NaNs).
+    # Fully-masked rows emit ZEROS — the reference kernels set
+    # scale_value=0 when a row's max is the mask fill
+    # (scaled_masked_softmax.h:304).
     x = jnp.ones((1, 1, 2, 128))
     mask = jnp.ones((1, 1, 2, 128), bool)
     y = generic_scaled_masked_softmax(x, mask, 1.0)
-    np.testing.assert_allclose(np.asarray(y), 1.0 / 128, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(y), 0.0, atol=1e-6)
+
+    # partially-masked rows still sum to 1
+    mask2 = mask.at[..., :64].set(False)
+    y2 = generic_scaled_masked_softmax(x, mask2, 1.0)
+    np.testing.assert_allclose(
+        np.asarray(jnp.sum(y2, -1)), 1.0, atol=1e-6
+    )
 
 
 def test_pallas_interpret_matches_ref(monkeypatch):
