@@ -1,0 +1,62 @@
+"""Model-parallel-aware grad scaler.
+
+Reference: apex/transformer/amp/grad_scaler.py:21 — a GradScaler subclass
+whose found-inf check allreduces the flag across the TP and PP groups so
+every shard of a model skips the step together.
+
+Here: the same ``LossScaleState`` machinery as ``apex_tpu.amp`` with the
+flag combined over any set of mesh axes (vma-aware; see
+utils/collectives.py).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+
+from apex_tpu.amp import scaler as scaler_lib
+from apex_tpu.utils.collectives import flag_or
+
+__all__ = ["GradScaler", "combine_found_inf"]
+
+
+def combine_found_inf(found_inf, axes: Sequence[str] = ("tp", "pp")):
+    """OR the overflow flag across model-parallel axes
+    (reference grad_scaler.py:55-70 allreduce MAX)."""
+    for axis in axes:
+        found_inf = flag_or(found_inf, axis)
+    return found_inf
+
+
+class GradScaler:
+    """Functional scaler bundle with model-parallel found-inf combining.
+
+    Usage inside the mapped train step::
+
+        gs = GradScaler(axes=("tp", "pp"))
+        cfg, state = gs.init()
+        scaled = gs.scale(loss, state)
+        grads, finite = gs.unscale(grads, state)
+        state, skip = gs.update(cfg, state, ~finite)
+    """
+
+    def __init__(self, loss_scale="dynamic",
+                 axes: Sequence[str] = ("tp", "pp"), **kwargs):
+        self.loss_scale = loss_scale
+        self.kwargs = kwargs
+        self.axes = tuple(axes)
+
+    def init(self) -> Tuple[scaler_lib.LossScaleConfig,
+                            scaler_lib.LossScaleState]:
+        return scaler_lib.init_loss_scale(self.loss_scale, **self.kwargs)
+
+    def scale(self, loss, state):
+        return scaler_lib.scale_loss(loss, state)
+
+    def unscale(self, grads, state):
+        return scaler_lib.unscale_grads(grads, state)
+
+    def update(self, cfg, state, found_inf):
+        found_inf = combine_found_inf(found_inf, self.axes)
+        return scaler_lib.update_loss_scale(cfg, state, found_inf)

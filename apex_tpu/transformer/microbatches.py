@@ -1,0 +1,127 @@
+"""Microbatch calculators.
+
+Reference: apex/transformer/microbatches.py — ``ConstantNumMicroBatches``
+(:93) and ``RampupBatchsizeNumMicroBatches`` (:112), built by
+``build_num_microbatches_calculator`` (:24).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = [
+    "build_num_microbatches_calculator",
+    "ConstantNumMicroBatches",
+    "RampupBatchsizeNumMicroBatches",
+]
+
+
+class NumMicroBatchesCalculator:
+    num_micro_batches: int
+    current_global_batch_size: int
+
+    def get(self) -> int:
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self) -> int:
+        return self.current_global_batch_size
+
+    def update(self, consumed_samples, consistency_check):
+        pass
+
+
+class ConstantNumMicroBatches(NumMicroBatchesCalculator):
+    """reference microbatches.py:93."""
+
+    def __init__(self, global_batch_size: int, micro_batch_size: int,
+                 data_parallel_size: int):
+        micro_batch_times_dp = micro_batch_size * data_parallel_size
+        if global_batch_size % micro_batch_times_dp != 0:
+            raise ValueError(
+                f"global batch size ({global_batch_size}) is not divisible "
+                f"by micro batch size ({micro_batch_size}) times "
+                f"data parallel size ({data_parallel_size})"
+            )
+        self.num_micro_batches = global_batch_size // micro_batch_times_dp
+        self.current_global_batch_size = global_batch_size
+
+
+class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
+    """Linear global-batch-size rampup (reference microbatches.py:112)."""
+
+    def __init__(self, start_batch_size: int, batch_size_increment: int,
+                 ramup_samples: int, global_batch_size: int,
+                 micro_batch_size: int, data_parallel_size: int):
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.start_batch_size = start_batch_size
+        self.batch_size_increment = batch_size_increment
+        self.ramup_samples = ramup_samples
+        self.global_batch_size = global_batch_size
+        diff = global_batch_size - start_batch_size
+        if diff < 0 or diff % batch_size_increment != 0:
+            raise ValueError(
+                "expected global batch size to be reachable from "
+                "start_batch_size by increments of batch_size_increment"
+            )
+        self.micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size
+        )
+        self.rampup_samples_per_increment = (
+            ramup_samples / (diff / batch_size_increment) if diff > 0 else 0
+        )
+        self.update(0, False)
+
+    def update(self, consumed_samples: int, consistency_check: bool):
+        if consumed_samples > self.ramup_samples or (
+            self.rampup_samples_per_increment == 0
+        ):
+            current = self.global_batch_size
+        else:
+            steps = int(
+                consumed_samples // self.rampup_samples_per_increment
+            )
+            current = min(
+                self.global_batch_size,
+                self.start_batch_size + steps * self.batch_size_increment,
+            )
+        if consistency_check and (
+            current % self.micro_batch_times_data_parallel_size != 0
+        ):
+            raise ValueError(
+                f"current global batch size ({current}) is not divisible "
+                "by micro-batch-size * data-parallel-size"
+            )
+        if current < self.micro_batch_times_data_parallel_size:
+            raise ValueError(
+                f"current global batch size ({current}) is smaller than "
+                "micro-batch-size * data-parallel-size "
+                f"({self.micro_batch_times_data_parallel_size}); lower the "
+                "micro batch size or raise start_batch_size"
+            )
+        self.num_micro_batches = (
+            current // self.micro_batch_times_data_parallel_size
+        )
+        self.current_global_batch_size = current
+
+
+def build_num_microbatches_calculator(
+    rampup_batch_size: Optional[Sequence[int]],
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+) -> NumMicroBatchesCalculator:
+    """reference microbatches.py:24."""
+    if rampup_batch_size is None:
+        return ConstantNumMicroBatches(
+            global_batch_size, micro_batch_size, data_parallel_size
+        )
+    if len(rampup_batch_size) != 3:
+        raise ValueError(
+            "rampup_batch_size must be [start, increment, samples]"
+        )
+    start, inc, samples = (int(v) for v in rampup_batch_size)
+    return RampupBatchsizeNumMicroBatches(
+        start, inc, samples, global_batch_size, micro_batch_size,
+        data_parallel_size,
+    )

@@ -1,0 +1,22 @@
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import (  # noqa: F401
+    recv_backward,
+    recv_forward,
+    send_backward,
+    send_backward_recv_backward,
+    send_forward,
+    send_forward_recv_forward,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    pipeline_forward,
+)
+from apex_tpu.transformer.pipeline_parallel.utils import (  # noqa: F401
+    average_losses_across_data_parallel_group,
+    get_num_microbatches,
+    report_memory,
+    setup_microbatch_calculator,
+    split_batch_into_microbatches,
+)

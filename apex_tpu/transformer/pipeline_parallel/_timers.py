@@ -1,0 +1,97 @@
+"""Timers (reference apex/transformer/pipeline_parallel/_timers.py:6,51 —
+``_Timer``/``_Timers`` with barrier-synced elapsed and TensorBoard write).
+
+On TPU a "barrier" is ``jax.block_until_ready`` on the values produced by
+the timed region — actual tracing/compile time is excluded on steady-state
+steps. TensorBoard writing is delegated to the caller (no torch SummaryWriter
+here); ``write`` returns the scalars instead.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+
+__all__ = ["Timer", "Timers", "get_timers"]
+
+
+class Timer:
+    def __init__(self, name: str):
+        self.name_ = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self._start_time = 0.0
+
+    def start(self, barrier_obj=None):
+        if self.started_:
+            raise RuntimeError(f"timer {self.name_} has already been started")
+        if barrier_obj is not None:
+            jax.block_until_ready(barrier_obj)
+        self._start_time = time.perf_counter()
+        self.started_ = True
+
+    def stop(self, barrier_obj=None):
+        if not self.started_:
+            raise RuntimeError(f"timer {self.name_} is not started")
+        if barrier_obj is not None:
+            jax.block_until_ready(barrier_obj)
+        self.elapsed_ += time.perf_counter() - self._start_time
+        self.started_ = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        was_started = self.started_
+        if was_started:
+            self.stop()
+        value = self.elapsed_
+        if reset:
+            self.reset()
+        if was_started:
+            self.start()
+        return value
+
+
+class Timers:
+    def __init__(self):
+        self.timers: Dict[str, Timer] = {}
+
+    def __call__(self, name: str) -> Timer:
+        if name not in self.timers:
+            self.timers[name] = Timer(name)
+        return self.timers[name]
+
+    def write(self, names, iteration: int, normalizer: float = 1.0,
+              reset: bool = False) -> Dict[str, float]:
+        """Return {name: seconds/normalizer} (caller logs it;
+        reference writes to TensorBoard)."""
+        assert normalizer > 0.0
+        return {
+            name: self.timers[name].elapsed(reset=reset) / normalizer
+            for name in names if name in self.timers
+        }
+
+    def log(self, names, normalizer: float = 1.0, reset: bool = True) -> str:
+        assert normalizer > 0.0
+        parts = ["time (ms)"]
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"| {name}: {ms:.2f}")
+        line = " ".join(parts)
+        from apex_tpu.utils.logging import print_rank_0
+
+        print_rank_0(line)
+        return line
+
+
+_TIMERS = Timers()
+
+
+def get_timers() -> Timers:
+    """reference pipeline_parallel/utils.py:153."""
+    return _TIMERS

@@ -1,0 +1,68 @@
+"""Stage-to-stage communication primitives.
+
+Reference: apex/transformer/pipeline_parallel/p2p_communication.py —
+``_communicate`` (:124) batches torch.distributed isend/irecv pairs between
+pipeline neighbors (``_run_p2pops`` :48), negotiates shapes (seq-parallel
+division included), and returns ``FutureTensor``s for async variants.
+
+TPU translation: neighbor exchange is ``lax.ppermute`` over the 'pp' mesh
+axis inside the jitted step. There is no shape negotiation (shapes are
+static under jit), no async API surface (XLA's latency-hiding scheduler
+overlaps the collective with compute), and no process boundary visible to
+user code. The send/recv names survive as thin ppermute wrappers so
+schedule code reads like the reference.
+
+All functions must run inside shard_map with the 'pp' axis bound. A
+"recv" is the same ppermute as its paired "send" — under SPMD both sides
+execute the identical collective; the wrappers differ only in which
+direction the permutation points.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PP_AXIS
+
+__all__ = [
+    "send_forward_recv_forward",
+    "send_backward_recv_backward",
+    "send_forward",
+    "recv_forward",
+    "send_backward",
+    "recv_backward",
+]
+
+
+def _shift(x: Any, axis: str, step: int) -> Any:
+    """ppermute every leaf by ``step`` along the pp ring (non-wrapping ends
+    receive zeros, like a silent recv of nothing)."""
+    n = jax.lax.axis_size(axis)
+    perm = [(i, i + step) for i in range(n) if 0 <= i + step < n]
+
+    def leaf(v):
+        return jax.lax.ppermute(v, axis, perm)
+
+    return jax.tree_util.tree_map(leaf, x)
+
+
+def send_forward_recv_forward(x: Any, axis: str = PP_AXIS) -> Any:
+    """Ship activations to the next stage; receive from the previous
+    (reference _communicate with both tensors set)."""
+    return _shift(x, axis, +1)
+
+
+def send_backward_recv_backward(g: Any, axis: str = PP_AXIS) -> Any:
+    """Ship gradients to the previous stage; receive from the next."""
+    return _shift(g, axis, -1)
+
+
+# Under SPMD a lone send or recv is still the same collective — aliases
+# keep reference-looking schedule code readable.
+send_forward = send_forward_recv_forward
+recv_forward = send_forward_recv_forward
+send_backward = send_backward_recv_backward
+recv_backward = send_backward_recv_backward

@@ -1,0 +1,105 @@
+"""Pipeline utilities.
+
+Reference: apex/transformer/pipeline_parallel/utils.py — the microbatch
+calculator singleton (:58,:92), loss averaging over DP (:242),
+``report_memory`` (:253), rank-0 printing (:159,:172).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.microbatches import (
+    NumMicroBatchesCalculator,
+    build_num_microbatches_calculator,
+)
+from apex_tpu.utils.logging import print_rank_0  # noqa: F401  (re-export)
+
+__all__ = [
+    "setup_microbatch_calculator",
+    "get_num_microbatches",
+    "get_current_global_batch_size",
+    "update_num_microbatches",
+    "average_losses_across_data_parallel_group",
+    "report_memory",
+    "print_rank_0",
+    "split_batch_into_microbatches",
+]
+
+_CALCULATOR: Optional[NumMicroBatchesCalculator] = None
+
+
+def setup_microbatch_calculator(
+    rank: int,
+    rampup_batch_size: Optional[Sequence[int]],
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+) -> None:
+    """reference utils.py:58 (rank arg kept for signature parity)."""
+    global _CALCULATOR
+    _CALCULATOR = build_num_microbatches_calculator(
+        rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size,
+    )
+
+
+def _get() -> NumMicroBatchesCalculator:
+    if _CALCULATOR is None:
+        raise RuntimeError(
+            "microbatch calculator is not set up; call "
+            "setup_microbatch_calculator() first"
+        )
+    return _CALCULATOR
+
+
+def get_num_microbatches() -> int:
+    return _get().get()
+
+
+def get_current_global_batch_size() -> int:
+    return _get().get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples: int,
+                            consistency_check: bool = True) -> None:
+    _get().update(consumed_samples, consistency_check)
+
+
+def average_losses_across_data_parallel_group(losses, axis: str = "dp"):
+    """reference utils.py:242 — must run inside the mapped context."""
+    stacked = jnp.stack([jnp.asarray(l, jnp.float32) for l in losses])
+    return jax.lax.pmean(stacked, axis)
+
+
+def report_memory(name: str = "") -> str:
+    """Device-memory report (reference utils.py:253 reports CUDA stats)."""
+    lines = [f"memory report{(' ' + name) if name else ''}:"]
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats() or {}
+            used = stats.get("bytes_in_use", 0) / 2**30
+            limit = stats.get("bytes_limit", 0) / 2**30
+            lines.append(f"  {d}: {used:.2f}/{limit:.2f} GiB in use")
+        except Exception:
+            lines.append(f"  {d}: memory stats unavailable")
+    report = "\n".join(lines)
+    print_rank_0(report)
+    return report
+
+
+def split_batch_into_microbatches(batch, n_micro: int):
+    """[B, ...] → [n_micro, B/n_micro, ...] for the schedule functions."""
+
+    def leaf(v):
+        b = v.shape[0]
+        if b % n_micro != 0:
+            raise ValueError(
+                f"batch dim {b} not divisible by n_micro={n_micro}"
+            )
+        return v.reshape(n_micro, b // n_micro, *v.shape[1:])
+
+    return jax.tree_util.tree_map(leaf, batch)

@@ -45,11 +45,7 @@ __all__ = [
 ]
 
 
-def _pvary(x, axis):
-    try:
-        return jax.lax.pcast(x, axis, to="varying")
-    except Exception:
-        return x
+from apex_tpu.utils.collectives import pvary as _pvary  # noqa: E402
 
 
 def _split_along(x, dim, axis):

@@ -18,7 +18,34 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-__all__ = ["is_varying", "grad_mean", "grad_sum", "flag_and", "flag_or"]
+__all__ = [
+    "is_varying",
+    "grad_mean",
+    "grad_sum",
+    "flag_and",
+    "flag_or",
+    "pvary",
+]
+
+
+def pvary(tree, axis_name: str):
+    """Type values as varying over ``axis_name`` (jax≥0.9 vma typing).
+
+    No-op for leaves already varying or outside a mapped context. This is
+    the single home for the pcast-to-varying dance (used by the TP mappings
+    and the pipeline scan carries).
+    """
+
+    def leaf(v):
+        if is_varying(v, axis_name):
+            return v
+        try:
+            return jax.lax.pcast(v, axis_name, to="varying")
+        except NameError:
+            # axis not bound (outside shard_map) — nothing to type
+            return v
+
+    return jax.tree_util.tree_map(leaf, tree)
 
 
 def is_varying(x, axis_name: str) -> bool:
