@@ -1,0 +1,30 @@
+"""apex_tpu.models — the standalone model family.
+
+Reference: apex/transformer/testing/{standalone_transformer_lm.py,
+standalone_gpt.py, standalone_bert.py} — the in-repo Megatron LM used by
+every GPT/BERT minimal/integration test, rebuilt TPU-first (functional core,
+scan-over-layers, GSPMD or shard_map parallelism).
+"""
+
+from apex_tpu.models.config import (  # noqa: F401
+    TransformerConfig,
+    bert_large,
+    gpt_125m,
+    gpt_tiny,
+)
+from apex_tpu.models.gpt import (  # noqa: F401
+    gpt_pipeline_loss_and_grads,
+    make_gpt_pipeline_stage,
+    make_gpt_train_step,
+    pipeline_packet,
+    stack_pipeline_params,
+)
+from apex_tpu.models.transformer_lm import (  # noqa: F401
+    TPContext,
+    gpt_forward,
+    gpt_loss,
+    gpt_param_specs,
+    gspmd_ctx,
+    init_gpt_params,
+    manual_ctx,
+)

@@ -1,0 +1,116 @@
+"""Model configuration for the standalone transformer LM family.
+
+Reference: apex/transformer/testing/arguments.py (971 LoC of Megatron-style
+argparse) collapses here into one frozen dataclass — the only fields the
+standalone GPT/BERT models (standalone_transformer_lm.py:1358
+``TransformerLanguageModel``) actually consume, plus the TPU-specific knobs
+(dtypes, remat, scan-over-layers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+__all__ = ["TransformerConfig", "gpt_tiny", "gpt_125m", "bert_large"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Static hyperparameters of a ParallelTransformer LM.
+
+    Mirrors the subset of reference ``arguments.py`` used by
+    ``standalone_transformer_lm.py`` (hidden_size, num_layers,
+    num_attention_heads, ffn_hidden_size, kv_channels,
+    max_position_embeddings, padded_vocab_size, hidden_dropout,
+    attention_dropout, init_method_std,
+    untie_embeddings_and_output_weights…).
+    """
+
+    num_layers: int = 2
+    hidden_size: int = 128
+    num_attention_heads: int = 8
+    ffn_hidden_size: Optional[int] = None         # default 4*h (2/3*4h swiglu)
+    kv_channels: Optional[int] = None             # default h // nh
+    vocab_size: int = 1024                        # padded to tp divisibility
+    max_position_embeddings: int = 512
+
+    # architecture switches
+    attn_mask_type: str = "causal"                # 'causal' | 'padding'
+    activation: str = "gelu"                      # 'gelu' | 'swiglu'
+    position_embedding_type: str = "learned"      # 'learned' | 'rope'
+    normalization: str = "layernorm"              # 'layernorm' | 'rmsnorm'
+    untie_embeddings_and_output_weights: bool = False
+    layernorm_epsilon: float = 1e-5
+
+    # regularization
+    hidden_dropout: float = 0.0
+    attention_dropout: float = 0.0
+    init_method_std: float = 0.02
+
+    # numerics / TPU execution
+    params_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    softmax_in_fp32: bool = True
+    remat: bool = False                           # jax.checkpoint each layer
+    scan_layers: bool = True                      # lax.scan over the stack
+
+    # parallelism (static degrees; 1 = off)
+    tensor_model_parallel_size: int = 1
+    sequence_parallel: bool = False
+
+    def __post_init__(self):
+        if self.ffn_hidden_size is None:
+            ffn = (
+                int(4 * self.hidden_size * 2 / 3)
+                if self.activation == "swiglu"
+                else 4 * self.hidden_size
+            )
+            object.__setattr__(self, "ffn_hidden_size", ffn)
+        if self.kv_channels is None:
+            if self.hidden_size % self.num_attention_heads:
+                raise ValueError(
+                    "num_attention_heads must divide hidden_size when "
+                    "kv_channels is not given"
+                )
+            object.__setattr__(
+                self, "kv_channels",
+                self.hidden_size // self.num_attention_heads,
+            )
+
+    @property
+    def projection_size(self) -> int:
+        return self.kv_channels * self.num_attention_heads
+
+
+def gpt_tiny(**kw) -> TransformerConfig:
+    """Four-layer toy GPT for tests/dryruns."""
+    kw.setdefault("num_layers", 4)
+    kw.setdefault("hidden_size", 128)
+    kw.setdefault("num_attention_heads", 8)
+    kw.setdefault("vocab_size", 512)
+    kw.setdefault("max_position_embeddings", 128)
+    return TransformerConfig(**kw)
+
+
+def gpt_125m(**kw) -> TransformerConfig:
+    """GPT-2 125M — the reference's benchmark config
+    (BASELINE.json: 'GPT-2 125M: FusedLayerNorm + scaled softmax + RoPE')."""
+    kw.setdefault("num_layers", 12)
+    kw.setdefault("hidden_size", 768)
+    kw.setdefault("num_attention_heads", 12)
+    kw.setdefault("vocab_size", 50304)            # 50257 padded to 128
+    kw.setdefault("max_position_embeddings", 1024)
+    return TransformerConfig(**kw)
+
+
+def bert_large(**kw) -> TransformerConfig:
+    """BERT-large pretrain shape (BASELINE.json FusedLAMB config)."""
+    kw.setdefault("num_layers", 24)
+    kw.setdefault("hidden_size", 1024)
+    kw.setdefault("num_attention_heads", 16)
+    kw.setdefault("vocab_size", 30592)            # 30522 padded to 128
+    kw.setdefault("max_position_embeddings", 512)
+    return TransformerConfig(**kw)

@@ -1,0 +1,252 @@
+"""GPT model wiring: GSPMD train step + shard_map pipeline stages.
+
+Reference analogs: apex/transformer/testing/standalone_gpt.py (``GPTModel``
+:45, ``gpt_model_provider`` :33) and the minimal train loops in
+tests/L0/run_transformer/run_gpt_minimal_test.py. Two composition modes:
+
+- :func:`make_gpt_train_step` — GSPMD: one jitted AMP train step over a
+  ('pp','dp','sp','tp') mesh; dp+tp+sp come from sharding annotations
+  (pp stays 1 on this path).
+- :func:`make_gpt_pipeline_stage` / :func:`stack_pipeline_params` — the
+  shard_map path: the decoder is cut into ``pp`` stages driven by the
+  differentiable-scan schedules (pipeline_parallel/schedules.py), tensor
+  parallelism via the manual mapping collectives inside each stage.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu.amp.frontend import make_train_step
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.transformer_lm import (
+    apply_norm,
+    gpt_loss,
+    gpt_param_specs,
+    gspmd_ctx,
+    init_gpt_params,
+    lm_cross_entropy,
+    manual_ctx,
+    single_device_ctx,
+    transformer_backbone,
+    vocab_parallel_embed,
+)
+
+__all__ = [
+    "make_gpt_train_step",
+    "make_gpt_pipeline_stage",
+    "stack_pipeline_params",
+    "pipeline_packet",
+    "gpt_pipeline_loss_and_grads",
+]
+
+
+def make_gpt_train_step(
+    cfg: TransformerConfig,
+    optimizer: Any,
+    policy_or_amp="O2",
+    mesh: Optional[Mesh] = None,
+    *,
+    seq_axis: Optional[str] = None,
+    grad_postprocess: Optional[Callable] = None,
+):
+    """GSPMD data/tensor/sequence-parallel AMP train step.
+
+    Returns ``(init_fn, step_fn)``; both are jitted against ``mesh`` when
+    given. ``init_fn(rng)`` places params per :func:`gpt_param_specs`;
+    ``step_fn(state, tokens, labels)`` is the full O2-style AMP step
+    (scale → grad → unscale+finite-check → fused update → skip-on-overflow)
+    with gradient mean over 'dp' handled by GSPMD sharding propagation.
+    """
+    ctx = gspmd_ctx(seq_axis=seq_axis) if mesh is not None else None
+    has_dropout = cfg.hidden_dropout > 0 or cfg.attention_dropout > 0
+
+    if has_dropout:
+        # dropout key rides in the batch: step(state, tokens, labels, rng)
+        def loss_fn(params, tokens, labels, dropout_rng):
+            return gpt_loss(params, tokens, labels, cfg, ctx,
+                            dropout_rng=dropout_rng)
+    else:
+        def loss_fn(params, tokens, labels):
+            return gpt_loss(params, tokens, labels, cfg, ctx)
+
+    init_fn, step_fn = make_train_step(
+        loss_fn, optimizer, policy_or_amp,
+        grad_postprocess=grad_postprocess,
+    )
+
+    def init(rng):
+        params = init_gpt_params(rng, cfg)
+        if mesh is not None:
+            specs = gpt_param_specs(cfg)
+            params = jax.device_put(
+                params,
+                jax.tree_util.tree_map(
+                    lambda s: NamedSharding(mesh, s), specs,
+                    is_leaf=lambda x: isinstance(x, P),
+                ),
+            )
+        return init_fn(params)
+
+    if mesh is None:
+        return init, jax.jit(step_fn, donate_argnums=0)
+
+    batch_sharding = NamedSharding(mesh, P("dp", seq_axis))
+    shardings = (None, batch_sharding, batch_sharding)
+    if has_dropout:
+        shardings = shardings + (NamedSharding(mesh, P()),)
+    jstep = jax.jit(step_fn, in_shardings=shardings, donate_argnums=0)
+
+    def step(state, *batch):
+        # the mesh context activates the model's with_sharding_constraint
+        # annotations (bare PartitionSpecs need an ambient mesh)
+        with jax.set_mesh(mesh):
+            return jstep(state, *batch)
+
+    return init, step
+
+
+# ---------------------------------------------------------------------------
+# shard_map pipeline path
+# ---------------------------------------------------------------------------
+
+
+def pipeline_packet(tokens_mb: jax.Array, labels_mb: jax.Array,
+                    cfg: TransformerConfig) -> dict:
+    """The activation packet ppermuted between stages.
+
+    The schedules require one uniform pytree for injection and transfer
+    (schedules.py ``pipeline_forward``), so token/label ids ride alongside
+    the hidden activation and the last stage banks its per-microbatch loss
+    in the ``loss`` slot. [n_micro, mb, s] token arrays → packets of
+    hidden [mb, s, h].
+    """
+    mb, s = tokens_mb.shape[-2], tokens_mb.shape[-1]
+    return {
+        "hidden": jnp.zeros((*tokens_mb.shape[:-2], mb, s, cfg.hidden_size),
+                            cfg.compute_dtype),
+        "tokens": tokens_mb,
+        "labels": labels_mb,
+        "loss": jnp.zeros(tokens_mb.shape[:-2], jnp.float32),
+    }
+
+
+def stack_pipeline_params(params: dict, cfg: TransformerConfig,
+                          n_stages: int) -> dict:
+    """Cut the layer stack into ``n_stages`` chunks with a leading pp axis.
+
+    Embedding / final-LN / head stay unstacked (replicated across pp via
+    ``in_specs=P()``; shard_map's AD psums their grads, and only the stages
+    that consume them contribute non-zeros — the reference ties embeddings
+    with an explicit embedding-group allreduce instead,
+    standalone_transformer_lm.py:49 ``MegatronModule.word_embeddings_weight``).
+    """
+    L = cfg.num_layers
+    if L % n_stages:
+        raise ValueError(f"num_layers {L} not divisible by pp {n_stages}")
+    per = L // n_stages
+    layers = jax.tree_util.tree_map(
+        lambda v: v.reshape((n_stages, per) + v.shape[1:]), params["layers"])
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def gpt_pipeline_loss_and_grads(
+    stage_fn: Callable,
+    stacked_params: dict,
+    packets: dict,
+    *,
+    n_micro: int,
+    pp_axis: str = "pp",
+    remat: bool = True,
+):
+    """Run the 1F1B scan schedule on GPT stage params; call inside shard_map.
+
+    Non-layer params (embedding, final LN, LM head) are replicated across
+    'pp'; they are marked pp-varying for the scan schedule's carry typing
+    and their gradients psum'd afterwards — the explicit form of the
+    reference's embedding-group allreduce
+    (apex/transformer/parallel_state.py:184-310 _EMBEDDING_GROUP;
+    standalone_transformer_lm.py:49 shared word_embeddings_weight).
+    """
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_pipelining_without_interleaving,
+    )
+    from apex_tpu.utils.collectives import pvary
+
+    varying = pvary(stacked_params, pp_axis)
+    loss, grads = forward_backward_pipelining_without_interleaving(
+        stage_fn, packets, varying,
+        n_micro=n_micro,
+        loss_fn=lambda out, _mb: out["loss"],
+        axis=pp_axis,
+        remat=remat,
+    )
+    grads = {
+        k: (v if k == "layers"
+            else jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, pp_axis), v))
+        for k, v in grads.items()
+    }
+    return loss, grads
+
+
+def make_gpt_pipeline_stage(cfg: TransformerConfig, n_stages: int,
+                            tp: int = 1, *, pp_axis: str = "pp",
+                            tp_axis: str = "tp") -> Callable:
+    """Build ``stage_fn(stage_params, packet) -> packet`` for the scan
+    schedules (reference forward_step, schedules/common.py:253).
+
+    Every device runs the same program; stage behavior is selected by
+    ``lax.axis_index(pp_axis)``: stage 0 embeds tokens, inner stages
+    transform the hidden, the last stage applies the final norm + LM head
+    and writes the per-microbatch loss into the packet. TP inside a stage
+    uses the manual mapping collectives over ``tp_axis``.
+    """
+    ctx = manual_ctx(tp, tp_axis) if tp > 1 else single_device_ctx()
+
+    def stage_fn(sp: dict, packet: dict) -> dict:
+        my = jax.lax.axis_index(pp_axis)
+        first = my == 0
+        last = my == n_stages - 1
+        cd = cfg.compute_dtype
+        tokens, labels = packet["tokens"], packet["labels"]
+
+        emb = sp["embedding"]
+        embedded = vocab_parallel_embed(emb["word"].astype(cd), tokens, ctx)
+        if cfg.position_embedding_type == "learned":
+            embedded = embedded + emb["position"][: tokens.shape[1]].astype(
+                cd)[None]
+        h = jnp.where(first, embedded, packet["hidden"])
+
+        # this stage's layer chunk: local leading pp dim of size 1
+        layers = jax.tree_util.tree_map(lambda v: v[0], sp["layers"])
+        h = transformer_backbone({"layers": layers}, h, cfg, ctx,
+                                 apply_final_norm=False)
+
+        h_final = apply_norm(cfg, h, sp["final_ln"]["scale"],
+                             sp["final_ln"]["bias"])
+        head = (sp["lm_head"]["kernel"]
+                if cfg.untie_embeddings_and_output_weights
+                else sp["embedding"]["word"])
+        # NOTE: SPMD uniformity — every stage runs the head einsum + CE and
+        # discards it except the last (jnp.where below). On the shard_map
+        # pipeline path this wastes ~(v/12h) of a stage's FLOPs per tick;
+        # the GSPMD path (make_gpt_train_step) is the performance path.
+        logits = jnp.einsum("bsh,vh->bsv", h_final, head.astype(cd),
+                            preferred_element_type=jnp.float32)
+        loss = lm_cross_entropy(logits, labels, ctx)
+
+        return {
+            "hidden": h.astype(cd),
+            "tokens": tokens,
+            "labels": labels,
+            "loss": jnp.where(last, loss, 0.0),
+        }
+
+    return stage_fn

@@ -1,0 +1,498 @@
+"""Standalone parallel transformer LM — the flagship model family.
+
+Reference: apex/transformer/testing/standalone_transformer_lm.py (1,574 LoC
+Megatron LM: ``Embedding`` :1239, ``ParallelAttention`` :358, ``ParallelMLP``
+:165, ``ParallelTransformerLayer`` :598, ``ParallelTransformer`` :780,
+``TransformerLanguageModel`` :1358, ``parallel_lm_logits`` :1130).
+
+TPU-native redesign — *one functional core, two parallel modes*:
+
+- Parameters are a plain pytree (layers stacked on a leading ``L`` axis so
+  the whole decoder is a single ``lax.scan`` — one compiled layer body
+  regardless of depth, the XLA-friendly shape of Megatron's ModuleList).
+- The forward is a pure function ``gpt_forward(params, tokens, cfg, ctx)``.
+  All tensor-parallel communication is injected through a tiny
+  :class:`TPContext`, with two implementations:
+
+  * :func:`gspmd_ctx` — sharding *constraints*; run under ``jit`` over a
+    mesh and XLA's SPMD partitioner inserts the collectives the reference
+    issues by hand (the recommended path).
+  * :func:`manual_ctx` — the eight mapping collectives
+    (tensor_parallel/mappings.py) for use inside ``shard_map``; params are
+    local shards and head/ffn counts divide by ``tp``. This is the mode the
+    pipeline schedules compose with.
+
+- Activations are batch-major ``[b, s, h]`` (TPU/XLA convention) rather
+  than the reference's ``[s, b, h]``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.ops import (
+    fused_apply_rotary_pos_emb_cached,
+    fused_layer_norm,
+    fused_rms_norm,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+    softmax_cross_entropy_loss,
+)
+from apex_tpu.ops.swiglu import fused_bias_swiglu_paired
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+)
+
+__all__ = [
+    "TPContext",
+    "gspmd_ctx",
+    "manual_ctx",
+    "single_device_ctx",
+    "init_gpt_params",
+    "gpt_param_specs",
+    "gpt_forward",
+    "gpt_loss",
+    "lm_cross_entropy",
+    "apply_norm",
+    "rope_cos_sin",
+]
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel context
+# ---------------------------------------------------------------------------
+
+
+class TPContext(NamedTuple):
+    """Injected TP communication — the model's only coupling to parallelism.
+
+    ``tp`` is the degree by which *local* param shards are divided (1 under
+    GSPMD where shapes stay global) and ``tp_axis`` the mesh axis name the
+    vocab-parallel embed/CE collectives run over. ``copy_in`` enters a
+    column-parallel region (reference mappings.py:268
+    ``copy_to_tensor_model_parallel_region``); ``reduce_out`` exits a
+    row-parallel region (allreduce of partials, mappings.py:83). The
+    ``constrain_*`` hooks are GSPMD sharding hints and identity in manual
+    mode; ``constrain_col`` receives activations of any rank with the
+    tp-sharded dim last.
+    """
+
+    tp: int
+    tp_axis: str
+    copy_in: Callable[[jax.Array], jax.Array]
+    reduce_out: Callable[[jax.Array], jax.Array]
+    constrain_hidden: Callable[[jax.Array], jax.Array]
+    constrain_col: Callable[[jax.Array], jax.Array]
+    vocab_parallel: bool
+
+
+def _constrain(x, spec: P):
+    """Apply a sharding constraint when a mesh context is active; no-op
+    outside one (single-device tests). Never swallows real sharding errors:
+    the mesh/axis check is explicit rather than a blanket except."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    names = set(mesh.axis_names)
+    for part in spec:
+        axes = part if isinstance(part, tuple) else (part,)
+        for a in axes:
+            if a is not None and a not in names:
+                return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def gspmd_ctx(batch_axis: str = "dp", tp_axis: str = "tp",
+              seq_axis: Optional[str] = None) -> TPContext:
+    """Constraint-based context: annotate, let XLA partition.
+
+    ``seq_axis`` shards activations along sequence (Megatron SP / context
+    parallelism under GSPMD)."""
+    def hidden(x):
+        return _constrain(x, P(batch_axis, seq_axis, *([None] * (x.ndim - 2))))
+
+    def col(x):
+        return _constrain(
+            x, P(batch_axis, *([None] * (x.ndim - 2)), tp_axis))
+
+    return TPContext(
+        tp=1,
+        tp_axis=tp_axis,
+        copy_in=lambda x: x,
+        reduce_out=hidden,
+        constrain_hidden=hidden,
+        constrain_col=col,
+        vocab_parallel=False,
+    )
+
+
+def manual_ctx(tp: int, axis: str = "tp") -> TPContext:
+    """shard_map context: explicit mapping collectives, local shards."""
+    return TPContext(
+        tp=tp,
+        tp_axis=axis,
+        copy_in=lambda x: copy_to_tensor_model_parallel_region(x, axis),
+        reduce_out=lambda x: reduce_from_tensor_model_parallel_region(
+            x, axis),
+        constrain_hidden=lambda x: x,
+        constrain_col=lambda x: x,
+        vocab_parallel=tp > 1,
+    )
+
+
+def single_device_ctx() -> TPContext:
+    return TPContext(
+        tp=1, tp_axis="tp", copy_in=lambda x: x, reduce_out=lambda x: x,
+        constrain_hidden=lambda x: x, constrain_col=lambda x: x,
+        vocab_parallel=False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def init_gpt_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
+    """Full (unsharded) parameter pytree.
+
+    Init follows the reference: N(0, std) everywhere
+    (standalone_transformer_lm.py:146 ``init_method_normal``), with output
+    projections scaled by 1/sqrt(2L) (:155 ``scaled_init_method_normal``).
+    Layers are stacked on a leading ``num_layers`` axis.
+    """
+    h, L = cfg.hidden_size, cfg.num_layers
+    p = cfg.projection_size
+    f = cfg.ffn_hidden_size
+    std = cfg.init_method_std
+    out_std = std / (2.0 * L) ** 0.5
+    dt = cfg.params_dtype
+
+    ks = jax.random.split(rng, 8)
+
+    def nrm(k, shape, s):
+        return (jax.random.normal(k, shape, jnp.float32) * s).astype(dt)
+
+    # swiglu uses the paired [h, 2, f] layout: sharding the trailing f dim
+    # keeps each tp shard a (gate, up) pair (see ops.swiglu paired variant)
+    fc1_shape = ((L, h, 2, f) if cfg.activation == "swiglu" else (L, h, f))
+    fc1_bias_shape = ((L, 2, f) if cfg.activation == "swiglu" else (L, f))
+
+    params = {
+        "embedding": {
+            "word": nrm(ks[0], (cfg.vocab_size, h), std),
+        },
+        "layers": {
+            "ln1_scale": jnp.ones((L, h), dt),
+            "ln1_bias": jnp.zeros((L, h), dt),
+            "qkv_kernel": nrm(ks[1], (L, h, 3 * p), std),
+            "qkv_bias": jnp.zeros((L, 3 * p), dt),
+            "proj_kernel": nrm(ks[2], (L, p, h), out_std),
+            "proj_bias": jnp.zeros((L, h), dt),
+            "ln2_scale": jnp.ones((L, h), dt),
+            "ln2_bias": jnp.zeros((L, h), dt),
+            "fc1_kernel": nrm(ks[3], fc1_shape, std),
+            "fc1_bias": jnp.zeros(fc1_bias_shape, dt),
+            "fc2_kernel": nrm(ks[4], (L, f, h), out_std),
+            "fc2_bias": jnp.zeros((L, h), dt),
+        },
+        "final_ln": {
+            "scale": jnp.ones((h,), dt),
+            "bias": jnp.zeros((h,), dt),
+        },
+    }
+    if cfg.position_embedding_type == "learned":
+        params["embedding"]["position"] = nrm(
+            ks[5], (cfg.max_position_embeddings, h), std)
+    if cfg.untie_embeddings_and_output_weights:
+        params["lm_head"] = {"kernel": nrm(ks[6], (cfg.vocab_size, h), std)}
+    return params
+
+
+def gpt_param_specs(cfg: TransformerConfig, *, tp_axis: str = "tp",
+                    pp_axis: Optional[str] = None) -> dict:
+    """PartitionSpec tree matching :func:`init_gpt_params`.
+
+    Used both for GSPMD ``device_put``/``in_shardings`` and as ``shard_map``
+    in_specs (with ``pp_axis`` set, layer stacks gain a leading pipeline
+    shard dim — see models/pipeline.py). Mirrors the reference's sharding:
+    vocab rows over tp (layers.py:167), qkv/fc1 columns over tp (:429),
+    proj/fc2 rows over tp (:613).
+    """
+    t = tp_axis
+    pp = (pp_axis,) if pp_axis else ()
+    swiglu = cfg.activation == "swiglu"
+
+    specs = {
+        "embedding": {"word": P(t, None)},
+        "layers": {
+            "ln1_scale": P(*pp, None, None),
+            "ln1_bias": P(*pp, None, None),
+            "qkv_kernel": P(*pp, None, None, t),
+            "qkv_bias": P(*pp, None, t),
+            "proj_kernel": P(*pp, None, t, None),
+            "proj_bias": P(*pp, None, None),
+            "ln2_scale": P(*pp, None, None),
+            "ln2_bias": P(*pp, None, None),
+            "fc1_kernel": (P(*pp, None, None, None, t) if swiglu
+                           else P(*pp, None, None, t)),
+            "fc1_bias": (P(*pp, None, None, t) if swiglu
+                         else P(*pp, None, t)),
+            "fc2_kernel": P(*pp, None, t, None),
+            "fc2_bias": P(*pp, None, None),
+        },
+        "final_ln": {"scale": P(None), "bias": P(None)},
+    }
+    if cfg.position_embedding_type == "learned":
+        specs["embedding"]["position"] = P(None, None)
+    if cfg.untie_embeddings_and_output_weights:
+        specs["lm_head"] = {"kernel": P(t, None)}
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# Forward pieces
+# ---------------------------------------------------------------------------
+
+
+def rope_cos_sin(seq_len: int, dim: int, base: float = 10000.0):
+    """Rotary tables [s, d2] (reference fused_rope RotaryPositionEmbedding)."""
+    inv = 1.0 / base ** (jnp.arange(0, dim, 2, jnp.float32) / dim)
+    ang = jnp.outer(jnp.arange(seq_len, dtype=jnp.float32), inv)
+    ang = jnp.concatenate([ang, ang], axis=-1)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _apply_rope(t, cos, sin):
+    # t [b, s, n, d]; cos/sin [s, d] — reshape to broadcast over batch and
+    # heads, then reuse the fused op (custom VJP recomputes from cos/sin)
+    return fused_apply_rotary_pos_emb_cached(
+        t, cos[None, :, None, :], sin[None, :, None, :])
+
+
+def apply_norm(cfg, x, scale, bias):
+    if cfg.normalization == "rmsnorm":
+        return fused_rms_norm(x, scale, eps=cfg.layernorm_epsilon)
+    return fused_layer_norm(x, scale, bias, eps=cfg.layernorm_epsilon)
+
+
+def _dropout(x, rate, rng):
+    if rate == 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0).astype(x.dtype)
+
+
+def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
+                    dropout_rng):
+    """softmax(QK^T/sqrt(d)) V with the fused softmax family
+    (reference CoreAttention, standalone_transformer_lm.py:213 →
+    FusedScaleMaskSoftmax → csrc/megatron/scaled_*_softmax)."""
+    hd = q.shape[-1]
+    scale = 1.0 / hd ** 0.5
+    # [b, s, n, d] x [b, t, n, d] -> [b, n, s, t]
+    scores = jnp.einsum(
+        "bsnd,btnd->bnst", q, k,
+        preferred_element_type=jnp.float32,
+    )
+    if not cfg.softmax_in_fp32:
+        scores = scores.astype(q.dtype)
+    if cfg.attn_mask_type == "causal":
+        probs = scaled_upper_triang_masked_softmax(scores, scale)
+    elif attention_mask is not None:
+        probs = scaled_masked_softmax(scores, attention_mask, scale)
+    else:
+        probs = scaled_softmax(scores, scale)
+    probs = _dropout(probs, cfg.attention_dropout, dropout_rng)
+    ctxv = jnp.einsum(
+        "bnst,btnd->bsnd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(v.dtype)
+    return ctxv
+
+
+def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
+               attention_mask, rope, dropout_rng):
+    """ParallelAttention (reference :358): column-parallel fused QKV,
+    core attention, row-parallel output projection."""
+    nh = cfg.num_attention_heads // ctx.tp
+    b, s, _ = x.shape
+
+    xi = ctx.copy_in(x)
+    qkv = xi @ lp["qkv_kernel"].astype(x.dtype) + lp["qkv_bias"].astype(
+        x.dtype)
+    qkv = ctx.constrain_col(qkv)
+    qkv = qkv.reshape(b, s, nh, -1)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    if rope is not None:
+        cos, sin = rope
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+    ctxv = _core_attention(cfg, q, k, v, attention_mask, dropout_rng)
+    ctxv = ctxv.reshape(b, s, -1)
+    out = ctxv @ lp["proj_kernel"].astype(x.dtype)
+    out = ctx.reduce_out(out)
+    return out + lp["proj_bias"].astype(x.dtype)
+
+
+def _mlp(cfg: TransformerConfig, lp: dict, x, ctx: TPContext):
+    """ParallelMLP (reference :165): column-parallel fc1 + fused bias-act,
+    row-parallel fc2 (fused bias_swiglu / bias+gelu epilogues)."""
+    xi = ctx.copy_in(x)
+    if cfg.activation == "swiglu":
+        # paired [h, 2, f] kernel: each tp shard of the f dim is a
+        # (gate, up) pair, matching the single-device layout exactly
+        y = jnp.einsum("bsh,hcf->bscf", xi, lp["fc1_kernel"].astype(x.dtype))
+        y = ctx.constrain_col(y)
+        y = fused_bias_swiglu_paired(y, lp["fc1_bias"].astype(x.dtype))
+    else:
+        y = xi @ lp["fc1_kernel"].astype(x.dtype) + lp["fc1_bias"].astype(
+            x.dtype)
+        y = ctx.constrain_col(y)
+        y = jax.nn.gelu(y.astype(jnp.float32), approximate=False).astype(
+            x.dtype)
+    out = y @ lp["fc2_kernel"].astype(x.dtype)
+    out = ctx.reduce_out(out)
+    return out + lp["fc2_bias"].astype(x.dtype)
+
+
+def _layer(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
+           attention_mask, rope, rngs):
+    """Pre-LN transformer block (reference ParallelTransformerLayer :598:
+    LN → attn → residual → LN → MLP → residual, bias_dropout_add fused)."""
+    r1, r2, r3 = rngs if rngs is not None else (None, None, None)
+    h = apply_norm(cfg, x, lp["ln1_scale"], lp["ln1_bias"])
+    a = _attention(cfg, lp, h, ctx, attention_mask, rope, r1)
+    x = x + _dropout(a, cfg.hidden_dropout, r2)
+    h = apply_norm(cfg, x, lp["ln2_scale"], lp["ln2_bias"])
+    m = _mlp(cfg, lp, h, ctx)
+    x = x + _dropout(m, cfg.hidden_dropout, r3)
+    return ctx.constrain_hidden(x)
+
+
+def vocab_parallel_embed(table, tokens, ctx: TPContext):
+    """Masked local lookup + allreduce (reference VocabParallelEmbedding
+    :167) in manual mode; plain take under GSPMD."""
+    if not ctx.vocab_parallel:
+        return jnp.take(table, tokens, axis=0)
+    axis = ctx.tp_axis
+    n_local = table.shape[0]
+    start = jax.lax.axis_index(axis) * n_local
+    local = tokens - start
+    in_range = (local >= 0) & (local < n_local)
+    local = jnp.clip(local, 0, n_local - 1)
+    out = jnp.take(table, local, axis=0)
+    out = jnp.where(in_range[..., None], out, 0).astype(table.dtype)
+    return jax.lax.psum(out, axis)
+
+
+def transformer_backbone(params: dict, hidden, cfg: TransformerConfig,
+                         ctx: TPContext, *, attention_mask=None,
+                         dropout_rng=None, apply_final_norm: bool = True):
+    """The scanned decoder stack + final norm. ``hidden`` [b, s, h]."""
+    s = hidden.shape[1]
+    rope = None
+    if cfg.position_embedding_type == "rope":
+        rope = rope_cos_sin(s, cfg.kv_channels)
+
+    n_layers = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
+
+    def body(x, layer_in):
+        lp, key = layer_in
+        rngs = jax.random.split(key, 3) if key is not None else None
+        return _layer(cfg, lp, x, ctx, attention_mask, rope, rngs), None
+
+    step = jax.checkpoint(body) if cfg.remat else body
+
+    needs_rng = dropout_rng is not None and (
+        cfg.hidden_dropout > 0 or cfg.attention_dropout > 0)
+    keys = jax.random.split(dropout_rng, n_layers) if needs_rng else None
+
+    if cfg.scan_layers:
+        hidden, _ = jax.lax.scan(step, hidden, (params["layers"], keys))
+    else:
+        for i in range(n_layers):
+            lp = jax.tree_util.tree_map(lambda v: v[i], params["layers"])
+            hidden, _ = step(hidden, (lp, keys[i] if needs_rng else None))
+
+    if not apply_final_norm:
+        return hidden
+    return apply_norm(cfg, hidden, params["final_ln"]["scale"],
+                 params["final_ln"]["bias"])
+
+
+def gpt_forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+                ctx: Optional[TPContext] = None, *, attention_mask=None,
+                dropout_rng=None) -> jax.Array:
+    """Token ids [b, s] → logits (reference GPTModel.forward,
+    standalone_gpt.py:45 → TransformerLanguageModel :1358 →
+    parallel_lm_logits :1130).
+
+    Logits come back tp-sharded on the vocab dim in manual mode (pair with
+    ``vocab_parallel_cross_entropy``) and full under GSPMD.
+    """
+    ctx = ctx or single_device_ctx()
+    cd = cfg.compute_dtype
+
+    emb = params["embedding"]
+    h = vocab_parallel_embed(emb["word"].astype(cd), tokens, ctx)
+    if cfg.position_embedding_type == "learned":
+        pos = emb["position"][: tokens.shape[1]].astype(cd)
+        h = h + pos[None]
+    h = ctx.constrain_hidden(h)
+
+    h = transformer_backbone(params, h, cfg, ctx,
+                             attention_mask=attention_mask,
+                             dropout_rng=dropout_rng)
+
+    head = (params["lm_head"]["kernel"]
+            if cfg.untie_embeddings_and_output_weights
+            else params["embedding"]["word"])
+    # [b,s,h] @ [v,h]^T; vocab dim sharded over tp in both modes
+    logits = jnp.einsum(
+        "bsh,vh->bsv", h, head.astype(cd),
+        preferred_element_type=jnp.float32,
+    )
+    return logits
+
+
+def gpt_loss(params: dict, tokens: jax.Array, labels: jax.Array,
+             cfg: TransformerConfig, ctx: Optional[TPContext] = None,
+             *, dropout_rng=None) -> jax.Array:
+    """Mean next-token CE. Uses the fused xentropy op (GSPMD/single) or the
+    vocab-parallel CE (manual TP) — reference post_language_model_processing
+    (standalone_transformer_lm.py:1547 → tensor_parallel/cross_entropy.py:23).
+    """
+    ctx = ctx or single_device_ctx()
+    logits = gpt_forward(params, tokens, cfg, ctx, dropout_rng=dropout_rng)
+    return lm_cross_entropy(logits, labels, ctx)
+
+
+def lm_cross_entropy(logits, labels, ctx: TPContext) -> jax.Array:
+    """Mean token CE over (possibly vocab-sharded) logits; labels of -1 are
+    padding and contribute zero (both paths agree — the fused xentropy op's
+    ``padding_idx`` semantics, xentropy_kernel.cu:431-436)."""
+    if ctx.vocab_parallel:
+        from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+            vocab_parallel_cross_entropy,
+        )
+        losses = vocab_parallel_cross_entropy(
+            logits.astype(jnp.float32), labels, ctx.tp_axis)
+        losses = jnp.where(labels == -1, 0.0, losses)
+    else:
+        losses = softmax_cross_entropy_loss(
+            logits.reshape(-1, logits.shape[-1]),
+            jnp.maximum(labels.reshape(-1), 0),
+            padding_idx=None,
+        )
+        losses = jnp.where(labels.reshape(-1) == -1, 0.0, losses)
+    # normalize by non-padding count (Megatron loss_mask.sum() semantics)
+    n_valid = jnp.maximum(jnp.sum(labels != -1), 1)
+    return jnp.sum(losses) / n_valid.astype(jnp.float32)

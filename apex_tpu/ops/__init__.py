@@ -26,5 +26,8 @@ from apex_tpu.ops.softmax import (  # noqa: F401
     scaled_softmax,
     scaled_upper_triang_masked_softmax,
 )
-from apex_tpu.ops.swiglu import fused_bias_swiglu  # noqa: F401
+from apex_tpu.ops.swiglu import (  # noqa: F401
+    fused_bias_swiglu,
+    fused_bias_swiglu_paired,
+)
 from apex_tpu.ops.xentropy import softmax_cross_entropy_loss  # noqa: F401

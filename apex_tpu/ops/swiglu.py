@@ -21,7 +21,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["fused_bias_swiglu", "bias_swiglu_ref"]
+__all__ = ["fused_bias_swiglu", "fused_bias_swiglu_paired", "bias_swiglu_ref"]
 
 
 def _silu(z):
@@ -73,3 +73,52 @@ def fused_bias_swiglu(x: jax.Array, bias: Optional[jax.Array] = None):
     if x.shape[-1] % 2 != 0:
         raise ValueError("fused_bias_swiglu needs an even last dimension")
     return _bias_swiglu(x, bias)
+
+
+@jax.custom_vjp
+def _bias_swiglu_paired(y, bias):
+    yf = y.astype(jnp.float32)
+    if bias is not None:
+        yf = yf + bias.astype(jnp.float32)
+    return (_silu(yf[..., 0, :]) * yf[..., 1, :]).astype(y.dtype)
+
+
+def _paired_fwd(y, bias):
+    return _bias_swiglu_paired(y, bias), (y, bias)
+
+
+def _paired_bwd(res, g):
+    y, bias = res
+    yf = y.astype(jnp.float32)
+    if bias is not None:
+        yf = yf + bias.astype(jnp.float32)
+    y1 = yf[..., 0, :]
+    y2 = yf[..., 1, :]
+    g32 = g.astype(jnp.float32)
+    sig = jax.nn.sigmoid(y1)
+    dsilu = sig * (1.0 + y1 * (1.0 - sig))
+    dy = jnp.stack([g32 * y2 * dsilu, g32 * _silu(y1)], axis=-2)
+    dbias = None
+    if bias is not None:
+        reduce_axes = tuple(range(dy.ndim - bias.ndim))
+        dbias = jnp.sum(dy, axis=reduce_axes).astype(bias.dtype)
+    return dy.astype(y.dtype), dbias
+
+
+_bias_swiglu_paired.defvjp(_paired_fwd, _paired_bwd)
+
+
+def fused_bias_swiglu_paired(y: jax.Array,
+                             bias: Optional[jax.Array] = None) -> jax.Array:
+    """SwiGLU on the paired layout ``[..., 2, f]`` — gate at index 0, up at
+    index 1 on the second-to-last dim.
+
+    Tensor-parallel-safe variant of :func:`fused_bias_swiglu`: sharding the
+    trailing ``f`` dim keeps each shard a (gate, up) pair, whereas sharding
+    the concatenated ``[..., 2f]`` layout splits gate columns across ranks.
+    Same math as the reference kernel (fused_bias_swiglu.cu), recompute-in-
+    backward like the concat variant.
+    """
+    if y.shape[-2] != 2:
+        raise ValueError("paired layout requires shape [..., 2, f]")
+    return _bias_swiglu_paired(y, bias)

@@ -1,0 +1,227 @@
+"""Flagship GPT model tests.
+
+Reference analogs: tests/L0/run_transformer/run_gpt_minimal_test.py and
+test_pipeline_parallel_fwd_bwd.py — loss/grad parity of the parallel model
+against a sequential single-device run of the same params.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.models import (
+    TransformerConfig,
+    gpt_pipeline_loss_and_grads,
+    gpt_forward,
+    gpt_loss,
+    gpt_param_specs,
+    gspmd_ctx,
+    init_gpt_params,
+    make_gpt_pipeline_stage,
+    make_gpt_train_step,
+    manual_ctx,
+    pipeline_packet,
+    stack_pipeline_params,
+)
+from apex_tpu.optimizers import fused_adam
+from apex_tpu.parallel.mesh import create_mesh
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_pipelining_without_interleaving,
+)
+
+shard_map = jax.shard_map
+
+
+def tiny_cfg(**kw):
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_position_embeddings", 32)
+    kw.setdefault("compute_dtype", jnp.float32)   # exact parity checks
+    return TransformerConfig(**kw)
+
+
+def data(cfg, b=4, s=16, seed=0):
+    rng = np.random.RandomState(seed)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+    return tokens, labels
+
+
+class TestSingleDevice:
+    def test_forward_shapes_and_loss(self):
+        cfg = tiny_cfg()
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        tokens, labels = data(cfg)
+        logits = gpt_forward(params, tokens, cfg)
+        assert logits.shape == (4, 16, cfg.vocab_size)
+        loss = gpt_loss(params, tokens, labels, cfg)
+        assert jnp.isfinite(loss)
+        # random init ⇒ loss ≈ log(vocab)
+        assert abs(float(loss) - np.log(cfg.vocab_size)) < 1.0
+
+    @pytest.mark.parametrize("variant", ["rope_swiglu_rms", "untied"])
+    def test_variants(self, variant):
+        if variant == "rope_swiglu_rms":
+            cfg = tiny_cfg(position_embedding_type="rope",
+                           activation="swiglu", normalization="rmsnorm")
+        else:
+            cfg = tiny_cfg(untie_embeddings_and_output_weights=True)
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        tokens, labels = data(cfg)
+        loss, grads = jax.value_and_grad(gpt_loss)(
+            params, tokens, labels, cfg)
+        assert jnp.isfinite(loss)
+        flat = jax.tree_util.tree_leaves(grads)
+        assert all(bool(jnp.all(jnp.isfinite(g))) for g in flat)
+        # every param gets gradient signal somewhere
+        assert any(float(jnp.max(jnp.abs(g))) > 0 for g in flat)
+
+    def test_scan_matches_unrolled(self):
+        cfg_s = tiny_cfg(scan_layers=True)
+        cfg_u = tiny_cfg(scan_layers=False)
+        params = init_gpt_params(jax.random.PRNGKey(1), cfg_s)
+        tokens, labels = data(cfg_s)
+        l1 = gpt_loss(params, tokens, labels, cfg_s)
+        l2 = gpt_loss(params, tokens, labels, cfg_u)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+
+    def test_causality(self):
+        cfg = tiny_cfg()
+        params = init_gpt_params(jax.random.PRNGKey(2), cfg)
+        tokens, _ = data(cfg)
+        logits = gpt_forward(params, tokens, cfg)
+        # perturb the last token: logits at earlier positions unchanged
+        tokens2 = tokens.at[:, -1].set((tokens[:, -1] + 1) % cfg.vocab_size)
+        logits2 = gpt_forward(params, tokens2, cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits[:, :-1]), np.asarray(logits2[:, :-1]),
+            atol=1e-5)
+        assert float(jnp.max(jnp.abs(logits[:, -1] - logits2[:, -1]))) > 1e-4
+
+
+class TestManualTP:
+    @pytest.mark.parametrize("activation", ["gelu", "swiglu"])
+    def test_tp_loss_matches_single_device(self, activation):
+        tp = 2
+        cfg = tiny_cfg(activation=activation)
+        params = init_gpt_params(jax.random.PRNGKey(3), cfg)
+        tokens, labels = data(cfg)
+        ref = float(gpt_loss(params, tokens, labels, cfg))
+
+        mesh = create_mesh(tp=tp)
+        specs = gpt_param_specs(cfg)
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(specs, P(), P()), out_specs=P())
+        def run(p, t, y):
+            ctx = manual_ctx(tp)
+            return gpt_loss(p, t, y, cfg, ctx)
+
+        got = float(run(params, tokens, labels))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_tp_grads_match_single_device(self):
+        tp = 2
+        cfg = tiny_cfg()
+        params = init_gpt_params(jax.random.PRNGKey(4), cfg)
+        tokens, labels = data(cfg)
+        ref_grads = jax.grad(gpt_loss)(params, tokens, labels, cfg)
+
+        mesh = create_mesh(tp=tp)
+        specs = gpt_param_specs(cfg)
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(specs, P(), P()),
+            out_specs=specs)
+        def run(p, t, y):
+            ctx = manual_ctx(tp)
+            return jax.grad(gpt_loss)(p, t, y, cfg, ctx)
+
+        grads = run(params, tokens, labels)
+        for path in [("embedding", "word"), ("layers", "qkv_kernel"),
+                     ("layers", "fc2_kernel"), ("final_ln", "scale")]:
+            g, r = grads, ref_grads
+            for k in path:
+                g, r = g[k], r[k]
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), atol=2e-4,
+                err_msg=str(path))
+
+
+class TestGSPMD:
+    def test_train_step_runs_and_learns(self):
+        cfg = tiny_cfg(compute_dtype=jnp.bfloat16)
+        mesh = create_mesh(tp=2, dp=4)
+        init, step = make_gpt_train_step(
+            cfg, fused_adam(lr=1e-3), "O2", mesh)
+        state = init(jax.random.PRNGKey(0))
+        tokens, labels = data(cfg, b=8)
+        losses = []
+        for _ in range(5):
+            state, metrics = step(state, tokens, labels)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+        assert int(state.step) == 5
+
+    def test_gspmd_loss_matches_single_device(self):
+        cfg = tiny_cfg()
+        mesh = create_mesh(tp=2, dp=2, pp=2)
+        params = init_gpt_params(jax.random.PRNGKey(5), cfg)
+        tokens, labels = data(cfg)
+        ref = float(gpt_loss(params, tokens, labels, cfg))
+        with jax.set_mesh(mesh):
+            got = float(
+                jax.jit(gpt_loss, static_argnums=(3, 4))(
+                    params, tokens, labels, cfg, gspmd_ctx()))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+class TestPipeline:
+    @pytest.mark.parametrize("tp", [1, 2])
+    def test_pipeline_loss_and_grads_match_sequential(self, tp):
+        pp, n_micro, mb = 2, 4, 2
+        cfg = tiny_cfg(num_layers=4, remat=False)
+        params = init_gpt_params(jax.random.PRNGKey(6), cfg)
+        tokens, labels = data(cfg, b=n_micro * mb)
+
+        ref_loss, ref_grads = jax.value_and_grad(gpt_loss)(
+            params, tokens, labels, cfg)
+
+        stacked = stack_pipeline_params(params, cfg, pp)
+        tokens_mb = tokens.reshape(n_micro, mb, -1)
+        labels_mb = labels.reshape(n_micro, mb, -1)
+        packets = pipeline_packet(tokens_mb, labels_mb, cfg)
+
+        mesh = create_mesh(pp=pp, tp=tp)
+        stage_fn = make_gpt_pipeline_stage(cfg, pp, tp)
+        pspecs = gpt_param_specs(cfg, pp_axis="pp")
+        if tp == 1:
+            pspecs = jax.tree_util.tree_map(
+                lambda s: P(*(a if a != "tp" else None for a in s)),
+                pspecs, is_leaf=lambda x: isinstance(x, P))
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(pspecs, P()), out_specs=(P(), pspecs))
+        def run(p, mbs):
+            return gpt_pipeline_loss_and_grads(
+                stage_fn, p, mbs, n_micro=n_micro)
+
+        loss, grads = run(stacked, packets)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+
+        ref_stacked = stack_pipeline_params(ref_grads, cfg, pp)
+        for path in [("embedding", "word"), ("layers", "qkv_kernel"),
+                     ("layers", "fc1_kernel"), ("final_ln", "scale")]:
+            g, r = grads, ref_stacked
+            for k in path:
+                g, r = g[k], r[k]
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), atol=3e-4, err_msg=str(path))
