@@ -57,10 +57,6 @@ class TransformerConfig:
     remat: bool = False                           # jax.checkpoint each layer
     scan_layers: bool = True                      # lax.scan over the stack
 
-    # parallelism (static degrees; 1 = off)
-    tensor_model_parallel_size: int = 1
-    sequence_parallel: bool = False
-
     def __post_init__(self):
         if self.ffn_hidden_size is None:
             ffn = (
@@ -113,4 +109,5 @@ def bert_large(**kw) -> TransformerConfig:
     kw.setdefault("num_attention_heads", 16)
     kw.setdefault("vocab_size", 30592)            # 30522 padded to 128
     kw.setdefault("max_position_embeddings", 512)
+    kw.setdefault("attn_mask_type", "padding")    # bidirectional encoder
     return TransformerConfig(**kw)

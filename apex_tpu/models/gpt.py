@@ -33,7 +33,8 @@ from apex_tpu.models.transformer_lm import (
     manual_ctx,
     single_device_ctx,
     transformer_backbone,
-    vocab_parallel_embed,
+    embed_tokens,
+    lm_head_logits,
 )
 
 __all__ = [
@@ -61,18 +62,21 @@ def make_gpt_train_step(
     ``step_fn(state, tokens, labels)`` is the full O2-style AMP step
     (scale → grad → unscale+finite-check → fused update → skip-on-overflow)
     with gradient mean over 'dp' handled by GSPMD sharding propagation.
+
+    Batch signature grows with the config: ``attn_mask_type='padding'``
+    appends an ``attention_mask`` (True = masked) element, dropout appends
+    a PRNG key — ``step(state, tokens, labels[, mask][, rng])``.
     """
     ctx = gspmd_ctx(seq_axis=seq_axis) if mesh is not None else None
     has_dropout = cfg.hidden_dropout > 0 or cfg.attention_dropout > 0
+    has_mask = cfg.attn_mask_type == "padding"
 
-    if has_dropout:
-        # dropout key rides in the batch: step(state, tokens, labels, rng)
-        def loss_fn(params, tokens, labels, dropout_rng):
-            return gpt_loss(params, tokens, labels, cfg, ctx,
-                            dropout_rng=dropout_rng)
-    else:
-        def loss_fn(params, tokens, labels):
-            return gpt_loss(params, tokens, labels, cfg, ctx)
+    def loss_fn(params, tokens, labels, *rest):
+        rest = list(rest)
+        mask = rest.pop(0) if has_mask else None
+        rng = rest.pop(0) if has_dropout else None
+        return gpt_loss(params, tokens, labels, cfg, ctx,
+                        attention_mask=mask, dropout_rng=rng)
 
     init_fn, step_fn = make_train_step(
         loss_fn, optimizer, policy_or_amp,
@@ -97,6 +101,9 @@ def make_gpt_train_step(
 
     batch_sharding = NamedSharding(mesh, P("dp", seq_axis))
     shardings = (None, batch_sharding, batch_sharding)
+    if has_mask:
+        # (b, 1, sq, sk) or (b, sq, sk) boolean padding mask
+        shardings = shardings + (NamedSharding(mesh, P("dp")),)
     if has_dropout:
         shardings = shardings + (NamedSharding(mesh, P()),)
     jstep = jax.jit(step_fn, in_shardings=shardings, donate_argnums=0)
@@ -208,6 +215,17 @@ def make_gpt_pipeline_stage(cfg: TransformerConfig, n_stages: int,
     and writes the per-microbatch loss into the packet. TP inside a stage
     uses the manual mapping collectives over ``tp_axis``.
     """
+    if cfg.hidden_dropout > 0 or cfg.attention_dropout > 0:
+        raise NotImplementedError(
+            "dropout is not yet threaded through the shard_map pipeline "
+            "path; use the GSPMD train step (make_gpt_train_step) or set "
+            "hidden_dropout=attention_dropout=0"
+        )
+    if cfg.attn_mask_type == "padding":
+        raise NotImplementedError(
+            "padding attention masks are not yet carried in the pipeline "
+            "packet; the shard_map pipeline path supports causal models"
+        )
     ctx = manual_ctx(tp, tp_axis) if tp > 1 else single_device_ctx()
 
     def stage_fn(sp: dict, packet: dict) -> dict:
@@ -217,11 +235,7 @@ def make_gpt_pipeline_stage(cfg: TransformerConfig, n_stages: int,
         cd = cfg.compute_dtype
         tokens, labels = packet["tokens"], packet["labels"]
 
-        emb = sp["embedding"]
-        embedded = vocab_parallel_embed(emb["word"].astype(cd), tokens, ctx)
-        if cfg.position_embedding_type == "learned":
-            embedded = embedded + emb["position"][: tokens.shape[1]].astype(
-                cd)[None]
+        embedded = embed_tokens(sp["embedding"], tokens, cfg, ctx)
         h = jnp.where(first, embedded, packet["hidden"])
 
         # this stage's layer chunk: local leading pp dim of size 1
@@ -231,15 +245,11 @@ def make_gpt_pipeline_stage(cfg: TransformerConfig, n_stages: int,
 
         h_final = apply_norm(cfg, h, sp["final_ln"]["scale"],
                              sp["final_ln"]["bias"])
-        head = (sp["lm_head"]["kernel"]
-                if cfg.untie_embeddings_and_output_weights
-                else sp["embedding"]["word"])
         # NOTE: SPMD uniformity — every stage runs the head einsum + CE and
         # discards it except the last (jnp.where below). On the shard_map
         # pipeline path this wastes ~(v/12h) of a stage's FLOPs per tick;
         # the GSPMD path (make_gpt_train_step) is the performance path.
-        logits = jnp.einsum("bsh,vh->bsv", h_final, head.astype(cd),
-                            preferred_element_type=jnp.float32)
+        logits = lm_head_logits(sp, h_final, cfg)
         loss = lm_cross_entropy(logits, labels, ctx)
 
         return {

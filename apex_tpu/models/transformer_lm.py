@@ -393,6 +393,30 @@ def vocab_parallel_embed(table, tokens, ctx: TPContext):
     return jax.lax.psum(out, axis)
 
 
+def embed_tokens(emb: dict, tokens, cfg: TransformerConfig,
+                 ctx: TPContext):
+    """Word embedding lookup + learned position add (shared by the GSPMD
+    forward and the shard_map pipeline stage)."""
+    cd = cfg.compute_dtype
+    h = vocab_parallel_embed(emb["word"].astype(cd), tokens, ctx)
+    if cfg.position_embedding_type == "learned":
+        h = h + emb["position"][: tokens.shape[1]].astype(cd)[None]
+    return h
+
+
+def lm_head_logits(params: dict, hidden, cfg: TransformerConfig):
+    """Final-hidden → vocab logits with tied/untied head selection
+    (reference parallel_lm_logits, standalone_transformer_lm.py:1130)."""
+    head = (params["lm_head"]["kernel"]
+            if cfg.untie_embeddings_and_output_weights
+            else params["embedding"]["word"])
+    # [b,s,h] @ [v,h]^T; vocab dim sharded over tp in both modes
+    return jnp.einsum(
+        "bsh,vh->bsv", hidden, head.astype(cfg.compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def transformer_backbone(params: dict, hidden, cfg: TransformerConfig,
                          ctx: TPContext, *, attention_mask=None,
                          dropout_rng=None, apply_final_norm: bool = True):
@@ -439,39 +463,27 @@ def gpt_forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     ``vocab_parallel_cross_entropy``) and full under GSPMD.
     """
     ctx = ctx or single_device_ctx()
-    cd = cfg.compute_dtype
-
-    emb = params["embedding"]
-    h = vocab_parallel_embed(emb["word"].astype(cd), tokens, ctx)
-    if cfg.position_embedding_type == "learned":
-        pos = emb["position"][: tokens.shape[1]].astype(cd)
-        h = h + pos[None]
-    h = ctx.constrain_hidden(h)
-
+    h = ctx.constrain_hidden(embed_tokens(params["embedding"], tokens,
+                                          cfg, ctx))
     h = transformer_backbone(params, h, cfg, ctx,
                              attention_mask=attention_mask,
                              dropout_rng=dropout_rng)
-
-    head = (params["lm_head"]["kernel"]
-            if cfg.untie_embeddings_and_output_weights
-            else params["embedding"]["word"])
-    # [b,s,h] @ [v,h]^T; vocab dim sharded over tp in both modes
-    logits = jnp.einsum(
-        "bsh,vh->bsv", h, head.astype(cd),
-        preferred_element_type=jnp.float32,
-    )
-    return logits
+    return lm_head_logits(params, h, cfg)
 
 
 def gpt_loss(params: dict, tokens: jax.Array, labels: jax.Array,
              cfg: TransformerConfig, ctx: Optional[TPContext] = None,
-             *, dropout_rng=None) -> jax.Array:
+             *, attention_mask=None, dropout_rng=None) -> jax.Array:
     """Mean next-token CE. Uses the fused xentropy op (GSPMD/single) or the
     vocab-parallel CE (manual TP) — reference post_language_model_processing
     (standalone_transformer_lm.py:1547 → tensor_parallel/cross_entropy.py:23).
+    ``attention_mask`` (True = masked) feeds ``attn_mask_type='padding'``
+    models; causal masking needs none.
     """
     ctx = ctx or single_device_ctx()
-    logits = gpt_forward(params, tokens, cfg, ctx, dropout_rng=dropout_rng)
+    logits = gpt_forward(params, tokens, cfg, ctx,
+                         attention_mask=attention_mask,
+                         dropout_rng=dropout_rng)
     return lm_cross_entropy(logits, labels, ctx)
 
 

@@ -112,7 +112,7 @@ def _softmax_fwd_pallas(x, scale, mask, causal):
     x2 = x.reshape(rows, sk)
     # The causal q-position of a row is (global_row % sq) regardless of the
     # block size, so any row blocking works.
-    br = max(8, min(512, (4 * 1024 * 1024 // 3) // (sk * 4)))
+    br = max(8, min(512, (4 * 1024 * 1024 // 3) // (sk * 4)) // 8 * 8)
     padded_rows = pl.cdiv(rows, br) * br
     if padded_rows != rows:
         x2 = jnp.pad(x2, ((0, padded_rows - rows), (0, 0)))

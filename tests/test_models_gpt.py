@@ -91,6 +91,24 @@ class TestSingleDevice:
         l2 = gpt_loss(params, tokens, labels, cfg_u)
         np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
 
+    def test_padding_mask_isolates_positions(self):
+        # bert_large-style bidirectional model: a fully-masked-out key
+        # position must not affect other positions' logits
+        cfg = tiny_cfg(attn_mask_type="padding")
+        params = init_gpt_params(jax.random.PRNGKey(7), cfg)
+        tokens, labels = data(cfg)
+        b, s = tokens.shape
+        mask = jnp.zeros((b, 1, s, s), bool).at[:, :, :, -1].set(True)
+        logits = gpt_forward(params, tokens, cfg, attention_mask=mask)
+        tokens2 = tokens.at[:, -1].set((tokens[:, -1] + 1) % cfg.vocab_size)
+        logits2 = gpt_forward(params, tokens2, cfg, attention_mask=mask)
+        np.testing.assert_allclose(
+            np.asarray(logits[:, :-1]), np.asarray(logits2[:, :-1]),
+            atol=1e-5)
+        # and the masked loss path runs through gpt_loss too
+        loss = gpt_loss(params, tokens, labels, cfg, attention_mask=mask)
+        assert jnp.isfinite(loss)
+
     def test_causality(self):
         cfg = tiny_cfg()
         params = init_gpt_params(jax.random.PRNGKey(2), cfg)
