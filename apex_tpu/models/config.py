@@ -54,6 +54,7 @@ class TransformerConfig:
     params_dtype: jnp.dtype = jnp.float32
     compute_dtype: jnp.dtype = jnp.bfloat16
     softmax_in_fp32: bool = True
+    attention_backend: str = "flash"              # 'flash' | 'fused_softmax'
     remat: bool = False                           # jax.checkpoint each layer
     scan_layers: bool = True                      # lax.scan over the stack
 

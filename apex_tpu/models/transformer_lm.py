@@ -292,11 +292,21 @@ def _dropout(x, rate, rng):
 
 def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
                     dropout_rng):
-    """softmax(QK^T/sqrt(d)) V with the fused softmax family
-    (reference CoreAttention, standalone_transformer_lm.py:213 →
-    FusedScaleMaskSoftmax → csrc/megatron/scaled_*_softmax)."""
+    """softmax(QK^T/sqrt(d)) V (reference CoreAttention,
+    standalone_transformer_lm.py:213 → FusedScaleMaskSoftmax →
+    csrc/megatron/scaled_*_softmax).
+
+    Backend: the Pallas flash-attention kernel when the pattern allows
+    (causal / unmasked, no attention dropout); otherwise the fused-softmax
+    family on materialized scores (generic masks, dropout).
+    """
     hd = q.shape[-1]
     scale = 1.0 / hd ** 0.5
+    use_dropout = cfg.attention_dropout > 0 and dropout_rng is not None
+    if (cfg.attention_backend == "flash" and attention_mask is None
+            and not use_dropout and cfg.attn_mask_type == "causal"):
+        from apex_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=True, scale=scale)
     # [b, s, n, d] x [b, t, n, d] -> [b, n, s, t]
     scores = jnp.einsum(
         "bsnd,btnd->bnst", q, k,
@@ -305,7 +315,17 @@ def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
     if not cfg.softmax_in_fp32:
         scores = scores.astype(q.dtype)
     if cfg.attn_mask_type == "causal":
-        probs = scaled_upper_triang_masked_softmax(scores, scale)
+        if attention_mask is not None:
+            # combine the causal triangle with the user mask rather than
+            # silently dropping either (e.g. padding inside a causal LM)
+            sq, sk = scores.shape[-2], scores.shape[-1]
+            row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+            causal_mask = (col > row)[None, None]
+            probs = scaled_masked_softmax(
+                scores, attention_mask | causal_mask, scale)
+        else:
+            probs = scaled_upper_triang_masked_softmax(scores, scale)
     elif attention_mask is not None:
         probs = scaled_masked_softmax(scores, attention_mask, scale)
     else:

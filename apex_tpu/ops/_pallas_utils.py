@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import os
 
+import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
@@ -11,7 +12,17 @@ from apex_tpu.utils.registry import on_tpu
 
 LANES = 128
 
-__all__ = ["LANES", "pallas_ok", "pad_rows"]
+__all__ = ["LANES", "pallas_ok", "pad_rows", "out_struct"]
+
+
+def out_struct(shape, dtype, like) -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct for a pallas_call output, propagating the mesh-axis
+    variance (vma) of ``like`` — required when the kernel runs inside a
+    ``jax.shard_map`` with its default ``check_vma=True``."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def pallas_ok(op_name: str, last_dim: int, dtype) -> bool:

@@ -1,0 +1,504 @@
+"""Flash attention — the TPU answer to the reference's fused attention stack.
+
+Reference parity targets: apex/contrib/csrc/fmha (seqlen<=512 BERT fwd/bwd,
+varlen via cu_seqlens — fmha_api.cpp:358) and apex/contrib/csrc/
+multihead_attn (pre-flash fused MHA with softmax/dropout epilogues). Instead
+of porting those CUDA tilings we implement one FlashAttention-2 style
+blockwise kernel set in Pallas: O(sq·d) memory, online softmax, fused causal
+/ key-padding masking, fp32 accumulation on the MXU. It also serves as the
+compute core of the ring-attention context-parallel path (the reference has
+no long-context story; SURVEY.md §5).
+
+Layout: [batch, seq, heads, head_dim] (the model's native BSND). The kernel
+grid runs (batch*heads, q-blocks, kv-blocks) with kv innermost; VMEM scratch
+carries the running max / normalizer / accumulator across kv steps.
+
+Variants:
+- ``causal=True`` — upper-triangular mask generated from iota in-kernel.
+- ``key_padding_mask`` [b, sk] bool (True = masked) — fused in-kernel.
+- generic additive ``bias`` or full boolean ``mask``, or dropout — routed to
+  the XLA composition (these are rare paths in the reference too; its fmha
+  supports only varlen+causal-free BERT shapes).
+
+Backward: custom_vjp with the standard two-kernel scheme — dq accumulates
+over kv blocks, dk/dv over q blocks, both recomputing the probabilities
+from the saved logsumexp (no O(s²) residuals).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops._pallas_utils import out_struct
+from apex_tpu.utils.registry import on_tpu
+
+__all__ = ["flash_attention", "mha_reference"]
+
+_NEG_INF = -1e30
+_LANES = 128
+
+
+def _pad_to(x, size, axis):
+    pad = size - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+# ---------------------------------------------------------------------------
+# Reference XLA path (also the fallback for bias / generic mask / dropout).
+# ---------------------------------------------------------------------------
+
+
+def mha_reference(q, k, v, *, causal=False, key_padding_mask=None,
+                  mask=None, bias=None, scale=None, dropout_p=0.0,
+                  dropout_rng=None):
+    """Materialized softmax(QK^T)V in fp32 — numerics oracle for the kernel
+    and the execution path for variants the kernel doesn't fuse."""
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    scale = (1.0 / d ** 0.5) if scale is None else scale
+    s = jnp.einsum("bsnd,btnd->bnst", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if mask is not None:
+        s = jnp.where(mask, _NEG_INF, s)
+    if key_padding_mask is not None:
+        s = jnp.where(key_padding_mask[:, None, None, :], _NEG_INF, s)
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where((col > row)[None, None], _NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_p > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bnst,btnd->bsnd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel.
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(scale, causal, sq_real, sk_real, block_q, block_k, has_kpm,
+                *refs):
+    if has_kpm:
+        q_ref, k_ref, v_ref, kpm_ref, o_ref, lse_ref, acc, m_s, l_s = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s = refs
+    qi, kj = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    q_start = qi * block_q
+    k_start = kj * block_k
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+        col = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        pred = col < sk_real                       # kv tail padding
+        if has_kpm:
+            pred &= kpm_ref[0] == 0
+        if causal:
+            row = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            pred &= col <= row
+        s = jnp.where(pred, s, _NEG_INF)
+
+        m_prev = m_s[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        # fully-masked-so-far rows: m_new == -inf ⇒ exp(NaN) guards
+        p = jnp.where(m_new > _NEG_INF / 2, p, 0.0)
+        alpha = jnp.where(m_new > _NEG_INF / 2, alpha, 0.0)
+
+        l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+
+    if causal:
+        # whole kv block above the diagonal → skip its FLOPs
+        pl.when(k_start <= q_start + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kj == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_s[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
+        # logsumexp (fully-masked rows get -inf-ish sentinel)
+        lse = m_s[:, :1] + jnp.log(safe_l)
+        lse_ref[0] = jnp.broadcast_to(
+            jnp.where(l == 0.0, _NEG_INF, lse), lse_ref.shape[1:])
+
+
+def _fwd_pallas(q3, k3, v3, kpm, scale, causal, sq_real, sk_real,
+                block_q, block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, sqp, d = q3.shape
+    skp = k3.shape[1]
+    grid = (bh, sqp // block_q, skp // block_k)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                          memory_space=pltpu.VMEM)
+    in_specs = [q_spec, k_spec, k_spec]
+    args = [q3, k3, v3]
+    if kpm is not None:
+        # [b, 1, skp] int32, indexed by batch = bh // heads
+        heads = bh // kpm.shape[0]
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_k),
+            lambda b, i, j, h=heads: (b // h, 0, j),
+            memory_space=pltpu.VMEM))
+        args.append(kpm)
+
+    out_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        out_struct((bh, sqp, d), q3.dtype, q3),
+        out_struct((bh, sqp, _LANES), jnp.float32, q3),
+    ]
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale, causal, sq_real, sk_real,
+                          block_q, block_k, kpm is not None),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return o, lse[:, :, 0]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels.
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(scale, causal, sk_real, block_q, block_k, has_kpm, *refs):
+    if has_kpm:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kpm_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+    qi, kj = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start, k_start = qi * block_q, kj * block_k
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        col = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        pred = col < sk_real
+        if has_kpm:
+            pred &= kpm_ref[0] == 0
+        if causal:
+            row = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            pred &= col <= row
+        lse = lse_ref[0][:, :1]
+        p = jnp.where(pred, jnp.exp(s - lse), 0.0)
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        delta = delta_ref[0][:, :1]
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kj == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(scale, causal, sq_real, sk_real, block_q, block_k,
+                    has_kpm, *refs):
+    if has_kpm:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kpm_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    kj, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start, k_start = qi * block_q, kj * block_k
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        col = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        row = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        pred = (col < sk_real) & (row < sq_real)
+        if has_kpm:
+            pred &= kpm_ref[0] == 0
+        if causal:
+            pred &= col <= row
+        lse = lse_ref[0][:, :1]
+        p = jnp.where(pred, jnp.exp(s - lse), 0.0)
+        do = do_ref[0].astype(jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        delta = delta_ref[0][:, :1]
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, scale, causal,
+                sq_real, sk_real, block_q, block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, sqp, d = q3.shape
+    skp = k3.shape[1]
+    lse3 = jnp.broadcast_to(lse[:, :, None], (bh, sqp, _LANES))
+    delta3 = jnp.broadcast_to(delta[:, :, None], (bh, sqp, _LANES))
+
+    def qspec(f):
+        return pl.BlockSpec((1, block_q, d), f, memory_space=pltpu.VMEM)
+
+    def kspec(f):
+        return pl.BlockSpec((1, block_k, d), f, memory_space=pltpu.VMEM)
+
+    def rowspec(f):
+        return pl.BlockSpec((1, block_q, _LANES), f,
+                            memory_space=pltpu.VMEM)
+
+    # --- dq: grid (bh, q, kv) ------------------------------------------
+    qmap = lambda b, i, j: (b, i, 0)
+    kmap = lambda b, i, j: (b, j, 0)
+    in_specs = [qspec(qmap), kspec(kmap), kspec(kmap), qspec(qmap),
+                rowspec(qmap), rowspec(qmap)]
+    args = [q3, k3, v3, do3, lse3, delta3]
+    if kpm is not None:
+        heads = bh // kpm.shape[0]
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_k), lambda b, i, j, h=heads: (b // h, 0, j),
+            memory_space=pltpu.VMEM))
+        args.append(kpm)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale, causal, sk_real,
+                          block_q, block_k, kpm is not None),
+        grid=(bh, sqp // block_q, skp // block_k),
+        in_specs=in_specs,
+        out_specs=qspec(qmap),
+        out_shape=out_struct((bh, sqp, d), q3.dtype, q3),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+
+    # --- dk/dv: grid (bh, kv, q) ---------------------------------------
+    qmap2 = lambda b, j, i: (b, i, 0)
+    kmap2 = lambda b, j, i: (b, j, 0)
+    in_specs = [qspec(qmap2), kspec(kmap2), kspec(kmap2), qspec(qmap2),
+                rowspec(qmap2), rowspec(qmap2)]
+    args = [q3, k3, v3, do3, lse3, delta3]
+    if kpm is not None:
+        heads = bh // kpm.shape[0]
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_k), lambda b, j, i, h=heads: (b // h, 0, j),
+            memory_space=pltpu.VMEM))
+        args.append(kpm)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale, causal, sq_real,
+                          sk_real, block_q, block_k, kpm is not None),
+        grid=(bh, skp // block_k, sqp // block_q),
+        in_specs=in_specs,
+        out_specs=[kspec(kmap2), kspec(kmap2)],
+        out_shape=[out_struct((bh, skp, d), k3.dtype, k3),
+                   out_struct((bh, skp, d), v3.dtype, k3)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper.
+# ---------------------------------------------------------------------------
+
+
+def _to_bh(x):
+    """[b, s, n, d] → [b*n, s, d]."""
+    b, s, n, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * n, s, d)
+
+
+def _from_bh(x3, b, n):
+    bh, s, d = x3.shape
+    return x3.reshape(b, n, s, d).transpose(0, 2, 1, 3)
+
+
+def _blocks(sq, sk):
+    bq = min(256, pl.cdiv(sq, _LANES) * _LANES)
+    bk = min(512, pl.cdiv(sk, _LANES) * _LANES)
+    return bq, bk
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, kpm, causal, scale):
+    o, _ = _flash_fwd(q, k, v, kpm, causal, scale)
+    return o
+
+
+def _flash_fwd(q, k, v, kpm, causal, scale):
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    block_q, block_k = _blocks(sq, sk)
+    sqp = pl.cdiv(sq, block_q) * block_q
+    skp = pl.cdiv(sk, block_k) * block_k
+    q3 = _pad_to(_to_bh(q), sqp, 1)
+    k3 = _pad_to(_to_bh(k), skp, 1)
+    v3 = _pad_to(_to_bh(v), skp, 1)
+    kpm3 = (None if kpm is None
+            else _pad_to(kpm.astype(jnp.int32)[:, None, :], skp, 2))
+    o3, lse = _fwd_pallas(q3, k3, v3, kpm3, scale, causal, sq, sk,
+                          block_q, block_k, interpret=not on_tpu())
+    o = _from_bh(o3, b, n)[:, :sq]
+    return o, (q, k, v, kpm, o, lse)
+
+
+def _flash_bwd(causal, scale, res, do):
+    q, k, v, kpm, o, lse = res
+    b, sq, n, d = q.shape
+    sk = k.shape[1]
+    block_q, block_k = _blocks(sq, sk)
+    sqp = pl.cdiv(sq, block_q) * block_q
+    skp = pl.cdiv(sk, block_k) * block_k
+    q3 = _pad_to(_to_bh(q), sqp, 1)
+    k3 = _pad_to(_to_bh(k), skp, 1)
+    v3 = _pad_to(_to_bh(v), skp, 1)
+    do3 = _pad_to(_to_bh(do), sqp, 1)
+    o3 = _pad_to(_to_bh(o), sqp, 1)
+    lse3 = _pad_to(lse, sqp, 1)
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)
+    kpm3 = (None if kpm is None
+            else _pad_to(kpm.astype(jnp.int32)[:, None, :], skp, 2))
+    dq3, dk3, dv3 = _bwd_pallas(
+        q3, k3, v3, do3, lse3, delta, kpm3, scale, causal, sq, sk,
+        block_q, block_k, interpret=not on_tpu())
+    dq = _from_bh(dq3, b, n)[:, :sq]
+    dk = _from_bh(dk3, b, n)[:, :sk]
+    dv = _from_bh(dv3, b, n)[:, :sk]
+    # bool mask has no tangent space — float0 cotangent
+    dkpm = (None if kpm is None
+            else np.zeros(kpm.shape, jax.dtypes.float0))
+    return dq, dk, dv, dkpm
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    key_padding_mask: Optional[jax.Array] = None,
+    mask: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    dropout_p: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Memory-efficient attention over [b, s, n, d] tensors.
+
+    The Pallas blockwise kernel handles ``causal`` and ``key_padding_mask``
+    ([b, sk] bool, True = masked — the cu_seqlens analog of reference
+    fmha_api.cpp:358). A generic boolean ``mask``, additive ``bias``, or
+    attention ``dropout`` falls back to the fused-softmax XLA composition
+    (reference fast_multihead_attn territory).
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected [b, s, n, d], got {q.shape}")
+    d = q.shape[-1]
+    scale = (1.0 / d ** 0.5) if scale is None else float(scale)
+    generic = (mask is not None or bias is not None
+               or (dropout_p > 0.0 and dropout_rng is not None))
+    if generic:
+        return mha_reference(
+            q, k, v, causal=causal, key_padding_mask=key_padding_mask,
+            mask=mask, bias=bias, scale=scale, dropout_p=dropout_p,
+            dropout_rng=dropout_rng)
+    return _flash(q, k, v, key_padding_mask, causal, scale)

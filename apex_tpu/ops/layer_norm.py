@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from apex_tpu.ops._pallas_utils import out_struct
 from apex_tpu.utils.registry import on_tpu
 
 __all__ = [
@@ -197,9 +198,9 @@ def _ln_fwd_pallas(x2, weight, bias, eps, rms):
         in_specs=in_specs,
         out_specs=(row_tile, stat_tile, stat_tile),
         out_shape=(
-            jax.ShapeDtypeStruct((prows, hidden), x2.dtype),
-            jax.ShapeDtypeStruct((prows, 1), jnp.float32),
-            jax.ShapeDtypeStruct((prows, 1), jnp.float32),
+            out_struct((prows, hidden), x2.dtype, x2),
+            out_struct((prows, 1), jnp.float32, x2),
+            out_struct((prows, 1), jnp.float32, x2),
         ),
         interpret=not on_tpu(),
     )(*args)
@@ -240,13 +241,13 @@ def _ln_bwd_pallas(dy2, x2, weight, mu, rs, rms, has_bias):
     args += [mu, rs]
 
     out_specs = [row_tile]
-    out_shape = [jax.ShapeDtypeStruct((prows, hidden), x2.dtype)]
+    out_shape = [out_struct((prows, hidden), x2.dtype, x2)]
     if affine:
         out_specs.append(acc_tile)
-        out_shape.append(jax.ShapeDtypeStruct((1, hidden), jnp.float32))
+        out_shape.append(out_struct((1, hidden), jnp.float32, x2))
         if has_bias:
             out_specs.append(acc_tile)
-            out_shape.append(jax.ShapeDtypeStruct((1, hidden), jnp.float32))
+            out_shape.append(out_struct((1, hidden), jnp.float32, x2))
 
     outs = pl.pallas_call(
         functools.partial(_ln_bwd_kernel, rms, affine, has_bias),

@@ -27,6 +27,7 @@ from jax.experimental import pallas as pl
 from jax.flatten_util import ravel_pytree
 
 from apex_tpu.utils.registry import on_tpu, register_op
+from apex_tpu.ops._pallas_utils import out_struct
 
 __all__ = ["flat_adam_update", "adam_kernel_flat"]
 
@@ -91,7 +92,7 @@ def adam_kernel_flat(
     tile = pl.BlockSpec(
         (block, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
-    out_shape = jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)
+    out_shape = out_struct((rows, _LANES), jnp.float32, g2)
     u2, m2n, v2n = pl.pallas_call(
         functools.partial(_adam_body, adam_w_mode),
         grid=grid,

@@ -30,6 +30,11 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from apex_tpu.ops._pallas_utils import (
+    out_struct,
+    pad_rows,
+    pallas_ok,
+)
 from apex_tpu.utils.registry import on_tpu
 
 __all__ = [
@@ -40,7 +45,6 @@ __all__ = [
 ]
 
 _MASK_FILL = -10000.0
-_LANES = 128
 
 
 # --------------------------------------------------------------------------
@@ -97,8 +101,6 @@ def _softmax_kernel(scale, causal, sq, has_mask, *refs):
 
 
 def _pallas_ok(sk: int, dtype) -> bool:
-    from apex_tpu.ops._pallas_utils import pallas_ok
-
     return pallas_ok("fused_softmax", sk, dtype)
 
 
@@ -109,13 +111,11 @@ def _softmax_fwd_pallas(x, scale, mask, causal):
     sk = shape[-1]
     sq = shape[-2]
     rows = x.size // sk
-    x2 = x.reshape(rows, sk)
     # The causal q-position of a row is (global_row % sq) regardless of the
     # block size, so any row blocking works.
     br = max(8, min(512, (4 * 1024 * 1024 // 3) // (sk * 4)) // 8 * 8)
-    padded_rows = pl.cdiv(rows, br) * br
-    if padded_rows != rows:
-        x2 = jnp.pad(x2, ((0, padded_rows - rows), (0, 0)))
+    x2, _ = pad_rows(x.reshape(rows, sk), br)
+    padded_rows = x2.shape[0]
     grid = (padded_rows // br,)
     row_tile = pl.BlockSpec((br, sk), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
@@ -124,9 +124,7 @@ def _softmax_fwd_pallas(x, scale, mask, causal):
     if mask is not None:
         # dispatcher guarantees mask.shape == x.shape here (broadcast masks
         # take the XLA path, which reads them with broadcast strides)
-        m2 = mask.reshape(rows, sk).astype(jnp.int8)
-        if padded_rows != rows:
-            m2 = jnp.pad(m2, ((0, padded_rows - rows), (0, 0)))
+        m2, _ = pad_rows(mask.reshape(rows, sk).astype(jnp.int32), br)
         in_specs.append(row_tile)
         args.append(m2)
     y = pl.pallas_call(
@@ -136,7 +134,7 @@ def _softmax_fwd_pallas(x, scale, mask, causal):
         grid=grid,
         in_specs=in_specs,
         out_specs=row_tile,
-        out_shape=jax.ShapeDtypeStruct((padded_rows, sk), x.dtype),
+        out_shape=out_struct((padded_rows, sk), x.dtype, x2),
         interpret=not on_tpu(),
     )(*args)
     return y[:rows].reshape(shape)

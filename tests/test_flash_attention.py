@@ -1,0 +1,123 @@
+"""Flash attention kernel vs materialized reference.
+
+Mirrors the reference fmha test pattern (apex/contrib/test/fmha/test_fmha.py:
+fused kernel vs PyTorch-composed attention at loose fp16 tolerances).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.flash_attention import flash_attention, mha_reference
+
+
+def make_qkv(b, s, n, d, dtype=jnp.float32, seed=0, sk=None):
+    rng = np.random.RandomState(seed)
+    sk = s if sk is None else sk
+    q = jnp.asarray(rng.randn(b, s, n, d), dtype) * 0.5
+    k = jnp.asarray(rng.randn(b, sk, n, d), dtype) * 0.5
+    v = jnp.asarray(rng.randn(b, sk, n, d), dtype) * 0.5
+    return q, k, v
+
+
+TOL = dict(atol=2e-5, rtol=2e-5)
+TOL_BF16 = dict(atol=2e-2, rtol=2e-2)
+
+
+class TestForward:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("shape", [(2, 128, 2, 64), (1, 384, 4, 32)])
+    def test_matches_reference(self, causal, shape):
+        q, k, v = make_qkv(*shape)
+        got = flash_attention(q, k, v, causal=causal)
+        want = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+    def test_unaligned_seq_len(self):
+        # seq 100 → padded to the 128-row block internally
+        q, k, v = make_qkv(2, 100, 2, 64)
+        got = flash_attention(q, k, v, causal=True)
+        want = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+    def test_cross_attention_lengths(self):
+        q, k, v = make_qkv(2, 64, 2, 64, sk=192)
+        got = flash_attention(q, k, v)
+        want = mha_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+    def test_key_padding_mask(self):
+        b, s, n, d = 2, 128, 2, 64
+        q, k, v = make_qkv(b, s, n, d)
+        lengths = np.array([80, 128])
+        kpm = jnp.asarray(
+            np.arange(s)[None, :] >= lengths[:, None])
+        got = flash_attention(q, k, v, key_padding_mask=kpm)
+        want = mha_reference(q, k, v, key_padding_mask=kpm)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+    def test_bf16(self):
+        q, k, v = make_qkv(2, 128, 2, 64, dtype=jnp.bfloat16)
+        got = flash_attention(q, k, v, causal=True)
+        want = mha_reference(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), causal=True)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want), **TOL_BF16)
+
+    def test_generic_mask_falls_back(self):
+        q, k, v = make_qkv(1, 64, 2, 32)
+        mask = jnp.zeros((1, 1, 64, 64), bool).at[:, :, :, 10].set(True)
+        got = flash_attention(q, k, v, mask=mask)
+        want = mha_reference(q, k, v, mask=mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+
+class TestBackward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_reference(self, causal):
+        q, k, v = make_qkv(2, 128, 2, 64, seed=3)
+
+        def f_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal)
+            return jnp.sum(o * jnp.cos(o.astype(jnp.float32)))
+
+        def f_ref(q, k, v):
+            o = mha_reference(q, k, v, causal=causal)
+            return jnp.sum(o * jnp.cos(o.astype(jnp.float32)))
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4,
+                err_msg=f"d{name}")
+
+    def test_grads_with_key_padding(self):
+        b, s, n, d = 2, 128, 2, 32
+        q, k, v = make_qkv(b, s, n, d, seed=4)
+        kpm = jnp.asarray(np.arange(s)[None, :] >= np.array([96, 128])[:, None])
+
+        g1 = jax.grad(lambda *a: jnp.sum(
+            flash_attention(*a, key_padding_mask=kpm)), argnums=(0, 1, 2))(
+                q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(
+            mha_reference(*a, key_padding_mask=kpm)), argnums=(0, 1, 2))(
+                q, k, v)
+        for a, b, name in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4,
+                err_msg=f"d{name}")
+
+    def test_grads_unaligned(self):
+        q, k, v = make_qkv(1, 100, 2, 64, seed=5)
+        g1 = jax.grad(lambda *a: jnp.sum(
+            flash_attention(*a, causal=True)), argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(
+            mha_reference(*a, causal=True)), argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4,
+                err_msg=f"d{name}")

@@ -109,6 +109,28 @@ class TestSingleDevice:
         loss = gpt_loss(params, tokens, labels, cfg, attention_mask=mask)
         assert jnp.isfinite(loss)
 
+    def test_causal_combines_with_user_mask(self):
+        # causal LM + explicit padding mask: both must apply
+        cfg = tiny_cfg()   # attn_mask_type='causal'
+        params = init_gpt_params(jax.random.PRNGKey(8), cfg)
+        tokens, _ = data(cfg)
+        b, s = tokens.shape
+        pad = jnp.zeros((b, 1, s, s), bool).at[:, :, :, s // 2].set(True)
+        logits = gpt_forward(params, tokens, cfg, attention_mask=pad)
+        # perturbing the masked-out key position changes nothing downstream
+        tokens2 = tokens.at[:, s // 2].set(
+            (tokens[:, s // 2] + 1) % cfg.vocab_size)
+        logits2 = gpt_forward(params, tokens2, cfg, attention_mask=pad)
+        np.testing.assert_allclose(
+            np.asarray(logits[:, s // 2 + 1:]),
+            np.asarray(logits2[:, s // 2 + 1:]), atol=1e-5)
+        # and causality still holds with the mask present
+        tokens3 = tokens.at[:, -1].set((tokens[:, -1] + 1) % cfg.vocab_size)
+        logits3 = gpt_forward(params, tokens3, cfg, attention_mask=pad)
+        np.testing.assert_allclose(
+            np.asarray(logits[:, :-1]), np.asarray(logits3[:, :-1]),
+            atol=1e-5)
+
     def test_causality(self):
         cfg = tiny_cfg()
         params = init_gpt_params(jax.random.PRNGKey(2), cfg)
