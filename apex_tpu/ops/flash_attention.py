@@ -35,13 +35,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._pallas_utils import out_struct
+from apex_tpu.ops._pallas_utils import LANES as _LANES, out_struct
 from apex_tpu.utils.registry import on_tpu
 
 __all__ = ["flash_attention", "mha_reference"]
 
 _NEG_INF = -1e30
-_LANES = 128
 
 
 def _pad_to(x, size, axis):
@@ -91,7 +90,7 @@ def mha_reference(q, k, v, *, causal=False, key_padding_mask=None,
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(scale, causal, sq_real, sk_real, block_q, block_k, has_kpm,
+def _fwd_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
                 *refs):
     if has_kpm:
         q_ref, k_ref, v_ref, kpm_ref, o_ref, lse_ref, acc, m_s, l_s = refs
@@ -158,7 +157,7 @@ def _fwd_kernel(scale, causal, sq_real, sk_real, block_q, block_k, has_kpm,
             jnp.where(l == 0.0, _NEG_INF, lse), lse_ref.shape[1:])
 
 
-def _fwd_pallas(q3, k3, v3, kpm, scale, causal, sq_real, sk_real,
+def _fwd_pallas(q3, k3, v3, kpm, scale, causal, sk_real,
                 block_q, block_k, interpret):
     from jax.experimental.pallas import tpu as pltpu
 
@@ -192,7 +191,7 @@ def _fwd_pallas(q3, k3, v3, kpm, scale, causal, sq_real, sk_real,
         out_struct((bh, sqp, _LANES), jnp.float32, q3),
     ]
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale, causal, sq_real, sk_real,
+        functools.partial(_fwd_kernel, scale, causal, sk_real,
                           block_q, block_k, kpm is not None),
         grid=grid,
         in_specs=in_specs,
@@ -431,7 +430,7 @@ def _flash_fwd(q, k, v, kpm, causal, scale):
     v3 = _pad_to(_to_bh(v), skp, 1)
     kpm3 = (None if kpm is None
             else _pad_to(kpm.astype(jnp.int32)[:, None, :], skp, 2))
-    o3, lse = _fwd_pallas(q3, k3, v3, kpm3, scale, causal, sq, sk,
+    o3, lse = _fwd_pallas(q3, k3, v3, kpm3, scale, causal, sk,
                           block_q, block_k, interpret=not on_tpu())
     o = _from_bh(o3, b, n)[:, :sq]
     return o, (q, k, v, kpm, o, lse)

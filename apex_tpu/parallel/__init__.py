@@ -12,6 +12,7 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
     make_ddp_train_step,
 )
 from apex_tpu.parallel.LARC import LARC, larc  # noqa: F401
+from apex_tpu.parallel.ring_attention import ring_attention  # noqa: F401
 from apex_tpu.parallel.mesh import (  # noqa: F401
     create_mesh,
     data_parallel_mesh,
