@@ -12,6 +12,15 @@ from apex_tpu.models.config import (  # noqa: F401
     gpt_125m,
     gpt_tiny,
 )
+from apex_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    make_resnet_train_step,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+)
 from apex_tpu.models.gpt import (  # noqa: F401
     gpt_pipeline_loss_and_grads,
     make_gpt_pipeline_stage,

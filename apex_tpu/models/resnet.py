@@ -1,0 +1,218 @@
+"""ResNet family (RN18/34/50/101/152) — the reference's flagship CNN config.
+
+Reference: examples/imagenet/main_amp.py (torchvision resnet50 under
+amp.initialize O2 + apex.parallel.DistributedDataParallel + optional
+convert_syncbn_model) — the L1 correctness baseline and BASELINE.json's
+headline metric ('ImageNet RN50 imgs/sec/chip, AMP O2 + DDP'). TPU-native
+choices: NHWC layout end-to-end (channels ride the 128-lane minor dim;
+reference groupbn's NHWC is the default here), bf16 compute with fp32
+normalization statistics, SyncBatchNorm semantics (apex
+convert_syncbn_model analog): under GSPMD (jit over a mesh) leave
+``axis_name=None`` — ``jnp.mean`` over the dp-sharded batch axis already
+computes GLOBAL statistics, XLA inserts the collective; set ``axis_name``
+only inside shard_map/pmap where the explicit ``pmean`` is needed.
+ResNet-v1.5 downsampling (stride on the 3x3, torchvision semantics).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu.amp.frontend import make_train_step
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+__all__ = [
+    "ResNet",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "resnet152",
+    "make_resnet_train_step",
+]
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    stride: int = 1
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        bn = partial(SyncBatchNorm, axis_name=self.axis_name)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.stride, self.stride),
+                 padding=[(1, 1), (1, 1)], name="conv1")(x)
+        y = bn(self.filters, fuse_relu=True, name="bn1")(
+            y, use_running_average=not train)
+        y = conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)],
+                 name="conv2")(y)
+        y = bn(self.filters, name="bn2")(y, use_running_average=not train)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            strides=(self.stride, self.stride),
+                            name="downsample_conv")(x)
+            residual = bn(self.filters, name="downsample_bn")(
+                residual, use_running_average=not train)
+        return jax.nn.relu(y + residual.astype(y.dtype))
+
+
+class Bottleneck(nn.Module):
+    """v1.5 bottleneck: 1x1 → 3x3(stride) → 1x1x4 (torchvision layout,
+    the reference example's model and contrib.bottleneck's block shape)."""
+
+    filters: int
+    stride: int = 1
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        bn = partial(SyncBatchNorm, axis_name=self.axis_name)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        out_ch = self.filters * self.expansion
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = bn(self.filters, fuse_relu=True, name="bn1")(
+            y, use_running_average=not train)
+        y = conv(self.filters, (3, 3), strides=(self.stride, self.stride),
+                 padding=[(1, 1), (1, 1)], name="conv2")(y)
+        y = bn(self.filters, fuse_relu=True, name="bn2")(
+            y, use_running_average=not train)
+        y = conv(out_ch, (1, 1), name="conv3")(y)
+        y = bn(out_ch, name="bn3")(y, use_running_average=not train)
+        if residual.shape != y.shape:
+            residual = conv(out_ch, (1, 1),
+                            strides=(self.stride, self.stride),
+                            name="downsample_conv")(x)
+            residual = bn(out_ch, name="downsample_bn")(
+                residual, use_running_average=not train)
+        return jax.nn.relu(y + residual.astype(y.dtype))
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: Any
+    num_classes: int = 1000
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        bn = partial(SyncBatchNorm, axis_name=self.axis_name)
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2),
+                    padding=[(3, 3), (3, 3)], use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
+        x = bn(64, fuse_relu=True, name="bn1")(
+            x, use_running_average=not train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                stride = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    filters=64 * 2 ** i, stride=stride,
+                    axis_name=self.axis_name, dtype=self.dtype,
+                    name=f"layer{i + 1}_{j}")(x, train=train)
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        # classifier in fp32 (reference O2 keeps the loss path fp32)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+        return x
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet(stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock, **kw)
+
+
+def resnet34(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=BasicBlock, **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=Bottleneck, **kw)
+
+
+def resnet101(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 23, 3], block_cls=Bottleneck, **kw)
+
+
+def resnet152(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 8, 36, 3], block_cls=Bottleneck, **kw)
+
+
+def make_resnet_train_step(
+    model: ResNet,
+    optimizer: Any,
+    policy_or_amp="O2",
+    mesh: Optional[Mesh] = None,
+    *,
+    image_shape: Tuple[int, int, int] = (224, 224, 3),
+):
+    """AMP train step for the imagenet config (examples/imagenet/main_amp.py
+    hot loop, SURVEY.md §3.2 — here one jitted step: SyncBN stats pmean'd
+    by GSPMD, grads mean'd over 'dp' via sharding propagation, fused
+    optimizer update, dynamic loss scale with skip-step).
+
+    Returns ``(init_fn, step_fn)``:
+      ``init_fn(rng) -> (train_state, batch_stats)``;
+      ``step_fn(train_state, batch_stats, images, labels)
+          -> (train_state, batch_stats, metrics)`` — images NHWC.
+    """
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            images, train=True, mutable=["batch_stats"])
+        one_hot = jax.nn.one_hot(labels, logits.shape[-1],
+                                 dtype=jnp.float32)
+        loss = -jnp.mean(
+            jnp.sum(jax.nn.log_softmax(logits.astype(jnp.float32))
+                    * one_hot, axis=-1))
+        return loss, mutated["batch_stats"]
+
+    init_amp, step_amp = make_train_step(
+        loss_fn, optimizer, policy_or_amp, has_aux=True)
+
+    def init(rng):
+        variables = model.init(
+            rng, jnp.zeros((1, *image_shape), jnp.float32), train=False)
+        state = init_amp(variables["params"])
+        stats = variables["batch_stats"]
+        if mesh is not None:
+            rep = NamedSharding(mesh, P())
+            state = jax.device_put(state, jax.tree_util.tree_map(
+                lambda _: rep, state))
+            stats = jax.device_put(stats, jax.tree_util.tree_map(
+                lambda _: rep, stats))
+        return state, stats
+
+    def raw_step(state, stats, images, labels):
+        state, metrics = step_amp(state, stats, images, labels)
+        new_stats = metrics.pop("aux")
+        return state, new_stats, metrics
+
+    if mesh is None:
+        return init, jax.jit(raw_step, donate_argnums=(0, 1))
+
+    batch_sharding = NamedSharding(mesh, P("dp"))
+    jstep = jax.jit(
+        raw_step,
+        in_shardings=(None, None, batch_sharding, batch_sharding),
+        donate_argnums=(0, 1),
+    )
+
+    def step(state, stats, images, labels):
+        with jax.set_mesh(mesh):
+            return jstep(state, stats, images, labels)
+
+    return init, step

@@ -158,7 +158,7 @@ def _fwd_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
 
 
 def _fwd_pallas(q3, k3, v3, kpm, scale, causal, sk_real,
-                block_q, block_k, interpret):
+                block_q, block_k, interpret, out_dtype=None):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, sqp, d = q3.shape
@@ -187,7 +187,7 @@ def _fwd_pallas(q3, k3, v3, kpm, scale, causal, sk_real,
                      memory_space=pltpu.VMEM),
     ]
     out_shape = [
-        out_struct((bh, sqp, d), q3.dtype, q3),
+        out_struct((bh, sqp, d), out_dtype or q3.dtype, q3),
         out_struct((bh, sqp, _LANES), jnp.float32, q3),
     ]
     o, lse = pl.pallas_call(
@@ -323,7 +323,8 @@ def _bwd_dkv_kernel(scale, causal, sq_real, sk_real, block_q, block_k,
 
 
 def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, scale, causal,
-                sq_real, sk_real, block_q, block_k, interpret):
+                sq_real, sk_real, block_q, block_k, interpret,
+                out_dtype=None):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, sqp, d = q3.shape
@@ -359,7 +360,7 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, scale, causal,
         grid=(bh, sqp // block_q, skp // block_k),
         in_specs=in_specs,
         out_specs=qspec(qmap),
-        out_shape=out_struct((bh, sqp, d), q3.dtype, q3),
+        out_shape=out_struct((bh, sqp, d), out_dtype or q3.dtype, q3),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(*args)
@@ -382,8 +383,8 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, scale, causal,
         grid=(bh, skp // block_k, sqp // block_q),
         in_specs=in_specs,
         out_specs=[kspec(kmap2), kspec(kmap2)],
-        out_shape=[out_struct((bh, skp, d), k3.dtype, k3),
-                   out_struct((bh, skp, d), v3.dtype, k3)],
+        out_shape=[out_struct((bh, skp, d), out_dtype or k3.dtype, k3),
+                   out_struct((bh, skp, d), out_dtype or v3.dtype, k3)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,

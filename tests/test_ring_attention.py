@@ -29,7 +29,7 @@ def data(b, s, n, d, seed=0):
     return q, k, v
 
 
-def ring_fn(mesh, causal, sp=4):
+def ring_fn(mesh, causal):
     @jax.jit
     @functools.partial(
         shard_map, mesh=mesh,
@@ -65,7 +65,7 @@ class TestRingForward:
         b, s, n, d = 1, 256, 2, 32
         q, k, v = data(b, s, n, d, seed=2)
         mesh = create_mesh(sp=8)
-        got = ring_fn(mesh, True, sp=8)(q, k, v)
+        got = ring_fn(mesh, True)(q, k, v)
         want = mha_reference(q, k, v, causal=True)
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
