@@ -1,0 +1,7 @@
+"""apex.contrib analog: higher-level / specialized components.
+
+Reference: apex/contrib (fmha, multihead_attn, optimizers, xentropy,
+focal_loss, transducer, sparsity, peer_memory, ...). The TPU build keeps
+the namespace; fused attention lives in apex_tpu.ops.flash_attention and
+ring attention in apex_tpu.parallel.ring_attention.
+"""

@@ -1,0 +1,4 @@
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (  # noqa: F401
+    ZeroTrainState,
+    make_distributed_adam_train_step,
+)

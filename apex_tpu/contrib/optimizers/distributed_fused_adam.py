@@ -1,0 +1,270 @@
+"""DistributedFusedAdam — ZeRO-2 sharded-state Adam over the 'dp' axis.
+
+Reference: apex/contrib/optimizers/distributed_fused_adam.py (param flatten
+→ fixed-size buckets → optimizer state sharded across DP ranks; overlapped
+reduce-scatter grad sync + all-gather param sync; bf16
+``store_param_remainders`` packing — :273-470). TPU-native shape: ONE flat
+fp32 buffer instead of buckets (the Pallas flat Adam kernel streams it in
+one HBM pass), shard_map over 'dp' instead of NCCL process groups, and XLA
+collectives instead of hand-overlapped NCCL streams — grad sync is the
+SPMD-AD psum, param sync is the all-gather GSPMD inserts when the
+'dp'-sharded updated flat buffer is unraveled back into replicated params;
+overlap comes from the XLA latency-hiding scheduler.
+
+State per device (ZeRO-2): replicated compute-dtype params + a 1/dp shard
+of the fp32 master, m, and v — 12 bytes/param/dp instead of 12 bytes/param.
+With ``store_param_remainders`` the fp32 master shard is reconstructed
+bit-exactly from the bf16 param shard plus a signed 16-bit mantissa
+remainder (reference :461-467), shaving another 2 bytes/param/dp.
+
+Full AMP semantics ride along: dynamic loss scaling, global finite check
+(the transformer GradScaler's found-inf allreduce,
+apex/transformer/amp/grad_scaler.py:21), skip-on-overflow.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu.amp import scaler as scaler_lib
+from apex_tpu.amp.policy import _effective, policy_for_opt_level
+from apex_tpu.ops.pallas_adam import adam_kernel_flat
+from apex_tpu.utils.registry import on_tpu
+
+__all__ = ["ZeroTrainState", "make_distributed_adam_train_step"]
+
+_LANES = 128
+
+
+class ZeroTrainState(NamedTuple):
+    step: jax.Array                 # i32, replicated
+    params: Any                     # compute-dtype pytree, replicated
+    master_shard: jax.Array         # f32 [n] sharded | int16 remainders
+    m_shard: jax.Array              # f32 [n] sharded over dp
+    v_shard: jax.Array              # f32 [n] sharded over dp
+    loss_scale_state: Any
+
+
+def _split_bits(x32: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """f32 → (truncated bf16 = high 16 bits, int16 = low 16 bits).
+
+    Truncation, not round-to-nearest: the reference kernel does
+    ``remainder = full & 0xFFFF; param = bf16(full >> 16)``
+    (multi_tensor_distopt_adam_kernel.cu) — and rounding has an unpackable
+    tie case (remainder +2^15 does not fit int16)."""
+    bits = jax.lax.bitcast_convert_type(x32, jnp.uint32)
+    bf = jax.lax.bitcast_convert_type(
+        (bits >> 16).astype(jnp.uint16), jnp.bfloat16)
+    rem = jax.lax.bitcast_convert_type(
+        (bits & 0xFFFF).astype(jnp.uint16), jnp.int16)
+    return bf, rem
+
+
+def _combine_bits(bf: jax.Array, rem: jax.Array) -> jax.Array:
+    hi = jax.lax.bitcast_convert_type(bf, jnp.uint16).astype(jnp.uint32) << 16
+    lo = jax.lax.bitcast_convert_type(rem, jnp.uint16).astype(jnp.uint32)
+    return jax.lax.bitcast_convert_type(hi | lo, jnp.float32)
+
+
+def make_distributed_adam_train_step(
+    loss_fn: Callable,
+    mesh: Mesh,
+    *,
+    axis_name: str = "dp",
+    lr: float = 1e-3,
+    betas: Tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    adam_w_mode: bool = True,
+    bias_correction: bool = True,
+    amp: str = "O2",
+    loss_scale="dynamic",
+    store_param_remainders: bool = False,
+    grad_clip_norm: Optional[float] = None,
+):
+    """Build ``(init_fn, step_fn)`` with ZeRO-2 sharded optimizer state.
+
+    ``loss_fn(params, *batch) -> loss`` runs on compute-dtype params.
+    ``init_fn(params_f32) -> ZeroTrainState`` (device_put onto ``mesh``:
+    params replicated, flat shards split along ``axis_name``).
+    ``step_fn(state, *batch) -> (state, metrics)`` — batch sharded on its
+    leading dim.
+    """
+    policy = policy_for_opt_level(amp)
+    # uniform compute dtype for the whole flat buffer (the fp32 master
+    # shard covers every param, so there is no keep-norm-fp32 split here);
+    # _effective realizes fp16 opt levels as bf16 on TPU
+    param_dtype = _effective(policy.param_dtype)
+    beta1, beta2 = betas
+    ndev = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    ls_cfg, ls_state0 = scaler_lib.init_loss_scale(loss_scale)
+    if store_param_remainders and param_dtype != jnp.bfloat16:
+        raise ValueError(
+            "store_param_remainders packs fp32 = bf16 param + 16-bit "
+            f"remainder; param dtype is {param_dtype} (use a bf16 "
+            "opt level — O2 maps to bf16 on TPU, O5 everywhere)"
+        )
+
+    def init_fn(params) -> ZeroTrainState:
+        # copy even for same-dtype leaves: aliasing the caller's arrays
+        # means step_fn's donate_argnums would delete them out from under
+        # the caller (same rationale as amp.frontend init_fn)
+        f32 = jax.tree_util.tree_map(
+            lambda x: jnp.array(x, jnp.float32, copy=True), params)
+        flat, _ = ravel_pytree(f32)
+        n = flat.shape[0]
+        shard_n = -(-n // (ndev * _LANES)) * _LANES
+        padded = shard_n * ndev
+        flat = jnp.pad(flat, (0, padded - n))
+        if store_param_remainders:
+            # compute params must be the TRUNCATED bf16 (high 16 bits of
+            # the master) so reconstruction is exact — see _split_bits
+            compute = jax.tree_util.tree_map(
+                lambda x: _split_bits(x)[0]
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, f32)
+            master = _split_bits(flat)[1]
+        else:
+            compute = jax.tree_util.tree_map(
+                lambda x: x.astype(param_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, f32)
+            master = flat
+        zeros = jnp.zeros((padded,), jnp.float32)
+        state = ZeroTrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=compute,
+            master_shard=master,
+            m_shard=zeros,
+            v_shard=zeros,
+            loss_scale_state=ls_state0,
+        )
+        rep = NamedSharding(mesh, P())
+        shard = NamedSharding(mesh, P(axis_name))
+        return jax.device_put(state, ZeroTrainState(
+            step=rep,
+            params=jax.tree_util.tree_map(lambda _: rep, state.params),
+            master_shard=shard, m_shard=shard, v_shard=shard,
+            loss_scale_state=jax.tree_util.tree_map(
+                lambda _: rep, state.loss_scale_state),
+        ))
+
+    def shard_step(state: ZeroTrainState, *batch):
+        my = jax.lax.axis_index(axis_name)
+        shard_n = state.m_shard.shape[0]
+        ls_state = state.loss_scale_state
+
+        # grads w.r.t. the replicated compute params; shard_map SPMD-AD
+        # psums them — that allreduce IS the ZeRO grad sync
+        def scaled_loss(p):
+            loss = loss_fn(p, *batch)
+            return scaler_lib.scale_loss(loss, ls_state), loss
+
+        grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params)
+        loss = jax.lax.pmean(loss, axis_name)
+
+        g_flat, _ = ravel_pytree(jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads))
+        total = shard_n * ndev
+        g_flat = jnp.pad(g_flat, (0, total - g_flat.shape[0]))
+        # ZeRO-2: this rank only keeps its shard of the summed grads
+        g_local = jax.lax.dynamic_slice(g_flat, (my * shard_n,), (shard_n,))
+        g_local = g_local / (ndev * ls_state.loss_scale)
+
+        finite = jnp.all(jnp.isfinite(g_local))
+        finite = jax.lax.pmin(finite.astype(jnp.int32), axis_name) > 0
+
+        if grad_clip_norm is not None:
+            sq = jax.lax.psum(jnp.sum(g_local * g_local), axis_name)
+            g_local = g_local * jnp.minimum(
+                1.0, grad_clip_norm / jnp.maximum(jnp.sqrt(sq), 1e-6))
+
+        bf_flat, _ = ravel_pytree(state.params)
+        # pad BEFORE slicing: dynamic_slice clamps out-of-bounds starts,
+        # which would hand the last shard a shifted window
+        bf_flat = jnp.pad(bf_flat, (0, total - bf_flat.shape[0]))
+        bf_local = jax.lax.dynamic_slice(bf_flat, (my * shard_n,),
+                                         (shard_n,))
+        master = (_combine_bits(bf_local, state.master_shard)
+                  if store_param_remainders else state.master_shard)
+
+        step_new = (state.step + 1).astype(jnp.float32)
+        bc1 = 1.0 - beta1 ** step_new if bias_correction else jnp.float32(1)
+        bc2 = 1.0 - beta2 ** step_new if bias_correction else jnp.float32(1)
+        if on_tpu():
+            scalars = jnp.stack([
+                jnp.asarray(lr, jnp.float32), jnp.float32(beta1),
+                jnp.float32(beta2), jnp.float32(eps),
+                jnp.asarray(weight_decay, jnp.float32), bc1, bc2])
+            u, m_new, v_new = adam_kernel_flat(
+                g_local, master, state.m_shard, state.v_shard, scalars,
+                adam_w_mode=adam_w_mode, interpret=False)
+        else:
+            # closed-form XLA path (the Pallas interpreter cannot run
+            # under shard_map vma typing); same math as _adam_body
+            g = g_local if adam_w_mode else g_local + weight_decay * master
+            m_new = beta1 * state.m_shard + (1.0 - beta1) * g
+            v_new = beta2 * state.v_shard + (1.0 - beta2) * g * g
+            u = -lr * (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if adam_w_mode:
+                u = u - lr * weight_decay * master
+        master_new = master + u
+
+        new_ls, overflow = scaler_lib.update_loss_scale(
+            ls_cfg, ls_state, ~finite)
+
+        def pick(new, old):
+            return jnp.where(overflow, old, new)
+
+        master_new = pick(master_new, master)
+        m_new = pick(m_new, state.m_shard)
+        v_new = pick(v_new, state.v_shard)
+
+        if store_param_remainders:
+            bf_new_local, master_store = _split_bits(master_new)
+        else:
+            bf_new_local = master_new.astype(bf_local.dtype)
+            master_store = master_new
+
+        partial = ZeroTrainState(
+            step=state.step + jnp.where(overflow, 0, 1),
+            params=None,                 # rebuilt outside the shard_map
+            master_shard=master_store,
+            m_shard=m_new,
+            v_shard=v_new,
+            loss_scale_state=new_ls,
+        )
+        metrics = {"loss": loss, "overflow": overflow,
+                   "loss_scale": new_ls.loss_scale}
+        return partial, bf_new_local, metrics
+
+    def step_fn(state: ZeroTrainState, *batch):
+        bf_flat, unravel_bf = ravel_pytree(state.params)
+        pspec = jax.tree_util.tree_map(lambda _: P(), state.params)
+        ls_spec = jax.tree_util.tree_map(
+            lambda _: P(), state.loss_scale_state)
+        in_state_spec = ZeroTrainState(
+            step=P(), params=pspec, master_shard=P(axis_name),
+            m_shard=P(axis_name), v_shard=P(axis_name),
+            loss_scale_state=ls_spec)
+        out_state_spec = in_state_spec._replace(params=None)
+        fn = jax.shard_map(
+            shard_step, mesh=mesh,
+            in_specs=(in_state_spec,) + tuple(P(axis_name) for _ in batch),
+            out_specs=(out_state_spec, P(axis_name), {
+                "loss": P(), "overflow": P(), "loss_scale": P()}),
+        )
+        partial, bf_new, metrics = fn(state, *batch)
+        # 'dp'-sharded flat buffer → replicated params: GSPMD inserts the
+        # ZeRO all-gather here (the reference's overlapped param sync)
+        params_new = unravel_bf(bf_new[: bf_flat.shape[0]])
+        return partial._replace(params=params_new), metrics
+
+    # NB: no donate_argnums — donating any input to a jit containing this
+    # shard_map raises INVALID_ARGUMENT on the tunneled TPU backend (the
+    # same donation works for plain-GSPMD steps); revisit when the backend
+    # accepts it, since donation halves peak optimizer-state memory here
+    return init_fn, jax.jit(step_fn)

@@ -22,9 +22,10 @@ from typing import Any, Optional, Sequence, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from apex_tpu.amp.frontend import make_train_step
+from apex_tpu.parallel.mesh import replicate, shard_batch
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
 
 __all__ = [
@@ -189,11 +190,8 @@ def make_resnet_train_step(
         state = init_amp(variables["params"])
         stats = variables["batch_stats"]
         if mesh is not None:
-            rep = NamedSharding(mesh, P())
-            state = jax.device_put(state, jax.tree_util.tree_map(
-                lambda _: rep, state))
-            stats = jax.device_put(stats, jax.tree_util.tree_map(
-                lambda _: rep, stats))
+            state = jax.device_put(state, replicate(mesh))
+            stats = jax.device_put(stats, replicate(mesh))
         return state, stats
 
     def raw_step(state, stats, images, labels):
@@ -204,7 +202,7 @@ def make_resnet_train_step(
     if mesh is None:
         return init, jax.jit(raw_step, donate_argnums=(0, 1))
 
-    batch_sharding = NamedSharding(mesh, P("dp"))
+    batch_sharding = shard_batch(mesh)
     jstep = jax.jit(
         raw_step,
         in_shardings=(None, None, batch_sharding, batch_sharding),

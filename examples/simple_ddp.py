@@ -16,8 +16,7 @@ import numpy as np
 
 from apex_tpu import amp
 from apex_tpu.optimizers import fused_adam
-from apex_tpu.parallel.mesh import create_mesh, shard_batch
-from jax.sharding import NamedSharding, PartitionSpec as P
+from apex_tpu.parallel.mesh import create_mesh, replicate, shard_batch
 
 
 def main():
@@ -41,8 +40,7 @@ def main():
     mesh = create_mesh()                      # all devices on 'dp'
     init, step = amp.make_train_step(loss_fn, fused_adam(lr=1e-3), "O1")
     state = init(params)
-    state = jax.device_put(state, jax.tree_util.tree_map(
-        lambda _: NamedSharding(mesh, P()), state))
+    state = jax.device_put(state, replicate(mesh))
     x = jax.device_put(x, shard_batch(mesh))
     y = jax.device_put(y, shard_batch(mesh))
 
