@@ -1,0 +1,10 @@
+from apex_tpu.RNN.models import GRU, LSTM, RNN, mLSTM  # noqa: F401
+from apex_tpu.RNN.cells import (  # noqa: F401
+    gru_cell,
+    init_cell_params,
+    lstm_cell,
+    mlstm_cell,
+    rnn_relu_cell,
+    rnn_tanh_cell,
+)
+from apex_tpu.RNN.runner import run_rnn  # noqa: F401

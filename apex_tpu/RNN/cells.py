@@ -1,0 +1,121 @@
+"""Recurrent cells (RNN/LSTM/GRU/mLSTM).
+
+Reference: apex/RNN/RNNBackend.py (``RNNCell`` :232 — a generic cell with
+``gate_multiplier`` × hidden gates and a nonlinearity; LSTMCell/GRUCell in
+cells.py; mLSTM from "Multiplicative LSTM for sequence modelling",
+Krause et al. 2016 — apex/RNN/models.py:19). The reference marks the whole
+package "under construction" (apex/RNN/README.md:1); this port completes
+the same surface functionally: pure cell functions + init, composed by
+``runner.run_rnn`` with lax.scan.
+
+Gate layouts follow torch convention (i, f, g, o for LSTM; r, z, n for
+GRU) so ported weights drop in.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "init_cell_params",
+    "rnn_relu_cell",
+    "rnn_tanh_cell",
+    "lstm_cell",
+    "gru_cell",
+    "mlstm_cell",
+]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3, "mlstm": 4}
+
+
+def init_cell_params(rng: jax.Array, cell: str, input_size: int,
+                     hidden_size: int, dtype=jnp.float32) -> dict:
+    """Uniform(-1/sqrt(h), 1/sqrt(h)) like torch RNN init."""
+    g = _GATES[cell]
+    k = 1.0 / hidden_size ** 0.5
+    ks = jax.random.split(rng, 6)
+
+    def u(key, shape):
+        return jax.random.uniform(key, shape, dtype, -k, k)
+
+    p = {
+        "w_ih": u(ks[0], (input_size, g * hidden_size)),
+        "w_hh": u(ks[1], (hidden_size, g * hidden_size)),
+        "b_ih": u(ks[2], (g * hidden_size,)),
+        "b_hh": u(ks[3], (g * hidden_size,)),
+    }
+    if cell == "mlstm":
+        # multiplicative intermediate state m = (x W_mx) ⊙ (h W_mh)
+        p["w_mx"] = u(ks[4], (input_size, hidden_size))
+        p["w_mh"] = u(ks[5], (hidden_size, hidden_size))
+    return p
+
+
+def _gates(p, x, h):
+    return (x @ p["w_ih"] + p["b_ih"]) + (h @ p["w_hh"] + p["b_hh"])
+
+
+def rnn_relu_cell(p, state, x):
+    h = jax.nn.relu(_gates(p, x, state[0]))
+    return (h,), h
+
+
+def rnn_tanh_cell(p, state, x):
+    h = jnp.tanh(_gates(p, x, state[0]))
+    return (h,), h
+
+
+def lstm_cell(p, state, x):
+    h, c = state
+    i, f, g, o = jnp.split(_gates(p, x, h), 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    c = f * c + i * jnp.tanh(g)
+    h = o * jnp.tanh(c)
+    return (h, c), h
+
+
+def gru_cell(p, state, x):
+    h = state[0]
+    xg = x @ p["w_ih"] + p["b_ih"]
+    hg = h @ p["w_hh"] + p["b_hh"]
+    xr, xz, xn = jnp.split(xg, 3, axis=-1)
+    hr, hz, hn = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    h = (1.0 - z) * n + z * h
+    return (h,), h
+
+
+def mlstm_cell(p, state, x):
+    """Multiplicative LSTM (reference mLSTMRNNCell, RNNBackend.py +
+    models.py:19): the hidden fed to the gates is the multiplicative
+    state m = (x W_mx) ⊙ (h W_mh)."""
+    h, c = state
+    m = (x @ p["w_mx"]) * (h @ p["w_mh"])
+    i, f, g, o = jnp.split(
+        (x @ p["w_ih"] + p["b_ih"]) + (m @ p["w_hh"] + p["b_hh"]), 4,
+        axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    c = f * c + i * jnp.tanh(g)
+    h = o * jnp.tanh(c)
+    return (h, c), h
+
+
+CELLS = {
+    "rnn_relu": rnn_relu_cell,
+    "rnn_tanh": rnn_tanh_cell,
+    "lstm": lstm_cell,
+    "gru": gru_cell,
+    "mlstm": mlstm_cell,
+}
+
+
+def zero_state(cell: str, batch: int, hidden: int, dtype) -> Tuple:
+    h = jnp.zeros((batch, hidden), dtype)
+    if cell in ("lstm", "mlstm"):
+        return (h, jnp.zeros((batch, hidden), dtype))
+    return (h,)

@@ -1,0 +1,69 @@
+"""RNN model factories.
+
+Reference: apex/RNN/models.py (``RNN`` :47 dispatching on nonlinearity,
+``LSTM`` :19, ``GRU`` :26, ``mLSTM`` :33). Factories return a lightweight
+module holding params + config, callable on [T, B, D] sequences.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from apex_tpu.RNN.runner import init_rnn_params, run_rnn
+
+__all__ = ["RNN", "LSTM", "GRU", "mLSTM"]
+
+
+class _RNNModule:
+    def __init__(self, cell: str, input_size: int, hidden_size: int,
+                 num_layers: int = 1, bidirectional: bool = False,
+                 dropout: float = 0.0):
+        self.cell = cell
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirectional = bidirectional
+        self.dropout = dropout
+
+    def init(self, rng: jax.Array, dtype=None):
+        import jax.numpy as jnp
+
+        return init_rnn_params(
+            rng, self.cell, self.input_size, self.hidden_size,
+            self.num_layers, self.bidirectional, dtype or jnp.float32)
+
+    def __call__(self, params, x, *, initial_states=None,
+                 dropout_rng: Optional[jax.Array] = None):
+        return run_rnn(
+            params, x, self.cell, bidirectional=self.bidirectional,
+            dropout=self.dropout, dropout_rng=dropout_rng,
+            initial_states=initial_states)
+
+
+def RNN(input_size, hidden_size, num_layers=1, nonlinearity="tanh",
+        bidirectional=False, dropout=0.0) -> _RNNModule:
+    """reference models.py:47 — nonlinearity 'tanh' | 'relu'."""
+    cell = {"tanh": "rnn_tanh", "relu": "rnn_relu"}[nonlinearity]
+    return _RNNModule(cell, input_size, hidden_size, num_layers,
+                      bidirectional, dropout)
+
+
+def LSTM(input_size, hidden_size, num_layers=1, bidirectional=False,
+         dropout=0.0) -> _RNNModule:
+    return _RNNModule("lstm", input_size, hidden_size, num_layers,
+                      bidirectional, dropout)
+
+
+def GRU(input_size, hidden_size, num_layers=1, bidirectional=False,
+        dropout=0.0) -> _RNNModule:
+    return _RNNModule("gru", input_size, hidden_size, num_layers,
+                      bidirectional, dropout)
+
+
+def mLSTM(input_size, hidden_size, num_layers=1, dropout=0.0) -> _RNNModule:
+    """Multiplicative LSTM (reference models.py:33; no bidirectional
+    variant upstream either)."""
+    return _RNNModule("mlstm", input_size, hidden_size, num_layers,
+                      False, dropout)

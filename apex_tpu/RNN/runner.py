@@ -1,0 +1,84 @@
+"""Stacked / bidirectional RNN runner.
+
+Reference: apex/RNN/RNNBackend.py — ``stackedRNN`` :90 (layer stack with
+inter-layer dropout), ``bidirectionalRNN`` :25 (fwd + reversed-bwd concat).
+Here one function drives any cell with ``lax.scan`` over time (the
+compiler-friendly control flow the reference's Python loop over timesteps
+can't give XLA).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.RNN.cells import CELLS, init_cell_params, zero_state
+
+__all__ = ["init_rnn_params", "run_rnn"]
+
+
+def init_rnn_params(rng, cell: str, input_size: int, hidden_size: int,
+                    num_layers: int = 1, bidirectional: bool = False,
+                    dtype=jnp.float32) -> list:
+    dirs = 2 if bidirectional else 1
+    layers = []
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else hidden_size * dirs
+        per_dir = []
+        for _ in range(dirs):
+            rng, k = jax.random.split(rng)
+            per_dir.append(init_cell_params(k, cell, in_sz, hidden_size,
+                                            dtype))
+        layers.append(per_dir)
+    return layers
+
+
+def run_rnn(
+    params: list,
+    x: jax.Array,
+    cell: str = "lstm",
+    *,
+    bidirectional: bool = False,
+    dropout: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    initial_states: Optional[list] = None,
+):
+    """x [T, B, D] → (outputs [T, B, H·dirs], final_states).
+
+    Layout matches the reference (seq-first, RNNBackend.py:107).
+    ``initial_states[layer][direction]`` defaults to zeros.
+    """
+    cell_fn = CELLS[cell]
+    T, B, _ = x.shape
+    hidden = params[0][0]["w_hh"].shape[0]
+    finals = []
+
+    def scan_dir(p, seq, state0):
+        def step(state, xt):
+            return cell_fn(p, state, xt)
+
+        return jax.lax.scan(step, state0, seq)
+
+    h = x
+    for li, layer in enumerate(params):
+        outs = []
+        layer_finals = []
+        for di, p in enumerate(layer):
+            seq = h if di == 0 else jnp.flip(h, axis=0)
+            s0 = (initial_states[li][di] if initial_states is not None
+                  else zero_state(cell, B, hidden, h.dtype))
+            final, ys = scan_dir(p, seq, s0)
+            if di == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs.append(ys)
+            layer_finals.append(final)
+        h = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=-1)
+        finals.append(layer_finals)
+        if dropout > 0.0 and dropout_rng is not None \
+                and li < len(params) - 1:
+            dropout_rng, k = jax.random.split(dropout_rng)
+            keep = jax.random.bernoulli(k, 1.0 - dropout, h.shape)
+            h = jnp.where(keep, h / (1.0 - dropout), 0.0)
+    return h, finals

@@ -1,0 +1,2 @@
+"""apex.contrib.clip_grad parity (clip_grad.py:16 fused clip_grad_norm_)."""
+from apex_tpu.parallel.clip_grad import clip_grad_norm, clip_grad_norm_  # noqa: F401

@@ -1,0 +1,1 @@
+from apex_tpu.contrib.focal_loss.focal_loss import FocalLoss, focal_loss  # noqa: F401

@@ -1,0 +1,1 @@
+from apex_tpu.contrib.index_mul_2d.index_mul_2d import index_mul_2d  # noqa: F401

@@ -28,12 +28,12 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from apex_tpu.amp import scaler as scaler_lib
 from apex_tpu.amp.policy import _effective, policy_for_opt_level
 from apex_tpu.ops.pallas_adam import adam_kernel_flat
+from apex_tpu.utils.collectives import flag_and
 from apex_tpu.utils.registry import on_tpu
 
 __all__ = ["ZeroTrainState", "make_distributed_adam_train_step"]
@@ -48,6 +48,51 @@ class ZeroTrainState(NamedTuple):
     m_shard: jax.Array              # f32 [n] sharded over dp
     v_shard: jax.Array              # f32 [n] sharded over dp
     loss_scale_state: Any
+
+
+def _is_float(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _ravel_floats(tree):
+    """Flatten ONLY floating leaves into one f32 vector; non-float leaves
+    (step counters, int tables) stay out of the master buffer entirely.
+
+    Returns (flat, unravel) where ``unravel(new_flat, like_tree)`` rebuilds
+    the full tree: float leaves from the buffer cast to each like-leaf's
+    dtype, non-float leaves taken from ``like_tree`` verbatim."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    fmask = [_is_float(x) for x in leaves]
+    shapes = [x.shape for x in leaves]
+    sizes = [int(np_prod(x.shape)) if m else 0
+             for x, m in zip(leaves, fmask)]
+    if any(fmask):
+        flat = jnp.concatenate(
+            [x.reshape(-1).astype(jnp.float32)
+             for x, m in zip(leaves, fmask) if m])
+    else:
+        flat = jnp.zeros((0,), jnp.float32)
+
+    def unravel(new_flat, like_tree):
+        like = jax.tree_util.tree_flatten(like_tree)[0]
+        out, off = [], 0
+        for x, m, shp, sz in zip(like, fmask, shapes, sizes):
+            if m:
+                out.append(new_flat[off: off + sz].reshape(shp)
+                           .astype(x.dtype))
+                off += sz
+            else:
+                out.append(x)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return flat, unravel
+
+
+def np_prod(shape):
+    r = 1
+    for d in shape:
+        r *= int(d)
+    return r
 
 
 def _split_bits(x32: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -83,7 +128,7 @@ def make_distributed_adam_train_step(
     adam_w_mode: bool = True,
     bias_correction: bool = True,
     amp: str = "O2",
-    loss_scale="dynamic",
+    loss_scale=None,
     store_param_remainders: bool = False,
     grad_clip_norm: Optional[float] = None,
 ):
@@ -102,6 +147,8 @@ def make_distributed_adam_train_step(
     param_dtype = _effective(policy.param_dtype)
     beta1, beta2 = betas
     ndev = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    if loss_scale is None:
+        loss_scale = policy.loss_scale    # inherit the opt level's choice
     ls_cfg, ls_state0 = scaler_lib.init_loss_scale(loss_scale)
     if store_param_remainders and param_dtype != jnp.bfloat16:
         raise ValueError(
@@ -115,8 +162,9 @@ def make_distributed_adam_train_step(
         # means step_fn's donate_argnums would delete them out from under
         # the caller (same rationale as amp.frontend init_fn)
         f32 = jax.tree_util.tree_map(
-            lambda x: jnp.array(x, jnp.float32, copy=True), params)
-        flat, _ = ravel_pytree(f32)
+            lambda x: jnp.array(x, jnp.float32, copy=True)
+            if _is_float(x) else x, params)
+        flat, _ = _ravel_floats(f32)
         n = flat.shape[0]
         shard_n = -(-n // (ndev * _LANES)) * _LANES
         padded = shard_n * ndev
@@ -125,13 +173,12 @@ def make_distributed_adam_train_step(
             # compute params must be the TRUNCATED bf16 (high 16 bits of
             # the master) so reconstruction is exact — see _split_bits
             compute = jax.tree_util.tree_map(
-                lambda x: _split_bits(x)[0]
-                if jnp.issubdtype(x.dtype, jnp.floating) else x, f32)
+                lambda x: _split_bits(x)[0] if _is_float(x) else x, f32)
             master = _split_bits(flat)[1]
         else:
             compute = jax.tree_util.tree_map(
-                lambda x: x.astype(param_dtype)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x, f32)
+                lambda x: x.astype(param_dtype) if _is_float(x) else x,
+                f32)
             master = flat
         zeros = jnp.zeros((padded,), jnp.float32)
         state = ZeroTrainState(
@@ -163,32 +210,34 @@ def make_distributed_adam_train_step(
             loss = loss_fn(p, *batch)
             return scaler_lib.scale_loss(loss, ls_state), loss
 
-        grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params)
+        # allow_int: non-float leaves (int tables etc.) ride in the tree;
+        # their float0 "grads" are skipped by _ravel_floats
+        grads, loss = jax.grad(scaled_loss, has_aux=True,
+                               allow_int=True)(state.params)
         loss = jax.lax.pmean(loss, axis_name)
 
-        g_flat, _ = ravel_pytree(jax.tree_util.tree_map(
-            lambda g: g.astype(jnp.float32), grads))
+        g_flat, _ = _ravel_floats(grads)
         total = shard_n * ndev
         g_flat = jnp.pad(g_flat, (0, total - g_flat.shape[0]))
         # ZeRO-2: this rank only keeps its shard of the summed grads
         g_local = jax.lax.dynamic_slice(g_flat, (my * shard_n,), (shard_n,))
         g_local = g_local / (ndev * ls_state.loss_scale)
 
-        finite = jnp.all(jnp.isfinite(g_local))
-        finite = jax.lax.pmin(finite.astype(jnp.int32), axis_name) > 0
+        finite = flag_and(jnp.all(jnp.isfinite(g_local)), axis_name)
 
         if grad_clip_norm is not None:
             sq = jax.lax.psum(jnp.sum(g_local * g_local), axis_name)
             g_local = g_local * jnp.minimum(
                 1.0, grad_clip_norm / jnp.maximum(jnp.sqrt(sq), 1e-6))
 
-        bf_flat, _ = ravel_pytree(state.params)
+        bf_flat, _ = _ravel_floats(state.params)
         # pad BEFORE slicing: dynamic_slice clamps out-of-bounds starts,
         # which would hand the last shard a shifted window
         bf_flat = jnp.pad(bf_flat, (0, total - bf_flat.shape[0]))
         bf_local = jax.lax.dynamic_slice(bf_flat, (my * shard_n,),
                                          (shard_n,))
-        master = (_combine_bits(bf_local, state.master_shard)
+        master = (_combine_bits(bf_local.astype(jnp.bfloat16),
+                                state.master_shard)
                   if store_param_remainders else state.master_shard)
 
         step_new = (state.step + 1).astype(jnp.float32)
@@ -226,7 +275,8 @@ def make_distributed_adam_train_step(
         if store_param_remainders:
             bf_new_local, master_store = _split_bits(master_new)
         else:
-            bf_new_local = master_new.astype(bf_local.dtype)
+            # communicate the param sync at compute precision
+            bf_new_local = master_new.astype(param_dtype)
             master_store = master_new
 
         partial = ZeroTrainState(
@@ -242,7 +292,7 @@ def make_distributed_adam_train_step(
         return partial, bf_new_local, metrics
 
     def step_fn(state: ZeroTrainState, *batch):
-        bf_flat, unravel_bf = ravel_pytree(state.params)
+        bf_flat, unravel_bf = _ravel_floats(state.params)
         pspec = jax.tree_util.tree_map(lambda _: P(), state.params)
         ls_spec = jax.tree_util.tree_map(
             lambda _: P(), state.loss_scale_state)
@@ -260,7 +310,7 @@ def make_distributed_adam_train_step(
         partial, bf_new, metrics = fn(state, *batch)
         # 'dp'-sharded flat buffer → replicated params: GSPMD inserts the
         # ZeRO all-gather here (the reference's overlapped param sync)
-        params_new = unravel_bf(bf_new[: bf_flat.shape[0]])
+        params_new = unravel_bf(bf_new[: bf_flat.shape[0]], state.params)
         return partial._replace(params=params_new), metrics
 
     # NB: no donate_argnums — donating any input to a jit containing this

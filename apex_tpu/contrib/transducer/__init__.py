@@ -1,0 +1,7 @@
+from apex_tpu.contrib.transducer.transducer import (  # noqa: F401
+    TransducerJoint,
+    TransducerLoss,
+    joint_mask,
+    transducer_joint,
+    transducer_loss,
+)

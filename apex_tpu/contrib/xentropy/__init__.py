@@ -1,0 +1,7 @@
+"""apex.contrib.xentropy parity: the fused label-smoothing CE lives in
+apex_tpu.ops.xentropy (reference xentropy/interface.cpp:50 →
+SoftmaxCrossEntropyLoss, softmax_xentropy.py:4)."""
+from apex_tpu.ops.xentropy import (  # noqa: F401
+    SoftmaxCrossEntropyLoss,
+    softmax_cross_entropy_loss,
+)

@@ -1,0 +1,108 @@
+"""Legacy FP16_Optimizer — master-weight wrapper with loss scaling.
+
+Reference: apex/fp16_utils/fp16_optimizer.py:13 (wraps an existing
+optimizer: keeps fp32 masters, scales the loss, unscales/copies grads,
+skips steps on overflow) — the pre-``amp`` manual path the reference keeps
+for backward compatibility. The JAX translation is a thin stateful shell
+over the same primitives the functional path uses
+(apex_tpu.amp.{policy,scaler} + any optax-style optimizer); prefer
+``amp.make_train_step`` for new code — this class exists for API parity
+and for porting reference training scripts 1:1.
+
+Usage (mirrors reference README.md:60-97 workflow)::
+
+    opt = FP16_Optimizer(fused_adam(lr=1e-3), params,
+                         dynamic_loss_scale=True)
+    for batch in data:
+        loss, grads = jax.value_and_grad(loss_fn)(opt.model_params, *batch)
+        opt.step(grads)          # unscale → check → update → recast
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import scaler as scaler_lib
+from apex_tpu.fp16_utils.fp16util import (
+    model_grads_to_master_grads,
+    network_to_half,
+)
+
+__all__ = ["FP16_Optimizer"]
+
+
+class FP16_Optimizer:
+    def __init__(self, optimizer: Any, params: Any, *,
+                 static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: dict = None,
+                 cast_model_params: bool = True):
+        self.optimizer = optimizer
+        self.master_params = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, jnp.float32)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x, params)
+        self.model_params = (network_to_half(params) if cast_model_params
+                             else params)
+        self.opt_state = optimizer.init(self.master_params)
+        spec = "dynamic" if dynamic_loss_scale else static_loss_scale
+        self.ls_cfg, self.ls_state = scaler_lib.init_loss_scale(
+            spec, **(dynamic_loss_args or {}))
+        self.overflow = False
+
+    @property
+    def loss_scale(self) -> float:
+        return float(self.ls_state.loss_scale)
+
+    def scale_loss(self, loss):
+        """Multiply the loss by the current scale (use inside your grad
+        fn; reference ``backward(loss)`` fused this with autograd)."""
+        return scaler_lib.scale_loss(loss, self.ls_state)
+
+    def step(self, model_grads: Any) -> bool:
+        """Unscale grads, update masters (skipped on overflow), recast
+        model params. Returns True if the step was skipped."""
+        master_grads = model_grads_to_master_grads(model_grads)
+        master_grads, finite = scaler_lib.unscale_grads(
+            master_grads, self.ls_state)
+        self.ls_state, skip = scaler_lib.update_loss_scale(
+            self.ls_cfg, self.ls_state, ~finite)
+        self.overflow = bool(skip)
+        if self.overflow:
+            return True
+        updates, self.opt_state = self.optimizer.update(
+            master_grads, self.opt_state, self.master_params)
+        self.master_params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype),
+            self.master_params, updates)
+        self.model_params = jax.tree_util.tree_map(
+            lambda m, p: m.astype(p.dtype) if hasattr(p, "dtype") else m,
+            self.master_params, self.model_params)
+        return False
+
+    # ---- checkpointing (reference fp16_optimizer.py state_dict keys) ----
+    def state_dict(self) -> dict:
+        return {
+            "loss_scaler": {
+                "loss_scale": float(self.ls_state.loss_scale),
+                "unskipped": int(self.ls_state.unskipped),
+            },
+            "overflow": self.overflow,
+            "master_params": self.master_params,
+            "optimizer_state": self.opt_state,
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        self.ls_state = scaler_lib.LossScaleState(
+            loss_scale=jnp.float32(d["loss_scaler"]["loss_scale"]),
+            unskipped=jnp.int32(d["loss_scaler"].get("unskipped", 0)),
+        )
+        self.overflow = bool(d.get("overflow", False))
+        self.master_params = d["master_params"]
+        self.opt_state = d["optimizer_state"]
+        self.model_params = jax.tree_util.tree_map(
+            lambda m, p: m.astype(p.dtype) if hasattr(p, "dtype") else m,
+            self.master_params, self.model_params)

@@ -1,0 +1,93 @@
+"""Legacy fp16 utility helpers.
+
+Reference: apex/fp16_utils/fp16util.py — module-surgery helpers
+(``network_to_half`` :35, ``convert_network`` :60, ``prep_param_lists``
+:90, ``model_grads_to_master_grads`` :136, ``master_params_to_model_params``
+:158). Functional JAX translation: every helper is a pytree cast; "keep
+batchnorm fp32" (BN_convert_float :22) uses the shared norm-path heuristic
+from the amp policy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import _effective, _is_norm_param
+
+__all__ = [
+    "network_to_half",
+    "convert_network",
+    "prep_param_lists",
+    "model_grads_to_master_grads",
+    "master_params_to_model_params",
+    "to_python_float",
+]
+
+
+def _cast_tree(params: Any, dtype, keep_norm_fp32: bool) -> Any:
+    dtype = _effective(dtype)
+
+    def leaf(path, x):
+        if not hasattr(x, "dtype") or not jnp.issubdtype(
+                x.dtype, jnp.floating):
+            return x
+        names = tuple(str(getattr(p, "key", getattr(p, "name", p)))
+                      for p in path)
+        if keep_norm_fp32 and _is_norm_param(names):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def network_to_half(params: Any) -> Any:
+    """Cast a param tree to half precision, keeping norm-layer params fp32
+    (reference :35: BN buffers stay fp32)."""
+    return _cast_tree(params, jnp.float16, keep_norm_fp32=True)
+
+
+def convert_network(params: Any, dtype) -> Any:
+    """Cast to ``dtype`` with norm params kept fp32 (reference :60)."""
+    return _cast_tree(params, dtype, keep_norm_fp32=True)
+
+
+def prep_param_lists(params: Any) -> Tuple[Any, Any]:
+    """(model_params_half, master_params_fp32) pair (reference :90; the
+    ``flat_master`` variant is the ZeRO flat buffer —
+    contrib.optimizers.distributed_fused_adam)."""
+    model = network_to_half(params)
+    master = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x, jnp.float32)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        params,
+    )
+    return model, master
+
+
+def model_grads_to_master_grads(model_grads: Any) -> Any:
+    """Half grads → fp32 master grads (reference :136)."""
+    return jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32)
+        if hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.inexact)
+        else g,
+        model_grads,
+    )
+
+
+def master_params_to_model_params(master_params: Any,
+                                  model_params: Any) -> Any:
+    """fp32 masters → model-dtype params (reference :158)."""
+    return jax.tree_util.tree_map(
+        lambda m, p: m.astype(p.dtype)
+        if hasattr(p, "dtype") else m,
+        master_params, model_params,
+    )
+
+
+def to_python_float(t) -> float:
+    """Reference :176 — device scalar → python float (a device sync)."""
+    return float(t)

@@ -1,0 +1,64 @@
+"""Legacy static/dynamic loss scaler classes.
+
+Reference: apex/fp16_utils/loss_scaler.py (``LossScaler`` :10 static,
+``DynamicLossScaler`` :47 — halve on overflow, double after
+``scale_window`` clean steps). These wrap the device-side scaler state
+from apex_tpu.amp.scaler in the legacy imperative API; the functional
+train-step path (amp.make_train_step) uses that state directly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.amp import scaler as scaler_lib
+
+__all__ = ["LossScaler", "DynamicLossScaler"]
+
+
+class LossScaler:
+    """Static scale (reference :10)."""
+
+    def __init__(self, scale=1.0):
+        self.cfg, self.state = scaler_lib.init_loss_scale(float(scale))
+
+    @property
+    def loss_scale(self) -> float:
+        return float(self.state.loss_scale)
+
+    def scale_loss(self, loss):
+        return scaler_lib.scale_loss(loss, self.state)
+
+    def unscale(self, grads):
+        grads, finite = scaler_lib.unscale_grads(grads, self.state)
+        self._last_finite = finite
+        return grads
+
+    def update_scale(self, overflow=None) -> bool:
+        """Returns should_skip (always False for static scale)."""
+        if overflow is None:
+            overflow = ~getattr(self, "_last_finite", jnp.asarray(True))
+        self.state, skip = scaler_lib.update_loss_scale(
+            self.cfg, self.state, jnp.asarray(overflow))
+        return bool(skip)
+
+    # reference checkpoint keys (loss_scaler pickled whole; we keep plain)
+    def state_dict(self) -> dict:
+        return {"loss_scale": float(self.state.loss_scale),
+                "unskipped": int(self.state.unskipped)}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.state = scaler_lib.LossScaleState(
+            loss_scale=jnp.float32(d["loss_scale"]),
+            unskipped=jnp.int32(d.get("unskipped", 0)),
+        )
+
+
+class DynamicLossScaler(LossScaler):
+    """Window-doubling dynamic scale (reference :47)."""
+
+    def __init__(self, init_scale=2.0 ** 32, scale_factor=2.0,
+                 scale_window=1000):
+        self.cfg, self.state = scaler_lib.init_loss_scale(
+            "dynamic", init_scale=init_scale, scale_factor=scale_factor,
+            scale_window=scale_window)

@@ -1,0 +1,86 @@
+"""Checkpoint/resume for AMP train states.
+
+Reference mechanisms (SURVEY.md §5): (1) ``amp.state_dict()`` serializing
+every LossScaler (README.md:60-97 workflow); (2) optimizer state re-cast on
+load (_initialize.py:205-207); (3) cluster-requeue via ADLR AutoResume
+(pipeline_parallel/utils.py:142). The TPU-idiomatic equivalent is orbax:
+one ``save``/``restore`` pair over the whole TrainState pytree (params,
+masters, optimizer moments, loss-scale state, step), sharded arrays
+restored to their original shardings.
+
+``AutoResume`` mirrors the ADLR hook shape (init / termination request /
+requeue) as a plain polling stub so Megatron-style loops port unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step",
+           "AutoResume"]
+
+
+def _ckptr():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(directory: str, step: int, state: Any) -> str:
+    """Write ``state`` (any pytree of arrays) to ``directory/step_N``."""
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    ckptr = _ckptr()
+    ckptr.save(path, state, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    steps = [int(d.split("_", 1)[1]) for d in os.listdir(directory)
+             if d.startswith("step_") and d.split("_", 1)[1].isdigit()]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(directory: str, state_like: Any,
+                       step: Optional[int] = None) -> Any:
+    """Restore into the structure/shardings of ``state_like`` (pass the
+    freshly-initialized state; dtypes, shapes, and shardings are taken
+    from it — the reference's load-then-recast trick,
+    _initialize.py:205-207, is implicit)."""
+    step = latest_step(directory) if step is None else step
+    if step is None:
+        raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if isinstance(x, jax.Array) else x,
+        state_like,
+    )
+    return _ckptr().restore(path, abstract)
+
+
+class AutoResume:
+    """ADLR AutoResume-shaped hook (reference testing/global_vars.py:156):
+    a scheduler writes ``termination_file`` to request
+    checkpoint-and-requeue; the training loop polls ``termination_requested``
+    and calls ``request_resume`` after saving."""
+
+    def __init__(self, termination_file: Optional[str] = None):
+        self.termination_file = termination_file or os.environ.get(
+            "APEX_TPU_TERMINATION_FILE", "")
+
+    def init(self):
+        return self
+
+    def termination_requested(self) -> bool:
+        return bool(self.termination_file) and os.path.exists(
+            self.termination_file)
+
+    def request_resume(self):
+        if self.termination_file and os.path.exists(self.termination_file):
+            os.unlink(self.termination_file)

@@ -104,7 +104,7 @@ class TestZero2:
         params, loss_fn, x, y = make_problem(seed=3)
         mesh = create_mesh()
         init_z, step_z = make_distributed_adam_train_step(
-            loss_fn, mesh, lr=1e-2, amp="O5")
+            loss_fn, mesh, lr=1e-2, amp="O5", loss_scale="dynamic")
         sz = init_z(params)
         sz, _ = step_z(sz, x, y)
         master_before = np.asarray(sz.master_shard)
@@ -116,6 +116,26 @@ class TestZero2:
                                       master_before)
         assert float(sz.loss_scale_state.loss_scale) == scale_before / 2
         assert int(sz.step) == 1
+
+    def test_non_float_leaves_preserved(self):
+        params, loss_fn, x, y = make_problem(seed=5)
+        params["lookup"] = jnp.arange(10, dtype=jnp.int32)  # int table
+        mesh = create_mesh()
+
+        def loss_with_table(p, x, y):
+            # the int leaf participates (as gather indices) but must not
+            # be Adam-updated or cast
+            return loss_fn(p, x, y) + 0.0 * jnp.sum(
+                p["w1"][p["lookup"] % p["w1"].shape[0], 0])
+
+        init_z, step_z = make_distributed_adam_train_step(
+            loss_with_table, mesh, lr=1e-2, amp="O5")
+        sz = init_z(params)
+        assert sz.params["lookup"].dtype == jnp.int32
+        sz, _ = step_z(sz, x, y)
+        assert sz.params["lookup"].dtype == jnp.int32
+        np.testing.assert_array_equal(np.asarray(sz.params["lookup"]),
+                                      np.arange(10))
 
     def test_grad_clip(self):
         params, loss_fn, x, y = make_problem(seed=4)

@@ -1,0 +1,142 @@
+"""Legacy fp16_utils layer + checkpoint/resume round-trips.
+
+Reference analogs: tests/L0/run_fp16util (master/model param helpers),
+run_amp/test_checkpointing.py (amp state_dict round-trip preserving the
+loss scaler), and the ADLR AutoResume hook shape.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.fp16_utils import (
+    DynamicLossScaler,
+    FP16_Optimizer,
+    network_to_half,
+    prep_param_lists,
+    master_params_to_model_params,
+)
+from apex_tpu.optimizers import fused_adam
+from apex_tpu.utils.checkpoint import (
+    AutoResume,
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+
+def params():
+    rng = np.random.RandomState(0)
+    return {
+        "dense": {"kernel": jnp.asarray(rng.randn(8, 4), jnp.float32)},
+        "bn": {"scale": jnp.ones((4,), jnp.float32)},
+    }
+
+
+class TestFp16Util:
+    def test_network_to_half_keeps_norms_fp32(self):
+        half = network_to_half(params())
+        assert half["dense"]["kernel"].dtype in (jnp.float16, jnp.bfloat16)
+        assert half["bn"]["scale"].dtype == jnp.float32
+
+    def test_prep_and_copyback(self):
+        p = params()
+        model, master = prep_param_lists(p)
+        assert master["dense"]["kernel"].dtype == jnp.float32
+        back = master_params_to_model_params(master, model)
+        assert back["dense"]["kernel"].dtype == model["dense"]["kernel"].dtype
+
+
+class TestFP16Optimizer:
+    def test_training_and_overflow(self):
+        p = params()
+        # modest init scale: fp16 grads overflow at the 2^16 default until
+        # the scaler backs off (realistic, but noisy for this test)
+        opt = FP16_Optimizer(fused_adam(lr=1e-2), p,
+                             dynamic_loss_scale=True,
+                             dynamic_loss_args={"init_scale": 128.0})
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(16, 8), jnp.float32)
+        y = jnp.asarray(rng.randn(16, 4), jnp.float32)
+
+        def loss_fn(mp, x, y):
+            h = x.astype(jnp.float32) @ mp["dense"]["kernel"].astype(
+                jnp.float32) * mp["bn"]["scale"]
+            return jnp.mean((h - y) ** 2)
+
+        losses = []
+        for i in range(20):
+            loss, grads = jax.value_and_grad(
+                lambda mp: opt.scale_loss(loss_fn(mp, x, y)))(
+                    opt.model_params)
+            skipped = opt.step(grads)
+            assert not skipped
+            losses.append(float(loss) / opt.loss_scale)
+        assert losses[-1] < losses[0]
+
+        # overflow: inf grads → step skipped, scale halved
+        scale = opt.loss_scale
+        master_before = np.asarray(opt.master_params["dense"]["kernel"])
+        bad = jax.tree_util.tree_map(
+            lambda g: g.at[(0,) * g.ndim].set(jnp.inf), grads)
+        assert opt.step(bad) is True
+        assert opt.loss_scale == scale / 2
+        np.testing.assert_array_equal(
+            np.asarray(opt.master_params["dense"]["kernel"]), master_before)
+
+    def test_state_dict_roundtrip(self):
+        opt = FP16_Optimizer(fused_adam(lr=1e-2), params(),
+                             dynamic_loss_scale=True)
+        d = opt.state_dict()
+        opt2 = FP16_Optimizer(fused_adam(lr=1e-2), params(),
+                              dynamic_loss_scale=True)
+        opt2.load_state_dict(d)
+        assert opt2.loss_scale == opt.loss_scale
+
+
+class TestDynamicLossScaler:
+    def test_window_doubling(self):
+        s = DynamicLossScaler(init_scale=4.0, scale_window=2)
+        assert s.loss_scale == 4.0
+        assert s.update_scale(overflow=False) is False
+        assert s.update_scale(overflow=False) is False
+        assert s.loss_scale == 8.0          # window hit → doubled
+        assert s.update_scale(overflow=True) is True
+        assert s.loss_scale == 4.0          # halved on overflow
+
+
+class TestCheckpoint:
+    def test_train_state_roundtrip(self, tmp_path):
+        init, step = amp.make_train_step(
+            lambda p, x: jnp.sum((x @ p["w"]) ** 2),
+            fused_adam(lr=1e-3), "O5")
+        state = init({"w": jnp.ones((4, 4), jnp.float32)})
+        x = jnp.ones((2, 4), jnp.float32)
+        state, _ = step(state, x)
+        state, _ = step(state, x)
+
+        d = str(tmp_path / "ckpt")
+        save_checkpoint(d, int(state.step), state)
+        assert latest_step(d) == 2
+
+        fresh = init({"w": jnp.ones((4, 4), jnp.float32)})
+        restored = restore_checkpoint(d, fresh)
+        assert int(restored.step) == 2
+        np.testing.assert_array_equal(
+            np.asarray(restored.master_params["w"]),
+            np.asarray(state.master_params["w"]))
+        # resumed training continues cleanly
+        restored, m = step(restored, x)
+        assert int(restored.step) == 3
+
+    def test_autoresume(self, tmp_path):
+        f = str(tmp_path / "term")
+        ar = AutoResume(termination_file=f).init()
+        assert not ar.termination_requested()
+        open(f, "w").close()
+        assert ar.termination_requested()
+        ar.request_resume()
+        assert not ar.termination_requested()
