@@ -12,6 +12,12 @@ from apex_tpu.models.config import (  # noqa: F401
     gpt_125m,
     gpt_tiny,
 )
+from apex_tpu.models.bert import (  # noqa: F401
+    bert_forward,
+    bert_pretrain_loss,
+    init_bert_params,
+    make_bert_train_step,
+)
 from apex_tpu.models.resnet import (  # noqa: F401
     ResNet,
     make_resnet_train_step,

@@ -303,10 +303,20 @@ def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
     hd = q.shape[-1]
     scale = 1.0 / hd ** 0.5
     use_dropout = cfg.attention_dropout > 0 and dropout_rng is not None
+    causal = cfg.attn_mask_type == "causal"
+    # a 2-D [b, s_k] mask means key padding (True = masked key) — the
+    # fused kernels handle it in-kernel without materializing [b,n,sq,sk]
+    kpm = None
+    if attention_mask is not None and attention_mask.ndim == 2:
+        kpm = attention_mask
+        attention_mask = None
     if (cfg.attention_backend == "flash" and attention_mask is None
-            and not use_dropout and cfg.attn_mask_type == "causal"):
+            and not use_dropout):
         from apex_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=True, scale=scale)
+        return flash_attention(q, k, v, causal=causal,
+                               key_padding_mask=kpm, scale=scale)
+    if kpm is not None:
+        attention_mask = kpm[:, None, None, :]   # broadcastable 4-D
     # [b, s, n, d] x [b, t, n, d] -> [b, n, s, t]
     scores = jnp.einsum(
         "bsnd,btnd->bnst", q, k,

@@ -1,0 +1,123 @@
+"""BERT family tests (reference run_bert_minimal_test.py pattern at toy
+scale: forward shapes, loss behavior, masking semantics, LAMB training)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.bert import (
+    bert_forward,
+    bert_pretrain_loss,
+    init_bert_params,
+    make_bert_train_step,
+)
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.optimizers import fused_lamb
+
+
+def tiny_cfg(**kw):
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_position_embeddings", 32)
+    kw.setdefault("attn_mask_type", "padding")
+    kw.setdefault("compute_dtype", jnp.float32)
+    return TransformerConfig(**kw)
+
+
+def batch(cfg, b=4, s=16, seed=0, mask_frac=0.15):
+    rng = np.random.RandomState(seed)
+    tokens = jnp.asarray(rng.randint(3, cfg.vocab_size, (b, s)), jnp.int32)
+    mlm = np.full((b, s), -1)
+    pos = rng.rand(b, s) < mask_frac
+    mlm[pos] = rng.randint(3, cfg.vocab_size, pos.sum())
+    nsp = jnp.asarray(rng.randint(0, 2, (b,)), jnp.int32)
+    tt = jnp.asarray((np.arange(s)[None] >= s // 2).astype(np.int32)
+                     .repeat(b, 0))
+    am = jnp.ones((b, s), jnp.int32)
+    return tokens, jnp.asarray(mlm), nsp, tt, am
+
+
+class TestForward:
+    def test_shapes(self):
+        cfg = tiny_cfg()
+        params = init_bert_params(jax.random.PRNGKey(0), cfg)
+        tokens, mlm, nsp, tt, am = batch(cfg)
+        lm_logits, bin_logits = bert_forward(
+            params, tokens, cfg, tokentype_ids=tt, attention_mask=am)
+        assert lm_logits.shape == (4, 16, cfg.vocab_size)
+        assert bin_logits.shape == (4, 2)
+
+    def test_bidirectional_not_causal(self):
+        cfg = tiny_cfg()
+        params = init_bert_params(jax.random.PRNGKey(1), cfg)
+        tokens, _, _, tt, am = batch(cfg)
+        lm1, _ = bert_forward(params, tokens, cfg, tokentype_ids=tt,
+                              attention_mask=am)
+        # changing the LAST token must affect EARLIER positions
+        tokens2 = tokens.at[:, -1].set((tokens[:, -1] + 1) % cfg.vocab_size)
+        lm2, _ = bert_forward(params, tokens2, cfg, tokentype_ids=tt,
+                              attention_mask=am)
+        assert float(jnp.max(jnp.abs(lm1[:, 0] - lm2[:, 0]))) > 1e-6
+
+    def test_padding_tokens_isolated(self):
+        cfg = tiny_cfg()
+        params = init_bert_params(jax.random.PRNGKey(2), cfg)
+        tokens, _, _, tt, _ = batch(cfg)
+        am = jnp.ones(tokens.shape, jnp.int32).at[:, -4:].set(0)
+        lm1, _ = bert_forward(params, tokens, cfg, tokentype_ids=tt,
+                              attention_mask=am)
+        tokens2 = tokens.at[:, -1].set((tokens[:, -1] + 1) % cfg.vocab_size)
+        lm2, _ = bert_forward(params, tokens2, cfg, tokentype_ids=tt,
+                              attention_mask=am)
+        np.testing.assert_allclose(np.asarray(lm1[:, :-4]),
+                                   np.asarray(lm2[:, :-4]), atol=1e-5)
+
+    def test_tokentype_changes_output(self):
+        cfg = tiny_cfg()
+        params = init_bert_params(jax.random.PRNGKey(3), cfg)
+        tokens, _, _, tt, am = batch(cfg)
+        lm1, _ = bert_forward(params, tokens, cfg, tokentype_ids=tt,
+                              attention_mask=am)
+        lm2, _ = bert_forward(params, tokens, cfg,
+                              tokentype_ids=1 - tt, attention_mask=am)
+        assert float(jnp.max(jnp.abs(lm1 - lm2))) > 1e-6
+
+
+class TestLoss:
+    def test_ignored_labels_do_not_contribute(self):
+        cfg = tiny_cfg()
+        params = init_bert_params(jax.random.PRNGKey(4), cfg)
+        tokens, mlm, nsp, tt, am = batch(cfg)
+        l1 = bert_pretrain_loss(params, tokens, mlm, nsp, cfg,
+                                tokentype_ids=tt, attention_mask=am)
+        # change labels only at ignored (-1) positions → loss unchanged
+        mlm2 = jnp.where(mlm < 0, -7, mlm)
+        l2 = bert_pretrain_loss(params, tokens, mlm2, nsp, cfg,
+                                tokentype_ids=tt, attention_mask=am)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+
+    def test_random_init_loss_near_log_vocab(self):
+        cfg = tiny_cfg()
+        params = init_bert_params(jax.random.PRNGKey(5), cfg)
+        tokens, mlm, nsp, tt, am = batch(cfg)
+        loss = bert_pretrain_loss(params, tokens, mlm, nsp, cfg,
+                                  tokentype_ids=tt, attention_mask=am)
+        # mlm ~ log V, nsp ~ log 2
+        expect = np.log(cfg.vocab_size) + np.log(2)
+        assert abs(float(loss) - expect) < 1.5
+
+
+class TestTrainStep:
+    def test_lamb_pretrain_loss_decreases(self):
+        cfg = tiny_cfg(compute_dtype=jnp.bfloat16)
+        init, step = make_bert_train_step(
+            cfg, fused_lamb(lr=1e-2), "O5")
+        state = init(jax.random.PRNGKey(0))
+        tokens, mlm, nsp, tt, am = batch(cfg, b=8)
+        losses = []
+        for _ in range(12):
+            state, m = step(state, tokens, mlm, nsp, tt, am)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0], losses
