@@ -32,9 +32,10 @@ def focal_loss(
     """Sum-reduced sigmoid focal loss over [N..., K] logits, divided by
     ``num_positives_sum``.
 
-    ``cls_targets`` holds integer class ids in [-1, K): negative ids mean
-    "no positive class" (pure background row, all-negative targets —
-    matching the reference's padded-anchor convention). Classes at index
+    ``cls_targets`` holds integer class ids in [-2, K): ``-1`` means "no
+    positive class" (pure background row, all-negative targets) and ``-2``
+    means "ignored match" — zero loss and zero gradient for the whole row
+    (kernel:60-67 skips y==-2 entirely). Classes at index
     ≥ ``num_real_classes`` (padding columns) are excluded from the loss.
     """
     x = cls_output.astype(jnp.float32)
@@ -42,8 +43,11 @@ def focal_loss(
     y = jax.nn.one_hot(cls_targets, k, dtype=jnp.float32)
 
     if label_smoothing > 0.0:
+        # The kernel smooths with a constant K=2 (sigmoid/binary smoothing,
+        # kernel:35-45): positive target 1-s+s/2, negative target s/2 —
+        # NOT 1/num_classes.
         s = label_smoothing
-        y_eff = y * (1.0 - s) + s / k
+        y_eff = y * (1.0 - s) + s / 2.0
     else:
         y_eff = y
 
@@ -58,6 +62,8 @@ def focal_loss(
     if num_real_classes < k:
         valid = jnp.arange(k) < num_real_classes
         loss = jnp.where(valid, loss, 0.0)
+
+    loss = jnp.where((cls_targets == -2)[..., None], 0.0, loss)
 
     return jnp.sum(loss) / jnp.asarray(num_positives_sum, jnp.float32)
 

@@ -73,6 +73,43 @@ class TestFocalLoss:
             label_smoothing=0.1))(x)
         assert np.all(np.isfinite(np.asarray(g)))
 
+    def test_label_smoothing_parity_k2(self):
+        """Smoothing uses the kernel's constant K=2 (kernel:35-45): the bce
+        term's effective targets are 1-s+s/2 (pos) / s/2 (neg), while the
+        modulating/alpha factors keep the hard targets."""
+        rng = np.random.RandomState(4)
+        n, k, s, alpha, gamma = 9, 16, 0.1, 0.3, 2.0
+        x = rng.randn(n, k).astype(np.float32)
+        classes = rng.randint(0, k, n)
+        y = np.eye(k, dtype=np.float32)[classes]
+        y_eff = y * (1.0 - s) + s / 2.0
+        p = 1.0 / (1.0 + np.exp(-x))
+        bce = np.maximum(x, 0) - x * y_eff + np.log1p(np.exp(-np.abs(x)))
+        p_t = p * y + (1 - p) * (1 - y)
+        alpha_t = alpha * y + (1 - alpha) * (1 - y)
+        want = (alpha_t * (1 - p_t) ** gamma * bce).sum()
+        got = focal_loss(jnp.asarray(x), jnp.asarray(classes), 1.0, k,
+                         alpha, gamma, label_smoothing=s)
+        np.testing.assert_allclose(float(got), want, rtol=1e-5)
+
+    def test_ignored_matches_skipped(self):
+        """Rows with target -2 contribute zero loss and zero grad
+        (kernel:60-67), unlike -1 which is an all-background row."""
+        rng = np.random.RandomState(5)
+        x = rng.randn(6, 8).astype(np.float32)
+        classes = np.array([3, -2, 1, -2, -1, 0])
+        keep = classes != -2
+
+        def f(x, cls):
+            return focal_loss(x, jnp.asarray(cls), 1.0, 8, 0.25, 2.0)
+
+        got = float(f(jnp.asarray(x), classes))
+        want = float(f(jnp.asarray(x[keep]), classes[keep]))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        g = np.asarray(jax.grad(f)(jnp.asarray(x), classes))
+        assert np.all(g[~keep] == 0.0)
+        assert np.any(g[keep] != 0.0)
+
 
 class TestIndexMul2d:
     def test_forward_and_grads(self):
