@@ -1,0 +1,16 @@
+"""ASP — Automatic SParsity (2:4 structured) for the TPU framework.
+
+TPU rebuild of ``apex.contrib.sparsity`` (reference: asp.py:28,
+sparse_masklib.py:145, permutation_lib.py:42).  The mask search is
+host-side numpy exactly like the reference; mask *application* is a pure
+``params * mask`` multiply that XLA fuses into the optimizer update.
+"""
+
+from .sparse_masklib import create_mask  # noqa: F401
+from .asp import ASP, sparsify_optimizer  # noqa: F401
+from .permutation_lib import (  # noqa: F401
+    sum_after_2_to_4,
+    apply_2_to_4,
+    search_for_good_permutation,
+    Permutation,
+)

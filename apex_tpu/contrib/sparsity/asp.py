@@ -1,0 +1,354 @@
+"""ASP — model/optimizer patcher for 2:4 structured sparse training.
+
+TPU rebuild of ``apex/contrib/sparsity/asp.py:28``.  The reference is a
+class-method singleton that (1) registers mask buffers on eligible
+``nn.Module``s, (2) monkey-patches ``optimizer.step`` to multiply grads by
+the mask before the step and params after it, and (3) computes masks with
+``sparse_masklib.create_mask``.
+
+JAX is functional, so the same three operations act on pytrees:
+
+1. :meth:`ASP.init_model_for_pruning` records which param-tree leaves are
+   prunable (path predicate + the reference's tensor-core shape gates).
+2. :func:`sparsify_optimizer` wraps any optax-style
+   ``GradientTransformation`` so its updates (a) zero masked grads and
+   (b) land exactly on the masked manifold: the returned update is
+   ``u' = (p + u) * mask - p`` — after ``apply_updates`` params are
+   masked bit-exactly, matching the reference's post-step ``p.mul_(mask)``
+   (asp.py:188-201) without a second pass.  Masks ride in the optimizer
+   state as a ``{path: bool array}`` dict (a stable jit-able pytree), so
+   refreshed masks swap in via ``state._replace(masks=...)`` with no
+   retracing.
+3. :meth:`ASP.compute_sparse_masks` runs the (optional) channel
+   permutation search then the mask search, prunes the params, and
+   stashes pruned values when ``allow_recompute_mask`` — mirroring
+   asp.py:204-254, including "checkpoints hold zeros for pruned weights".
+
+The class-method singleton API is kept for parity; :meth:`ASP.reset` is a
+TPU addition (tests need to re-init, the reference asserts one init per
+process).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...optimizers._common import GradientTransformation
+from .sparse_masklib import create_mask
+
+__all__ = ["ASP", "sparsify_optimizer", "SparseState"]
+
+
+def _path_name(path) -> str:
+    return "/".join(
+        str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+    )
+
+
+def _flatten_with_paths(tree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return [(_path_name(p), leaf) for p, leaf in flat], treedef
+
+
+def _map_masked(fn, tree, masks: dict, *others):
+    """Map ``fn(leaf, mask_or_None, *other_leaves)`` over ``tree``,
+    looking masks up by flattened path name.  ``others`` must share
+    ``tree``'s structure."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    other_leaves = [jax.tree_util.tree_leaves(o) for o in others]
+    out = []
+    for i, (path, leaf) in enumerate(flat):
+        m = masks.get(_path_name(path))
+        out.append(fn(leaf, m, *(ol[i] for ol in other_leaves)))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _default_whitelist(name: str, leaf) -> bool:
+    """Prunable by default: rank>=2 float leaves (Linear/Conv kernels).
+    Mirrors the reference whitelist of Linear/Conv1d/2d/3d weights
+    (asp.py:42,98-100); biases and norm scales are rank-1 and excluded."""
+    return hasattr(leaf, "ndim") and leaf.ndim >= 2
+
+
+def _tc_shape_ok(leaf) -> bool:
+    """The reference auto-skips tensors whose 2-D view is not a multiple
+    of (8, 16) — the sparse-MMA tile gate (asp.py:136-141).  The TPU
+    analog keeps the same gate (it also guarantees the pruned reduction
+    dim tiles onto 8 sublanes), with the dims read from the JAX layout's
+    2-D pruning view (see sparse_masklib.create_mask)."""
+    shape = leaf.shape
+    if len(shape) == 2:
+        i, o = shape  # JAX (in, out); the view pruned is (out, in)
+        return o % 8 == 0 and i % 16 == 0
+    if len(shape) == 3:
+        b, i, o = shape
+        return (b * o) % 8 == 0 and i % 16 == 0
+    if len(shape) == 4:
+        h, w, i, o = shape
+        return (h * w * o) % 8 == 0 and i % 16 == 0
+    return True
+
+
+class SparseState(NamedTuple):
+    """Optimizer-state wrapper: inner state + ``{path: mask}`` dict."""
+
+    inner: Any
+    masks: dict
+
+
+def sparsify_optimizer(
+    tx: GradientTransformation,
+    masks: Optional[dict] = None,
+) -> GradientTransformation:
+    """Wrap an optax-style transformation so training stays on the 2:4
+    manifold (functional analog of ``ASP.init_optimizer_for_pruning``,
+    reference asp.py:176-201).
+
+    ``masks`` maps flattened param paths (as produced by
+    ``ASP.compute_sparse_masks``) to bool arrays; params not in the dict
+    stay dense.  When ``masks`` is None, the masks current in the ASP
+    singleton at ``init`` time are captured (all-ones before
+    ``compute_sparse_masks`` — the reference's "sparsity is off by
+    default" behavior, asp.py:55).
+    """
+
+    def init(params):
+        m = masks if masks is not None else ASP.current_masks()
+        m = {k: jnp.asarray(v) for k, v in m.items()}
+        return SparseState(inner=tx.init(params), masks=m)
+
+    def update(grads, state, params=None, **kw):
+        def mask_grad(g, m):
+            if m is None or g is None:
+                return g
+            return g * m.astype(g.dtype)
+
+        masked_grads = _map_masked(mask_grad, grads, state.masks)
+        updates, inner = tx.update(masked_grads, state.inner, params, **kw)
+        if params is not None:
+
+            def land_on_manifold(u, m, p):
+                if m is None or u is None:
+                    return u
+                mm = m.astype(p.dtype)
+                return (p + u.astype(p.dtype)) * mm - p
+
+            updates = _map_masked(
+                land_on_manifold, updates, state.masks, params
+            )
+        return updates, SparseState(inner=inner, masks=state.masks)
+
+    return GradientTransformation(init=init, update=update)
+
+
+class ASP:
+    """Class-method singleton facade matching the reference API."""
+
+    __initialized = False
+    __verbosity = 0
+    __sparse_names: list = []
+    __calculate_mask: Optional[Callable] = None
+    __allow_recompute_mask = False
+    __pruned_values: dict = {}
+    __masks: dict = {}
+    __permutation_groups: list = []
+
+    # -- reference API ------------------------------------------------
+
+    @classmethod
+    def init_model_for_pruning(
+        cls,
+        params,
+        mask_calculator="m4n2_1d",
+        verbosity: int = 3,
+        whitelist: Callable[[str, Any], bool] = _default_whitelist,
+        allowed_layer_names=None,
+        disallowed_layer_names=(),
+        allow_recompute_mask: bool = False,
+        allow_permutation: bool = False,
+        permutation_groups=None,
+    ):
+        """Record which leaves of ``params`` will be pruned.
+
+        ``whitelist`` is a predicate ``(path_name, leaf) -> bool`` — the
+        functional stand-in for the reference's module-type whitelist
+        (asp.py:42).  ``permutation_groups`` (TPU deviation, see
+        permutation_lib.Permutation) is the explicit replacement for
+        torch.fx graph tracing; each group is a list of
+        ``(path, axis, kind)``.
+        """
+        assert not cls.__initialized, "ASP has been initialized already."
+        cls.__initialized = True
+        cls.__verbosity = verbosity
+
+        if isinstance(mask_calculator, str):
+            pattern = mask_calculator
+            cls.__calculate_mask = lambda t: create_mask(t, pattern)
+        else:
+            cls.__calculate_mask = mask_calculator
+        cls.__allow_recompute_mask = allow_recompute_mask
+        cls.__permutation_groups = (
+            list(permutation_groups or []) if allow_permutation else []
+        )
+
+        flat, _ = _flatten_with_paths(params)
+        cls.__sparse_names = []
+        for name, leaf in flat:
+            if not whitelist(name, leaf):
+                continue
+            if allowed_layer_names is not None and name not in allowed_layer_names:
+                continue
+            if name in disallowed_layer_names:
+                continue
+            if not _tc_shape_ok(leaf):
+                if verbosity >= 1:
+                    print(
+                        f"[ASP] Auto skipping pruning {name} of "
+                        f"size={tuple(leaf.shape)} for sparsity"
+                    )
+                continue
+            if verbosity >= 3:
+                print(
+                    f"[ASP] Sparsifying {name} of size={tuple(leaf.shape)} "
+                    f"and type={leaf.dtype} for sparsity"
+                )
+            cls.__sparse_names.append(name)
+            cls.__masks[name] = np.ones(leaf.shape, dtype=bool)
+
+    @classmethod
+    def already_init_asp_model(cls) -> bool:
+        return cls.__initialized
+
+    @classmethod
+    def init_optimizer_for_pruning(cls, tx: GradientTransformation):
+        """Return the sparsity-preserving wrapped optimizer (functional
+        analog of monkey-patching ``optimizer.step``, asp.py:176)."""
+        assert cls.__calculate_mask is not None, (
+            "Call ASP.init_model_for_pruning before "
+            "ASP.init_optimizer_for_pruning."
+        )
+        return sparsify_optimizer(tx, masks=None)
+
+    @classmethod
+    def compute_sparse_masks(cls, params):
+        """Run permutation search + mask search; prune ``params``.
+
+        Returns ``(pruned_params, masks)`` where ``masks`` is a
+        ``{path: bool array}`` dict for :func:`sparsify_optimizer` (or to
+        swap into an existing ``SparseState``).
+        """
+        assert cls.__calculate_mask is not None, "ASP not initialized."
+        from .permutation_lib import Permutation
+
+        # Reference compute_sparse_masks steps 1-3 (asp.py:209-239):
+        # offline channel permutation before masking.
+        host = jax.tree_util.tree_map(np.asarray, params)
+        for group in cls.__permutation_groups:
+            host, _perm = Permutation.search_and_apply(host, group)
+
+        flat, treedef = _flatten_with_paths(host)
+        new_leaves = []
+        for name, leaf in flat:
+            if name not in cls.__sparse_names:
+                new_leaves.append(jnp.asarray(leaf))
+                continue
+            prev_mask = cls.__masks.get(name)
+            arr = np.asarray(leaf, dtype=np.float32)
+            if prev_mask is not None and prev_mask.sum() < prev_mask.size:
+                # recomputing: restore dense weights first (asp.py:241-245)
+                assert cls.__allow_recompute_mask, (
+                    "Unable to restore dense parameter because "
+                    "allow_recompute_mask == False"
+                )
+                arr = arr + cls.__pruned_values[name]
+            mask = cls.__calculate_mask(arr)
+            cls.__masks[name] = mask
+            if cls.__allow_recompute_mask:
+                cls.__pruned_values[name] = arr * (~mask)
+            new_leaves.append(
+                jnp.asarray((arr * mask).astype(np.asarray(leaf).dtype))
+            )
+            if cls.__verbosity >= 2:
+                pct = 100.0 - 100.0 * mask.sum() / mask.size
+                print(
+                    f"[ASP] Enabled {pct:.2f}% sparsity for {name} "
+                    f"of size={tuple(leaf.shape)}"
+                )
+        new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        return new_params, cls.current_masks()
+
+    @classmethod
+    def restore_pruned_weights(cls, params):
+        """Disable sparsity; add stashed pruned values back
+        (reference asp.py:256-269).  Requires allow_recompute_mask."""
+        flat, treedef = _flatten_with_paths(
+            jax.tree_util.tree_map(np.asarray, params)
+        )
+        out = []
+        for name, leaf in flat:
+            if name in cls.__sparse_names:
+                mask = cls.__masks[name]
+                if mask.sum() < mask.size:
+                    assert name in cls.__pruned_values, (
+                        "Unable to restore dense parameter because "
+                        "allow_recompute_mask == False"
+                    )
+                    leaf = leaf + cls.__pruned_values[name].astype(leaf.dtype)
+                    cls.__masks[name] = np.ones(leaf.shape, dtype=bool)
+                    cls.__pruned_values[name] = np.zeros_like(leaf)
+            out.append(jnp.asarray(leaf))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    @classmethod
+    def is_sparsity_enabled(cls) -> bool:
+        """True iff every tracked mask is exactly 50% dense
+        (reference asp.py:271-290 asserts all-dense or all-half)."""
+        total, sp100, sp50 = 0, 0, 0
+        for name in cls.__sparse_names:
+            m = cls.__masks[name]
+            total += 1
+            s = m.sum()
+            if s == m.size:
+                sp100 += 1
+            elif 2 * s == m.size:
+                sp50 += 1
+        assert total in (sp100, sp50), "Inconsistent model sparsity"
+        return total != 0 and total == sp50
+
+    @classmethod
+    def prune_trained_model(cls, params, tx):
+        """One-call recipe (reference asp.py:292-297): init, compute
+        masks, wrap optimizer.  Returns (pruned_params, wrapped_tx)."""
+        cls.init_model_for_pruning(
+            params, mask_calculator="m4n2_1d", verbosity=2,
+            allow_recompute_mask=False,
+        )
+        pruned, masks = cls.compute_sparse_masks(params)
+        return pruned, sparsify_optimizer(tx, masks)
+
+    # -- TPU additions ------------------------------------------------
+
+    @classmethod
+    def current_masks(cls) -> dict:
+        """Current masks as a ``{path: bool ndarray}`` dict."""
+        return {n: cls.__masks[n] for n in cls.__sparse_names}
+
+    @classmethod
+    def sparse_parameter_names(cls):
+        return list(cls.__sparse_names)
+
+    @classmethod
+    def reset(cls):
+        """Forget all state so tests can re-init (TPU addition; the
+        reference allows a single init per process)."""
+        cls.__initialized = False
+        cls.__sparse_names = []
+        cls.__calculate_mask = None
+        cls.__allow_recompute_mask = False
+        cls.__pruned_values = {}
+        cls.__masks = {}
+        cls.__permutation_groups = []

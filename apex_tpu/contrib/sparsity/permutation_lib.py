@@ -1,0 +1,347 @@
+"""Accuracy-preserving input-channel permutation search for 2:4 sparsity.
+
+TPU rebuild of ``apex/contrib/sparsity/permutation_lib.py:42`` and
+``permutation_search_kernels/``.  Permuting input channels before pruning
+changes which weights land in the same group-of-4, so a good permutation
+raises the magnitude retained by the 2:4 mask; the inverse permutation is
+absorbed into the *producer* layer's output channels so the network
+function is unchanged.
+
+The reference discovers which tensors must co-permute by tracing the
+model with torch.fx (permutation_lib.py ``build_offline_permutation_graph``).
+A jitted JAX model has no module graph to trace, so this port takes the
+coupling explicitly: a *permutation group* is a list of ``(param, axis,
+kind)`` entries sharing one channel dimension — see :class:`Permutation`.
+
+Search strategies mirror
+``permutation_search_kernels/call_permutation_search_kernels.py``:
+
+- ``exhaustive`` (default, options ``stripe_group_size=8``,
+  ``escape_attempts=100``): bounded exhaustive search over windows of
+  stripes (groups of 4 channels), iterated to a fixed point, with random
+  escape swaps (reference exhaustive_search.py:312 ``Exhaustive_Search``).
+- ``progressive channel swap``: random cross-stripe swaps kept when they
+  improve retained magnitude, until a time limit.
+
+All search kernels are vectorized numpy (the reference's CUDA search
+kernels exist only to accelerate this same host-side math; on TPU the
+search stays on host — it runs once, offline).
+"""
+
+from __future__ import annotations
+
+import time
+from itertools import permutations as _permutations
+
+import numpy as np
+
+__all__ = [
+    "sum_after_2_to_4",
+    "apply_2_to_4",
+    "try_swap",
+    "exhaustive_search",
+    "progressive_channel_swap",
+    "search_for_good_permutation",
+    "Permutation",
+]
+
+
+def _group_view(matrix: np.ndarray) -> np.ndarray:
+    """abs(matrix) reshaped to (rows, n_groups, 4); trailing columns that
+    do not fill a group of 4 are ignored (reference sum_after_2_to_4
+    iterates ``range(0, cols, 4)`` over full groups only)."""
+    mat = np.abs(np.asarray(matrix, dtype=np.float32))
+    cols = (mat.shape[1] // 4) * 4
+    return mat[:, :cols].reshape(mat.shape[0], -1, 4)
+
+
+def sum_after_2_to_4(matrix: np.ndarray) -> float:
+    """Total magnitude retained if 2:4 pruning were applied
+    (reference permutation_utilities.py ``sum_after_2_to_4``)."""
+    g = _group_view(matrix)
+    top2 = np.partition(g, 2, axis=-1)[..., 2:]
+    return float(top2.sum())
+
+
+def apply_2_to_4(matrix: np.ndarray) -> np.ndarray:
+    """Zero the 2 smallest-|w| entries of every group of 4 (reference
+    permutation_utilities.py ``apply_2_to_4``)."""
+    mat = np.array(matrix, dtype=np.float32, copy=True)
+    cols = (mat.shape[1] // 4) * 4
+    g = mat[:, :cols].reshape(mat.shape[0], -1, 4)
+    order = np.argsort(np.abs(g), axis=-1)
+    rows, ngroups = g.shape[:2]
+    ridx = np.arange(rows)[:, None]
+    gidx = np.arange(ngroups)[None, :]
+    g[ridx, gidx, order[..., 0]] = 0.0
+    g[ridx, gidx, order[..., 1]] = 0.0
+    mat[:, :cols] = g.reshape(mat.shape[0], cols)
+    return mat
+
+
+def _stripe_sums(matrix: np.ndarray) -> np.ndarray:
+    """Retained magnitude per stripe (group of 4 columns), shape (G,)."""
+    g = _group_view(matrix)
+    top2 = np.partition(g, 2, axis=-1)[..., 2:]
+    return top2.sum(axis=(0, 2))
+
+
+def try_swap(matrix: np.ndarray, dst: int, src: int):
+    """(new_total, improvement) if columns src/dst were swapped.  Only the
+    two affected stripes are re-scored (reference
+    permutation_utilities.py ``try_swap``)."""
+    g_src, g_dst = src // 4, dst // 4
+    if g_src == g_dst:
+        total = sum_after_2_to_4(matrix)
+        return total, 0.0
+    cols = [4 * g_src + i for i in range(4)] + [4 * g_dst + i for i in range(4)]
+    sub = np.array(matrix[:, cols], copy=True)
+    before = sum_after_2_to_4(sub)
+    # positions of src/dst inside the 8-col sub-matrix
+    p_src = cols.index(src)
+    p_dst = cols.index(dst)
+    sub[:, [p_src, p_dst]] = sub[:, [p_dst, p_src]]
+    after = sum_after_2_to_4(sub)
+    improvement = after - before
+    total = sum_after_2_to_4(matrix) + improvement
+    return total, improvement
+
+
+# ---------------------------------------------------------------------------
+# strategy: bounded exhaustive over stripe windows
+# ---------------------------------------------------------------------------
+
+_unique_perm_cache: dict = {}
+
+
+def _unique_group_permutations(c: int) -> np.ndarray:
+    """Unique permutations of c columns into groups of 4 where in-group
+    order and group order don't matter (canonical form: groups sorted
+    internally, groups sorted by first element, element 0 fixed first —
+    reference exhaustive_search.py ``generate_unique_combinations``)."""
+    if c in _unique_perm_cache:
+        return _unique_perm_cache[c]
+    assert c % 4 == 0
+    results: list = []
+
+    def rec(built, remaining):
+        if not remaining:
+            results.append(list(built))
+            return
+        for i, col in enumerate(remaining):
+            if len(built) % 4 == 0:
+                # new group: canonical iff everything smaller is placed and
+                # this group leader exceeds the previous group leader
+                if any(v < col for v in remaining if v != col):
+                    # some smaller value is unplaced -> not canonical
+                    if min(remaining) != col:
+                        continue
+                if built and col <= built[-4]:
+                    continue
+            elif col <= built[-1]:
+                continue
+            built.append(col)
+            rest = remaining[:i] + remaining[i + 1 :]
+            rec(built, rest)
+            built.pop()
+
+    rec([], list(range(c)))
+    perms = np.array(results, dtype=np.int64)
+    _unique_perm_cache[c] = perms
+    return perms
+
+
+def _best_window_permutation(sub: np.ndarray) -> np.ndarray:
+    """Exhaustively find the best unique grouping of the window's columns.
+    Fully vectorized: scores all P permutations at once."""
+    c = sub.shape[1]
+    perms = _unique_group_permutations(c)  # (P, c)
+    permuted = np.abs(sub[:, perms])  # (rows, P, c)
+    g = permuted.reshape(sub.shape[0], perms.shape[0], c // 4, 4)
+    top2 = np.partition(g, 2, axis=-1)[..., 2:]
+    scores = top2.sum(axis=(0, 2, 3))  # (P,)
+    return perms[int(np.argmax(scores))]
+
+
+def exhaustive_search(
+    matrix: np.ndarray,
+    stripe_group_size: int = 8,
+    escape_attempts: int = 100,
+    rng: np.random.Generator | None = None,
+):
+    """Bounded exhaustive permutation search.
+
+    Slides a window of ``stripe_group_size`` columns (i.e. window of
+    stripes) over all stripe pairs/sets, exhaustively re-grouping each
+    window, repeating until no window improves; then uses up to
+    ``escape_attempts`` random cross-stripe swaps to escape local optima
+    (accepted only if they improve).  Returns
+    ``(permuted_matrix, seconds, permutation)`` like the reference's
+    ``Exhaustive_Search`` (exhaustive_search.py:312).
+    """
+    t0 = time.perf_counter()
+    mat = np.array(matrix, dtype=np.float32, copy=True)
+    cols = mat.shape[1]
+    perm = np.arange(cols)
+    if cols % 4 != 0 or cols < 8:
+        return mat, time.perf_counter() - t0, perm
+    n_stripes = cols // 4
+    win_stripes = max(2, stripe_group_size // 4)
+    rng = rng or np.random.default_rng(0)
+
+    def window_pass() -> bool:
+        improved = False
+        from itertools import combinations
+
+        for stripes in combinations(range(n_stripes), win_stripes):
+            idx = np.concatenate([np.arange(4 * s, 4 * s + 4) for s in stripes])
+            sub = mat[:, idx]
+            base = sum_after_2_to_4(sub)
+            best = _best_window_permutation(sub)
+            if sum_after_2_to_4(sub[:, best]) > base + 1e-7:
+                mat[:, idx] = sub[:, best]
+                perm[idx] = perm[idx][best]
+                improved = True
+        return improved
+
+    while window_pass():
+        pass
+    for _ in range(escape_attempts):
+        src = int(rng.integers(cols))
+        dst = int(rng.integers(cols))
+        if src // 4 == dst // 4:
+            continue
+        _, improvement = try_swap(mat, dst, src)
+        if improvement > 1e-9:
+            mat[:, [src, dst]] = mat[:, [dst, src]]
+            perm[[src, dst]] = perm[[dst, src]]
+            while window_pass():
+                pass
+    return mat, time.perf_counter() - t0, perm
+
+
+def progressive_channel_swap(
+    matrix: np.ndarray,
+    search_time_limit: float = 60.0,
+    improvement_threshold: float = 1e-9,
+    rng: np.random.Generator | None = None,
+):
+    """Random swap search until the time limit (reference
+    call_permutation_search_kernels.py 'progressive channel swap')."""
+    t0 = time.perf_counter()
+    mat = np.array(matrix, dtype=np.float32, copy=True)
+    cols = mat.shape[1]
+    perm = np.arange(cols)
+    rng = rng or np.random.default_rng(0)
+    while time.perf_counter() - t0 < search_time_limit:
+        src = int(rng.integers(cols))
+        dst = int(rng.integers(cols))
+        if src // 4 == dst // 4:
+            continue
+        _, improvement = try_swap(mat, dst, src)
+        if improvement > improvement_threshold:
+            mat[:, [src, dst]] = mat[:, [dst, src]]
+            perm[[src, dst]] = perm[[dst, src]]
+    return mat, time.perf_counter() - t0, perm
+
+
+def search_for_good_permutation(matrix, options: dict | None = None):
+    """Strategy dispatch — mirror of the reference's
+    ``accelerated_search_for_good_permutation``
+    (call_permutation_search_kernels.py:5).  Returns the permutation
+    sequence (list of column indices)."""
+    options = dict(options or {})
+    strategy = options.setdefault("strategy", "exhaustive")
+    mat = np.asarray(matrix, dtype=np.float32)
+    if strategy == "exhaustive":
+        _, _, perm = exhaustive_search(
+            mat,
+            stripe_group_size=options.get("stripe_group_size", 8),
+            escape_attempts=options.get("escape_attempts", 100),
+        )
+    elif strategy == "progressive channel swap":
+        _, _, perm = progressive_channel_swap(
+            mat,
+            search_time_limit=options.get(
+                "progressive_search_time_limit", 60
+            ),
+            improvement_threshold=options.get(
+                "improvement_threshold", 1e-9
+            ),
+        )
+    elif strategy == "user defined":
+        perm = np.arange(mat.shape[1])
+    else:
+        raise ValueError(f"unknown permutation strategy {strategy!r}")
+    return list(map(int, perm))
+
+
+# ---------------------------------------------------------------------------
+# pytree-level application
+# ---------------------------------------------------------------------------
+
+
+class Permutation:
+    """Apply one channel permutation consistently across coupled params.
+
+    A *group* is a list of ``(path, axis, kind)`` where ``kind`` is:
+
+    - ``"consumer"`` — the axis indexes the channels being permuted (the
+      pruned layer's reduction axis, or a BatchNorm stat vector); the
+      param is gathered with ``perm`` along ``axis``.
+    - ``"producer"`` — the axis is the upstream layer's output-channel
+      axis; it absorbs the *inverse* permutation so the composition is
+      the identity function (reference permutation_lib.py
+      ``apply_offline_permutation``).
+
+    Since producer takes ``perm`` on its output exactly when consumer
+    takes ``perm`` on its input, both gather with the same index list —
+    the distinction is only documentation of intent.
+    """
+
+    @staticmethod
+    def permute_axis(array, axis: int, perm) -> np.ndarray:
+        return np.take(np.asarray(array), np.asarray(perm), axis=axis)
+
+    @staticmethod
+    def apply(params: dict, group, perm):
+        """Return a copy of the (nested) ``params`` dict with every entry
+        in ``group`` permuted.  ``group`` entries are
+        ``(path_tuple_or_str, axis, kind)``."""
+        import copy
+
+        out = copy.deepcopy(params)
+        for path, axis, _kind in group:
+            keys = path.split("/") if isinstance(path, str) else list(path)
+            node = out
+            for k in keys[:-1]:
+                node = node[k]
+            node[keys[-1]] = Permutation.permute_axis(
+                node[keys[-1]], axis, perm
+            )
+        return out
+
+    @staticmethod
+    def search_and_apply(params: dict, group, options: dict | None = None):
+        """Search a permutation on the concatenation of the group's
+        consumer matrices (reference concatenates all consumers' 2-D
+        views along rows — permutation_lib.py ``find_permutations``),
+        then apply it to every entry.  Returns (new_params, perm)."""
+        views = []
+        for path, axis, kind in group:
+            if kind != "consumer":
+                continue
+            keys = path.split("/") if isinstance(path, str) else list(path)
+            node = params
+            for k in keys:
+                node = node[k]
+            arr = np.asarray(node, dtype=np.float32)
+            if arr.ndim == 1:
+                continue  # BN-style stat vectors don't inform the search
+            arr = np.moveaxis(arr, axis, -1)
+            views.append(arr.reshape(-1, arr.shape[-1]))
+        if not views:
+            return params, list(range(0))
+        matrix = np.concatenate(views, axis=0)
+        perm = search_for_good_permutation(matrix, options)
+        return Permutation.apply(params, group, perm), perm
