@@ -1,0 +1,14 @@
+"""Fused multi-head attention modules.
+
+TPU rebuild of ``apex.contrib.multihead_attn`` (reference:
+self_multihead_attn.py:22, encdec_multihead_attn.py:22,
+mask_softmax_dropout_func.py).  The reference's hand-written CUDA MHA
+(8.4k LoC: rocBLAS GEMMs + Philox dropout + fused softmax + fused
+layernorm/residual epilogues) collapses into the Pallas flash-attention
+kernel (attention dropout fused in-kernel via a counter-hash PRNG — the
+Philox analog) plus XLA-fused projections.
+"""
+
+from .self_multihead_attn import SelfMultiheadAttn  # noqa: F401
+from .encdec_multihead_attn import EncdecMultiheadAttn  # noqa: F401
+from .mask_softmax_dropout_func import fast_mask_softmax_dropout_func  # noqa: F401

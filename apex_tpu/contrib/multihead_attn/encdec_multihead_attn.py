@@ -1,0 +1,140 @@
+"""Encoder-decoder multi-head attention module.
+
+Reference: ``apex/contrib/multihead_attn/encdec_multihead_attn.py:22`` —
+query projected from the decoder stream, fused KV projection from the
+encoder output, same fast/norm-add CUDA variants as self-attention.
+Flash-attention kernel backend with fused attention dropout; layouts and
+init match the reference (q weight xavier, kv fused weight xavier with
+gain sqrt(2); norm-add layernorms the *query* stream,
+fast_encdec_multihead_attn_norm_add_func.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.layer_norm import fused_layer_norm
+
+from .self_multihead_attn import _resolve_time_mask, _xavier_uniform
+
+__all__ = ["EncdecMultiheadAttn"]
+
+
+class EncdecMultiheadAttn(nn.Module):
+    """Drop-in for reference ``EncdecMultiheadAttn`` (flax edition)."""
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"
+
+    def setup(self):
+        e = self.embed_dim
+        assert e % self.num_heads == 0, (
+            "embed_dim must be divisible by num_heads"
+        )
+        self.in_proj_weight_q = self.param(
+            "in_proj_weight_q", _xavier_uniform(), (e, e))
+        # fused [e, 2e] KV initialized like an [e, e] matrix:
+        # sqrt(6/(e+e)) / sqrt(6/(2e+e)) = sqrt(3/2)
+        self.in_proj_weight_kv = self.param(
+            "in_proj_weight_kv", _xavier_uniform(math.sqrt(1.5)),
+            (e, 2 * e))
+        self.out_proj_weight = self.param(
+            "out_proj_weight", _xavier_uniform(), (e, e))
+        if self.bias:
+            self.in_proj_bias_q = self.param(
+                "in_proj_bias_q", nn.initializers.zeros, (e,))
+            self.in_proj_bias_kv = self.param(
+                "in_proj_bias_kv", nn.initializers.zeros, (2 * e,))
+            self.out_proj_bias = self.param(
+                "out_proj_bias", nn.initializers.zeros, (e,))
+        if self.include_norm_add:
+            self.lyr_nrm_gamma_weights = self.param(
+                "lyr_nrm_gamma_weights", nn.initializers.ones, (e,))
+            self.lyr_nrm_beta_weights = self.param(
+                "lyr_nrm_beta_weights", nn.initializers.zeros, (e,))
+
+    def __call__(
+        self,
+        query: jax.Array,
+        key: jax.Array,
+        value: Optional[jax.Array] = None,
+        key_padding_mask: Optional[jax.Array] = None,
+        need_weights: bool = False,
+        attn_mask: Optional[bool] = None,
+        is_training: bool = True,
+    ):
+        """``query``: [tgt_len, batch, e] (decoder); ``key``: [src_len,
+        batch, e] (encoder output; ``value`` must alias it — the fused
+        KV projection reads one stream, like the reference).  Returns
+        ``(output, None)``."""
+        assert not need_weights, (
+            "need_weights is unsupported on the fused path"
+        )
+        assert value is None or value is key, (
+            "EncdecMultiheadAttn projects K and V from one encoder "
+            "stream (fused KV projection, like the reference): value "
+            "must alias key"
+        )
+        tq, b, e = query.shape
+        tk = key.shape[0]
+        h = self.num_heads
+        d = e // h
+
+        residual = query
+        q_in = query
+        if self.include_norm_add:
+            q_in = fused_layer_norm(
+                q_in, self.lyr_nrm_gamma_weights,
+                self.lyr_nrm_beta_weights)
+
+        q = q_in @ self.in_proj_weight_q
+        kv = key @ self.in_proj_weight_kv
+        if self.bias:
+            q = q + self.in_proj_bias_q
+            kv = kv + self.in_proj_bias_kv
+        k, v = jnp.split(kv, 2, axis=-1)
+
+        def to_bshd(x, t):
+            return x.reshape(t, b, h, d).transpose(1, 0, 2, 3)
+
+        if key_padding_mask is not None:
+            key_padding_mask = key_padding_mask.astype(jnp.bool_)
+
+        dropout_rng = None
+        attn_dropout = self.dropout if is_training else 0.0
+        if attn_dropout > 0.0:
+            dropout_rng = self.make_rng("dropout")
+
+        causal, generic_mask = _resolve_time_mask(attn_mask)
+        ctx = flash_attention(
+            to_bshd(q, tq), to_bshd(k, tk), to_bshd(v, tk),
+            causal=causal,
+            mask=generic_mask,
+            key_padding_mask=key_padding_mask,
+            scale=d ** -0.5,
+            dropout_p=attn_dropout,
+            dropout_rng=dropout_rng,
+        )
+        ctx = ctx.transpose(1, 0, 2, 3).reshape(tq, b, e)
+        out = ctx @ self.out_proj_weight
+        if self.bias:
+            out = out + self.out_proj_bias
+
+        if self.include_norm_add:
+            if is_training and self.dropout > 0.0:
+                rng = self.make_rng("dropout")
+                keep = jax.random.bernoulli(
+                    rng, 1.0 - self.dropout, out.shape)
+                out = jnp.where(keep, out / (1.0 - self.dropout), 0.0)
+            out = residual + out
+        return out, None

@@ -1,0 +1,63 @@
+"""Standalone fused masked-softmax + dropout.
+
+Reference: ``apex/contrib/multihead_attn/mask_softmax_dropout_func.py``
+(``fast_mask_softmax_dropout_func``) — softmax over attention scores with
+a byte or additive padding mask, then dropout, as one fused op (used to
+splice the reference MHA's middle section into other models).  Under jit
+XLA fuses the chain into one kernel pass; the fused scaled-masked softmax
+kernel supplies the softmax core.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fast_mask_softmax_dropout_func", "mask_softmax_dropout"]
+
+_NEG_INF = -1e30
+
+
+def mask_softmax_dropout(
+    is_training: bool,
+    heads: int,
+    inputs: jax.Array,
+    pad_mask: Optional[jax.Array],
+    mask_additive: bool,
+    dropout_prob: float,
+    dropout_rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """``inputs``: [batch*heads, tgt_len, src_len] attention scores (the
+    reference layout).  ``pad_mask``: [batch, src_len] — byte (1 =
+    masked) or additive float when ``mask_additive``.  Returns dropped
+    softmax probabilities."""
+    bh, tq, tk = inputs.shape
+    s = inputs.astype(jnp.float32)
+    if pad_mask is not None:
+        b = pad_mask.shape[0]
+        rep = bh // b
+        m = jnp.repeat(pad_mask, rep, axis=0)[:, None, :]
+        if mask_additive:
+            s = s + m.astype(jnp.float32)
+        else:
+            s = jnp.where(m.astype(jnp.bool_), _NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1).astype(inputs.dtype)
+    if is_training and dropout_prob > 0.0:
+        if dropout_rng is None:
+            raise ValueError(
+                "dropout_rng is required when is_training and "
+                "dropout_prob > 0 (JAX has no global PRNG state to "
+                "fall back on, unlike the reference's Philox stream)"
+            )
+        keep = jax.random.bernoulli(
+            dropout_rng, 1.0 - dropout_prob, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_prob), 0.0)
+    return p
+
+
+# reference-named alias (positional signature parity:
+# fast_mask_softmax_dropout_func(is_training, heads, inputs, pad_mask,
+# mask_additive, dropout_prob))
+fast_mask_softmax_dropout_func = mask_softmax_dropout

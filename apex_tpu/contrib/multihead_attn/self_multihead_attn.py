@@ -1,0 +1,223 @@
+"""Self multi-head attention module with fused attention dropout.
+
+Reference: ``apex/contrib/multihead_attn/self_multihead_attn.py:22`` —
+an nn.Module owning fused-QKV projection weights that dispatches to one
+of four CUDA autograd functions (fast / fast-norm-add / default, with
+Philox softmax-dropout, additive or byte padding masks, optional causal
+time mask).  Here all four collapse onto :func:`flash_attention`, whose
+Pallas kernel fuses causal masking, (additive) key-padding masks, and
+attention dropout, so training with attention dropout keeps O(s·d)
+memory — the direct analog of the reference's in-kernel
+``philox.cuh`` dropout.
+
+Layout parity: inputs are seq-first ``[tgt_len, batch, embed_dim]``
+exactly like the reference ("Input shape: Time x Batch x Channel").
+Weights use the JAX (in, out) convention — ``in_proj_weight`` is
+``[embed_dim, 3*embed_dim]`` where the reference stores
+``[3*embed_dim, embed_dim]``; initialization matches the reference's
+``xavier_uniform_(gain=sqrt(2))`` fused-QKV recipe
+(self_multihead_attn.py:113-124).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.layer_norm import fused_layer_norm
+
+__all__ = ["SelfMultiheadAttn"]
+
+
+def _resolve_time_mask(attn_mask):
+    """(causal_flag, generic_mask) from the reference's attn_mask arg:
+    None → no mask; non-tensor truthy → causal; a [tgt, tgt] byte/bool
+    tensor (1 = masked) → generic boolean mask broadcast over
+    batch/heads (the XLA fallback path)."""
+    if attn_mask is None:
+        return False, None
+    if isinstance(attn_mask, (bool, int)):
+        return bool(attn_mask), None
+    m = jnp.asarray(attn_mask)
+    if m.ndim == 0:
+        return bool(m), None
+    return False, m.astype(jnp.bool_)[None, None, :, :]
+
+
+def _xavier_uniform(gain: float = 1.0):
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, fan_out = shape[0], shape[1]
+        limit = gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(
+            key, shape, dtype, minval=-limit, maxval=limit
+        )
+
+    return init
+
+
+class SelfMultiheadAttn(nn.Module):
+    """Drop-in for reference ``SelfMultiheadAttn`` (flax edition).
+
+    Args mirror self_multihead_attn.py:28-38: ``bias`` adds projection
+    biases; ``include_norm_add`` pre-layernorms the input and returns
+    ``residual + dropout(attn_out)``; ``mask_additive`` marks the
+    key_padding_mask as an additive float mask; ``separate_qkv_params``
+    stores q/k/v weights separately.  ``impl`` is accepted for API
+    compatibility ("fast"/"default" both run the flash kernel).
+    """
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"
+    separate_qkv_params: bool = False
+    mask_additive: bool = False
+
+    def setup(self):
+        e = self.embed_dim
+        assert e % self.num_heads == 0, (
+            "embed_dim must be divisible by num_heads"
+        )
+        if self.mask_additive:
+            assert not self.include_norm_add, (
+                "additive mask not supported with layer norm"
+            )
+        if self.separate_qkv_params:
+            self.q_weight = self.param(
+                "q_weight", _xavier_uniform(), (e, e))
+            self.k_weight = self.param(
+                "k_weight", _xavier_uniform(), (e, e))
+            self.v_weight = self.param(
+                "v_weight", _xavier_uniform(), (e, e))
+        else:
+            # gain sqrt(2): fused [e, 3e] initialized like an [e, e]
+            # matrix (reference reset_parameters rationale)
+            self.in_proj_weight = self.param(
+                "in_proj_weight", _xavier_uniform(math.sqrt(2.0)),
+                (e, 3 * e))
+        self.out_proj_weight = self.param(
+            "out_proj_weight", _xavier_uniform(), (e, e))
+        if self.bias:
+            if self.separate_qkv_params:
+                self.q_bias = self.param(
+                    "q_bias", nn.initializers.zeros, (e,))
+                self.k_bias = self.param(
+                    "k_bias", nn.initializers.zeros, (e,))
+                self.v_bias = self.param(
+                    "v_bias", nn.initializers.zeros, (e,))
+            else:
+                self.in_proj_bias = self.param(
+                    "in_proj_bias", nn.initializers.zeros, (3 * e,))
+            self.out_proj_bias = self.param(
+                "out_proj_bias", nn.initializers.zeros, (e,))
+        if self.include_norm_add:
+            self.lyr_nrm_gamma_weights = self.param(
+                "lyr_nrm_gamma_weights", nn.initializers.ones, (e,))
+            self.lyr_nrm_beta_weights = self.param(
+                "lyr_nrm_beta_weights", nn.initializers.zeros, (e,))
+
+    def __call__(
+        self,
+        query: jax.Array,
+        key: Optional[jax.Array] = None,
+        value: Optional[jax.Array] = None,
+        key_padding_mask: Optional[jax.Array] = None,
+        need_weights: bool = False,
+        attn_mask: Optional[bool] = None,
+        is_training: bool = True,
+    ):
+        """``query``: [tgt_len, batch, embed_dim]; ``key``/``value`` are
+        accepted for API parity and must alias query (self-attention).
+        ``attn_mask`` is the causal time mask: pass ``True`` (or any
+        non-tensor truthy) to mask future timesteps — the reference's
+        use_time_mask flag — or an explicit [tgt, tgt] byte/bool tensor
+        (1 = masked), which routes to the generic-mask path.
+        ``key_padding_mask``: [batch, src_len]; byte/bool (1 = masked)
+        or additive float when ``mask_additive``.  Returns
+        ``(output, None)`` like the reference fast path (attention
+        weights are not materialized — that is the point)."""
+        assert key is None or key is query, (
+            "SelfMultiheadAttn is self-attention: key must alias query"
+        )
+        assert value is None or value is query, (
+            "SelfMultiheadAttn is self-attention: value must alias query"
+        )
+        assert not need_weights, (
+            "need_weights is unsupported on the fused path (the "
+            "reference fast impl returns None as well)"
+        )
+        t, b, e = query.shape
+        h = self.num_heads
+        d = e // h
+
+        residual = query
+        inputs = query
+        if self.include_norm_add:
+            inputs = fused_layer_norm(
+                inputs, self.lyr_nrm_gamma_weights,
+                self.lyr_nrm_beta_weights)
+
+        if self.separate_qkv_params:
+            wq, wk, wv = self.q_weight, self.k_weight, self.v_weight
+            bq = self.q_bias if self.bias else None
+            bk = self.k_bias if self.bias else None
+            bv = self.v_bias if self.bias else None
+        else:
+            wq, wk, wv = jnp.split(self.in_proj_weight, 3, axis=1)
+            if self.bias:
+                bq, bk, bv = jnp.split(self.in_proj_bias, 3)
+            else:
+                bq = bk = bv = None
+
+        def proj(x, w, bias_vec):
+            y = x @ w
+            return y if bias_vec is None else y + bias_vec
+
+        # [t, b, e] -> [b, t, h, d]
+        def to_bshd(x):
+            return x.reshape(t, b, h, d).transpose(1, 0, 2, 3)
+
+        q = to_bshd(proj(inputs, wq, bq))
+        k = to_bshd(proj(inputs, wk, bk))
+        v = to_bshd(proj(inputs, wv, bv))
+
+        if key_padding_mask is not None and not self.mask_additive:
+            key_padding_mask = key_padding_mask.astype(jnp.bool_)
+
+        dropout_rng = None
+        attn_dropout = self.dropout if is_training else 0.0
+        if attn_dropout > 0.0:
+            dropout_rng = self.make_rng("dropout")
+
+        causal, generic_mask = _resolve_time_mask(attn_mask)
+        ctx = flash_attention(
+            q, k, v,
+            causal=causal,
+            mask=generic_mask,
+            key_padding_mask=key_padding_mask,
+            scale=d ** -0.5,
+            dropout_p=attn_dropout,
+            dropout_rng=dropout_rng,
+        )
+        # [b, t, h, d] -> [t, b, e]
+        ctx = ctx.transpose(1, 0, 2, 3).reshape(t, b, e)
+        out = ctx @ self.out_proj_weight
+        if self.bias:
+            out = out + self.out_proj_bias
+
+        if self.include_norm_add:
+            # dropout-add epilogue (reference jit_dropout_add)
+            if is_training and self.dropout > 0.0:
+                rng = self.make_rng("dropout")
+                keep = jax.random.bernoulli(
+                    rng, 1.0 - self.dropout, out.shape)
+                out = jnp.where(keep, out / (1.0 - self.dropout), 0.0)
+            out = residual + out
+        return out, None

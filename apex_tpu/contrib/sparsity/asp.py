@@ -194,6 +194,16 @@ class ASP:
         cls.__permutation_groups = (
             list(permutation_groups or []) if allow_permutation else []
         )
+        if cls.__permutation_groups and allow_recompute_mask:
+            # A second compute_sparse_masks would re-permute the already
+            # permuted params while the stashed pruned values stay in the
+            # old channel order — restoring would corrupt the weights.
+            # The reference applies its permutation once, offline.
+            raise ValueError(
+                "allow_recompute_mask cannot be combined with "
+                "permutation_groups: recomputing masks would re-permute "
+                "channels while stashed pruned values keep the old order"
+            )
 
         flat, _ = _flatten_with_paths(params)
         cls.__sparse_names = []

@@ -86,14 +86,15 @@ def _stripe_sums(matrix: np.ndarray) -> np.ndarray:
     return top2.sum(axis=(0, 2))
 
 
-def try_swap(matrix: np.ndarray, dst: int, src: int):
-    """(new_total, improvement) if columns src/dst were swapped.  Only the
-    two affected stripes are re-scored (reference
-    permutation_utilities.py ``try_swap``)."""
+def try_swap(matrix: np.ndarray, dst: int, src: int) -> float:
+    """Retained-magnitude improvement if columns src/dst were swapped.
+    Only the two affected stripes are re-scored (reference
+    permutation_utilities.py ``try_swap``; unlike the reference this
+    returns only the improvement — the callers never use the total, and
+    computing it would cost a full-matrix rescore per probe)."""
     g_src, g_dst = src // 4, dst // 4
     if g_src == g_dst:
-        total = sum_after_2_to_4(matrix)
-        return total, 0.0
+        return 0.0
     cols = [4 * g_src + i for i in range(4)] + [4 * g_dst + i for i in range(4)]
     sub = np.array(matrix[:, cols], copy=True)
     before = sum_after_2_to_4(sub)
@@ -101,10 +102,7 @@ def try_swap(matrix: np.ndarray, dst: int, src: int):
     p_src = cols.index(src)
     p_dst = cols.index(dst)
     sub[:, [p_src, p_dst]] = sub[:, [p_dst, p_src]]
-    after = sum_after_2_to_4(sub)
-    improvement = after - before
-    total = sum_after_2_to_4(matrix) + improvement
-    return total, improvement
+    return sum_after_2_to_4(sub) - before
 
 
 # ---------------------------------------------------------------------------
@@ -211,7 +209,7 @@ def exhaustive_search(
         dst = int(rng.integers(cols))
         if src // 4 == dst // 4:
             continue
-        _, improvement = try_swap(mat, dst, src)
+        improvement = try_swap(mat, dst, src)
         if improvement > 1e-9:
             mat[:, [src, dst]] = mat[:, [dst, src]]
             perm[[src, dst]] = perm[[dst, src]]
@@ -238,7 +236,7 @@ def progressive_channel_swap(
         dst = int(rng.integers(cols))
         if src // 4 == dst // 4:
             continue
-        _, improvement = try_swap(mat, dst, src)
+        improvement = try_swap(mat, dst, src)
         if improvement > improvement_threshold:
             mat[:, [src, dst]] = mat[:, [dst, src]]
             perm[[src, dst]] = perm[[dst, src]]

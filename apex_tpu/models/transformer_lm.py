@@ -297,8 +297,9 @@ def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
     csrc/megatron/scaled_*_softmax).
 
     Backend: the Pallas flash-attention kernel when the pattern allows
-    (causal / unmasked, no attention dropout); otherwise the fused-softmax
-    family on materialized scores (generic masks, dropout).
+    (causal / unmasked / key-padding, attention dropout fused in-kernel);
+    otherwise the fused-softmax family on materialized scores (generic
+    4-D masks).
     """
     hd = q.shape[-1]
     scale = 1.0 / hd ** 0.5
@@ -310,11 +311,12 @@ def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
     if attention_mask is not None and attention_mask.ndim == 2:
         kpm = attention_mask
         attention_mask = None
-    if (cfg.attention_backend == "flash" and attention_mask is None
-            and not use_dropout):
+    if cfg.attention_backend == "flash" and attention_mask is None:
         from apex_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=causal,
-                               key_padding_mask=kpm, scale=scale)
+        return flash_attention(
+            q, k, v, causal=causal, key_padding_mask=kpm, scale=scale,
+            dropout_p=cfg.attention_dropout if use_dropout else 0.0,
+            dropout_rng=dropout_rng if use_dropout else None)
     if kpm is not None:
         attention_mask = kpm[:, None, None, :]   # broadcastable 4-D
     # [b, s, n, d] x [b, t, n, d] -> [b, n, s, t]
@@ -365,6 +367,13 @@ def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
         cos, sin = rope
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
+    if dropout_rng is not None and ctx.tp > 1:
+        # attention probs are head-sharded over tp: each tp rank needs its
+        # own dropout stream (the reference's model-parallel RNG,
+        # tensor_parallel/random.py CudaRNGStatesTracker); replicated
+        # hidden-dropout keys stay shared.
+        dropout_rng = jax.random.fold_in(
+            dropout_rng, jax.lax.axis_index(ctx.tp_axis))
     ctxv = _core_attention(cfg, q, k, v, attention_mask, dropout_rng)
     ctxv = ctxv.reshape(b, s, -1)
     out = ctxv @ lp["proj_kernel"].astype(x.dtype)

@@ -5,9 +5,9 @@ varlen via cu_seqlens — fmha_api.cpp:358) and apex/contrib/csrc/
 multihead_attn (pre-flash fused MHA with softmax/dropout epilogues). Instead
 of porting those CUDA tilings we implement one FlashAttention-2 style
 blockwise kernel set in Pallas: O(sq·d) memory, online softmax, fused causal
-/ key-padding masking, fp32 accumulation on the MXU. It also serves as the
-compute core of the ring-attention context-parallel path (the reference has
-no long-context story; SURVEY.md §5).
+/ key-padding masking and attention dropout, fp32 accumulation on the MXU.
+It also serves as the compute core of the ring-attention context-parallel
+path (the reference has no long-context story; SURVEY.md §5).
 
 Layout: [batch, seq, heads, head_dim] (the model's native BSND). The kernel
 grid runs (batch*heads, q-blocks, kv-blocks) with kv innermost; VMEM scratch
@@ -15,10 +15,20 @@ carries the running max / normalizer / accumulator across kv steps.
 
 Variants:
 - ``causal=True`` — upper-triangular mask generated from iota in-kernel.
-- ``key_padding_mask`` [b, sk] bool (True = masked) — fused in-kernel.
-- generic additive ``bias`` or full boolean ``mask``, or dropout — routed to
-  the XLA composition (these are rare paths in the reference too; its fmha
-  supports only varlen+causal-free BERT shapes).
+- ``key_padding_mask`` [b, sk] — bool (True = masked) or additive float
+  (the reference's ``mask_additive`` MHA mode) — fused in-kernel as an
+  additive score term.
+- ``dropout_p`` — attention dropout fused in-kernel. The keep mask is a
+  counter-based hash of (seed, batch·head, query row, key col) — the
+  Philox-counter analog of the reference's in-kernel dropout
+  (contrib/csrc/multihead_attn/philox.cuh): stateless, order-independent,
+  so the forward and both backward kernels regenerate identical bits for
+  every tile with no O(s²) residual.  Dropout is applied to the
+  *unnormalized* probabilities feeding the accumulator while the softmax
+  normalizer accumulates the un-dropped weights, which equals dropping
+  the normalized probabilities.
+- generic additive ``bias`` or full boolean ``mask`` — routed to the XLA
+  composition (rare paths in the reference too).
 
 Backward: custom_vjp with the standard two-kernel scheme — dq accumulates
 over kv blocks, dk/dv over q blocks, both recomputing the probabilities
@@ -43,6 +53,31 @@ __all__ = ["flash_attention", "mha_reference"]
 _NEG_INF = -1e30
 
 
+def _unify_vma(*arrays):
+    """Promote every (non-None) array to the union of the group's varying
+    manual axes (jax 0.9 shard_map vma typing).  A Pallas call with
+    mixed-vma operands — e.g. a closure-constant mask next to a
+    pp-varying activation inside a shard_map pipeline stage — fails the
+    dynamic_slice vma check in the interpreter/lowering; unifying here
+    makes the kernel's type uniform.  No-op outside shard_map."""
+    vmas = []
+    for a in arrays:
+        if a is None:
+            continue
+        vmas.append(set(getattr(jax.typeof(a), "vma", ()) or ()))
+    union = set().union(*vmas) if vmas else set()
+    if not union:
+        return arrays
+    out = []
+    for a in arrays:
+        if a is None:
+            out.append(None)
+            continue
+        missing = tuple(union - set(getattr(jax.typeof(a), "vma", ())))
+        out.append(jax.lax.pvary(a, missing) if missing else a)
+    return tuple(out)
+
+
 def _pad_to(x, size, axis):
     pad = size - x.shape[axis]
     if pad == 0:
@@ -53,7 +88,45 @@ def _pad_to(x, size, axis):
 
 
 # ---------------------------------------------------------------------------
-# Reference XLA path (also the fallback for bias / generic mask / dropout).
+# In-kernel dropout PRNG: counter-based hash (Philox-counter analog).
+#
+# pltpu.prng_* is hardware-only (no CPU interpret lowering), so the keep
+# mask is a murmur3-style integer hash over global (seed, bh, row, col)
+# coordinates — bit-identical on TPU and in CPU interpret mode, and
+# trivially order-independent across the three kernels.
+# ---------------------------------------------------------------------------
+
+
+def _u32(x):
+    return jnp.uint32(x)
+
+
+def _keep_mask(seed, bh, q_start, k_start, shape, keep_prob):
+    """Boolean keep mask for a (block_q, block_k) tile."""
+    row = (
+        q_start
+        + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    ).astype(jnp.uint32)
+    col = (
+        k_start
+        + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    ).astype(jnp.uint32)
+    h = seed.astype(jnp.uint32) + bh.astype(jnp.uint32) * _u32(0x9E3779B1)
+    h = h ^ (row * _u32(0x85EBCA77))
+    h = h ^ (h >> 16)
+    h = h * _u32(0x7FEB352D)
+    h = h ^ (col * _u32(0xC2B2AE3D))
+    h = h ^ (h >> 16)
+    h = h * _u32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * _u32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    threshold = min(int(round(keep_prob * 4294967296.0)), 4294967295)
+    return h < _u32(threshold)
+
+
+# ---------------------------------------------------------------------------
+# Reference XLA path (also the fallback for generic bias / mask).
 # ---------------------------------------------------------------------------
 
 
@@ -72,7 +145,10 @@ def mha_reference(q, k, v, *, causal=False, key_padding_mask=None,
     if mask is not None:
         s = jnp.where(mask, _NEG_INF, s)
     if key_padding_mask is not None:
-        s = jnp.where(key_padding_mask[:, None, None, :], _NEG_INF, s)
+        if key_padding_mask.dtype == jnp.bool_:
+            s = jnp.where(key_padding_mask[:, None, None, :], _NEG_INF, s)
+        else:  # additive float mask (reference mask_additive mode)
+            s = s + key_padding_mask[:, None, None, :].astype(jnp.float32)
     if causal:
         row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
@@ -91,12 +167,14 @@ def mha_reference(q, k, v, *, causal=False, key_padding_mask=None,
 
 
 def _fwd_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
-                *refs):
+                dropout_p, *refs):
+    if dropout_p > 0.0:
+        seed_ref, refs = refs[0], refs[1:]
     if has_kpm:
         q_ref, k_ref, v_ref, kpm_ref, o_ref, lse_ref, acc, m_s, l_s = refs
     else:
         q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s = refs
-    qi, kj = pl.program_id(1), pl.program_id(2)
+    bh, qi, kj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(kj == 0)
     def _init():
@@ -113,12 +191,12 @@ def _fwd_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        if has_kpm:
+            s = s + kpm_ref[0]  # additive [1, block_k] broadcast
 
         col = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         pred = col < sk_real                       # kv tail padding
-        if has_kpm:
-            pred &= kpm_ref[0] == 0
         if causal:
             row = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -135,8 +213,14 @@ def _fwd_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
         alpha = jnp.where(m_new > _NEG_INF / 2, alpha, 0.0)
 
         l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref[0], bh, q_start, k_start,
+                              (block_q, block_k), 1.0 - dropout_p)
+            p_acc = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+        else:
+            p_acc = p
         acc[:] = acc[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            p_acc.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
 
@@ -157,8 +241,8 @@ def _fwd_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
             jnp.where(l == 0.0, _NEG_INF, lse), lse_ref.shape[1:])
 
 
-def _fwd_pallas(q3, k3, v3, kpm, scale, causal, sk_real,
-                block_q, block_k, interpret, out_dtype=None):
+def _fwd_pallas(q3, k3, v3, kpm, seed, scale, causal, sk_real,
+                block_q, block_k, dropout_p, interpret, out_dtype=None):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, sqp, d = q3.shape
@@ -169,10 +253,15 @@ def _fwd_pallas(q3, k3, v3, kpm, scale, causal, sk_real,
                           memory_space=pltpu.VMEM)
     k_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
                           memory_space=pltpu.VMEM)
-    in_specs = [q_spec, k_spec, k_spec]
-    args = [q3, k3, v3]
+    in_specs = []
+    args = []
+    if dropout_p > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    in_specs += [q_spec, k_spec, k_spec]
+    args += [q3, k3, v3]
     if kpm is not None:
-        # [b, 1, skp] int32, indexed by batch = bh // heads
+        # [b, 1, skp] additive f32, indexed by batch = bh // heads
         heads = bh // kpm.shape[0]
         in_specs.append(pl.BlockSpec(
             (1, 1, block_k),
@@ -192,7 +281,7 @@ def _fwd_pallas(q3, k3, v3, kpm, scale, causal, sk_real,
     ]
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale, causal, sk_real,
-                          block_q, block_k, kpm is not None),
+                          block_q, block_k, kpm is not None, dropout_p),
         grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,
@@ -212,14 +301,17 @@ def _fwd_pallas(q3, k3, v3, kpm, scale, causal, sk_real,
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(scale, causal, sk_real, block_q, block_k, has_kpm, *refs):
+def _bwd_dq_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
+                   dropout_p, *refs):
+    if dropout_p > 0.0:
+        seed_ref, refs = refs[0], refs[1:]
     if has_kpm:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kpm_ref,
          dq_ref, dq_acc) = refs
     else:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dq_ref, dq_acc) = refs
-    qi, kj = pl.program_id(1), pl.program_id(2)
+    bh, qi, kj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(kj == 0)
     def _init():
@@ -233,21 +325,29 @@ def _bwd_dq_kernel(scale, causal, sk_real, block_q, block_k, has_kpm, *refs):
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        if has_kpm:
+            s = s + kpm_ref[0]
         col = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         pred = col < sk_real
-        if has_kpm:
-            pred &= kpm_ref[0] == 0
         if causal:
             row = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             pred &= col <= row
         lse = lse_ref[0][:, :1]
+        # fully-masked rows carry the -inf lse sentinel: s - lse would be
+        # ~0 there (additive -1e30 mask == -1e30 sentinel), not -inf —
+        # zero them explicitly or pad keys receive garbage gradients
+        pred &= lse > _NEG_INF / 2
         p = jnp.where(pred, jnp.exp(s - lse), 0.0)
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
             do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref[0], bh, q_start, k_start,
+                              (block_q, block_k), 1.0 - dropout_p)
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
         delta = delta_ref[0][:, :1]
         ds = p * (dp - delta) * scale
         dq_acc[:] += jax.lax.dot_general(
@@ -265,14 +365,16 @@ def _bwd_dq_kernel(scale, causal, sk_real, block_q, block_k, has_kpm, *refs):
 
 
 def _bwd_dkv_kernel(scale, causal, sq_real, sk_real, block_q, block_k,
-                    has_kpm, *refs):
+                    has_kpm, dropout_p, *refs):
+    if dropout_p > 0.0:
+        seed_ref, refs = refs[0], refs[1:]
     if has_kpm:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kpm_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
     else:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
-    kj, qi = pl.program_id(1), pl.program_id(2)
+    bh, kj, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(qi == 0)
     def _init():
@@ -287,24 +389,35 @@ def _bwd_dkv_kernel(scale, causal, sq_real, sk_real, block_q, block_k,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        if has_kpm:
+            s = s + kpm_ref[0]
         col = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         row = q_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         pred = (col < sk_real) & (row < sq_real)
-        if has_kpm:
-            pred &= kpm_ref[0] == 0
         if causal:
             pred &= col <= row
         lse = lse_ref[0][:, :1]
+        # see _bwd_dq_kernel: zero fully-masked rows (lse sentinel)
+        pred &= lse > _NEG_INF / 2
         p = jnp.where(pred, jnp.exp(s - lse), 0.0)
         do = do_ref[0].astype(jnp.float32)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref[0], bh, q_start, k_start,
+                              (block_q, block_k), 1.0 - dropout_p)
+            inv = 1.0 / (1.0 - dropout_p)
+            p_acc = jnp.where(keep, p * inv, 0.0)
+        else:
+            p_acc = p
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_acc, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
         delta = delta_ref[0][:, :1]
         ds = p * (dp - delta) * scale
         dk_acc[:] += jax.lax.dot_general(
@@ -322,8 +435,8 @@ def _bwd_dkv_kernel(scale, causal, sq_real, sk_real, block_q, block_k,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, scale, causal,
-                sq_real, sk_real, block_q, block_k, interpret,
+def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, seed, scale, causal,
+                sq_real, sk_real, block_q, block_k, dropout_p, interpret,
                 out_dtype=None):
     from jax.experimental.pallas import tpu as pltpu
 
@@ -345,9 +458,14 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, scale, causal,
     # --- dq: grid (bh, q, kv) ------------------------------------------
     qmap = lambda b, i, j: (b, i, 0)
     kmap = lambda b, i, j: (b, j, 0)
-    in_specs = [qspec(qmap), kspec(kmap), kspec(kmap), qspec(qmap),
-                rowspec(qmap), rowspec(qmap)]
-    args = [q3, k3, v3, do3, lse3, delta3]
+    in_specs = []
+    args = []
+    if dropout_p > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    in_specs += [qspec(qmap), kspec(kmap), kspec(kmap), qspec(qmap),
+                 rowspec(qmap), rowspec(qmap)]
+    args += [q3, k3, v3, do3, lse3, delta3]
     if kpm is not None:
         heads = bh // kpm.shape[0]
         in_specs.append(pl.BlockSpec(
@@ -356,7 +474,7 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, scale, causal,
         args.append(kpm)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale, causal, sk_real,
-                          block_q, block_k, kpm is not None),
+                          block_q, block_k, kpm is not None, dropout_p),
         grid=(bh, sqp // block_q, skp // block_k),
         in_specs=in_specs,
         out_specs=qspec(qmap),
@@ -368,9 +486,14 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, scale, causal,
     # --- dk/dv: grid (bh, kv, q) ---------------------------------------
     qmap2 = lambda b, j, i: (b, i, 0)
     kmap2 = lambda b, j, i: (b, j, 0)
-    in_specs = [qspec(qmap2), kspec(kmap2), kspec(kmap2), qspec(qmap2),
-                rowspec(qmap2), rowspec(qmap2)]
-    args = [q3, k3, v3, do3, lse3, delta3]
+    in_specs = []
+    args = []
+    if dropout_p > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    in_specs += [qspec(qmap2), kspec(kmap2), kspec(kmap2), qspec(qmap2),
+                 rowspec(qmap2), rowspec(qmap2)]
+    args += [q3, k3, v3, do3, lse3, delta3]
     if kpm is not None:
         heads = bh // kpm.shape[0]
         in_specs.append(pl.BlockSpec(
@@ -379,7 +502,8 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, scale, causal,
         args.append(kpm)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale, causal, sq_real,
-                          sk_real, block_q, block_k, kpm is not None),
+                          sk_real, block_q, block_k, kpm is not None,
+                          dropout_p),
         grid=(bh, skp // block_k, sqp // block_q),
         in_specs=in_specs,
         out_specs=[kspec(kmap2), kspec(kmap2)],
@@ -414,13 +538,13 @@ def _blocks(sq, sk):
     return bq, bk
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, kpm, causal, scale):
-    o, _ = _flash_fwd(q, k, v, kpm, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash(q, k, v, kpm, seed, causal, scale, dropout_p):
+    o, _ = _flash_fwd(q, k, v, kpm, seed, causal, scale, dropout_p)
     return o
 
 
-def _flash_fwd(q, k, v, kpm, causal, scale):
+def _flash_fwd(q, k, v, kpm, seed, causal, scale, dropout_p):
     b, sq, n, d = q.shape
     sk = k.shape[1]
     block_q, block_k = _blocks(sq, sk)
@@ -430,15 +554,17 @@ def _flash_fwd(q, k, v, kpm, causal, scale):
     k3 = _pad_to(_to_bh(k), skp, 1)
     v3 = _pad_to(_to_bh(v), skp, 1)
     kpm3 = (None if kpm is None
-            else _pad_to(kpm.astype(jnp.int32)[:, None, :], skp, 2))
-    o3, lse = _fwd_pallas(q3, k3, v3, kpm3, scale, causal, sk,
-                          block_q, block_k, interpret=not on_tpu())
+            else _pad_to(kpm.astype(jnp.float32)[:, None, :], skp, 2))
+    q3, k3, v3, kpm3, seed = _unify_vma(q3, k3, v3, kpm3, seed)
+    o3, lse = _fwd_pallas(q3, k3, v3, kpm3, seed, scale, causal, sk,
+                          block_q, block_k, dropout_p,
+                          interpret=not on_tpu())
     o = _from_bh(o3, b, n)[:, :sq]
-    return o, (q, k, v, kpm, o, lse)
+    return o, (q, k, v, kpm, seed, o, lse)
 
 
-def _flash_bwd(causal, scale, res, do):
-    q, k, v, kpm, o, lse = res
+def _flash_bwd(causal, scale, dropout_p, res, do):
+    q, k, v, kpm, seed, o, lse = res
     b, sq, n, d = q.shape
     sk = k.shape[1]
     block_q, block_k = _blocks(sq, sk)
@@ -453,20 +579,34 @@ def _flash_bwd(causal, scale, res, do):
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)
     kpm3 = (None if kpm is None
-            else _pad_to(kpm.astype(jnp.int32)[:, None, :], skp, 2))
+            else _pad_to(kpm.astype(jnp.float32)[:, None, :], skp, 2))
+    q3, k3, v3, do3, lse3, delta, kpm3, seed = _unify_vma(
+        q3, k3, v3, do3, lse3, delta, kpm3, seed)
     dq3, dk3, dv3 = _bwd_pallas(
-        q3, k3, v3, do3, lse3, delta, kpm3, scale, causal, sq, sk,
-        block_q, block_k, interpret=not on_tpu())
+        q3, k3, v3, do3, lse3, delta, kpm3, seed, scale, causal, sq, sk,
+        block_q, block_k, dropout_p, interpret=not on_tpu())
     dq = _from_bh(dq3, b, n)[:, :sq]
     dk = _from_bh(dk3, b, n)[:, :sk]
     dv = _from_bh(dv3, b, n)[:, :sk]
-    # bool mask has no tangent space — float0 cotangent
-    dkpm = (None if kpm is None
-            else np.zeros(kpm.shape, jax.dtypes.float0))
-    return dq, dk, dv, dkpm
+    # The kernel treats the (float) mask as a constant: the wrapper
+    # stop-gradients it, so a zero cotangent is the user-visible truth.
+    # Learned additive masks/biases belong on the differentiable XLA
+    # ``bias`` path.
+    dkpm = None if kpm is None else jnp.zeros_like(kpm)
+    dseed = np.zeros(seed.shape, jax.dtypes.float0)
+    return dq, dk, dv, dkpm, dseed
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _seed_from_rng(dropout_rng) -> jax.Array:
+    """Collapse a PRNG key (typed or raw uint32 pair) to an int32 seed."""
+    data = jax.random.key_data(dropout_rng).reshape(-1)
+    seed = data[-1]
+    if data.shape[0] > 1:
+        seed = seed ^ (data[-2] * jnp.uint32(0x9E3779B1))
+    return seed.astype(jnp.int32).reshape(1)
 
 
 def flash_attention(
@@ -484,21 +624,39 @@ def flash_attention(
 ) -> jax.Array:
     """Memory-efficient attention over [b, s, n, d] tensors.
 
-    The Pallas blockwise kernel handles ``causal`` and ``key_padding_mask``
-    ([b, sk] bool, True = masked — the cu_seqlens analog of reference
-    fmha_api.cpp:358). A generic boolean ``mask``, additive ``bias``, or
-    attention ``dropout`` falls back to the fused-softmax XLA composition
-    (reference fast_multihead_attn territory).
+    The Pallas blockwise kernel handles ``causal``, ``key_padding_mask``
+    ([b, sk] bool True = masked, or additive float — the reference's
+    ``mask_additive`` MHA mode / the cu_seqlens analog of fmha_api.cpp:358)
+    and attention ``dropout`` (fused in-kernel, O(sq·d) memory — reference
+    multihead_attn philox.cuh analog).  A generic boolean ``mask`` or
+    additive ``bias`` falls back to the fused-softmax XLA composition.
     """
     if q.ndim != 4:
         raise ValueError(f"expected [b, s, n, d], got {q.shape}")
     d = q.shape[-1]
     scale = (1.0 / d ** 0.5) if scale is None else float(scale)
-    generic = (mask is not None or bias is not None
-               or (dropout_p > 0.0 and dropout_rng is not None))
+    generic = mask is not None or bias is not None
+    # Off-TPU inside shard_map (vma non-empty): the Pallas HLO
+    # interpreter's internal while-loop cannot carry mixed varying-axes
+    # buffers (jax 0.9 check) — run the XLA composition instead.  On
+    # real TPU the kernel runs under shard_map as normal (same choice as
+    # distributed_fused_adam's CPU path).
+    if not on_tpu() and getattr(jax.typeof(q), "vma", ()):
+        generic = True
     if generic:
         return mha_reference(
             q, k, v, causal=causal, key_padding_mask=key_padding_mask,
             mask=mask, bias=bias, scale=scale, dropout_p=dropout_p,
             dropout_rng=dropout_rng)
-    return _flash(q, k, v, key_padding_mask, causal, scale)
+    kpm = key_padding_mask
+    if kpm is not None:
+        if kpm.dtype == jnp.bool_:
+            kpm = jnp.where(kpm, jnp.float32(_NEG_INF), jnp.float32(0.0))
+        # the fused kernel does not differentiate the mask — learned
+        # additive masks must use ``bias`` (XLA path) instead
+        kpm = jax.lax.stop_gradient(kpm)
+    use_dropout = dropout_p > 0.0 and dropout_rng is not None
+    seed = (_seed_from_rng(dropout_rng) if use_dropout
+            else jnp.zeros((1,), jnp.int32))
+    return _flash(q, k, v, kpm, seed, causal, scale,
+                  float(dropout_p) if use_dropout else 0.0)

@@ -121,3 +121,134 @@ class TestBackward:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4,
                 err_msg=f"d{name}")
+
+
+class TestKernelDropout:
+    """In-kernel attention dropout (hash-PRNG Philox analog).
+
+    Mirrors the reference multihead_attn dropout checks: determinism per
+    seed, correct keep statistics, and fwd/bwd mask consistency.
+    """
+
+    def test_dropout_deterministic_per_seed(self):
+        q, k, v = make_qkv(2, 128, 2, 32, seed=10)
+        rng = jax.random.PRNGKey(7)
+        a = flash_attention(q, k, v, dropout_p=0.3, dropout_rng=rng)
+        b = flash_attention(q, k, v, dropout_p=0.3, dropout_rng=rng)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c = flash_attention(q, k, v, dropout_p=0.3,
+                            dropout_rng=jax.random.PRNGKey(8))
+        assert not np.allclose(np.asarray(a), np.asarray(c))
+
+    def test_dropout_zero_equals_dense(self):
+        q, k, v = make_qkv(2, 128, 2, 32, seed=11)
+        base = flash_attention(q, k, v)
+        out = flash_attention(q, k, v, dropout_p=0.0,
+                              dropout_rng=jax.random.PRNGKey(0))
+        np.testing.assert_allclose(np.asarray(base), np.asarray(out), **TOL)
+
+    def test_dropout_statistics_via_identity_values(self):
+        """With v = I, rows of the output are the dropped attention
+        probabilities: zero fraction ~ p, kept entries scaled 1/(1-p)."""
+        b, s, n, d = 1, 128, 1, 128
+        q, k, _ = make_qkv(b, s, n, d, seed=12)
+        v = jnp.eye(d)[None, :, None, :]
+        p_drop = 0.4
+        out = flash_attention(q, k, v, dropout_p=p_drop,
+                              dropout_rng=jax.random.PRNGKey(3))
+        probs = flash_attention(q, k, v)  # dense P
+        dense = np.asarray(probs, np.float64)
+        dropped = np.asarray(out, np.float64)
+        # kept entries = dense / (1-p): ratio is 1/(1-p) or 0
+        ratio = dropped / np.maximum(dense, 1e-30)
+        kept = ratio > 0.5
+        np.testing.assert_allclose(
+            ratio[kept], 1.0 / (1.0 - p_drop), rtol=1e-3)
+        zero_frac = 1.0 - kept.mean()
+        assert abs(zero_frac - p_drop) < 0.02, zero_frac
+
+    def test_dropout_mask_consistent_fwd_bwd(self):
+        """grad wrt v of sum(out) = column sums of dropped P — matches the
+        forward-observed mask exactly if fwd/bwd regenerate the same
+        bits."""
+        b, s, n, d = 1, 128, 1, 128
+        q, k, _ = make_qkv(b, s, n, d, seed=13)
+        v = jnp.eye(d)[None, :, None, :]
+        rng = jax.random.PRNGKey(5)
+        p_drop = 0.25
+
+        out = flash_attention(q, k, v, dropout_p=p_drop, dropout_rng=rng)
+        P_dropped = np.asarray(out)[0, :, 0, :]  # [sq, sk]
+
+        dv = jax.grad(lambda vv: jnp.sum(flash_attention(
+            q, k, vv, dropout_p=p_drop, dropout_rng=rng)))(v)
+        # dL/dv[t, e] = sum_q P_dropped[q, t] (same for every column e)
+        col_sums = P_dropped.sum(axis=0)
+        got = np.asarray(dv)[0, :, 0, :].mean(axis=-1)
+        np.testing.assert_allclose(got, col_sums, atol=1e-5, rtol=1e-4)
+
+    def test_dropout_grad_finite_differences(self):
+        """Analytic grads match finite differences through the kernel
+        (the dropout mask is deterministic given the seed)."""
+        b, s, n, d = 1, 8, 1, 8
+        q, k, v = make_qkv(b, s, n, d, seed=14)
+        rng = jax.random.PRNGKey(9)
+
+        def f(q_):
+            return jnp.sum(jnp.sin(flash_attention(
+                q_, k, v, dropout_p=0.3, dropout_rng=rng)))
+
+        g = np.asarray(jax.grad(f)(q))
+        eps = 1e-3
+        rs = np.random.RandomState(0)
+        for _ in range(5):
+            i = tuple(rs.randint(x) for x in q.shape)
+            dq = np.zeros(q.shape, np.float32)
+            dq[i] = eps
+            fd = (float(f(q + dq)) - float(f(q - dq))) / (2 * eps)
+            np.testing.assert_allclose(fd, g[i], atol=5e-3, rtol=5e-2)
+
+    def test_dropout_with_causal_and_padding(self):
+        q, k, v = make_qkv(2, 96, 2, 32, seed=15)
+        kpm = jnp.asarray(
+            np.arange(96)[None, :] >= np.array([64, 96])[:, None])
+        rng = jax.random.PRNGKey(11)
+        out = flash_attention(q, k, v, causal=True, key_padding_mask=kpm,
+                              dropout_p=0.2, dropout_rng=rng)
+        assert np.all(np.isfinite(np.asarray(out)))
+        grads = jax.grad(lambda *a: jnp.sum(flash_attention(
+            *a, causal=True, key_padding_mask=kpm, dropout_p=0.2,
+            dropout_rng=rng)), argnums=(0, 1, 2))(q, k, v)
+        for g in grads:
+            assert np.all(np.isfinite(np.asarray(g)))
+
+    def test_additive_key_padding_mask(self):
+        """Float (additive) key_padding_mask — the reference MHA
+        mask_additive mode — fused in-kernel."""
+        b, s, n, d = 2, 128, 2, 32
+        q, k, v = make_qkv(b, s, n, d, seed=16)
+        add = np.zeros((b, s), np.float32)
+        add[0, 100:] = -1e30
+        add[1, 64:] = -1e30
+        out_add = flash_attention(q, k, v,
+                                  key_padding_mask=jnp.asarray(add))
+        kpm = jnp.asarray(add < 0)
+        out_bool = flash_attention(q, k, v, key_padding_mask=kpm)
+        np.testing.assert_allclose(
+            np.asarray(out_add), np.asarray(out_bool), **TOL)
+
+    def test_fully_masked_sequence_zero_grads(self):
+        """Regression: a fully padded sequence (all keys masked) must get
+        exact-zero dk/dv and zero dq — the additive-mask bwd kernels must
+        honor the lse sentinel, not recompute p = exp(0) = 1."""
+        b, s, n, d = 2, 64, 2, 32
+        q, k, v = make_qkv(b, s, n, d, seed=17)
+        kpm = jnp.asarray(
+            np.stack([np.ones(s, bool), np.zeros(s, bool)]))  # row0 all pad
+        dq, dk, dv = jax.grad(lambda *a: jnp.sum(flash_attention(
+            *a, key_padding_mask=kpm)), argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_array_equal(np.asarray(dq)[0], 0.0)
+        np.testing.assert_array_equal(np.asarray(dk)[0], 0.0)
+        np.testing.assert_array_equal(np.asarray(dv)[0], 0.0)
+        # the unmasked sequence still gets real gradients
+        assert np.abs(np.asarray(dv)[1]).sum() > 0

@@ -1,0 +1,221 @@
+"""MHA module tests.
+
+Mirrors reference apex/contrib/test/multihead_attn/: the fused module vs
+a PyTorch-composed (here: jnp-composed) reference at dropout=0, plus
+norm-add residual behavior, additive masks, and dropout statistics.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.multihead_attn import (
+    SelfMultiheadAttn,
+    EncdecMultiheadAttn,
+    fast_mask_softmax_dropout_func,
+)
+
+E, H = 64, 4
+T, B = 32, 2
+
+
+def _composed_self_attn(params, x, key_padding_mask=None, causal=False):
+    """Plain jnp composition of the same math (the torch F.multi_head_
+    attention_forward analog used by the reference tests)."""
+    t, b, e = x.shape
+    h = H
+    d = e // h
+    w = params["in_proj_weight"]
+    wq, wk, wv = jnp.split(w, 3, axis=1)
+    q = (x @ wq).reshape(t, b, h, d)
+    k = (x @ wk).reshape(t, b, h, d)
+    v = (x @ wv).reshape(t, b, h, d)
+    s = jnp.einsum("qbhd,kbhd->bhqk", q, k) * (d ** -0.5)
+    if key_padding_mask is not None:
+        s = jnp.where(
+            key_padding_mask[:, None, None, :].astype(bool), -1e30, s)
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+        s = jnp.where((col > row)[None, None], -1e30, s)
+    p = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bhqk,kbhd->qbhd", p, v).reshape(t, b, e)
+    return ctx @ params["out_proj_weight"]
+
+
+class TestSelfMultiheadAttn:
+    def _mk(self, **kw):
+        mod = SelfMultiheadAttn(embed_dim=E, num_heads=H, **kw)
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(T, B, E), jnp.float32) * 0.3
+        params = mod.init(jax.random.PRNGKey(0), x, is_training=False)
+        return mod, params, x
+
+    def test_matches_composed_reference(self):
+        mod, params, x = self._mk()
+        out, weights = mod.apply(params, x, is_training=False)
+        assert weights is None
+        expect = _composed_self_attn(params["params"], x)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expect), atol=2e-5, rtol=2e-5)
+
+    def test_time_mask(self):
+        mod, params, x = self._mk()
+        out, _ = mod.apply(params, x, attn_mask=True, is_training=False)
+        expect = _composed_self_attn(params["params"], x, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expect), atol=2e-5, rtol=2e-5)
+
+    def test_key_padding_mask(self):
+        mod, params, x = self._mk()
+        kpm = jnp.asarray(
+            np.arange(T)[None, :] >= np.array([24, T])[:, None])
+        out, _ = mod.apply(
+            params, x, key_padding_mask=kpm, is_training=False)
+        expect = _composed_self_attn(params["params"], x,
+                                     key_padding_mask=kpm)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expect), atol=2e-5, rtol=2e-5)
+
+    def test_mask_additive(self):
+        mod, params, x = self._mk(mask_additive=True)
+        add = np.zeros((B, T), np.float32)
+        add[0, 24:] = -1e30
+        out, _ = mod.apply(
+            params, x, key_padding_mask=jnp.asarray(add),
+            is_training=False)
+        expect = _composed_self_attn(
+            params["params"], x, key_padding_mask=jnp.asarray(add < 0))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expect), atol=2e-5, rtol=2e-5)
+
+    def test_bias_and_separate_qkv(self):
+        mod = SelfMultiheadAttn(
+            embed_dim=E, num_heads=H, bias=True, separate_qkv_params=True)
+        x = jnp.asarray(
+            np.random.RandomState(1).randn(T, B, E), jnp.float32) * 0.3
+        params = mod.init(jax.random.PRNGKey(1), x, is_training=False)
+        p = params["params"]
+        assert set(p) >= {"q_weight", "k_weight", "v_weight",
+                          "q_bias", "k_bias", "v_bias",
+                          "out_proj_weight", "out_proj_bias"}
+        out, _ = mod.apply(params, x, is_training=False)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_norm_add_residual(self):
+        mod, params, x = self._mk(include_norm_add=True)
+        out, _ = mod.apply(params, x, is_training=False)
+        # out = x + attn(LN(x)): subtracting the residual must give the
+        # attention of the normalized input
+        ln = (x - x.mean(-1, keepdims=True)) / jnp.sqrt(
+            x.var(-1, keepdims=True) + 1e-5)
+        expect = x + _composed_self_attn(params["params"], ln)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expect), atol=1e-4, rtol=1e-4)
+
+    def test_dropout_training_stochastic_and_unbiased(self):
+        mod, params, x = self._mk(dropout=0.3)
+        dense, _ = mod.apply(params, x, is_training=False)
+        outs = []
+        for i in range(32):
+            out, _ = mod.apply(
+                params, x, is_training=True,
+                rngs={"dropout": jax.random.PRNGKey(i)})
+            outs.append(np.asarray(out))
+        assert not np.allclose(outs[0], outs[1])
+        mean = np.stack(outs).mean(0)
+        # E[dropout(P)] = P -> mean over seeds approaches the dense out
+        err = np.abs(mean - np.asarray(dense)).mean()
+        scale = np.abs(np.asarray(dense)).mean()
+        assert err < 0.15 * scale, (err, scale)
+
+    def test_dropout_grads_finite(self):
+        mod, params, x = self._mk(dropout=0.2)
+
+        def loss(p):
+            out, _ = mod.apply(
+                p, x, is_training=True,
+                rngs={"dropout": jax.random.PRNGKey(0)})
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(loss)(params)
+        for leaf in jax.tree_util.tree_leaves(g):
+            assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+class TestEncdecMultiheadAttn:
+    def _mk(self, **kw):
+        mod = EncdecMultiheadAttn(embed_dim=E, num_heads=H, **kw)
+        rs = np.random.RandomState(2)
+        q = jnp.asarray(rs.randn(T, B, E), jnp.float32) * 0.3
+        kv = jnp.asarray(rs.randn(T + 8, B, E), jnp.float32) * 0.3
+        params = mod.init(jax.random.PRNGKey(2), q, kv, is_training=False)
+        return mod, params, q, kv
+
+    def test_matches_composed_reference(self):
+        mod, params, q, kv = self._mk()
+        out, _ = mod.apply(params, q, kv, is_training=False)
+        p = params["params"]
+        d = E // H
+        tq, tk = q.shape[0], kv.shape[0]
+        qq = (q @ p["in_proj_weight_q"]).reshape(tq, B, H, d)
+        kvp = kv @ p["in_proj_weight_kv"]
+        kk, vv = jnp.split(kvp, 2, axis=-1)
+        kk = kk.reshape(tk, B, H, d)
+        vv = vv.reshape(tk, B, H, d)
+        s = jnp.einsum("qbhd,kbhd->bhqk", qq, kk) * (d ** -0.5)
+        probs = jax.nn.softmax(s, -1)
+        ctx = jnp.einsum("bhqk,kbhd->qbhd", probs, vv).reshape(tq, B, E)
+        expect = ctx @ p["out_proj_weight"]
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expect), atol=2e-5, rtol=2e-5)
+
+    def test_norm_add_and_dropout(self):
+        mod, params, q, kv = self._mk(include_norm_add=True, dropout=0.2)
+        out, _ = mod.apply(
+            params, q, kv, is_training=True,
+            rngs={"dropout": jax.random.PRNGKey(3)})
+        assert out.shape == q.shape
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_key_padding(self):
+        mod, params, q, kv = self._mk()
+        kpm = jnp.asarray(
+            np.arange(kv.shape[0])[None, :]
+            >= np.array([kv.shape[0] - 8, kv.shape[0]])[:, None])
+        out, _ = mod.apply(
+            params, q, kv, key_padding_mask=kpm, is_training=False)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+
+class TestMaskSoftmaxDropout:
+    def test_matches_softmax(self):
+        rs = np.random.RandomState(3)
+        s = jnp.asarray(rs.randn(B * H, T, T), jnp.float32)
+        out = fast_mask_softmax_dropout_func(False, H, s, None, False, 0.5)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(jax.nn.softmax(s, -1)),
+            atol=1e-6, rtol=1e-5)
+
+    def test_byte_and_additive_masks_agree(self):
+        rs = np.random.RandomState(4)
+        s = jnp.asarray(rs.randn(B * H, T, T), jnp.float32)
+        byte = np.zeros((B, T), np.uint8)
+        byte[0, 20:] = 1
+        add = np.where(byte, -1e30, 0.0).astype(np.float32)
+        a = fast_mask_softmax_dropout_func(
+            False, H, s, jnp.asarray(byte), False, 0.0)
+        b = fast_mask_softmax_dropout_func(
+            False, H, s, jnp.asarray(add), True, 0.0)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-5)
+
+    def test_dropout_statistics(self):
+        rs = np.random.RandomState(5)
+        s = jnp.asarray(rs.randn(B * H, T, T), jnp.float32)
+        out = fast_mask_softmax_dropout_func(
+            True, H, s, None, False, 0.4,
+            dropout_rng=jax.random.PRNGKey(0))
+        frac = (np.asarray(out) == 0).mean()
+        assert abs(frac - 0.4) < 0.03
