@@ -2,3 +2,7 @@ from apex_tpu.contrib.optimizers.distributed_fused_adam import (  # noqa: F401
     ZeroTrainState,
     make_distributed_adam_train_step,
 )
+from apex_tpu.contrib.optimizers.distributed_fused_lamb import (  # noqa: F401
+    ZeroLambState,
+    make_distributed_lamb_train_step,
+)
