@@ -123,7 +123,9 @@ def make_gpt_train_step(
 
 
 def pipeline_packet(tokens_mb: jax.Array, labels_mb: jax.Array,
-                    cfg: TransformerConfig) -> dict:
+                    cfg: TransformerConfig, *,
+                    attention_mask_mb: Optional[jax.Array] = None,
+                    dropout_seeds: Optional[jax.Array] = None) -> dict:
     """The activation packet ppermuted between stages.
 
     The schedules require one uniform pytree for injection and transfer
@@ -131,15 +133,28 @@ def pipeline_packet(tokens_mb: jax.Array, labels_mb: jax.Array,
     the hidden activation and the last stage banks its per-microbatch loss
     in the ``loss`` slot. [n_micro, mb, s] token arrays → packets of
     hidden [mb, s, h].
+
+    ``attention_mask_mb`` ([n_micro, mb, s] bool, True = masked key) rides
+    in the packet when the model needs padding masks
+    (cfg.attn_mask_type == 'padding' — BERT-style).  ``dropout_seeds``
+    ([n_micro] int32) seeds per-microbatch dropout; each stage folds its
+    own pp index in so no two (stage, microbatch) pairs share a stream —
+    the pipeline analog of the reference's per-region RNG tracker
+    (tensor_parallel/random.py CudaRNGStatesTracker).
     """
     mb, s = tokens_mb.shape[-2], tokens_mb.shape[-1]
-    return {
+    packet = {
         "hidden": jnp.zeros((*tokens_mb.shape[:-2], mb, s, cfg.hidden_size),
                             cfg.compute_dtype),
         "tokens": tokens_mb,
         "labels": labels_mb,
         "loss": jnp.zeros(tokens_mb.shape[:-2], jnp.float32),
     }
+    if attention_mask_mb is not None:
+        packet["attention_mask"] = attention_mask_mb
+    if dropout_seeds is not None:
+        packet["dropout_seed"] = dropout_seeds.astype(jnp.int32)
+    return packet
 
 
 def stack_pipeline_params(params: dict, cfg: TransformerConfig,
@@ -214,18 +229,13 @@ def make_gpt_pipeline_stage(cfg: TransformerConfig, n_stages: int,
     transform the hidden, the last stage applies the final norm + LM head
     and writes the per-microbatch loss into the packet. TP inside a stage
     uses the manual mapping collectives over ``tp_axis``.
+
+    Dropout keys and padding masks ride in the packet (see
+    :func:`pipeline_packet`); the LM head + CE run under ``lax.cond`` so
+    only the last stage pays their FLOPs — safe because all members of a
+    tp group share one pp index, so the vocab-parallel collectives inside
+    the branch cannot diverge across a tp group.
     """
-    if cfg.hidden_dropout > 0 or cfg.attention_dropout > 0:
-        raise NotImplementedError(
-            "dropout is not yet threaded through the shard_map pipeline "
-            "path; use the GSPMD train step (make_gpt_train_step) or set "
-            "hidden_dropout=attention_dropout=0"
-        )
-    if cfg.attn_mask_type == "padding":
-        raise NotImplementedError(
-            "padding attention masks are not yet carried in the pipeline "
-            "packet; the shard_map pipeline path supports causal models"
-        )
     ctx = manual_ctx(tp, tp_axis) if tp > 1 else single_device_ctx()
 
     def stage_fn(sp: dict, packet: dict) -> dict:
@@ -234,29 +244,72 @@ def make_gpt_pipeline_stage(cfg: TransformerConfig, n_stages: int,
         last = my == n_stages - 1
         cd = cfg.compute_dtype
         tokens, labels = packet["tokens"], packet["labels"]
+        mask = packet.get("attention_mask")
+        seed = packet.get("dropout_seed")
+        if cfg.attn_mask_type == "padding" and mask is None:
+            raise ValueError(
+                "attn_mask_type='padding' needs the key-padding mask in "
+                "the packet: pipeline_packet(..., attention_mask_mb=...)"
+            )
+        if (cfg.hidden_dropout > 0 or cfg.attention_dropout > 0) \
+                and seed is None:
+            raise ValueError(
+                "dropout is enabled but the packet carries no "
+                "dropout_seed: pipeline_packet(..., dropout_seeds=...) "
+                "(silently training without dropout would diverge from "
+                "the configured model)"
+            )
+        rng = None
+        if seed is not None and (
+                cfg.hidden_dropout > 0 or cfg.attention_dropout > 0):
+            # distinct stream per (stage, microbatch): the seed is
+            # per-microbatch, each stage folds in its pp index (attention
+            # additionally folds the tp index in — see _attention)
+            rng = jax.random.fold_in(jax.random.PRNGKey(seed), my)
 
-        embedded = embed_tokens(sp["embedding"], tokens, cfg, ctx)
-        h = jnp.where(first, embedded, packet["hidden"])
+        # first stage only (same lax.cond treatment as the head: under
+        # manual TP the vocab-parallel embed carries a psum, and all tp
+        # peers share one pp index, so branches cannot diverge).  Both
+        # branches pvary'd so their varying-axes types unify.
+        from apex_tpu.utils.collectives import pvary as _pvary
+
+        h = jax.lax.cond(
+            first,
+            lambda: _pvary(
+                embed_tokens(sp["embedding"], tokens, cfg, ctx
+                             ).astype(packet["hidden"].dtype), pp_axis),
+            lambda: _pvary(packet["hidden"], pp_axis))
 
         # this stage's layer chunk: local leading pp dim of size 1
         layers = jax.tree_util.tree_map(lambda v: v[0], sp["layers"])
         h = transformer_backbone({"layers": layers}, h, cfg, ctx,
+                                 attention_mask=mask, dropout_rng=rng,
                                  apply_final_norm=False)
 
-        h_final = apply_norm(cfg, h, sp["final_ln"]["scale"],
-                             sp["final_ln"]["bias"])
-        # NOTE: SPMD uniformity — every stage runs the head einsum + CE and
-        # discards it except the last (jnp.where below). On the shard_map
-        # pipeline path this wastes ~(v/12h) of a stage's FLOPs per tick;
-        # the GSPMD path (make_gpt_train_step) is the performance path.
-        logits = lm_head_logits(sp, h_final, cfg)
-        loss = lm_cross_entropy(logits, labels, ctx)
+        def head_and_ce(h_in):
+            h_final = apply_norm(cfg, h_in, sp["final_ln"]["scale"],
+                                 sp["final_ln"]["bias"])
+            logits = lm_head_logits(sp, h_final, cfg)
+            return lm_cross_entropy(logits, labels, ctx)
 
-        return {
+        # last stage only: the v/12h-per-stage FLOP tax of running the
+        # head everywhere (round-1 design) is gone.  The false branch's
+        # zero must carry the same varying-axes type as the head output
+        # (pp-varying), hence the pvary.
+        loss = jax.lax.cond(
+            last, head_and_ce,
+            lambda _h: _pvary(jnp.float32(0.0), pp_axis), h)
+
+        out = {
             "hidden": h.astype(cd),
             "tokens": tokens,
             "labels": labels,
-            "loss": jnp.where(last, loss, 0.0),
+            "loss": loss,
         }
+        if mask is not None:
+            out["attention_mask"] = mask
+        if seed is not None:
+            out["dropout_seed"] = seed
+        return out
 
     return stage_fn

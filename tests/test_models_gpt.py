@@ -265,3 +265,95 @@ class TestPipeline:
                 g, r = g[k], r[k]
             np.testing.assert_allclose(
                 np.asarray(g), np.asarray(r), atol=3e-4, err_msg=str(path))
+
+
+class TestPipelineMasksAndDropout:
+    """VERDICT r1 item 7: padding masks + dropout through the pipeline
+    packet (BERT-style models under PP)."""
+
+    def test_padding_mask_matches_sequential(self):
+        pp, n_micro, mb = 2, 2, 2
+        cfg = tiny_cfg(num_layers=4, remat=False,
+                       attn_mask_type="padding")
+        params = init_gpt_params(jax.random.PRNGKey(7), cfg)
+        tokens, labels = data(cfg, b=n_micro * mb)
+        s = tokens.shape[-1]
+        # mask out a tail of keys per sequence
+        lens = np.array([10, 16, 12, 16])
+        kpm = jnp.asarray(np.arange(s)[None, :] >= lens[:, None])
+
+        ref_loss, ref_grads = jax.value_and_grad(gpt_loss)(
+            params, tokens, labels, cfg, attention_mask=kpm)
+
+        stacked = stack_pipeline_params(params, cfg, pp)
+        packets = pipeline_packet(
+            tokens.reshape(n_micro, mb, -1),
+            labels.reshape(n_micro, mb, -1), cfg,
+            attention_mask_mb=kpm.reshape(n_micro, mb, -1))
+
+        mesh = create_mesh(pp=pp, tp=1)
+        stage_fn = make_gpt_pipeline_stage(cfg, pp, 1)
+        pspecs = gpt_param_specs(cfg, pp_axis="pp")
+        pspecs = jax.tree_util.tree_map(
+            lambda sp: P(*(a if a != "tp" else None for a in sp)),
+            pspecs, is_leaf=lambda x: isinstance(x, P))
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(pspecs, P()), out_specs=(P(), pspecs))
+        def run(p, mbs):
+            return gpt_pipeline_loss_and_grads(
+                stage_fn, p, mbs, n_micro=n_micro)
+
+        loss, grads = run(stacked, packets)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        ref_stacked = stack_pipeline_params(ref_grads, cfg, pp)
+        for path in [("embedding", "word"), ("layers", "qkv_kernel")]:
+            g, r = grads, ref_stacked
+            for k in path:
+                g, r = g[k], r[k]
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), atol=3e-4,
+                err_msg=str(path))
+
+    def test_dropout_runs_and_is_seed_deterministic(self):
+        pp, n_micro, mb = 2, 2, 2
+        cfg = tiny_cfg(num_layers=4, remat=False,
+                       hidden_dropout=0.1, attention_dropout=0.1)
+        params = init_gpt_params(jax.random.PRNGKey(8), cfg)
+        tokens, labels = data(cfg, b=n_micro * mb)
+        stacked = stack_pipeline_params(params, cfg, pp)
+        seeds = jnp.arange(n_micro, dtype=jnp.int32) + 7
+        packets = pipeline_packet(
+            tokens.reshape(n_micro, mb, -1),
+            labels.reshape(n_micro, mb, -1), cfg, dropout_seeds=seeds)
+
+        mesh = create_mesh(pp=pp, tp=1)
+        stage_fn = make_gpt_pipeline_stage(cfg, pp, 1)
+        pspecs = gpt_param_specs(cfg, pp_axis="pp")
+        pspecs = jax.tree_util.tree_map(
+            lambda sp: P(*(a if a != "tp" else None for a in sp)),
+            pspecs, is_leaf=lambda x: isinstance(x, P))
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(pspecs, P()), out_specs=(P(), pspecs))
+        def run(p, mbs):
+            return gpt_pipeline_loss_and_grads(
+                stage_fn, p, mbs, n_micro=n_micro)
+
+        loss1, grads1 = run(stacked, packets)
+        loss2, _ = run(stacked, packets)
+        # same seeds -> identical stochastic loss; grads finite
+        np.testing.assert_allclose(float(loss1), float(loss2))
+        # different seeds -> different dropout mask
+        packets2 = pipeline_packet(
+            tokens.reshape(n_micro, mb, -1),
+            labels.reshape(n_micro, mb, -1), cfg,
+            dropout_seeds=seeds + 100)
+        loss3, _ = run(stacked, packets2)
+        assert float(loss3) != float(loss1)
+        for leaf in jax.tree_util.tree_leaves(grads1):
+            assert np.all(np.isfinite(np.asarray(leaf)))
