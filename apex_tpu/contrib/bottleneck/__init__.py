@@ -1,0 +1,8 @@
+from .bottleneck import (  # noqa: F401
+    Bottleneck,
+    SpatialBottleneck,
+    bottleneck_forward,
+    frozen_bn_scale_bias,
+    init_bottleneck_params,
+    spatial_bottleneck_forward,
+)

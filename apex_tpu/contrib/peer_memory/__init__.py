@@ -1,0 +1,15 @@
+"""Halo exchange for spatial parallelism.
+
+TPU rebuild of ``apex.contrib.peer_memory`` (reference:
+peer_memory.py:5 ``PeerMemoryPool``, peer_halo_exchanger_1d.py:5
+``PeerHaloExchanger1d``, csrc peer_memory.cpp:20-28).  The reference
+moves halo rows through CUDA-IPC peer mappings with SM-driven push/pull
+kernels and spin-lock signal flags; on TPU the same neighbor exchange is
+one pair of ``ppermute`` collectives over the spatial mesh axis — XLA
+owns the buffers (no allocator/IPC analog needed, SURVEY.md §2.3 row
+nccl_allocator) and the latency-hiding scheduler overlaps the transfer
+with the convolution the way the reference overlaps with numSM-limited
+copy kernels.
+"""
+
+from .halo_exchange import HaloExchanger1d, halo_exchange_1d  # noqa: F401

@@ -1,0 +1,73 @@
+"""1-D halo exchange over a spatial mesh axis.
+
+Reference semantics (peer_halo_exchanger_1d.py:20-67 + csrc
+push_pull_halos_1d): each rank holds a spatial shard with ``half_halo``
+rows of padding on each side; the rows just inside the low edge go to
+the low neighbor's high input halo and vice versa; ranks at the global
+boundary receive zeros (``low_zero``/``high_zero``).
+
+``jax.lax.ppermute`` gives exactly this: destinations not named in the
+permutation receive zeros, so the non-circular boundary behavior falls
+out of sending over the open chain [(1,0),(2,1),...] / [(0,1),(1,2),...].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["halo_exchange_1d", "HaloExchanger1d"]
+
+
+def halo_exchange_1d(
+    x: jax.Array,
+    half_halo: int,
+    axis_name: str,
+    *,
+    dim: int = 1,
+) -> jax.Array:
+    """Pad ``x`` (this rank's spatial shard, NO halo) with ``half_halo``
+    rows of neighbor data on each side of ``dim``.
+
+    Call inside ``shard_map`` with the spatial dim sharded over
+    ``axis_name``.  Returns shape grown by ``2*half_halo`` along ``dim``;
+    the first/last rank's outer halo is zeros (matching the reference's
+    low_zero/high_zero edge handling).
+    """
+    if half_halo <= 0:
+        return x
+    n = jax.lax.axis_size(axis_name)
+    # slices of my edges
+    lo_edge = jax.lax.slice_in_dim(x, 0, half_halo, axis=dim)
+    hi_edge = jax.lax.slice_in_dim(
+        x, x.shape[dim] - half_halo, x.shape[dim], axis=dim)
+    # my high edge becomes my high-neighbor's low halo (send i -> i+1);
+    # ranks with no source (rank 0's low halo) get zeros from ppermute
+    recv_lo = jax.lax.ppermute(
+        hi_edge, axis_name, [(i, i + 1) for i in range(n - 1)])
+    recv_hi = jax.lax.ppermute(
+        lo_edge, axis_name, [(i + 1, i) for i in range(n - 1)])
+    return jnp.concatenate([recv_lo, x, recv_hi], axis=dim)
+
+
+class HaloExchanger1d:
+    """API shim matching the reference ``PeerHaloExchanger1d`` call shape.
+
+    The reference's ctor takes (ranks, rank_in_group, peer_pool,
+    half_halo); here the mesh axis name replaces the rank group and there
+    is no pool to allocate from.  ``__call__(y, H_split=True)`` takes a
+    shard WITH halo regions already allocated (the reference writes into
+    ``y`` in place) and returns a new array with the halos filled.
+    """
+
+    def __init__(self, axis_name: str, half_halo: int):
+        self.axis_name = axis_name
+        self.half_halo = half_halo
+
+    def __call__(self, y: jax.Array, H_split: bool = True) -> jax.Array:
+        hh = self.half_halo
+        dim = 1 if H_split else 2  # NHWC
+        interior = jax.lax.slice_in_dim(
+            y, hh, y.shape[dim] - hh, axis=dim)
+        return halo_exchange_1d(
+            interior, hh, self.axis_name, dim=dim)
