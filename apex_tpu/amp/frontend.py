@@ -85,6 +85,8 @@ def make_train_step(
     axis_name: Optional[str] = None,
     has_aux: bool = False,
     grad_postprocess: Optional[Callable[[Any], Any]] = None,
+    accum_steps: int = 1,
+    main_grad_dtype=jnp.float32,
 ) -> Tuple[Callable, Callable]:
     """Build ``(init_fn, step_fn)`` implementing the full AMP training step.
 
@@ -100,6 +102,17 @@ def make_train_step(
         GradScaler's found-inf allreduce (apex/transformer/amp/grad_scaler.py:21).
       grad_postprocess: optional hook applied to unscaled fp32 grads
         (e.g. clipping).
+      accum_steps: gradient accumulation with **fp32 main-grad** semantics
+        (reference ``fused_weight_gradient_dense.cpp:19-20``
+        ``wgrad_gemm_accum_fp32`` + the ``main_grad`` path in
+        ``apex/transformer/tensor_parallel/layers.py:272``): the batch's
+        leading dim is split into ``accum_steps`` microbatches scanned
+        sequentially, each microbatch's (bf16-computed) grads are
+        accumulated into a persistent ``main_grad_dtype`` buffer, and one
+        optimizer step runs on the accumulated total.  This keeps bf16
+        training's accumulated wgrad at fp32 fidelity instead of summing
+        rounded bf16 grads.
+      main_grad_dtype: dtype of the accumulation buffer (fp32 default).
 
     The returned ``step_fn(state, *batch) -> (state, metrics)`` is pure and
     jittable; metrics carry ``loss``, ``overflow``, ``loss_scale``.
@@ -140,7 +153,7 @@ def make_train_step(
     def step_fn(state: TrainState, *batch):
         ls_state = state.loss_scale_state
 
-        def scaled_loss_fn(master_params):
+        def scaled_loss_fn(master_params, *mb):
             # Forward runs on compute-dtype params derived from the masters
             # (reference O2: model holds fp16 copies of fp32 masters).
             compute_params = policy.cast_params(master_params)
@@ -148,13 +161,45 @@ def make_train_step(
                 compute_params = policy.cast_to_compute(
                     compute_params, respect_norms=True
                 )
-            out = loss_fn(compute_params, *batch)
+            out = loss_fn(compute_params, *mb)
             loss, aux = (out if has_aux else (out, None))
             return scaler_lib.scale_loss(loss, ls_state), (loss, aux)
 
-        grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(
-            state.master_params
-        )
+        if accum_steps > 1:
+            # fp32 main-grad accumulation across microbatches (see
+            # docstring).  The scan carries the main_grad buffer; each
+            # microbatch's scaled grads are cast up before the add.
+            micro = jax.tree_util.tree_map(
+                lambda v: v.reshape(
+                    (accum_steps, v.shape[0] // accum_steps)
+                    + v.shape[1:]),
+                tuple(batch))
+
+            def one_micro(main_grad, mb):
+                g, (l, aux_mb) = jax.grad(
+                    scaled_loss_fn, has_aux=True)(
+                        state.master_params, *mb)
+                main_grad = jax.tree_util.tree_map(
+                    lambda a, gg: a + gg.astype(a.dtype), main_grad, g)
+                return main_grad, (l, aux_mb)
+
+            main_grad0 = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, main_grad_dtype)
+                if hasattr(p, "dtype")
+                and jnp.issubdtype(p.dtype, jnp.floating) else p,
+                state.master_params)
+            grads, (losses, aux) = jax.lax.scan(
+                one_micro, main_grad0, micro)
+            loss = jnp.mean(losses)
+            if aux is not None:
+                aux = jax.tree_util.tree_map(lambda v: v[-1], aux)
+            grads = jax.tree_util.tree_map(
+                lambda g: g / accum_steps if hasattr(g, "dtype")
+                and jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
+        else:
+            grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(
+                state.master_params, *batch
+            )
         grads, finite = scaler_lib.unscale_grads(grads, ls_state)
 
         if axis_name is not None:
