@@ -1,0 +1,132 @@
+"""L1 convergence-trace tests.
+
+Rebuild of the reference's L1 strategy (tests/L1/common/run_test.sh:19-40
++ compare.py): a deterministic short training run is traced (loss +
+global grad norm per step); the fp32 O0 trace is pinned against a stored
+golden file (catches any numerical regression, 1-step resolution), and
+the mixed-precision levels must track the O0 trace within per-level
+tolerances (the reference compares O1/O2/O3 runs against a stored O0
+baseline of ResNet-50; here the workload is the tiny in-repo GPT).
+
+Regenerate the golden file after an *intentional* numerics change:
+    python tests/test_l1_traces.py --regen
+"""
+
+import json
+import os
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.amp.frontend import make_train_step
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.transformer_lm import gpt_loss, init_gpt_params
+from apex_tpu.optimizers import fused_adam
+from apex_tpu.optimizers._common import GradientTransformation, global_norm
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data", "l1_trace_o0.json")
+N_STEPS = 12
+
+
+class _NormState(NamedTuple):
+    inner: Any
+    grad_norm: jax.Array
+
+
+def _norm_tracking(tx: GradientTransformation) -> GradientTransformation:
+    """Record the global grad norm in the optimizer state (the L1 trace's
+    second channel, reference compare.py)."""
+
+    def init(params):
+        return _NormState(tx.init(params), jnp.zeros((), jnp.float32))
+
+    def update(grads, state, params=None):
+        updates, inner = tx.update(grads, state.inner, params)
+        return updates, _NormState(inner, global_norm(grads))
+
+    return GradientTransformation(init, update)
+
+
+def _cfg():
+    return TransformerConfig(
+        num_layers=2, hidden_size=64, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=32,
+        compute_dtype=jnp.float32, remat=False)
+
+
+def _data(cfg, b=8, s=16):
+    rng = np.random.RandomState(1234)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+    return tokens, labels
+
+
+def run_trace(opt_level: str, n_steps: int = N_STEPS):
+    """Deterministic training trace: (losses, grad_norms) per step."""
+    cfg = _cfg()
+    params = init_gpt_params(jax.random.PRNGKey(42), cfg)
+    tokens, labels = _data(cfg)
+
+    def loss_fn(p, t, l):
+        return gpt_loss(p, t, l, cfg)
+
+    tx = _norm_tracking(fused_adam(lr=1e-3))
+    init_fn, step_fn = make_train_step(loss_fn, tx, opt_level)
+    step_fn = jax.jit(step_fn)
+    state = init_fn(params)
+    losses, norms = [], []
+    for _ in range(n_steps):
+        state, metrics = step_fn(state, tokens, labels)
+        losses.append(float(metrics["loss"]))
+        norms.append(float(state.opt_state.grad_norm))
+    return np.array(losses), np.array(norms)
+
+
+class TestL1Traces:
+    def test_o0_matches_stored_golden(self):
+        """1-step-resolution regression pin for fp32 numerics."""
+        assert os.path.exists(GOLDEN), (
+            "golden trace missing; run `python tests/test_l1_traces.py "
+            "--regen` and commit tests/data/l1_trace_o0.json")
+        with open(GOLDEN) as f:
+            gold = json.load(f)
+        losses, norms = run_trace("O0")
+        np.testing.assert_allclose(
+            losses, np.array(gold["loss"]), rtol=2e-5, atol=1e-6,
+            err_msg="O0 loss trace drifted from the stored baseline")
+        np.testing.assert_allclose(
+            norms, np.array(gold["grad_norm"]), rtol=2e-4, atol=1e-5,
+            err_msg="O0 grad-norm trace drifted from the stored baseline")
+
+    @pytest.mark.parametrize("level,loss_tol,norm_tol", [
+        ("O1", 2e-2, 0.15),
+        ("O2", 2e-2, 0.15),
+        ("O5", 2e-2, 0.15),
+    ])
+    def test_amp_levels_track_o0(self, level, loss_tol, norm_tol):
+        """Mixed precision must converge along the fp32 trajectory
+        (reference run_test.sh opt-level cross-product vs O0 baseline)."""
+        ref_losses, ref_norms = run_trace("O0")
+        losses, norms = run_trace(level)
+        np.testing.assert_allclose(
+            losses, ref_losses, rtol=loss_tol,
+            err_msg=f"{level} loss trace diverged from O0")
+        np.testing.assert_allclose(
+            norms, ref_norms, rtol=norm_tol,
+            err_msg=f"{level} grad-norm trace diverged from O0")
+        # and training must actually make progress
+        assert losses[-1] < losses[0]
+
+
+if __name__ == "__main__":
+    import sys
+    if "--regen" in sys.argv:
+        losses, norms = run_trace("O0")
+        os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+        with open(GOLDEN, "w") as f:
+            json.dump({"loss": losses.tolist(),
+                       "grad_norm": norms.tolist()}, f, indent=1)
+        print(f"wrote {GOLDEN}")
