@@ -374,7 +374,8 @@ def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
         # hidden-dropout keys stay shared.
         dropout_rng = jax.random.fold_in(
             dropout_rng, jax.lax.axis_index(ctx.tp_axis))
-    ctxv = _core_attention(cfg, q, k, v, attention_mask, dropout_rng)
+    with jax.named_scope("core_attention"):
+        ctxv = _core_attention(cfg, q, k, v, attention_mask, dropout_rng)
     ctxv = ctxv.reshape(b, s, -1)
     out = ctxv @ lp["proj_kernel"].astype(x.dtype)
     out = ctx.reduce_out(out)
@@ -405,13 +406,22 @@ def _mlp(cfg: TransformerConfig, lp: dict, x, ctx: TPContext):
 def _layer(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
            attention_mask, rope, rngs):
     """Pre-LN transformer block (reference ParallelTransformerLayer :598:
-    LN → attn → residual → LN → MLP → residual, bias_dropout_add fused)."""
+    LN → attn → residual → LN → MLP → residual, bias_dropout_add fused).
+
+    ``jax.named_scope`` blocks are the NVTX-range analog (reference DDP
+    ``prof`` flag, distributed.py:193; SURVEY.md §5) — they label the
+    profiler trace in xprof/TensorBoard without touching the compute.
+    """
     r1, r2, r3 = rngs if rngs is not None else (None, None, None)
-    h = apply_norm(cfg, x, lp["ln1_scale"], lp["ln1_bias"])
-    a = _attention(cfg, lp, h, ctx, attention_mask, rope, r1)
+    with jax.named_scope("ln1"):
+        h = apply_norm(cfg, x, lp["ln1_scale"], lp["ln1_bias"])
+    with jax.named_scope("attention"):
+        a = _attention(cfg, lp, h, ctx, attention_mask, rope, r1)
     x = x + _dropout(a, cfg.hidden_dropout, r2)
-    h = apply_norm(cfg, x, lp["ln2_scale"], lp["ln2_bias"])
-    m = _mlp(cfg, lp, h, ctx)
+    with jax.named_scope("ln2"):
+        h = apply_norm(cfg, x, lp["ln2_scale"], lp["ln2_bias"])
+    with jax.named_scope("mlp"):
+        m = _mlp(cfg, lp, h, ctx)
     x = x + _dropout(m, cfg.hidden_dropout, r3)
     return ctx.constrain_hidden(x)
 

@@ -1,15 +1,30 @@
-"""examples/imagenet analog: ResNet-50, AMP O2 + DP + SyncBN.
+"""examples/imagenet analog: ResNet-50, AMP O2 + DP + SyncBN — full
+resumable trainer.
 
 Reference: examples/imagenet/main_amp.py (torchvision resnet50, O0-O3
-opt levels, DDP, optional SyncBN) — the L1 baseline workload and
-BASELINE.json's headline metric. This runs the same config TPU-native on
-synthetic data and reports imgs/sec; swap ``synthetic_batches`` for a real
+opt levels, DDP, optional SyncBN, data prefetcher, prec@1/prec@5,
+checkpoint save/resume).  Feature parity on TPU:
+
+- AMP opt levels via ``make_resnet_train_step`` (O0-O5; O2 default)
+- data-parallel mesh when >1 device (SyncBN stats ride GSPMD pmean)
+- background-thread prefetcher (the ``data_prefetcher`` analog,
+  main_amp.py:256 — host→device copy overlaps the device step)
+- prec@1 / prec@5 on the last batch (main_amp.py ``accuracy`` :439)
+- step-decay LR schedule with warmup (``adjust_learning_rate`` :421)
+- checkpoint save/restore + ADLR AutoResume requeue
+  (utils/checkpoint.py; resume picks up at the saved step)
+
+Runs on synthetic data by default; swap ``synthetic_batches`` for a real
 input pipeline to train ImageNet.
 
-Run: python examples/imagenet_rn50.py [--batch 128] [--opt-level O2]
+Run:     python examples/imagenet_rn50.py [--batch 128] [--opt-level O2]
+Resume:  python examples/imagenet_rn50.py --ckpt-dir /tmp/rn50ckpt
+         (a second run with the same dir continues from the last save)
 """
 
 import argparse
+import queue
+import threading
 import time
 
 import jax
@@ -19,42 +34,127 @@ import numpy as np
 from apex_tpu.models import make_resnet_train_step, resnet50
 from apex_tpu.optimizers import fused_sgd
 from apex_tpu.parallel.mesh import create_mesh
+from apex_tpu.utils.checkpoint import (
+    AutoResume,
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
 
 
 def synthetic_batches(batch, hw=224, classes=1000, seed=0):
     rng = np.random.RandomState(seed)
-    x = jnp.asarray(rng.randn(batch, hw, hw, 3), jnp.float32)
-    y = jnp.asarray(rng.randint(0, classes, (batch,)), jnp.int32)
     while True:
+        x = rng.randn(batch, hw, hw, 3).astype(np.float32)
+        y = rng.randint(0, classes, (batch,)).astype(np.int32)
         yield x, y
+
+
+def prefetcher(it, depth=2):
+    """Background-thread prefetch: the host prepares + transfers the next
+    batch while the device runs the current step (reference
+    data_prefetcher, examples/imagenet/main_amp.py:256)."""
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+
+    def worker():
+        for item in it:
+            q.put(jax.device_put(item))
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        yield q.get()
+
+
+def accuracy(logits, labels, topk=(1, 5)):
+    """prec@k (reference accuracy(), main_amp.py:439)."""
+    order = np.argsort(-np.asarray(logits, np.float32), axis=-1)
+    labels = np.asarray(labels)
+    out = []
+    for k in topk:
+        hit = (order[:, :k] == labels[:, None]).any(axis=1)
+        out.append(100.0 * hit.mean())
+    return out
+
+
+def lr_schedule(base_lr, step, steps_per_epoch):
+    """Step decay /10 at epochs 30/60/80 with 5-epoch warmup
+    (adjust_learning_rate, main_amp.py:421)."""
+    import jax.numpy as jnp
+
+    epoch = step / steps_per_epoch
+    factor = ((epoch >= 30).astype(jnp.float32)
+              + (epoch >= 60) + (epoch >= 80))
+    lr = base_lr * (0.1 ** factor)
+    warm = base_lr * (1.0 + step) / (5.0 * steps_per_epoch)
+    return jnp.where(epoch < 5, warm, lr)
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=128)
-    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--opt-level", default="O2")
     ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="enable save/resume in this directory")
+    ap.add_argument("--ckpt-every", type=int, default=25)
+    ap.add_argument("--steps-per-epoch", type=int, default=5000)
     args = ap.parse_args()
 
     mesh = create_mesh() if len(jax.devices()) > 1 else None
     model = resnet50(num_classes=1000)
+    schedule = lambda step: lr_schedule(  # noqa: E731
+        args.lr, step, args.steps_per_epoch)
     init, step = make_resnet_train_step(
-        model, fused_sgd(lr=args.lr, momentum=0.9, weight_decay=1e-4),
+        model, fused_sgd(lr=schedule, momentum=0.9, weight_decay=1e-4),
         args.opt_level, mesh)
     state, stats = init(jax.random.PRNGKey(0))
 
-    batches = synthetic_batches(args.batch)
+    start = 0
+    if args.ckpt_dir:
+        last = latest_step(args.ckpt_dir)
+        if last is not None:
+            state, stats = restore_checkpoint(
+                args.ckpt_dir, (state, stats))
+            start = last
+            print(f"resumed from step {start}")
+
+    auto = AutoResume()
+    auto.init()
+
+    batches = prefetcher(synthetic_batches(args.batch))
     x, y = next(batches)
     state, stats, m = step(state, stats, x, y)      # compile
     float(m["loss"])
+
     t0 = time.perf_counter()
-    for i in range(args.steps):
+    done = 0
+    for i in range(start, args.steps):
         x, y = next(batches)
         state, stats, m = step(state, stats, x, y)
+        done += 1
+        if args.ckpt_dir and (i + 1) % args.ckpt_every == 0:
+            float(m["loss"])                         # drain the device
+            save_checkpoint(args.ckpt_dir, i + 1, (state, stats))
+        if auto.termination_requested():
+            # cluster wants the slot back: checkpoint + requeue
+            float(m["loss"])
+            if args.ckpt_dir:
+                save_checkpoint(args.ckpt_dir, i + 1, (state, stats))
+            auto.request_resume()
+            print(f"AutoResume: checkpointed at step {i + 1}, requeued")
+            return
     loss = float(m["loss"])                          # device sync
-    dt = (time.perf_counter() - t0) / args.steps
-    print(f"loss {loss:.4f}  {args.batch / dt:.1f} imgs/sec "
+    dt = (time.perf_counter() - t0) / max(done, 1)
+
+    # eval-style metrics on the last batch (prec@k)
+    logits = model.apply(
+        {"params": state.params, "batch_stats": stats},
+        jnp.asarray(x), train=False)
+    p1, p5 = accuracy(logits, y)
+    print(f"loss {loss:.4f}  prec@1 {p1:.2f}  prec@5 {p5:.2f}  "
+          f"{args.batch / dt:.1f} imgs/sec "
           f"({len(jax.devices())} device(s), {args.opt_level})")
 
 
