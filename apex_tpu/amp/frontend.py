@@ -158,10 +158,20 @@ def make_train_step(
             # (reference O2: model holds fp16 copies of fp32 masters).
             compute_params = policy.cast_params(master_params)
             if policy.per_op_casts:
+                # O1/O4 "patch the world": params pre-cast at the step
+                # boundary AND jax entry points patched per the cast
+                # lists while the user function traces (amp/patch.py —
+                # the wrap.py:31-116 analog).
+                from apex_tpu.amp.patch import amp_patch_scope
+                from apex_tpu.amp.policy import _effective
+
                 compute_params = policy.cast_to_compute(
                     compute_params, respect_norms=True
                 )
-            out = loss_fn(compute_params, *mb)
+                with amp_patch_scope(_effective(policy.compute_dtype)):
+                    out = loss_fn(compute_params, *mb)
+            else:
+                out = loss_fn(compute_params, *mb)
             loss, aux = (out if has_aux else (out, None))
             return scaler_lib.scale_loss(loss, ls_state), (loss, aux)
 

@@ -1,0 +1,126 @@
+"""O1-style per-op cast patching — the "patch the world" engine.
+
+The reference's O1/O4 opt levels monkey-patch torch functions at
+``amp.initialize`` time according to the cast lists
+(apex/amp/amp.py:75 ``init``, wrap.py:31-116, lists/*).  Under jit the
+same mechanism works *at trace time*: while the AMP train step traces
+the user's loss function, :func:`amp_patch_scope` temporarily replaces
+the matmul-class entry points in ``jax.numpy`` / ``jax.lax`` /
+``jax.nn`` with wrappers that cast inputs to the compute dtype, and the
+reduction-class entry points with wrappers that cast low-precision
+inputs up to fp32 (lists.FP16_FUNCS / lists.FP32_FUNCS).  The patch is
+active only inside the ``with`` block — i.e. only while tracing — and
+is exception-safe.
+
+Known deviations (documented; reference wrap.py has the same hole for
+``from torch import mm`` style imports):
+
+- functions grabbed *before* the patch (``from jax.numpy import
+  matmul``) bypass it; call through the module (``jnp.matmul``) or use
+  the explicit decorators in :mod:`apex_tpu.amp.lists`.
+- nested ``@jax.jit`` functions interact with the jit cache: a helper
+  first traced *inside* the scope caches an executable with the casts
+  baked in (later non-AMP calls at the same shapes reuse it), and a
+  helper traced *before* the scope skips the casts when reused inside
+  it.  Keep O1 user code un-jitted at the top level (the AMP step jits
+  the whole thing) or decorate precision-sensitive helpers explicitly
+  with :func:`apex_tpu.amp.lists.float_function`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["amp_patch_scope", "PATCHED_COMPUTE", "PATCHED_FP32"]
+
+
+def _is_array(x) -> bool:
+    """True only for actual array values — never dtype classes or other
+    kwargs like ``preferred_element_type=jnp.float32``."""
+    import numpy as np
+
+    return isinstance(x, (jax.Array, np.ndarray)) or (
+        hasattr(x, "aval") and hasattr(x, "astype"))
+
+
+def _is_low_float(x) -> bool:
+    return _is_array(x) and x.dtype in (jnp.float16, jnp.bfloat16)
+
+
+def _is_f32(x) -> bool:
+    return _is_array(x) and x.dtype == jnp.float32
+
+
+def _cast_tree(args, kwargs, pred, dtype):
+    def cast(x):
+        return x.astype(dtype) if pred(x) else x
+
+    return (jax.tree_util.tree_map(cast, args),
+            jax.tree_util.tree_map(cast, kwargs))
+
+
+# (module, attribute) pairs — resolved lazily so reloads stay safe.
+# ``jax.lax`` primitives are deliberately NOT patched: this package's own
+# fused kernels (flash attention, Pallas ops) call them with explicit
+# precision management (fp32 accumulators via preferred_element_type),
+# the same reason the reference never patches its own CUDA kernels —
+# only the user-level entry points.
+PATCHED_COMPUTE = [
+    (jnp, "matmul"), (jnp, "dot"), (jnp, "einsum"), (jnp, "tensordot"),
+    (jnp, "vdot"), (jnp, "inner"), (jnp, "outer"),
+]
+
+PATCHED_FP32 = [
+    (jax.nn, "softmax"), (jax.nn, "log_softmax"), (jax.nn, "gelu"),
+    (jax.nn, "sigmoid"), (jax.nn, "softplus"), (jax.nn, "logsumexp"),
+    (jnp, "exp"), (jnp, "expm1"), (jnp, "log"), (jnp, "log1p"),
+    (jnp, "logaddexp"), (jnp, "cumsum"), (jnp, "cumprod"),
+]
+
+
+def _wrap_compute(fn, compute_dtype):
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        args, kwargs = _cast_tree(args, kwargs, _is_f32, compute_dtype)
+        return fn(*args, **kwargs)
+
+    wrapped.__amp_patched__ = True
+    return wrapped
+
+
+def _wrap_fp32(fn):
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        args, kwargs = _cast_tree(args, kwargs, _is_low_float, jnp.float32)
+        return fn(*args, **kwargs)
+
+    wrapped.__amp_patched__ = True
+    return wrapped
+
+
+@contextlib.contextmanager
+def amp_patch_scope(compute_dtype=jnp.bfloat16):
+    """Patch jax entry points per the O1 cast lists for the duration of
+    the block (trace-time; see module docstring)."""
+    saved = []
+    try:
+        for mod, name in PATCHED_COMPUTE:
+            orig = getattr(mod, name)
+            if getattr(orig, "__amp_patched__", False):
+                continue  # re-entrant use
+            saved.append((mod, name, orig))
+            setattr(mod, name, _wrap_compute(orig, compute_dtype))
+        for mod, name in PATCHED_FP32:
+            orig = getattr(mod, name)
+            if getattr(orig, "__amp_patched__", False):
+                continue
+            saved.append((mod, name, orig))
+            setattr(mod, name, _wrap_fp32(orig))
+        yield
+    finally:
+        for mod, name, orig in saved:
+            setattr(mod, name, orig)

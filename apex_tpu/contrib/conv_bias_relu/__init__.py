@@ -1,0 +1,6 @@
+from .conv_bias_relu import (  # noqa: F401
+    ConvBias,
+    ConvBiasMaskReLU,
+    ConvBiasReLU,
+    ConvFrozenScaleBiasReLU,
+)

@@ -1,0 +1,179 @@
+"""Megatron-style batch samplers for DP-sharded pretraining input.
+
+Reference: ``apex/transformer/_data/_batchsampler.py``
+(``MegatronPretrainingSampler``, ``MegatronPretrainingRandomSampler`` —
+themselves extracted from Megatron-LM's data_samplers).  Semantics
+preserved torch-free:
+
+- a *local minibatch* is ``global_batch_size / data_parallel_size``
+  indices for THIS dp rank;
+- ``consumed_samples`` makes sampling resumable mid-epoch (the
+  checkpoint carries it);
+- the random sampler shards the dataset into per-rank buckets and
+  reshuffles per epoch with a deterministic seed (epoch number), so
+  every rank draws a disjoint, epoch-stable permutation — numpy
+  ``default_rng(epoch)`` replaces ``torch.Generator.manual_seed``.
+
+On TPU the yielded index lists feed whatever host pipeline stages the
+batch (e.g. ``examples/imagenet_rn50.prefetcher``); the arrays then land
+on device via ``jax.device_put`` with a ('dp',)-sharded layout.
+"""
+
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+__all__ = [
+    "MegatronPretrainingSampler",
+    "MegatronPretrainingRandomSampler",
+]
+
+
+class _Base(abc.ABC):
+    """Base class for Megatron-style batch samplers."""
+
+    @abc.abstractmethod
+    def __len__(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def __iter__(self):
+        ...
+
+    @property
+    def local_minibatch_size(self) -> int:
+        return self._local_minibatch_size
+
+    @local_minibatch_size.setter
+    def local_minibatch_size(self, new: int) -> None:
+        self._local_minibatch_size = new
+        self.local_minibatch_times_data_parallel_size = (
+            new * self.data_parallel_size)
+
+
+class MegatronPretrainingSampler(_Base):
+    """Sequential sampler: global batches walk the dataset in order; each
+    rank takes its contiguous slice of every global batch."""
+
+    def __init__(
+        self,
+        total_samples: int,
+        consumed_samples: int,
+        local_minibatch_size: int,
+        data_parallel_rank: int,
+        data_parallel_size: int,
+        drop_last: bool = True,
+    ):
+        if total_samples <= 0:
+            raise RuntimeError(f"no sample to consume: {total_samples}")
+        if consumed_samples >= total_samples:
+            raise RuntimeError(
+                f"no samples left to consume: {consumed_samples}, "
+                f"{total_samples}")
+        if local_minibatch_size <= 0:
+            raise RuntimeError(
+                "local minibatch size must be greater than 0: "
+                f"{local_minibatch_size}")
+        if data_parallel_size <= 0:
+            raise RuntimeError(
+                f"data parallel size must be greater than 0: "
+                f"{data_parallel_size}")
+        if data_parallel_rank >= data_parallel_size:
+            raise RuntimeError(
+                "data_parallel_rank should be smaller than data size: "
+                f"{data_parallel_rank}, {data_parallel_size}")
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self._local_minibatch_size = local_minibatch_size
+        self.data_parallel_rank = data_parallel_rank
+        self.data_parallel_size = data_parallel_size
+        self.local_minibatch_times_data_parallel_size = (
+            local_minibatch_size * data_parallel_size)
+        self.drop_last = drop_last
+
+    def __len__(self):
+        return self.total_samples
+
+    def get_start_end_idx(self):
+        start = self.data_parallel_rank * self.local_minibatch_size
+        return start, start + self.local_minibatch_size
+
+    def __iter__(self):
+        batch = []
+        for idx in range(self.consumed_samples, self.total_samples):
+            batch.append(idx)
+            if len(batch) == self.local_minibatch_times_data_parallel_size:
+                start, end = self.get_start_end_idx()
+                yield batch[start:end]
+                batch = []
+        if batch and not self.drop_last:
+            start, end = self.get_start_end_idx()
+            yield batch[start:end]
+
+
+class MegatronPretrainingRandomSampler(_Base):
+    """Random sampler: per-rank disjoint buckets, epoch-seeded shuffles,
+    resumable via ``consumed_samples``."""
+
+    def __init__(
+        self,
+        total_samples: int,
+        consumed_samples: int,
+        local_minibatch_size: int,
+        data_parallel_rank: int,
+        data_parallel_size: int,
+    ) -> None:
+        if total_samples <= 0:
+            raise ValueError(
+                f"no sample to consume: total_samples of {total_samples}")
+        if local_minibatch_size <= 0:
+            raise ValueError(
+                f"Invalid local_minibatch_size: {local_minibatch_size}")
+        if data_parallel_size <= 0:
+            raise ValueError(
+                f"Invalid data_parallel_size: {data_parallel_size}")
+        if data_parallel_rank >= data_parallel_size:
+            raise ValueError(
+                "data_parallel_rank should be smaller than data parallel "
+                f"size: {data_parallel_rank} < {data_parallel_size}")
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self._local_minibatch_size = local_minibatch_size
+        self.data_parallel_rank = data_parallel_rank
+        self.data_parallel_size = data_parallel_size
+        self.local_minibatch_times_data_parallel_size = (
+            local_minibatch_size * data_parallel_size)
+        self.last_batch_size = (
+            self.total_samples
+            % self.local_minibatch_times_data_parallel_size)
+
+    def __len__(self) -> int:
+        return self.total_samples
+
+    def __iter__(self):
+        active_total = self.total_samples - self.last_batch_size
+        self.epoch = self.consumed_samples // active_total
+        current_epoch_samples = self.consumed_samples % active_total
+
+        bucket_size = (
+            self.total_samples
+            // self.local_minibatch_times_data_parallel_size
+        ) * self.local_minibatch_size
+        bucket_offset = current_epoch_samples // self.data_parallel_size
+        start_idx = self.data_parallel_rank * bucket_size
+
+        rng = np.random.default_rng(self.epoch)
+        random_idx = rng.permutation(bucket_size).tolist()
+        idx_range = [start_idx + x for x in random_idx[bucket_offset:]]
+
+        batch = []
+        # Last batch if not complete will be dropped.
+        for idx in idx_range:
+            batch.append(idx)
+            if len(batch) == self.local_minibatch_size:
+                self.consumed_samples += (
+                    self.local_minibatch_times_data_parallel_size)
+                yield batch
+                batch = []

@@ -50,20 +50,34 @@ def synthetic_batches(batch, hw=224, classes=1000, seed=0):
         yield x, y
 
 
+_DONE = object()
+
+
 def prefetcher(it, depth=2):
     """Background-thread prefetch: the host prepares + transfers the next
     batch while the device runs the current step (reference
-    data_prefetcher, examples/imagenet/main_amp.py:256)."""
+    data_prefetcher, examples/imagenet/main_amp.py:256).  A sentinel
+    marks exhaustion (or a pipeline exception) so finite iterators end
+    the epoch instead of hanging the consumer."""
     q: "queue.Queue" = queue.Queue(maxsize=depth)
 
     def worker():
-        for item in it:
-            q.put(jax.device_put(item))
+        try:
+            for item in it:
+                q.put(jax.device_put(item))
+            q.put(_DONE)
+        except BaseException as e:  # surface pipeline errors downstream
+            q.put(e)
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
     while True:
-        yield q.get()
+        item = q.get()
+        if item is _DONE:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item
 
 
 def accuracy(logits, labels, topk=(1, 5)):
@@ -125,8 +139,15 @@ def main():
 
     batches = prefetcher(synthetic_batches(args.batch))
     x, y = next(batches)
-    state, stats, m = step(state, stats, x, y)      # compile
+    # compile-only warmup on a throwaway COPY (the step donates its
+    # inputs), so resumed runs don't accumulate uncounted optimizer
+    # updates across preemption cycles
+    warm = jax.tree_util.tree_map(
+        lambda v: jnp.array(v, copy=True) if isinstance(v, jax.Array)
+        else v, (state, stats))
+    _s, _st, m = step(*warm, x, y)
     float(m["loss"])
+    del _s, _st, warm
 
     t0 = time.perf_counter()
     done = 0

@@ -5,23 +5,32 @@ Mirrors the reference's multi-process-without-a-cluster strategy
 processes on one host). On the JAX side one process with 8 virtual CPU
 devices exercises the same mesh/collective code paths.
 
+Hardware kernel tests (`pytest -m tpu tests/test_on_tpu_kernels.py`) set
+``APEX_TPU_TEST_ON_TPU=1`` to keep the real chip attached instead (the
+`tpu` marker is excluded by default — pyproject addopts).
+
 Must set env vars before jax is imported anywhere.
 """
 
 import os
 
-# Force CPU: the driver environment presets a real-TPU platform (and its
-# sitecustomize overrides the JAX_PLATFORMS env var via jax config), so unit
-# tests must both set the env var and update the config after import.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_ON_TPU = os.environ.get("APEX_TPU_TEST_ON_TPU") == "1"
+
+if not _ON_TPU:
+    # Force CPU: the driver environment presets a real-TPU platform (and
+    # its sitecustomize overrides the JAX_PLATFORMS env var via jax
+    # config), so unit tests must both set the env var and update the
+    # config after import.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 # Keep x64 off (TPU-realistic numerics).
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")

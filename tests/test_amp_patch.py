@@ -1,0 +1,78 @@
+"""O1 per-op cast patching tests (amp/patch.py — the trace-time analog
+of the reference's monkey-patch engine, apex/amp/wrap.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.amp.frontend import make_train_step
+from apex_tpu.amp.patch import amp_patch_scope
+from apex_tpu.optimizers import fused_sgd
+
+
+class TestPatchScope:
+    def test_matmul_casts_down_inside_scope(self):
+        a = jnp.ones((4, 4), jnp.float32)
+        with amp_patch_scope(jnp.bfloat16):
+            out = jnp.matmul(a, a)
+        assert out.dtype == jnp.bfloat16
+        assert jnp.matmul(a, a).dtype == jnp.float32  # restored
+
+    def test_softmax_casts_up_inside_scope(self):
+        x = jnp.ones((4, 4), jnp.bfloat16)
+        with amp_patch_scope(jnp.bfloat16):
+            out = jax.nn.softmax(x)
+        assert out.dtype == jnp.float32
+        assert jax.nn.softmax(x).dtype == jnp.bfloat16  # restored
+
+    def test_exception_safe_restore(self):
+        orig = jnp.matmul
+        try:
+            with amp_patch_scope():
+                raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+        assert jnp.matmul is orig
+
+    def test_reentrant(self):
+        a = jnp.ones((2, 2), jnp.float32)
+        with amp_patch_scope(jnp.bfloat16):
+            with amp_patch_scope(jnp.bfloat16):
+                out = jnp.matmul(a, a)
+            # inner exit must not unpatch the outer scope
+            out2 = jnp.matmul(a, a)
+        assert out.dtype == jnp.bfloat16
+        assert out2.dtype == jnp.bfloat16
+        assert jnp.matmul(a, a).dtype == jnp.float32
+
+    def test_non_float_args_pass_through(self):
+        with amp_patch_scope(jnp.bfloat16):
+            out = jnp.cumsum(jnp.arange(4))
+        assert out.dtype == jnp.int32
+
+
+class TestO1StepUsesPatch:
+    def test_o1_matmuls_run_in_compute_dtype(self):
+        """Inside an O1 step the (undecorated) user matmul must execute
+        in the compute dtype; O0 must keep fp32."""
+        seen = {}
+
+        def loss_fn(p, x):
+            y = jnp.matmul(x, p["w"])
+            seen.setdefault("dtype", y.dtype)
+            return jnp.mean(jax.nn.softmax(y) ** 2)
+
+        params = {"w": jnp.ones((8, 8), jnp.float32)}
+        x = jnp.ones((2, 8), jnp.float32)
+
+        from apex_tpu.amp.policy import _effective, policy_for_opt_level
+
+        expect = _effective(policy_for_opt_level("O1").compute_dtype)
+        init, step = make_train_step(loss_fn, fused_sgd(lr=0.1), "O1")
+        step(init(params), x)
+        assert seen["dtype"] == expect  # fp16 (bf16 on real TPU)
+
+        seen.clear()
+        init0, step0 = make_train_step(loss_fn, fused_sgd(lr=0.1), "O0")
+        step0(init0(params), x)
+        assert seen["dtype"] == jnp.float32

@@ -1,0 +1,109 @@
+"""Hardware (non-interpret) Pallas kernel tests — `pytest -m tpu`.
+
+The CPU suite runs every kernel in interpret mode; real-TPU tiling bugs
+(e.g. the round-1 softmax lane bug fixed in f3e44b8) only surface when
+Mosaic compiles the kernel.  These tests re-run the core kernel parity
+checks non-interpret; they self-skip unless a TPU is attached:
+
+    APEX_TPU_TEST_ON_TPU=1 PYTHONPATH=/root/repo:/root/.axon_site \
+        python -m pytest tests/test_on_tpu_kernels.py -m tpu -q
+
+(the env var tells tests/conftest.py to keep the real chip instead of
+forcing the CPU mesh; verified green on v5e, round 2.)
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+on_real_tpu = any(d.platform == "tpu" for d in jax.devices())
+
+pytestmark = [
+    pytest.mark.tpu,
+    pytest.mark.skipif(not on_real_tpu, reason="needs a real TPU chip"),
+]
+
+
+def test_flash_attention_parity_on_chip():
+    from apex_tpu.ops.flash_attention import flash_attention, mha_reference
+
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(2, 256, 4, 64), jnp.float32) * 0.5
+    k = jnp.asarray(rs.randn(2, 256, 4, 64), jnp.float32) * 0.5
+    v = jnp.asarray(rs.randn(2, 256, 4, 64), jnp.float32) * 0.5
+    got = flash_attention(q, k, v, causal=True)
+    want = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=5e-3, rtol=5e-3)
+
+
+def test_flash_dropout_statistics_on_chip():
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    rs = np.random.RandomState(1)
+    b, s, n, d = 1, 256, 2, 128
+    q = jnp.asarray(rs.randn(b, s, n, d), jnp.float32) * 0.5
+    k = jnp.asarray(rs.randn(b, s, n, d), jnp.float32) * 0.5
+    v = jnp.asarray(np.tile(np.eye(s)[None, :, None, :d], (b, 1, n, 1)),
+                    jnp.float32)
+    out = flash_attention(q, k, v, dropout_p=0.4,
+                          dropout_rng=jax.random.PRNGKey(3))
+    dense = flash_attention(q, k, v)
+    ratio = np.asarray(out, np.float64) / np.maximum(
+        np.asarray(dense, np.float64), 1e-30)
+    zero_frac = 1.0 - (ratio > 0.5).mean()
+    assert abs(zero_frac - 0.4) < 0.02
+
+
+def test_layer_norm_kernel_on_chip():
+    from apex_tpu.ops.layer_norm import fused_layer_norm
+
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(64, 1024), jnp.float32)
+    w = jnp.asarray(1 + 0.1 * rs.randn(1024), jnp.float32)
+    b = jnp.asarray(0.1 * rs.randn(1024), jnp.float32)
+    got = fused_layer_norm(x, w, b)
+    mu = np.asarray(x).mean(-1, keepdims=True)
+    var = np.asarray(x).var(-1, keepdims=True)
+    want = (np.asarray(x) - mu) / np.sqrt(var + 1e-5)
+    want = want * np.asarray(w) + np.asarray(b)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-5, rtol=2e-5)
+
+
+def test_softmax_kernels_on_chip():
+    from apex_tpu.ops.softmax import (
+        scaled_softmax, scaled_upper_triang_masked_softmax)
+
+    rs = np.random.RandomState(3)
+    s = jnp.asarray(rs.randn(2, 4, 256, 256), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(scaled_softmax(s, 0.5)),
+        np.asarray(jax.nn.softmax(np.asarray(s) * 0.5, axis=-1)),
+        atol=2e-5, rtol=2e-5)
+    got = np.asarray(scaled_upper_triang_masked_softmax(s, 0.5))
+    mask = np.triu(np.ones((256, 256), bool), 1)
+    ref = np.where(mask[None, None], -1e30, np.asarray(s) * 0.5)
+    ref = np.asarray(jax.nn.softmax(ref, axis=-1))
+    np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flat_adam_kernel_on_chip():
+    from apex_tpu.ops.pallas_adam import adam_kernel_flat
+
+    rs = np.random.RandomState(4)
+    n = 4096
+    g = jnp.asarray(rs.randn(n), jnp.float32)
+    p = jnp.asarray(rs.randn(n), jnp.float32)
+    m = jnp.zeros((n,), jnp.float32)
+    v = jnp.zeros((n,), jnp.float32)
+    scalars = jnp.asarray([1e-3, 0.9, 0.999, 1e-8, 0.0, 0.1, 0.001999],
+                          jnp.float32)
+    u, m2, v2 = adam_kernel_flat(g, p, m, v, scalars, adam_w_mode=True,
+                                 interpret=False)
+    m_ref = 0.1 * np.asarray(g)
+    v_ref = 0.001 * np.asarray(g) ** 2
+    u_ref = -1e-3 * (m_ref / 0.1) / (np.sqrt(v_ref / 0.001999) + 1e-8)
+    np.testing.assert_allclose(np.asarray(m2), m_ref, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(u), u_ref, rtol=1e-4, atol=1e-7)
