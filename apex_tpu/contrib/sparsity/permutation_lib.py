@@ -57,7 +57,16 @@ def _group_view(matrix: np.ndarray) -> np.ndarray:
 
 def sum_after_2_to_4(matrix: np.ndarray) -> float:
     """Total magnitude retained if 2:4 pruning were applied
-    (reference permutation_utilities.py ``sum_after_2_to_4``)."""
+    (reference permutation_utilities.py ``sum_after_2_to_4``).
+
+    Dispatches to the native C++ kernel when built (the reference's
+    CUDA-search-kernel analog — see permutation_native.py); numpy
+    otherwise."""
+    from . import permutation_native as _native
+
+    result = _native.sum_after_2_to_4(np.asarray(matrix, np.float32))
+    if result is not None:
+        return result
     g = _group_view(matrix)
     top2 = np.partition(g, 2, axis=-1)[..., 2:]
     return float(top2.sum())
@@ -150,14 +159,19 @@ def _unique_group_permutations(c: int) -> np.ndarray:
 
 
 def _best_window_permutation(sub: np.ndarray) -> np.ndarray:
-    """Exhaustively find the best unique grouping of the window's columns.
-    Fully vectorized: scores all P permutations at once."""
+    """Exhaustively find the best unique grouping of the window's columns
+    (native batch scorer when built; vectorized numpy otherwise)."""
+    from . import permutation_native as _native
+
     c = sub.shape[1]
     perms = _unique_group_permutations(c)  # (P, c)
-    permuted = np.abs(sub[:, perms])  # (rows, P, c)
-    g = permuted.reshape(sub.shape[0], perms.shape[0], c // 4, 4)
-    top2 = np.partition(g, 2, axis=-1)[..., 2:]
-    scores = top2.sum(axis=(0, 2, 3))  # (P,)
+    scores = _native.score_permutations(
+        np.asarray(sub, np.float32), perms)
+    if scores is None:
+        permuted = np.abs(sub[:, perms])  # (rows, P, c)
+        g = permuted.reshape(sub.shape[0], perms.shape[0], c // 4, 4)
+        top2 = np.partition(g, 2, axis=-1)[..., 2:]
+        scores = top2.sum(axis=(0, 2, 3))  # (P,)
     return perms[int(np.argmax(scores))]
 
 

@@ -1,0 +1,116 @@
+"""ctypes loader for the native permutation-search kernels.
+
+The reference ships CUDA search kernels and falls back to a slow numpy
+path when they are absent (permutation_search_kernels/
+permutation_utilities.py:10-16 try-import).  Same shape here: a small
+C++ shared library (apex_tpu/csrc/permutation_search.cpp) built lazily
+with g++ and cached next to the source; every entry point degrades to
+the vectorized-numpy implementation when the toolchain is unavailable
+(``available()`` reports which path is active, and
+``APEX_TPU_DISABLE_NATIVE=1`` forces the fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "csrc", "permutation_search.cpp")
+_LIB = os.path.join(os.path.dirname(_SRC), "libpermsearch.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("APEX_TPU_DISABLE_NATIVE") == "1":
+            return None
+        if not os.path.exists(_LIB) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        f64, i64, i32p = ctypes.c_double, ctypes.c_int64, ctypes.POINTER(
+            ctypes.c_int32)
+        f32p, f64p = ctypes.POINTER(ctypes.c_float), ctypes.POINTER(f64)
+        lib.ps_sum_after_2_to_4.restype = f64
+        lib.ps_sum_after_2_to_4.argtypes = [f32p, i64, i64]
+        lib.ps_score_permutations.restype = None
+        lib.ps_score_permutations.argtypes = [f32p, i64, i64, i32p, i64,
+                                              f64p]
+        lib.ps_try_swap_improvement.restype = f64
+        lib.ps_try_swap_improvement.argtypes = [f32p, i64, i64, i64, i64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _f32c(mat: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(mat, dtype=np.float32)
+
+
+def sum_after_2_to_4(matrix: np.ndarray) -> float | None:
+    lib = _load()
+    if lib is None:
+        return None
+    m = _f32c(matrix)
+    return float(lib.ps_sum_after_2_to_4(
+        m.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        m.shape[0], m.shape[1]))
+
+
+def score_permutations(matrix: np.ndarray,
+                       perms: np.ndarray) -> np.ndarray | None:
+    """scores[p] = retained magnitude of matrix[:, perms[p]]."""
+    lib = _load()
+    if lib is None:
+        return None
+    m = _f32c(matrix)
+    p = np.ascontiguousarray(perms, dtype=np.int32)
+    out = np.empty((p.shape[0],), np.float64)
+    lib.ps_score_permutations(
+        m.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        m.shape[0], m.shape[1],
+        p.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        p.shape[0],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    return out
+
+
+def try_swap_improvement(matrix: np.ndarray, a: int, b: int) -> float | None:
+    lib = _load()
+    if lib is None:
+        return None
+    m = _f32c(matrix)
+    return float(lib.ps_try_swap_improvement(
+        m.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        m.shape[0], m.shape[1], a, b))

@@ -1,0 +1,107 @@
+// Native permutation-search kernels for ASP 2:4 sparsity.
+//
+// Reference: apex/contrib/sparsity/permutation_search_kernels/CUDA_kernels/
+// permutation_search_kernels.cu — the reference accelerates the
+// magnitude-retention scoring of candidate channel permutations with CUDA
+// kernels; the search itself is a host-side loop.  On TPU the search stays
+// on host (it runs once, offline — SURVEY.md §2.4), so the native analog is
+// a C++ core hot loop called through ctypes, with the vectorized-numpy
+// implementation as the portable fallback (apex_tpu/contrib/sparsity/
+// permutation_native.py picks whichever is available).
+//
+// Build (done lazily by permutation_native.py, cached next to this file):
+//   g++ -O3 -shared -fPIC -o libpermsearch.so permutation_search.cpp
+//
+// Exported C ABI:
+//   ps_sum_after_2_to_4(mat, rows, cols)            -> double
+//   ps_score_permutations(mat, rows, cols, perms, n_perms, out_scores)
+//   ps_try_swap_improvement(mat, rows, cols, a, b)  -> double
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+
+namespace {
+
+// Sum of the two largest of |v0..v3| — the magnitude a 2:4 mask keeps
+// from one group of 4.
+inline double top2_abs(float v0, float v1, float v2, float v3) {
+    float a = std::fabs(v0), b = std::fabs(v1);
+    float c = std::fabs(v2), d = std::fabs(v3);
+    float lo_ab = std::min(a, b), hi_ab = std::max(a, b);
+    float lo_cd = std::min(c, d), hi_cd = std::max(c, d);
+    float hi1 = std::max(hi_ab, hi_cd);
+    // second largest overall: the loser pair-maximum competes with the
+    // winner pair's minimum
+    float second = (hi_ab >= hi_cd)
+        ? std::max(lo_ab, hi_cd)
+        : std::max(lo_cd, hi_ab);
+    return (double)hi1 + second;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Retained magnitude if 2:4 pruning were applied (reference
+// permutation_utilities.py sum_after_2_to_4; trailing columns that do
+// not fill a group of 4 are ignored, matching the Python port).
+double ps_sum_after_2_to_4(const float* mat, int64_t rows, int64_t cols) {
+    const int64_t groups = cols / 4;
+    double total = 0.0;
+    for (int64_t r = 0; r < rows; ++r) {
+        const float* row = mat + r * cols;
+        for (int64_t g = 0; g < groups; ++g) {
+            total += top2_abs(row[4 * g], row[4 * g + 1],
+                              row[4 * g + 2], row[4 * g + 3]);
+        }
+    }
+    return total;
+}
+
+// Score a batch of candidate column permutations:
+// out_scores[p] = retained magnitude of mat[:, perms[p*cols .. +cols]].
+void ps_score_permutations(const float* mat, int64_t rows, int64_t cols,
+                           const int32_t* perms, int64_t n_perms,
+                           double* out_scores) {
+    const int64_t groups = cols / 4;
+    for (int64_t p = 0; p < n_perms; ++p) {
+        const int32_t* perm = perms + p * cols;
+        double total = 0.0;
+        for (int64_t r = 0; r < rows; ++r) {
+            const float* row = mat + r * cols;
+            for (int64_t g = 0; g < groups; ++g) {
+                total += top2_abs(row[perm[4 * g]], row[perm[4 * g + 1]],
+                                  row[perm[4 * g + 2]],
+                                  row[perm[4 * g + 3]]);
+            }
+        }
+        out_scores[p] = total;
+    }
+}
+
+// Improvement in retained magnitude from swapping columns a and b; only
+// the two affected stripes are rescored (reference try_swap).
+double ps_try_swap_improvement(const float* mat, int64_t rows,
+                               int64_t cols, int64_t a, int64_t b) {
+    const int64_t ga = a / 4, gb = b / 4;
+    if (ga == gb) return 0.0;
+    double before = 0.0, after = 0.0;
+    for (int64_t r = 0; r < rows; ++r) {
+        const float* row = mat + r * cols;
+        float va[4], vb[4];
+        for (int k = 0; k < 4; ++k) {
+            va[k] = row[4 * ga + k];
+            vb[k] = row[4 * gb + k];
+        }
+        before += top2_abs(va[0], va[1], va[2], va[3])
+                + top2_abs(vb[0], vb[1], vb[2], vb[3]);
+        va[a % 4] = row[b];
+        vb[b % 4] = row[a];
+        after += top2_abs(va[0], va[1], va[2], va[3])
+               + top2_abs(vb[0], vb[1], vb[2], vb[3]);
+    }
+    return after - before;
+}
+
+}  // extern "C"

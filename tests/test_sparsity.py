@@ -335,3 +335,58 @@ class TestPermutationSearch:
             return h @ p["l2"]["kernel"]
 
         np.testing.assert_allclose(fwd(params), fwd(new), rtol=1e-5)
+
+
+class TestNativeKernels:
+    """C++ permutation-search kernels vs the numpy fallback (reference
+    pattern: CUDA search kernels vs CPU path,
+    permutation_search_kernels/permutation_utilities.py)."""
+
+    def test_native_builds_and_matches_numpy(self):
+        from apex_tpu.contrib.sparsity import permutation_native as nat
+
+        if not nat.available():
+            pytest.skip("no C++ toolchain in this environment")
+        rng = np.random.default_rng(0)
+        m = rng.normal(size=(64, 32)).astype(np.float32)
+        got = nat.sum_after_2_to_4(m)
+        g = np.abs(m).reshape(64, -1, 4)
+        want = float(np.partition(g, 2, axis=-1)[..., 2:].sum())
+        assert got == pytest.approx(want, rel=1e-6)
+
+    def test_native_score_permutations(self):
+        from apex_tpu.contrib.sparsity import permutation_native as nat
+        from apex_tpu.contrib.sparsity.permutation_lib import (
+            _unique_group_permutations,
+        )
+
+        if not nat.available():
+            pytest.skip("no C++ toolchain in this environment")
+        rng = np.random.default_rng(1)
+        m = rng.normal(size=(16, 8)).astype(np.float32)
+        perms = _unique_group_permutations(8)
+        got = nat.score_permutations(m, perms)
+        for p, s in zip(perms[:10], got[:10]):
+            g = np.abs(m[:, p]).reshape(16, -1, 4)
+            want = float(np.partition(g, 2, axis=-1)[..., 2:].sum())
+            assert s == pytest.approx(want, rel=1e-6)
+
+    def test_native_try_swap_matches_python(self):
+        import os
+
+        from apex_tpu.contrib.sparsity import permutation_native as nat
+        from apex_tpu.contrib.sparsity.permutation_lib import try_swap
+
+        if not nat.available():
+            pytest.skip("no C++ toolchain in this environment")
+        rng = np.random.default_rng(2)
+        m = rng.normal(size=(8, 16)).astype(np.float32)
+        for a, b in ((0, 5), (2, 14), (7, 9)):
+            got = nat.try_swap_improvement(m, a, b)
+            # force the numpy path for the oracle
+            os.environ["APEX_TPU_DISABLE_NATIVE"] = "1"
+            try:
+                want = try_swap(m, b, a)
+            finally:
+                del os.environ["APEX_TPU_DISABLE_NATIVE"]
+            assert got == pytest.approx(want, abs=1e-5)
