@@ -1,0 +1,69 @@
+"""groupbn — NHWC persistent BatchNorm analog.
+
+Reference: ``apex/contrib/groupbn`` (5.8k LoC: hand-written NHWC
+persistent-BN CUDA kernels, CUDA-IPC inter-GPU buffers for ``bn_group``
+cross-device stats, CTA-occupancy tuning — batch_norm.py:135
+``BatchNorm2d_NHWC(num_features, fuse_relu, bn_group, ...)`` with
+``forward(x, z=None)`` where ``z`` is a fused residual add).
+
+TPU disposition (the explicit writeup SURVEY.md §7 promised):
+
+- **NHWC layout** is this package's native conv layout — no dedicated
+  kernel needed; XLA fuses normalize+affine(+add+relu) into one
+  elementwise epilogue (same class of fusion verified by HLO for
+  contrib.conv_bias_relu).
+- **persistent kernels / CTA occupancy / multi_stream** are
+  CUDA-scheduling machinery with no TPU analog: XLA owns scheduling.
+- **bn_group cross-device stats over CUDA-IPC** map to ``lax.pmean``
+  over a mesh axis — exactly :class:`apex_tpu.parallel.SyncBatchNorm`.
+
+So :class:`BatchNorm2d_NHWC` here is a thin flax module with the
+reference's call shape (``fuse_relu``, optional residual ``z``) backed
+by SyncBatchNorm; ``bn_group > 1`` = stats over the ``axis_name`` mesh
+axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+__all__ = ["BatchNorm2d_NHWC"]
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    """Reference-shaped NHWC BatchNorm (batch_norm.py:135).
+
+    ``bn_group > 1`` enables cross-device stats over ``axis_name``
+    (the CUDA-IPC group analog); ``forward(x, z)`` fuses the residual
+    add before the optional ReLU like the reference's bn_add_relu
+    kernels.
+    """
+
+    num_features: int
+    fuse_relu: bool = False
+    bn_group: int = 1
+    axis_name: Optional[str] = "dp"
+    momentum: float = 0.1    # torch running-stat convention (SyncBN's)
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array, z: Optional[jax.Array] = None,
+                 train: bool = True) -> jax.Array:
+        bn = SyncBatchNorm(
+            num_features=self.num_features,
+            axis_name=self.axis_name if self.bn_group > 1 else None,
+            fuse_relu=False,              # relu applied after the add
+            momentum=self.momentum,
+            eps=self.eps,
+        )
+        y = bn(x, use_running_average=not train)
+        if z is not None:
+            y = y + z
+        if self.fuse_relu:
+            y = jax.nn.relu(y)
+        return y

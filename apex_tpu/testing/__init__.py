@@ -1,0 +1,5 @@
+from .common_utils import (  # noqa: F401
+    skipFlakyTest,
+    skipIfNoTPU,
+    skipIfTPU,
+)

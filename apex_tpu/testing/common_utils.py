@@ -1,0 +1,42 @@
+"""Test skip decorators.
+
+Reference: ``apex/testing/common_utils.py:12-33`` — env-driven
+``skipIfRocm`` / ``skipFlakyTest``.  The platform split here is
+CPU-mesh vs real-TPU instead of CUDA vs ROCm:
+
+- ``skipIfNoTPU`` — test needs a real chip (non-interpret Pallas);
+  prefer the ``tpu`` pytest marker (pyproject) for whole files.
+- ``skipIfTPU`` — test only makes sense on the CPU mesh.
+- ``skipFlakyTest`` — honored when ``APEX_TPU_SKIP_FLAKY_TEST=1``
+  (reference APEX_SKIP_FLAKY_TEST).
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+__all__ = ["skipIfNoTPU", "skipIfTPU", "skipFlakyTest"]
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+def skipIfNoTPU(fn):
+    return pytest.mark.skipif(
+        not _on_tpu(), reason="test requires a real TPU chip")(fn)
+
+
+def skipIfTPU(fn):
+    return pytest.mark.skipif(
+        _on_tpu(), reason="test only runs on the CPU mesh")(fn)
+
+
+def skipFlakyTest(fn):
+    return pytest.mark.skipif(
+        os.environ.get("APEX_TPU_SKIP_FLAKY_TEST") == "1",
+        reason="flaky test skipped via APEX_TPU_SKIP_FLAKY_TEST")(fn)

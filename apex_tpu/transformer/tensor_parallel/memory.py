@@ -1,0 +1,62 @@
+"""MemoryBuffer / RingMemBuffer — documented N/A with API shims.
+
+Reference: ``apex/transformer/tensor_parallel/memory.py`` —
+``MemoryBuffer`` pre-allocates one contiguous CUDA tensor and hands out
+zero-copy views (``get``) to dodge allocator fragmentation and
+per-tensor malloc latency; ``RingMemBuffer`` rotates N of them.
+
+On TPU this is a **non-problem by construction**: XLA owns all device
+memory, buffers are planned at compile time inside each executable, and
+jit boundaries donate/alias arrays (``donate_argnums``), so there is no
+allocator churn for a pre-allocation pool to absorb.  The classes below
+keep the reference API importable for ported code — ``get`` returns a
+correctly-shaped zero view into one flat array, which under jit compiles
+to exactly the same thing any fresh ``jnp.zeros`` would.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["MemoryBuffer", "RingMemBuffer"]
+
+
+def _prod(shape):
+    out = 1
+    for d in shape:
+        out *= int(d)
+    return out
+
+
+class MemoryBuffer:
+    """API shim of reference ``MemoryBuffer(numel, dtype)``."""
+
+    def __init__(self, numel: int, dtype=jnp.float32):
+        self.numel = int(numel)
+        self.dtype = dtype
+        self.data = jnp.zeros((self.numel,), dtype)
+
+    def zero(self):
+        self.data = jnp.zeros((self.numel,), self.dtype)
+
+    def get(self, shape, start_index: int = 0):
+        end = start_index + _prod(shape)
+        if end > self.numel:
+            raise ValueError(
+                f"requested tensor [{start_index}:{end}) is out of the "
+                f"buffer's {self.numel} elements")
+        return self.data[start_index:end].reshape(shape)
+
+
+class RingMemBuffer:
+    """API shim of reference ``RingMemBuffer(name, num_buffers, numel,
+    dtype)`` — rotates through ``num_buffers`` MemoryBuffers."""
+
+    def __init__(self, num_buffers: int, numel: int, dtype=jnp.float32):
+        self.buffers = [MemoryBuffer(numel, dtype)
+                        for _ in range(num_buffers)]
+        self._index = -1
+
+    def get_next_buffer(self) -> MemoryBuffer:
+        self._index = (self._index + 1) % len(self.buffers)
+        return self.buffers[self._index]

@@ -1,0 +1,84 @@
+"""groupbn BatchNorm2d_NHWC shim + testing decorators."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+from apex_tpu.testing import skipFlakyTest, skipIfNoTPU, skipIfTPU
+
+
+class TestBatchNorm2dNHWC:
+    def _x(self, b=8, hw=4, c=16, seed=0):
+        rs = np.random.RandomState(seed)
+        return jnp.asarray(rs.randn(b, hw, hw, c) * 2 + 1, jnp.float32)
+
+    def test_normalizes_like_reference_bn(self):
+        x = self._x()
+        mod = BatchNorm2d_NHWC(num_features=16, bn_group=1)
+        vars_ = mod.init(jax.random.PRNGKey(0), x, train=False)
+        y, _ = mod.apply(vars_, x, train=True, mutable=["batch_stats"])
+        y = np.asarray(y)
+        np.testing.assert_allclose(
+            y.reshape(-1, 16).mean(0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(
+            y.reshape(-1, 16).std(0), 1.0, atol=1e-3)
+
+    def test_fused_add_relu(self):
+        x = self._x(seed=1)
+        z = jnp.asarray(
+            np.random.RandomState(2).randn(*x.shape), jnp.float32)
+        mod = BatchNorm2d_NHWC(num_features=16, fuse_relu=True)
+        vars_ = mod.init(jax.random.PRNGKey(0), x, train=False)
+        y, _ = mod.apply(vars_, x, z, train=True,
+                         mutable=["batch_stats"])
+        plain = BatchNorm2d_NHWC(num_features=16)
+        yp, _ = plain.apply(
+            plain.init(jax.random.PRNGKey(0), x, train=False), x,
+            train=True, mutable=["batch_stats"])
+        np.testing.assert_allclose(
+            np.asarray(y), np.maximum(np.asarray(yp) + np.asarray(z), 0),
+            atol=1e-5)
+
+    def test_bn_group_stats_over_axis(self):
+        """bn_group>1 = cross-device stats (the CUDA-IPC group analog):
+        the per-device shard normalized with GLOBAL batch stats."""
+        mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+        x = self._x(b=8, seed=3)
+        mod = BatchNorm2d_NHWC(num_features=16, bn_group=2,
+                               axis_name="dp")
+        vars_ = mod.init(jax.random.PRNGKey(0), x[:4], train=False)
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(), P("dp")),
+            out_specs=P("dp"))
+        def run(v, xloc):
+            y, _ = mod.apply(v, xloc, train=True,
+                             mutable=["batch_stats"])
+            return y
+
+        y = np.asarray(run(vars_, x))
+        # global-batch normalization: all 8 samples together are ~N(0,1)
+        np.testing.assert_allclose(y.reshape(-1, 16).mean(0), 0.0,
+                                   atol=1e-5)
+        np.testing.assert_allclose(y.reshape(-1, 16).std(0), 1.0,
+                                   atol=1e-3)
+
+
+class TestSkipDecorators:
+    @skipIfNoTPU
+    def test_only_on_tpu(self):
+        assert any(d.platform == "tpu" for d in jax.devices())
+
+    @skipIfTPU
+    def test_only_on_cpu_mesh(self):
+        assert not any(d.platform == "tpu" for d in jax.devices())
+
+    @skipFlakyTest
+    def test_flaky_runs_unless_env_set(self):
+        assert True
