@@ -1,7 +1,18 @@
 """apex.contrib analog: higher-level / specialized components.
 
-Reference: apex/contrib (fmha, multihead_attn, optimizers, xentropy,
-focal_loss, transducer, sparsity, peer_memory, ...). The TPU build keeps
-the namespace; fused attention lives in apex_tpu.ops.flash_attention and
-ring attention in apex_tpu.parallel.ring_attention.
+Reference: apex/contrib. The TPU build keeps the namespace:
+
+- ``multihead_attn``  — Self/Encdec MHA modules over the flash kernel
+- ``sparsity``        — ASP 2:4 structured sparsity (+ C++ search kernels)
+- ``optimizers``      — ZeRO DistributedFusedAdam / DistributedFusedLAMB
+- ``bottleneck``      — (Spatial)Bottleneck blocks
+- ``peer_memory``     — ppermute halo exchange
+- ``conv_bias_relu``  — fused conv epilogues (XLA, HLO-verified)
+- ``groupbn``         — NHWC BatchNorm shim over SyncBN (N/A writeup)
+- ``transducer`` / ``focal_loss`` / ``index_mul_2d`` / ``xentropy`` /
+  ``clip_grad``
+
+The fmha analog lives in ``apex_tpu.ops.flash_attention``; ring
+attention (our long-context extension) in
+``apex_tpu.parallel.ring_attention``.
 """
