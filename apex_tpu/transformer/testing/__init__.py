@@ -1,0 +1,18 @@
+"""Megatron-style trainer plumbing for the standalone test models.
+
+Reference: ``apex/transformer/testing`` — the 971-LoC Megatron argparse
+(arguments.py), the global-vars singleton (global_vars.py), and the
+standalone GPT/BERT models.  The models live in ``apex_tpu.models``
+(transformer_lm / gpt / bert); this package supplies the argparse →
+``TransformerConfig`` bridge and the global-vars surface so
+Megatron-shaped launch scripts port directly.
+"""
+
+from .arguments import parse_args  # noqa: F401
+from .global_vars import (  # noqa: F401
+    get_args,
+    get_adlr_autoresume,
+    get_num_microbatches,
+    get_timers,
+    set_global_variables,
+)

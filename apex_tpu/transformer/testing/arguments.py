@@ -1,0 +1,143 @@
+"""Megatron-style argparse → TransformerConfig + parallel topology.
+
+Reference: ``apex/transformer/testing/arguments.py`` (971 LoC).  The TPU
+port keeps the flag names the reference's launch scripts use (network
+size, regularization, training, mixed precision, parallelism groups) and
+adds ``to_transformer_config`` to materialize ``apex_tpu``'s config
+object.  Flags whose machinery has no TPU analog (NCCL/UCC transport,
+CUDA graphs, CPU offload) are accepted-and-ignored with a warning so
+ported scripts keep running.
+"""
+
+from __future__ import annotations
+
+import argparse
+import warnings
+
+import jax.numpy as jnp
+
+__all__ = ["parse_args", "to_transformer_config", "core_parser"]
+
+_IGNORED = {
+    "cpu_offload", "use_cpu_initialization", "empty_unused_memory_level",
+}
+
+
+def core_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="apex_tpu Megatron-style arguments",
+        allow_abbrev=False)
+
+    g = parser.add_argument_group(title="network size")
+    g.add_argument("--num-layers", type=int, default=2)
+    g.add_argument("--hidden-size", type=int, default=64)
+    g.add_argument("--ffn-hidden-size", type=int, default=None)
+    g.add_argument("--num-attention-heads", type=int, default=4)
+    g.add_argument("--kv-channels", type=int, default=None)
+    g.add_argument("--max-position-embeddings", type=int, default=128)
+    g.add_argument("--make-vocab-size-divisible-by", type=int, default=128)
+    g.add_argument("--layernorm-epsilon", type=float, default=1e-5)
+    g.add_argument("--vocab-size", type=int, default=8192)
+
+    g = parser.add_argument_group(title="regularization")
+    g.add_argument("--attention-dropout", type=float, default=0.1)
+    g.add_argument("--hidden-dropout", type=float, default=0.1)
+    g.add_argument("--weight-decay", type=float, default=0.01)
+    g.add_argument("--clip-grad", type=float, default=1.0)
+    g.add_argument("--adam-beta1", type=float, default=0.9)
+    g.add_argument("--adam-beta2", type=float, default=0.999)
+    g.add_argument("--adam-eps", type=float, default=1e-8)
+
+    g = parser.add_argument_group(title="training")
+    g.add_argument("--micro-batch-size", type=int, default=2)
+    g.add_argument("--global-batch-size", type=int, default=None)
+    g.add_argument("--rampup-batch-size", nargs="*", default=None)
+    g.add_argument("--train-iters", type=int, default=10)
+    g.add_argument("--lr", type=float, default=1e-4)
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--cpu-offload", action="store_true", default=False)
+    g.add_argument("--use-cpu-initialization", action="store_true",
+                   default=False)
+
+    g = parser.add_argument_group(title="mixed precision")
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--bf16", action="store_true")
+    g.add_argument("--loss-scale", type=float, default=None)
+    g.add_argument("--initial-loss-scale", type=float, default=2 ** 32)
+    g.add_argument("--hysteresis", type=int, default=2)
+
+    g = parser.add_argument_group(title="distributed")
+    g.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    g.add_argument("--virtual-pipeline-model-parallel-size", type=int,
+                   default=None)
+    g.add_argument("--sequence-parallel", action="store_true")
+    g.add_argument("--distributed-backend", default="xla",
+                   choices=["xla", "nccl", "ucc", "gloo"])
+
+    g = parser.add_argument_group(title="checkpointing / autoresume")
+    g.add_argument("--save", type=str, default=None)
+    g.add_argument("--load", type=str, default=None)
+    g.add_argument("--save-interval", type=int, default=None)
+    g.add_argument("--adlr-autoresume", action="store_true")
+    g.add_argument("--adlr-autoresume-interval", type=int, default=1000)
+
+    g = parser.add_argument_group(title="logging")
+    g.add_argument("--log-interval", type=int, default=100)
+    g.add_argument("--tensorboard-dir", type=str, default=None)
+    return parser
+
+
+def parse_args(extra_args_provider=None, defaults=None,
+               ignore_unknown_args=False, args=None):
+    """Reference-shaped entry (arguments.py ``parse_args``)."""
+    parser = core_parser()
+    if extra_args_provider is not None:
+        parser = extra_args_provider(parser)
+    if ignore_unknown_args:
+        parsed, _ = parser.parse_known_args(args)
+    else:
+        parsed = parser.parse_args(args)
+    for key, value in (defaults or {}).items():
+        if getattr(parsed, key, None) is None:
+            setattr(parsed, key, value)
+
+    for flag in _IGNORED:
+        if getattr(parsed, flag, False):
+            warnings.warn(
+                f"--{flag.replace('_', '-')} has no TPU analog; ignored")
+    if parsed.distributed_backend in ("nccl", "ucc", "gloo"):
+        warnings.warn(
+            f"distributed backend {parsed.distributed_backend!r} maps to "
+            "XLA collectives on TPU (SURVEY.md §5); proceeding with xla")
+
+    # world sizing: DP is whatever the mesh leaves after tp × pp
+    parsed.data_parallel_size = None  # resolved against the actual mesh
+    if parsed.global_batch_size is None:
+        parsed.global_batch_size = parsed.micro_batch_size
+    # pad vocab like the reference (arguments.py _vocab_size_with_padding)
+    mult = parsed.make_vocab_size_divisible_by * \
+        parsed.tensor_model_parallel_size
+    parsed.padded_vocab_size = ((parsed.vocab_size + mult - 1)
+                                // mult) * mult
+    return parsed
+
+
+def to_transformer_config(args):
+    """Materialize ``apex_tpu.models.config.TransformerConfig``."""
+    from apex_tpu.models.config import TransformerConfig
+
+    compute = jnp.bfloat16 if (args.bf16 or args.fp16) else jnp.float32
+    return TransformerConfig(
+        num_layers=args.num_layers,
+        hidden_size=args.hidden_size,
+        num_attention_heads=args.num_attention_heads,
+        ffn_hidden_size=args.ffn_hidden_size,
+        kv_channels=args.kv_channels,
+        vocab_size=args.padded_vocab_size,
+        max_position_embeddings=args.max_position_embeddings,
+        attention_dropout=args.attention_dropout,
+        hidden_dropout=args.hidden_dropout,
+        layernorm_epsilon=args.layernorm_epsilon,
+        compute_dtype=compute,
+    )

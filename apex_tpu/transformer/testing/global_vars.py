@@ -1,0 +1,131 @@
+"""Global-variables singleton for Megatron-shaped launch scripts.
+
+Reference: ``apex/transformer/testing/global_vars.py`` — args, the
+microbatch calculator, tensorboard writer, ADLR AutoResume, and timers
+behind ``get_*`` accessors with initialize-once semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = [
+    "get_args",
+    "get_num_microbatches",
+    "get_current_global_batch_size",
+    "update_num_microbatches",
+    "get_tensorboard_writer",
+    "get_adlr_autoresume",
+    "get_timers",
+    "set_global_variables",
+    "destroy_global_vars",
+]
+
+_GLOBAL_ARGS = None
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_TENSORBOARD_WRITER = None
+_GLOBAL_ADLR_AUTORESUME = None
+_GLOBAL_TIMERS = None
+
+
+def _ensure(var, name):
+    assert var is not None, f"{name} is not initialized."
+    return var
+
+
+def _ensure_not(var, name):
+    assert var is None, f"{name} is already initialized."
+
+
+def get_args():
+    return _ensure(_GLOBAL_ARGS, "args")
+
+
+def get_num_microbatches() -> int:
+    return _ensure(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+        "num microbatches calculator").get()
+
+
+def get_current_global_batch_size() -> int:
+    return _ensure(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+        "num microbatches calculator").get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples: int, *,
+                            consistency_check: bool = True) -> None:
+    _ensure(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+            "num microbatches calculator").update(
+        consumed_samples, consistency_check)
+
+
+def get_tensorboard_writer():
+    """May be None (only set when --tensorboard-dir is given and
+    tensorboard is importable) — same contract as the reference."""
+    return _GLOBAL_TENSORBOARD_WRITER
+
+
+def get_adlr_autoresume():
+    return _GLOBAL_ADLR_AUTORESUME
+
+
+def get_timers():
+    return _ensure(_GLOBAL_TIMERS, "timers")
+
+
+def set_global_variables(extra_args_provider=None, args_defaults=None,
+                         ignore_unknown_args=False, args=None):
+    """Parse args and initialize every global (reference
+    global_vars.py:87 ``set_global_variables``)."""
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    global _GLOBAL_TENSORBOARD_WRITER, _GLOBAL_ADLR_AUTORESUME
+    global _GLOBAL_TIMERS
+
+    from apex_tpu.transformer.microbatches import (
+        build_num_microbatches_calculator,
+    )
+    from apex_tpu.transformer.pipeline_parallel._timers import Timers
+    from apex_tpu.utils.checkpoint import AutoResume
+
+    from .arguments import parse_args
+
+    _ensure_not(_GLOBAL_ARGS, "args")
+    a = parse_args(extra_args_provider, args_defaults or {},
+                   ignore_unknown_args, args)
+    _GLOBAL_ARGS = a
+
+    dp = a.data_parallel_size or 1
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rampup_batch_size=a.rampup_batch_size,
+        global_batch_size=a.global_batch_size,
+        micro_batch_size=a.micro_batch_size,
+        data_parallel_size=dp,
+    )
+
+    if a.tensorboard_dir:
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            _GLOBAL_TENSORBOARD_WRITER = SummaryWriter(
+                log_dir=a.tensorboard_dir)
+        except ImportError:
+            _GLOBAL_TENSORBOARD_WRITER = None
+
+    if a.adlr_autoresume:
+        _GLOBAL_ADLR_AUTORESUME = AutoResume().init()
+
+    _GLOBAL_TIMERS = Timers()
+    return a
+
+
+def destroy_global_vars():
+    """Reset (TPU addition, for tests — the reference leaks globals)."""
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    global _GLOBAL_TENSORBOARD_WRITER, _GLOBAL_ADLR_AUTORESUME
+    global _GLOBAL_TIMERS
+    _GLOBAL_ARGS = None
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+    _GLOBAL_TENSORBOARD_WRITER = None
+    _GLOBAL_ADLR_AUTORESUME = None
+    _GLOBAL_TIMERS = None

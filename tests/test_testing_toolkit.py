@@ -1,0 +1,73 @@
+"""Megatron-style arguments + global_vars toolkit."""
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.transformer.testing import (
+    get_args,
+    get_num_microbatches,
+    get_timers,
+    parse_args,
+    set_global_variables,
+)
+from apex_tpu.transformer.testing.arguments import to_transformer_config
+from apex_tpu.transformer.testing.global_vars import destroy_global_vars
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    destroy_global_vars()
+    yield
+    destroy_global_vars()
+
+
+class TestArguments:
+    def test_megatron_flags_parse(self):
+        a = parse_args(args=[
+            "--num-layers", "4", "--hidden-size", "128",
+            "--num-attention-heads", "8", "--micro-batch-size", "4",
+            "--global-batch-size", "16", "--bf16",
+            "--tensor-model-parallel-size", "2",
+            "--pipeline-model-parallel-size", "2",
+            "--vocab-size", "1000",
+        ])
+        assert a.num_layers == 4
+        assert a.tensor_model_parallel_size == 2
+        # vocab padded to make_vocab_size_divisible_by * tp = 256
+        assert a.padded_vocab_size == 1024
+
+    def test_to_transformer_config(self):
+        a = parse_args(args=["--bf16", "--hidden-size", "64",
+                             "--num-attention-heads", "4"])
+        cfg = to_transformer_config(a)
+        assert cfg.hidden_size == 64
+        assert cfg.compute_dtype == jnp.bfloat16
+
+    def test_foreign_backend_warns_not_raises(self):
+        with pytest.warns(UserWarning, match="XLA collectives"):
+            parse_args(args=["--distributed-backend", "nccl"])
+
+    def test_extra_args_provider_and_defaults(self):
+        def extra(p):
+            p.add_argument("--my-flag", type=int, default=None)
+            return p
+
+        a = parse_args(extra_args_provider=extra,
+                       defaults={"my_flag": 7}, args=[])
+        assert a.my_flag == 7
+
+
+class TestGlobalVars:
+    def test_set_and_get(self):
+        a = set_global_variables(args=[
+            "--micro-batch-size", "2", "--global-batch-size", "8"])
+        assert get_args() is a
+        assert get_num_microbatches() == 4
+        timers = get_timers()
+        timers("fwd").start()
+        timers("fwd").stop()
+
+    def test_double_init_asserts(self):
+        set_global_variables(args=[])
+        with pytest.raises(AssertionError):
+            set_global_variables(args=[])
