@@ -36,6 +36,8 @@ __all__ = [
     "resnet101",
     "resnet152",
     "make_resnet_train_step",
+    "space_to_depth",
+    "stem_kernel_to_space_to_depth",
 ]
 
 
@@ -101,20 +103,51 @@ class Bottleneck(nn.Module):
         return jax.nn.relu(y + residual.astype(y.dtype))
 
 
+def space_to_depth(x: jax.Array) -> jax.Array:
+    """NHWC 2x2 space-to-depth: [n,H,W,C] → [n,H/2,W/2,4C] with
+    ``out[..., (di*2+dj)*C + c] = x[n, 2i+di, 2j+dj, c]``."""
+    n, H, W, C = x.shape
+    xs = x.reshape(n, H // 2, 2, W // 2, 2, C).transpose(0, 1, 3, 2, 4, 5)
+    return xs.reshape(n, H // 2, W // 2, 4 * C)
+
+
+def stem_kernel_to_space_to_depth(w7: jax.Array) -> jax.Array:
+    """Convert a (7,7,C,F) stride-2 stem kernel to its exactly-equivalent
+    (4,4,4C,F) space-to-depth kernel (zero-pad to 8x8 at the top-left,
+    then interleave the 2x2 phases into channels — the MLPerf ResNet TPU
+    stem transform).  Used with stride (1,1) and padding [(2,1),(2,1)]
+    on the space-to-depth input; tested bit-close vs the 7x7 stem."""
+    C, F = w7.shape[2], w7.shape[3]
+    w8 = jnp.pad(w7, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    w8r = w8.reshape(4, 2, 4, 2, C, F).transpose(0, 2, 1, 3, 4, 5)
+    return w8r.reshape(4, 4, 4 * C, F)
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     block_cls: Any
     num_classes: int = 1000
     axis_name: Optional[str] = None
     dtype: Any = jnp.bfloat16
+    # MLPerf-style TPU stem: 2x2 space-to-depth on the input + an
+    # equivalent 4x4x12 conv — the 7x7x3 stem's 3 input channels waste
+    # the 128-wide MXU lanes; 12 channels at a quarter the spatial size
+    # do the same math with far better tiling.
+    space_to_depth_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         bn = partial(SyncBatchNorm, axis_name=self.axis_name)
         x = x.astype(self.dtype)
-        x = nn.Conv(64, (7, 7), strides=(2, 2),
-                    padding=[(3, 3), (3, 3)], use_bias=False,
-                    dtype=self.dtype, name="conv1")(x)
+        if self.space_to_depth_stem:
+            x = space_to_depth(x)
+            x = nn.Conv(64, (4, 4), strides=(1, 1),
+                        padding=[(2, 1), (2, 1)], use_bias=False,
+                        dtype=self.dtype, name="conv1")(x)
+        else:
+            x = nn.Conv(64, (7, 7), strides=(2, 2),
+                        padding=[(3, 3), (3, 3)], use_bias=False,
+                        dtype=self.dtype, name="conv1")(x)
         x = bn(64, fuse_relu=True, name="bn1")(
             x, use_running_average=not train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])

@@ -113,3 +113,45 @@ class TestTrainStep:
         np.testing.assert_array_equal(
             np.asarray(state.master_params["conv1"]["kernel"]), w_before)
         assert float(state.loss_scale_state.loss_scale) == scale_before / 2
+
+
+class TestSpaceToDepthStem:
+    """MLPerf-style TPU stem: exact equivalence with the 7x7 stem."""
+
+    def test_kernel_transform_exact(self):
+        from apex_tpu.models.resnet import (
+            space_to_depth, stem_kernel_to_space_to_depth)
+
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(2, 64, 64, 3), jnp.float32)
+        w7 = jnp.asarray(rs.randn(7, 7, 3, 8) * 0.1, jnp.float32)
+        ref = jax.lax.conv_general_dilated(
+            x, w7, (2, 2), [(3, 3), (3, 3)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        out = jax.lax.conv_general_dilated(
+            space_to_depth(x), stem_kernel_to_space_to_depth(w7),
+            (1, 1), [(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+    def test_model_forward_matches_plain_stem(self):
+        from apex_tpu.models.resnet import (
+            resnet18, stem_kernel_to_space_to_depth)
+
+        plain = resnet18(num_classes=8, dtype=jnp.float32)
+        s2d = resnet18(num_classes=8, dtype=jnp.float32,
+                       space_to_depth_stem=True)
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(2, 64, 64, 3), jnp.float32)
+        vars_p = plain.init(jax.random.PRNGKey(0), x, train=False)
+        # graft the converted stem kernel into (a structural copy of)
+        # the variables — tree_map rebuilds the containers, so mutating
+        # the copy leaves vars_p untouched
+        vars_s = jax.tree_util.tree_map(lambda v: v, vars_p)
+        vars_s["params"]["conv1"]["kernel"] = stem_kernel_to_space_to_depth(
+            vars_p["params"]["conv1"]["kernel"])
+        out_p = plain.apply(vars_p, x, train=False)
+        out_s = s2d.apply(vars_s, x, train=False)
+        np.testing.assert_allclose(
+            np.asarray(out_s), np.asarray(out_p), atol=1e-4, rtol=1e-4)
