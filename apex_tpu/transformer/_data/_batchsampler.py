@@ -55,7 +55,14 @@ class _Base(abc.ABC):
 
 class MegatronPretrainingSampler(_Base):
     """Sequential sampler: global batches walk the dataset in order; each
-    rank takes its contiguous slice of every global batch."""
+    rank takes its contiguous slice of every global batch.
+
+    Deviation note: the apex fork fills its buffer only to
+    ``local_minibatch_size`` before slicing ``[rank*lmbs:(rank+1)*lmbs]``
+    (_batchsampler.py:88-97), which yields an empty list for every rank
+    > 0; this port implements the upstream Megatron-LM semantics the
+    fork was extracted from (fill to ``lmbs * data_parallel_size``, then
+    slice), which is the behavior its own docstring describes."""
 
     def __init__(
         self,
