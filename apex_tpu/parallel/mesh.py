@@ -43,29 +43,32 @@ def create_mesh(
     tp: int = 1,
     pp: int = 1,
     sp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Build a ('pp','dp','sp','tp') mesh over the available devices.
+    """Build a ('pp','dp','sp','ep','tp') mesh over the available devices.
 
-    ``dp=None`` absorbs whatever is left after tp/pp/sp. Mirrors
+    ``dp=None`` absorbs whatever is left after tp/pp/sp/ep. Mirrors
     ``initialize_model_parallel``'s world-size divisibility checks
-    (parallel_state.py:81-130).
+    (parallel_state.py:81-130); the ``ep`` axis carries expert
+    parallelism (transformer/moe.py — beyond the reference, which has no
+    MoE runtime).
     """
     devices = list(devices if devices is not None else jax.devices())
     world = len(devices)
-    denom = tp * pp * sp
+    denom = tp * pp * sp * ep
     if world % denom != 0:
         raise ValueError(
-            f"world size {world} is not divisible by tp*pp*sp = {denom}"
+            f"world size {world} is not divisible by tp*pp*sp*ep = {denom}"
         )
     if dp is None:
         dp = world // denom
     if dp * denom != world:
         raise ValueError(
-            f"dp*tp*pp*sp = {dp * denom} != world size {world}"
+            f"dp*tp*pp*sp*ep = {dp * denom} != world size {world}"
         )
-    arr = np.asarray(devices).reshape(pp, dp, sp, tp)
-    return Mesh(arr, axis_names=("pp", "dp", "sp", "tp"))
+    arr = np.asarray(devices).reshape(pp, dp, sp, ep, tp)
+    return Mesh(arr, axis_names=("pp", "dp", "sp", "ep", "tp"))
 
 
 def data_parallel_mesh(devices: Optional[Sequence] = None) -> Mesh:

@@ -1,0 +1,156 @@
+"""Switch-style Mixture-of-Experts MLP with expert parallelism.
+
+Beyond the reference: ROCm/apex has no MoE runtime (its testing argparse
+reserves ``--num-experts``, arguments.py:389, but nothing consumes it).
+Expert parallelism is first-class on a TPU mesh, so apex_tpu supplies it
+the GSPMD way (the GShard/Switch formulation):
+
+- top-1 (or top-2) routing with a capacity limit per expert;
+- dispatch/combine expressed as one-hot einsums, so the entire layer is
+  dense linear algebra the partitioner can shard: the expert-major
+  tensors carry a ``P('ep', ...)`` constraint and XLA inserts the
+  all-to-alls between the token-major and expert-major layouts;
+- the standard load-balancing auxiliary loss
+  (num_experts · Σ_e fraction_of_tokens(e) · mean_router_prob(e)).
+
+Works on one device (constraints no-op), under ``jit`` over a mesh with
+an ``ep`` axis (``parallel.mesh.create_mesh(ep=...)``), and composes
+with dp/tp the same way the rest of the model does.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.models.transformer_lm import _constrain
+
+__all__ = ["init_moe_params", "switch_moe_mlp", "MoEOutput"]
+
+
+class MoEOutput(NamedTuple):
+    out: jax.Array          # [b, s, h]
+    aux_loss: jax.Array     # scalar load-balance loss
+    dropped_fraction: jax.Array  # scalar: tokens over capacity
+
+
+def init_moe_params(
+    rng: jax.Array,
+    hidden_size: int,
+    ffn_hidden_size: int,
+    num_experts: int,
+    *,
+    init_std: float = 0.02,
+    dtype=jnp.float32,
+) -> dict:
+    """Expert-stacked FFN params [E, ...] + router [h, E]."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+
+    def nrm(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * init_std).astype(dtype)
+
+    return {
+        "router": nrm(k1, (hidden_size, num_experts)),
+        "fc1": nrm(k2, (num_experts, hidden_size, ffn_hidden_size)),
+        "fc1_bias": jnp.zeros((num_experts, ffn_hidden_size), dtype),
+        "fc2": nrm(k3, (num_experts, ffn_hidden_size, hidden_size)),
+        "fc2_bias": jnp.zeros((num_experts, hidden_size), dtype),
+    }
+
+
+def _expert_constrain(x, ep_axis: Optional[str]):
+    """Shard the leading expert dim over the ep mesh axis (no-op when no
+    mesh / axis — same contract as the model's other constraints)."""
+    if ep_axis is None:
+        return x
+    return _constrain(x, P(ep_axis, *([None] * (x.ndim - 1))))
+
+
+def switch_moe_mlp(
+    params: dict,
+    x: jax.Array,
+    *,
+    capacity_factor: float = 1.25,
+    top_k: int = 1,
+    ep_axis: Optional[str] = "ep",
+    router_noise_rng: Optional[jax.Array] = None,
+) -> MoEOutput:
+    """Token-choice top-k MoE FFN over ``x`` [b, s, h].
+
+    Static shapes throughout: each expert processes a fixed capacity of
+    ``ceil(top_k * s * capacity_factor / E)`` token slots per batch row;
+    tokens over capacity fall through with a zero update (the Switch
+    drop-token rule) and are reported in ``dropped_fraction``.
+    """
+    b, s, h = x.shape
+    E = params["router"].shape[-1]
+    cap = max(1, int(top_k * s * capacity_factor / E))
+
+    logits = (x.astype(jnp.float32)
+              @ params["router"].astype(jnp.float32))  # [b, s, E]
+    if router_noise_rng is not None:
+        logits = logits + jax.random.uniform(
+            router_noise_rng, logits.shape, jnp.float32, -1e-2, 1e-2)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    combine = jnp.zeros((b, s, E, cap), jnp.float32)
+    remaining = probs
+    position_in_expert = jnp.zeros((b, E), jnp.int32)
+    dropped = jnp.zeros((), jnp.float32)
+    for _ in range(top_k):
+        choice = jnp.argmax(remaining, axis=-1)           # [b, s]
+        gate = jnp.take_along_axis(
+            remaining, choice[..., None], axis=-1)[..., 0]  # [b, s]
+        onehot = jax.nn.one_hot(choice, E)                 # [b, s, E]
+        # position of each token within its chosen expert's queue
+        pos = (jnp.cumsum(onehot, axis=1) - 1.0)           # [b, s, E]
+        pos_tok = jnp.sum(pos * onehot, axis=-1)           # [b, s]
+        pos_tok = pos_tok + jnp.take_along_axis(
+            position_in_expert.astype(jnp.float32),
+            choice, axis=-1)
+        keep = pos_tok < cap
+        dropped = dropped + jnp.sum(~keep) / (b * s * top_k)
+        slot = jax.nn.one_hot(
+            jnp.where(keep, pos_tok, cap).astype(jnp.int32),
+            cap)                                           # [b, s, cap]
+        combine = combine + (gate * keep)[..., None, None] \
+            * onehot[..., None] * slot[:, :, None, :]
+        position_in_expert = position_in_expert + jnp.sum(
+            (onehot * keep[..., None]).astype(jnp.int32), axis=1)
+        remaining = remaining * (1.0 - onehot)
+
+    dispatch = (combine > 0.0).astype(x.dtype)             # [b, s, E, cap]
+
+    # token-major -> expert-major (GSPMD inserts the all-to-all here)
+    expert_in = jnp.einsum(
+        "bsec,bsh->ebch", dispatch, x)                     # [E, b, cap, h]
+    expert_in = _expert_constrain(expert_in, ep_axis)
+    fc1 = _expert_constrain(params["fc1"], ep_axis)
+    fc2 = _expert_constrain(params["fc2"], ep_axis)
+    h1 = jnp.einsum("ebch,ehf->ebcf", expert_in, fc1.astype(x.dtype))
+    h1 = h1 + _expert_constrain(params["fc1_bias"], ep_axis)[
+        :, None, None, :].astype(x.dtype)
+    h1 = jax.nn.gelu(h1.astype(jnp.float32), approximate=False).astype(
+        x.dtype)
+    h2 = jnp.einsum("ebcf,efh->ebch", h1, fc2.astype(x.dtype))
+    h2 = h2 + _expert_constrain(params["fc2_bias"], ep_axis)[
+        :, None, None, :].astype(x.dtype)
+    h2 = _expert_constrain(h2, ep_axis)
+
+    # expert-major -> token-major, weighted by the router gates
+    out = jnp.einsum(
+        "bsec,ebch->bsh", combine.astype(x.dtype), h2)     # [b, s, h]
+
+    # load-balance aux loss (Switch eq. 4): E * Σ_e f_e * P_e
+    token_frac = jnp.mean(
+        jax.nn.one_hot(jnp.argmax(probs, -1), E), axis=(0, 1))
+    prob_frac = jnp.mean(probs, axis=(0, 1))
+    aux = E * jnp.sum(token_frac * prob_frac)
+
+    return MoEOutput(out=out.astype(x.dtype),
+                     aux_loss=aux,
+                     dropped_fraction=dropped / 1.0)

@@ -31,7 +31,7 @@ shard_map = jax.shard_map
 
 def test_create_mesh_shapes():
     mesh = create_mesh(tp=2, pp=2)
-    assert mesh.shape == {"pp": 2, "dp": 2, "sp": 1, "tp": 2}
+    assert mesh.shape == {"pp": 2, "dp": 2, "sp": 1, "ep": 1, "tp": 2}
     with pytest.raises(ValueError):
         create_mesh(tp=3)
     with pytest.raises(ValueError):
