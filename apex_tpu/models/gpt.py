@@ -41,8 +41,11 @@ __all__ = [
     "make_gpt_train_step",
     "make_gpt_pipeline_stage",
     "stack_pipeline_params",
+    "stack_pipeline_params_vpp",
+    "make_gpt_vpp_stage",
     "pipeline_packet",
     "gpt_pipeline_loss_and_grads",
+    "gpt_vpp_loss_and_grads",
 ]
 
 
@@ -313,3 +316,159 @@ def make_gpt_pipeline_stage(cfg: TransformerConfig, n_stages: int,
         return out
 
     return stage_fn
+
+
+# ---------------------------------------------------------------------------
+# interleaved virtual-pipeline (vpp) path
+# ---------------------------------------------------------------------------
+
+
+def stack_pipeline_params_vpp(params: dict, cfg: TransformerConfig,
+                              n_stages: int, vpp: int) -> dict:
+    """Cut the layer stack into ``n_stages * vpp`` chunks stacked
+    [vpp, pp, layers_per_chunk, ...] (chunk c = j*pp + d lives on device
+    d slot j — the interleaved schedule's placement,
+    reference fwd_bwd_pipelining_with_interleaving.py:26 / build_model
+    virtual chunks, schedules/common.py:30).
+
+    A ``chunk_id`` leaf rides along so the stage can tell which global
+    chunk it is holding (the schedule slices slot j and shard_map shards
+    device d; the value that arrives is exactly ``j*pp + d``).
+    """
+    L = cfg.num_layers
+    n_chunks = n_stages * vpp
+    if L % n_chunks:
+        raise ValueError(
+            f"num_layers {L} not divisible by pp*vpp = {n_chunks}")
+    per = L // n_chunks
+    layers = jax.tree_util.tree_map(
+        lambda v: v.reshape((vpp, n_stages, per) + v.shape[1:]),
+        params["layers"])
+    # the interleaved schedule slices slot j from EVERY leaf, so the
+    # replicated (embedding / final-LN / head) params get a broadcast
+    # leading vpp dim (lazy under jit — no real copy)
+    out = {
+        k: jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a, (vpp,) + a.shape), v)
+        for k, v in params.items() if k != "layers"
+    }
+    out["layers"] = layers
+    # float32 so the leaf is differentiable-typed (its grad is zero);
+    # value_and_grad in the schedule rejects integer params
+    out["chunk_id"] = jnp.arange(n_chunks, dtype=jnp.float32).reshape(
+        vpp, n_stages)
+    return out
+
+
+def make_gpt_vpp_stage(cfg: TransformerConfig, n_stages: int, vpp: int,
+                       tp: int = 1, *, tp_axis: str = "tp") -> Callable:
+    """Chunk-apply function for the interleaved schedule:
+    ``stage_fn(chunk_params, packet) -> packet``.
+
+    Chunk identity comes from the ``chunk_id`` leaf (global chunk
+    ``c = j*pp + my``): chunk 0 embeds, chunk ``pp*vpp - 1`` runs the
+    final norm + LM head + CE — both under ``lax.cond`` so only the
+    owning chunk pays the FLOPs (same argument as
+    :func:`make_gpt_pipeline_stage`).
+    """
+    from apex_tpu.utils.collectives import pvary as _pvary
+
+    ctx = manual_ctx(tp, tp_axis) if tp > 1 else single_device_ctx()
+    n_chunks = n_stages * vpp
+    pp_axis = "pp"
+
+    def stage_fn(sp: dict, packet: dict) -> dict:
+        cid = sp["chunk_id"][0] if sp["chunk_id"].ndim else sp["chunk_id"]
+        first = cid == 0
+        last = cid == n_chunks - 1
+        cd = cfg.compute_dtype
+        tokens, labels = packet["tokens"], packet["labels"]
+        mask = packet.get("attention_mask")
+        seed = packet.get("dropout_seed")
+        rng = None
+        if seed is not None and (
+                cfg.hidden_dropout > 0 or cfg.attention_dropout > 0):
+            rng = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                     cid.astype(jnp.int32))
+
+        h = jax.lax.cond(
+            first,
+            lambda: _pvary(
+                embed_tokens(sp["embedding"], tokens, cfg, ctx
+                             ).astype(packet["hidden"].dtype), pp_axis),
+            lambda: _pvary(packet["hidden"], pp_axis))
+
+        # this chunk's layer slice: leading dims already sliced down to
+        # the local (per-chunk) stack by the schedule + shard_map
+        layers = jax.tree_util.tree_map(lambda v: v[0], sp["layers"])
+        h = transformer_backbone({"layers": layers}, h, cfg, ctx,
+                                 attention_mask=mask, dropout_rng=rng,
+                                 apply_final_norm=False)
+
+        def head_and_ce(h_in):
+            h_final = apply_norm(cfg, h_in, sp["final_ln"]["scale"],
+                                 sp["final_ln"]["bias"])
+            logits = lm_head_logits(sp, h_final, cfg)
+            return lm_cross_entropy(logits, labels, ctx)
+
+        loss = jax.lax.cond(
+            last, head_and_ce,
+            lambda _h: _pvary(jnp.float32(0.0), pp_axis), h)
+
+        out = {
+            "hidden": h.astype(cd),
+            "tokens": tokens,
+            "labels": labels,
+            "loss": loss,
+        }
+        if mask is not None:
+            out["attention_mask"] = mask
+        if seed is not None:
+            out["dropout_seed"] = seed
+        return out
+
+    return stage_fn
+
+
+def gpt_vpp_loss_and_grads(
+    stage_fn: Callable,
+    stacked_params: dict,
+    packets: dict,
+    *,
+    n_micro: int,
+    vpp: int,
+    pp_axis: str = "pp",
+    remat: bool = True,
+):
+    """Interleaved-schedule loss+grads for GPT; call inside shard_map.
+
+    Same grad handling as :func:`gpt_pipeline_loss_and_grads`: layer
+    grads are per-chunk exact, the replicated embedding/head/final-LN
+    grads are psum'd over 'pp' (embedding-group allreduce analog)."""
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_pipelining_with_interleaving,
+    )
+    from apex_tpu.utils.collectives import pvary
+
+    varying = pvary(stacked_params, pp_axis)
+    loss, grads = forward_backward_pipelining_with_interleaving(
+        stage_fn, packets, varying,
+        n_micro=n_micro,
+        num_model_chunks=vpp,
+        loss_fn=lambda out, _mb: out["loss"],
+        axis=pp_axis,
+        remat=remat,
+    )
+    # layers: exact per-chunk grads, stacked.  Replicated params: sum the
+    # per-slot contributions (vpp dim) then psum over pp (the embedding-
+    # group allreduce analog).  chunk_id is a constant — dropped.
+    out = {}
+    for k, v in grads.items():
+        if k == "layers":
+            out[k] = v
+        elif k == "chunk_id":
+            continue
+        else:
+            out[k] = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(jnp.sum(g, axis=0), pp_axis), v)
+    return loss, out
