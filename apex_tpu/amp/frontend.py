@@ -20,7 +20,6 @@ functions where
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Callable, NamedTuple, Optional, Tuple, Union
 
 import jax

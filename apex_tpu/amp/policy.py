@@ -23,7 +23,7 @@ O5    bf16         bf16           yes         yes             1.0
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Optional, Union
+from typing import Any, Callable, Union
 
 import jax
 import jax.numpy as jnp

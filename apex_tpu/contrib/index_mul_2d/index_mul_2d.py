@@ -13,7 +13,6 @@ upcast inside the custom VJP below.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

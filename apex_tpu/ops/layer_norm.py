@@ -25,7 +25,7 @@ input dtype, params may be fp32 while inputs are bf16 (the Megatron
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

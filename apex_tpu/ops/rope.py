@@ -22,7 +22,6 @@ ignored (XLA owns memory layout).
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -15,7 +15,6 @@ avoids saving silu activations (recomputes from x+bias like the reference).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

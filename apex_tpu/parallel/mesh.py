@@ -24,7 +24,7 @@ let XLA insert collectives.)
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import numpy as np

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 from typing import Sequence, Tuple
 
-import jax
 
 from apex_tpu.amp import scaler as scaler_lib
 from apex_tpu.utils.collectives import flag_or

@@ -20,7 +20,7 @@ with dp/tp the same way the rest of the model does.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp

@@ -10,7 +10,7 @@ here); ``write`` returns the scalars instead.
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 import jax
 

@@ -20,10 +20,9 @@ direction the permutation points.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 import jax
-import jax.numpy as jnp
 
 from apex_tpu.transformer.parallel_state import PP_AXIS
 

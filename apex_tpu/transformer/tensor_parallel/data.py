@@ -13,7 +13,6 @@ from __future__ import annotations
 from typing import Dict
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.transformer.tensor_parallel.layers import constrain

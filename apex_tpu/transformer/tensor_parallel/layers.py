@@ -26,14 +26,13 @@ are the recommended (GSPMD) path.
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Callable
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from apex_tpu.transformer.utils import divide
 
 __all__ = [
     "VocabParallelEmbedding",

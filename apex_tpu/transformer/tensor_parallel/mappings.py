@@ -30,7 +30,6 @@ from __future__ import annotations
 import functools
 
 import jax
-import jax.numpy as jnp
 
 from apex_tpu.transformer.parallel_state import TP_AXIS
 

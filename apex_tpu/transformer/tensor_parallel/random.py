@@ -17,7 +17,7 @@ exists for API parity and for code that wants named streams.
 from __future__ import annotations
 
 import contextlib
-from typing import Dict, Optional
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

@@ -7,7 +7,6 @@ behind ``get_*`` accessors with initialize-once semantics.
 
 from __future__ import annotations
 
-from typing import Optional
 
 __all__ = [
     "get_args",

@@ -3,7 +3,6 @@ of the reference's monkey-patch engine, apex/amp/wrap.py)."""
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from apex_tpu.amp.frontend import make_train_step
 from apex_tpu.amp.patch import amp_patch_scope

@@ -5,7 +5,6 @@ run_amp/test_checkpointing.py (amp state_dict round-trip preserving the
 loss scaler), and the ADLR AutoResume hook shape.
 """
 
-import os
 
 import jax
 import jax.numpy as jnp

@@ -8,7 +8,6 @@ norm-add residual behavior, additive masks, and dropout statistics.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from apex_tpu.contrib.multihead_attn import (
     SelfMultiheadAttn,

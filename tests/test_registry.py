@@ -1,7 +1,6 @@
 """Op registry tests (reference analog: tests/test_extension_import.py —
 every compatibility shim imports; here: every registered op resolves)."""
 
-import os
 
 import pytest
 

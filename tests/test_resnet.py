@@ -9,7 +9,6 @@ two_gpu_unit_test.py: multi-rank BN == single-rank BN on the full batch).
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from apex_tpu.models.resnet import (
     make_resnet_train_step,
