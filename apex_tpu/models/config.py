@@ -45,6 +45,13 @@ class TransformerConfig:
     untie_embeddings_and_output_weights: bool = False
     layernorm_epsilon: float = 1e-5
 
+    # mixture-of-experts (beyond the reference; transformer/moe.py)
+    num_experts: "Optional[int]" = None           # None = dense FFN
+    moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1
+    moe_aux_loss_coeff: float = 1e-2
+    moe_ep_axis: str = "ep"                       # expert mesh axis name
+
     # regularization
     hidden_dropout: float = 0.0
     attention_dropout: float = 0.0

@@ -239,6 +239,13 @@ def make_gpt_pipeline_stage(cfg: TransformerConfig, n_stages: int,
     tp group share one pp index, so the vocab-parallel collectives inside
     the branch cannot diverge across a tp group.
     """
+    if cfg.num_experts:
+        raise NotImplementedError(
+            "MoE configs are not supported on the shard_map pipeline "
+            "path yet: the stage fns do not thread the load-balance aux "
+            "loss, and expert sharding inside shard_map needs local-"
+            "shard routing. Use the GSPMD path (make_gpt_train_step "
+            "over a mesh with an 'ep' axis).")
     ctx = manual_ctx(tp, tp_axis) if tp > 1 else single_device_ctx()
 
     def stage_fn(sp: dict, packet: dict) -> dict:
@@ -373,6 +380,10 @@ def make_gpt_vpp_stage(cfg: TransformerConfig, n_stages: int, vpp: int,
     """
     from apex_tpu.utils.collectives import pvary as _pvary
 
+    if cfg.num_experts:
+        raise NotImplementedError(
+            "MoE configs are not supported on the shard_map pipeline "
+            "path yet (see make_gpt_pipeline_stage); use the GSPMD path.")
     ctx = manual_ctx(tp, tp_axis) if tp > 1 else single_device_ctx()
     n_chunks = n_stages * vpp
     pp_axis = "pp"

@@ -185,24 +185,41 @@ def init_gpt_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
     fc1_shape = ((L, h, 2, f) if cfg.activation == "swiglu" else (L, h, f))
     fc1_bias_shape = ((L, 2, f) if cfg.activation == "swiglu" else (L, f))
 
-    params = {
-        "embedding": {
-            "word": nrm(ks[0], (cfg.vocab_size, h), std),
-        },
-        "layers": {
-            "ln1_scale": jnp.ones((L, h), dt),
-            "ln1_bias": jnp.zeros((L, h), dt),
-            "qkv_kernel": nrm(ks[1], (L, h, 3 * p), std),
-            "qkv_bias": jnp.zeros((L, 3 * p), dt),
-            "proj_kernel": nrm(ks[2], (L, p, h), out_std),
-            "proj_bias": jnp.zeros((L, h), dt),
-            "ln2_scale": jnp.ones((L, h), dt),
-            "ln2_bias": jnp.zeros((L, h), dt),
+    layers = {
+        "ln1_scale": jnp.ones((L, h), dt),
+        "ln1_bias": jnp.zeros((L, h), dt),
+        "qkv_kernel": nrm(ks[1], (L, h, 3 * p), std),
+        "qkv_bias": jnp.zeros((L, 3 * p), dt),
+        "proj_kernel": nrm(ks[2], (L, p, h), out_std),
+        "proj_bias": jnp.zeros((L, h), dt),
+        "ln2_scale": jnp.ones((L, h), dt),
+        "ln2_bias": jnp.zeros((L, h), dt),
+    }
+    if cfg.num_experts:
+        if cfg.activation == "swiglu":
+            raise NotImplementedError(
+                "MoE layers currently pair with the gelu FFN")
+        E = cfg.num_experts
+        layers.update({
+            "router_kernel": nrm(ks[3], (L, h, E), std),
+            "moe_fc1": nrm(ks[4], (L, E, h, f), std),
+            "moe_fc1_bias": jnp.zeros((L, E, f), dt),
+            "moe_fc2": nrm(ks[7], (L, E, f, h), out_std),
+            "moe_fc2_bias": jnp.zeros((L, E, h), dt),
+        })
+    else:
+        layers.update({
             "fc1_kernel": nrm(ks[3], fc1_shape, std),
             "fc1_bias": jnp.zeros(fc1_bias_shape, dt),
             "fc2_kernel": nrm(ks[4], (L, f, h), out_std),
             "fc2_bias": jnp.zeros((L, h), dt),
+        })
+
+    params = {
+        "embedding": {
+            "word": nrm(ks[0], (cfg.vocab_size, h), std),
         },
+        "layers": layers,
         "final_ln": {
             "scale": jnp.ones((h,), dt),
             "bias": jnp.zeros((h,), dt),
@@ -230,24 +247,39 @@ def gpt_param_specs(cfg: TransformerConfig, *, tp_axis: str = "tp",
     pp = (pp_axis,) if pp_axis else ()
     swiglu = cfg.activation == "swiglu"
 
-    specs = {
-        "embedding": {"word": P(t, None)},
-        "layers": {
-            "ln1_scale": P(*pp, None, None),
-            "ln1_bias": P(*pp, None, None),
-            "qkv_kernel": P(*pp, None, None, t),
-            "qkv_bias": P(*pp, None, t),
-            "proj_kernel": P(*pp, None, t, None),
-            "proj_bias": P(*pp, None, None),
-            "ln2_scale": P(*pp, None, None),
-            "ln2_bias": P(*pp, None, None),
+    layer_specs = {
+        "ln1_scale": P(*pp, None, None),
+        "ln1_bias": P(*pp, None, None),
+        "qkv_kernel": P(*pp, None, None, t),
+        "qkv_bias": P(*pp, None, t),
+        "proj_kernel": P(*pp, None, t, None),
+        "proj_bias": P(*pp, None, None),
+        "ln2_scale": P(*pp, None, None),
+        "ln2_bias": P(*pp, None, None),
+    }
+    if cfg.num_experts:
+        # experts shard over cfg.moe_ep_axis; the router stays replicated
+        ep = cfg.moe_ep_axis
+        layer_specs.update({
+            "router_kernel": P(*pp, None, None, None),
+            "moe_fc1": P(*pp, None, ep, None, None),
+            "moe_fc1_bias": P(*pp, None, ep, None),
+            "moe_fc2": P(*pp, None, ep, None, None),
+            "moe_fc2_bias": P(*pp, None, ep, None),
+        })
+    else:
+        layer_specs.update({
             "fc1_kernel": (P(*pp, None, None, None, t) if swiglu
                            else P(*pp, None, None, t)),
             "fc1_bias": (P(*pp, None, None, t) if swiglu
                          else P(*pp, None, t)),
             "fc2_kernel": P(*pp, None, t, None),
             "fc2_bias": P(*pp, None, None),
-        },
+        })
+
+    specs = {
+        "embedding": {"word": P(t, None)},
+        "layers": layer_specs,
         "final_ln": {"scale": P(None), "bias": P(None)},
     }
     if cfg.position_embedding_type == "learned":
@@ -382,6 +414,28 @@ def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
     return out + lp["proj_bias"].astype(x.dtype)
 
 
+def _moe_mlp(cfg: TransformerConfig, lp: dict, x):
+    """Switch MoE FFN (transformer/moe.py) in place of the dense MLP when
+    ``cfg.num_experts`` is set; returns (out, aux_loss).  Experts shard
+    over the 'ep' mesh axis under GSPMD; tp inside experts is not
+    combined (experts ARE the parallelism for the FFN block)."""
+    from apex_tpu.transformer.moe import switch_moe_mlp
+
+    moe_params = {
+        "router": lp["router_kernel"],
+        "fc1": lp["moe_fc1"],
+        "fc1_bias": lp["moe_fc1_bias"],
+        "fc2": lp["moe_fc2"],
+        "fc2_bias": lp["moe_fc2_bias"],
+    }
+    o = switch_moe_mlp(
+        moe_params, x,
+        capacity_factor=cfg.moe_capacity_factor,
+        top_k=cfg.moe_top_k,
+        ep_axis=cfg.moe_ep_axis)
+    return o.out, o.aux_loss
+
+
 def _mlp(cfg: TransformerConfig, lp: dict, x, ctx: TPContext):
     """ParallelMLP (reference :165): column-parallel fc1 + fused bias-act,
     row-parallel fc2 (fused bias_swiglu / bias+gelu epilogues)."""
@@ -421,9 +475,13 @@ def _layer(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
     with jax.named_scope("ln2"):
         h = apply_norm(cfg, x, lp["ln2_scale"], lp["ln2_bias"])
     with jax.named_scope("mlp"):
-        m = _mlp(cfg, lp, h, ctx)
+        if cfg.num_experts:
+            m, aux = _moe_mlp(cfg, lp, h)
+        else:
+            m = _mlp(cfg, lp, h, ctx)
+            aux = jnp.float32(0.0)
     x = x + _dropout(m, cfg.hidden_dropout, r3)
-    return ctx.constrain_hidden(x)
+    return ctx.constrain_hidden(x), aux
 
 
 def vocab_parallel_embed(table, tokens, ctx: TPContext):
@@ -468,8 +526,12 @@ def lm_head_logits(params: dict, hidden, cfg: TransformerConfig):
 
 def transformer_backbone(params: dict, hidden, cfg: TransformerConfig,
                          ctx: TPContext, *, attention_mask=None,
-                         dropout_rng=None, apply_final_norm: bool = True):
-    """The scanned decoder stack + final norm. ``hidden`` [b, s, h]."""
+                         dropout_rng=None, apply_final_norm: bool = True,
+                         with_aux: bool = False):
+    """The scanned decoder stack + final norm. ``hidden`` [b, s, h].
+
+    ``with_aux=True`` additionally returns the summed per-layer auxiliary
+    loss (the MoE load-balance term; 0 for dense configs)."""
     s = hidden.shape[1]
     rope = None
     if cfg.position_embedding_type == "rope":
@@ -477,10 +539,12 @@ def transformer_backbone(params: dict, hidden, cfg: TransformerConfig,
 
     n_layers = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
 
-    def body(x, layer_in):
+    def body(carry, layer_in):
+        x, aux_acc = carry
         lp, key = layer_in
         rngs = jax.random.split(key, 3) if key is not None else None
-        return _layer(cfg, lp, x, ctx, attention_mask, rope, rngs), None
+        x, aux = _layer(cfg, lp, x, ctx, attention_mask, rope, rngs)
+        return (x, aux_acc + aux), None
 
     step = jax.checkpoint(body) if cfg.remat else body
 
@@ -488,22 +552,27 @@ def transformer_backbone(params: dict, hidden, cfg: TransformerConfig,
         cfg.hidden_dropout > 0 or cfg.attention_dropout > 0)
     keys = jax.random.split(dropout_rng, n_layers) if needs_rng else None
 
+    aux0 = jnp.float32(0.0)
     if cfg.scan_layers:
-        hidden, _ = jax.lax.scan(step, hidden, (params["layers"], keys))
+        (hidden, aux), _ = jax.lax.scan(
+            step, (hidden, aux0), (params["layers"], keys))
     else:
+        carry = (hidden, aux0)
         for i in range(n_layers):
             lp = jax.tree_util.tree_map(lambda v: v[i], params["layers"])
-            hidden, _ = step(hidden, (lp, keys[i] if needs_rng else None))
+            carry, _ = step(carry, (lp, keys[i] if needs_rng else None))
+        hidden, aux = carry
 
     if not apply_final_norm:
-        return hidden
-    return apply_norm(cfg, hidden, params["final_ln"]["scale"],
-                 params["final_ln"]["bias"])
+        return (hidden, aux) if with_aux else hidden
+    out = apply_norm(cfg, hidden, params["final_ln"]["scale"],
+                     params["final_ln"]["bias"])
+    return (out, aux) if with_aux else out
 
 
 def gpt_forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
                 ctx: Optional[TPContext] = None, *, attention_mask=None,
-                dropout_rng=None) -> jax.Array:
+                dropout_rng=None, with_aux: bool = False):
     """Token ids [b, s] → logits (reference GPTModel.forward,
     standalone_gpt.py:45 → TransformerLanguageModel :1358 →
     parallel_lm_logits :1130).
@@ -514,10 +583,11 @@ def gpt_forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     ctx = ctx or single_device_ctx()
     h = ctx.constrain_hidden(embed_tokens(params["embedding"], tokens,
                                           cfg, ctx))
-    h = transformer_backbone(params, h, cfg, ctx,
-                             attention_mask=attention_mask,
-                             dropout_rng=dropout_rng)
-    return lm_head_logits(params, h, cfg)
+    h, aux = transformer_backbone(params, h, cfg, ctx,
+                                  attention_mask=attention_mask,
+                                  dropout_rng=dropout_rng, with_aux=True)
+    logits = lm_head_logits(params, h, cfg)
+    return (logits, aux) if with_aux else logits
 
 
 def gpt_loss(params: dict, tokens: jax.Array, labels: jax.Array,
@@ -530,10 +600,14 @@ def gpt_loss(params: dict, tokens: jax.Array, labels: jax.Array,
     models; causal masking needs none.
     """
     ctx = ctx or single_device_ctx()
-    logits = gpt_forward(params, tokens, cfg, ctx,
-                         attention_mask=attention_mask,
-                         dropout_rng=dropout_rng)
-    return lm_cross_entropy(logits, labels, ctx)
+    logits, aux = gpt_forward(params, tokens, cfg, ctx,
+                              attention_mask=attention_mask,
+                              dropout_rng=dropout_rng, with_aux=True)
+    loss = lm_cross_entropy(logits, labels, ctx)
+    if cfg.num_experts:
+        # Switch load-balance term, mean over layers
+        loss = loss + cfg.moe_aux_loss_coeff * aux / cfg.num_layers
+    return loss
 
 
 def lm_cross_entropy(logits, labels, ctx: TPContext) -> jax.Array:
