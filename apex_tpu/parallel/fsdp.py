@@ -1,0 +1,63 @@
+"""FSDP / ZeRO-3-style fully-sharded parameters under GSPMD.
+
+Beyond the reference: apex stops at ZeRO-2 (optimizer-state sharding,
+``DistributedFusedAdam``).  On a TPU mesh, full parameter sharding is a
+*placement decision*, not a runtime: shard every parameter (and its
+master copy and optimizer state, which inherit the placement through the
+AMP train step) across the ``dp`` axis, and GSPMD inserts the
+all-gathers before each layer's compute and the reduce-scatters in the
+backward — the latency-hiding scheduler overlaps them with compute the
+way hand-written FSDP prefetch does.
+
+Usage::
+
+    mesh = create_mesh()                       # dp = world
+    init_fn, step_fn = make_train_step(loss_fn, fused_adam(1e-3), "O2")
+    state = init_fn(params)
+    state = jax.device_put(state, fsdp_shardings(state, mesh))
+    step = jax.jit(step_fn, donate_argnums=0)
+    with jax.set_mesh(mesh):
+        state, metrics = step(state, *batch)   # batch sharded over dp
+
+Works with every optimizer in :mod:`apex_tpu.optimizers` (their state
+pytrees mirror param shapes, so :func:`fsdp_shardings` shards them the
+same way).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["fsdp_spec", "fsdp_shardings"]
+
+
+def fsdp_spec(shape, ndev: int, axis: str = "dp") -> P:
+    """Shard the largest divisible dim of ``shape`` over ``axis``;
+    replicate leaves too small or oddly shaped to split (the scalar /
+    norm-vector case — same policy as t5x/maxtext FSDP rules)."""
+    best = None
+    for i, d in enumerate(shape):
+        if d % ndev == 0 and (best is None or d > shape[best]):
+            best = i
+    if best is None:
+        return P()
+    return P(*(axis if i == best else None for i in range(len(shape))))
+
+
+def fsdp_shardings(tree: Any, mesh: Mesh, axis: str = "dp"):
+    """NamedSharding pytree for ``tree``: every float array leaf sharded
+    per :func:`fsdp_spec`, everything else replicated."""
+    ndev = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+
+    def one(x):
+        if (hasattr(x, "shape") and hasattr(x, "dtype")
+                and jnp.issubdtype(x.dtype, jnp.floating)
+                and len(getattr(x, "shape", ())) >= 1):
+            return NamedSharding(mesh, fsdp_spec(x.shape, ndev, axis))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(one, tree)
