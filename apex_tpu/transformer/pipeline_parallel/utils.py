@@ -103,3 +103,26 @@ def split_batch_into_microbatches(batch, n_micro: int):
         return v.reshape(n_micro, b // n_micro, *v.shape[1:])
 
     return jax.tree_util.tree_map(leaf, batch)
+
+
+def print_params_min_max_norm(params, iteration: int) -> str:
+    """Debug dump: per-parameter (min, max, l2-norm) — reference
+    pipeline_parallel/utils.py:265 ``print_params_min_max_norm`` (which
+    walks optimizer param groups; here the pytree)."""
+    import jax.numpy as jnp
+
+    lines = [f"iteration {iteration}"]
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    for path, leaf in flat:
+        if not hasattr(leaf, "dtype") or not jnp.issubdtype(
+                leaf.dtype, jnp.floating):
+            continue
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        v = leaf.astype(jnp.float32)
+        lines.append(
+            f"  {name}: min {float(v.min()):+.3e} "
+            f"max {float(v.max()):+.3e} "
+            f"norm {float(jnp.sqrt(jnp.sum(v * v))):.3e}")
+    report = "\n".join(lines)
+    print_rank_0(report)
+    return report
