@@ -107,3 +107,47 @@ def test_flat_adam_kernel_on_chip():
     u_ref = -1e-3 * (m_ref / 0.1) / (np.sqrt(v_ref / 0.001999) + 1e-8)
     np.testing.assert_allclose(np.asarray(m2), m_ref, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(u), u_ref, rtol=1e-4, atol=1e-7)
+
+
+def test_xentropy_kernel_on_chip():
+    from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+    rs = np.random.RandomState(5)
+    logits = jnp.asarray(rs.randn(64, 512), jnp.float32)
+    labels = jnp.asarray(rs.randint(0, 512, (64,)), jnp.int32)
+    got = softmax_cross_entropy_loss(logits, labels)
+    lse = np.log(np.exp(np.asarray(logits)).sum(-1))
+    want = lse - np.asarray(logits)[np.arange(64), np.asarray(labels)]
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4, rtol=1e-4)
+
+
+def test_rope_kernel_on_chip():
+    from apex_tpu.ops.rope import fused_apply_rotary_pos_emb
+
+    rs = np.random.RandomState(6)
+    s, d = 128, 64
+    t = jnp.asarray(rs.randn(s, 2, 4, d), jnp.float32)  # [s,b,n,d]
+    inv = 1.0 / (10000 ** (np.arange(0, d, 2) / d))
+    pos = np.arange(s)[:, None] * inv[None, :]
+    freqs = jnp.asarray(
+        np.concatenate([pos, pos], -1)[:, None, None, :], jnp.float32)
+    got = np.asarray(fused_apply_rotary_pos_emb(t, freqs))
+    cos = np.cos(np.concatenate([pos, pos], -1))[:, None, None, :]
+    sin = np.sin(np.concatenate([pos, pos], -1))[:, None, None, :]
+    x = np.asarray(t)
+    rot = np.concatenate([-x[..., d // 2:], x[..., : d // 2]], -1)
+    want = x * cos + rot * sin
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_swiglu_kernel_on_chip():
+    from apex_tpu.ops.swiglu import fused_bias_swiglu
+
+    rs = np.random.RandomState(7)
+    y = jnp.asarray(rs.randn(64, 2 * 256), jnp.float32)
+    b = jnp.asarray(rs.randn(2 * 256), jnp.float32)
+    got = np.asarray(fused_bias_swiglu(y, b))
+    yb = np.asarray(y) + np.asarray(b)
+    gate, up = yb[:, :256], yb[:, 256:]
+    silu = gate / (1.0 + np.exp(-gate))
+    np.testing.assert_allclose(got, silu * up, atol=2e-5, rtol=2e-5)
