@@ -178,6 +178,15 @@ def make_train_step(
             # fp32 main-grad accumulation across microbatches (see
             # docstring).  The scan carries the main_grad buffer; each
             # microbatch's scaled grads are cast up before the add.
+            # ``aux`` is reported from the LAST microbatch only (losses
+            # are averaged; auxiliary outputs are not).
+            for v in jax.tree_util.tree_leaves(tuple(batch)):
+                if hasattr(v, "shape") and v.shape and (
+                        v.shape[0] % accum_steps):
+                    raise ValueError(
+                        f"accum_steps={accum_steps} does not divide the "
+                        f"leading batch dimension {v.shape[0]}; pad or "
+                        f"resize the batch so every microbatch is equal.")
             micro = jax.tree_util.tree_map(
                 lambda v: v.reshape(
                     (accum_steps, v.shape[0] // accum_steps)

@@ -25,17 +25,30 @@ Known deviations (documented; reference wrap.py has the same hole for
   it.  Keep O1 user code un-jitted at the top level (the AMP step jits
   the whole thing) or decorate precision-sensitive helpers explicitly
   with :func:`apex_tpu.amp.lists.float_function`.
+
+Thread safety: the module attributes are process-global, but the
+installed wrappers consult a *thread-local* activation flag — a trace
+running concurrently in another thread calls straight through to the
+originals, and tear-down restores the attributes under a lock only when
+the last scope in the process exits.  Entering scopes with *different*
+compute dtypes concurrently is fine (each thread sees its own dtype).
 """
 
 from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
 
 __all__ = ["amp_patch_scope", "PATCHED_COMPUTE", "PATCHED_FP32"]
+
+_tls = threading.local()          # .depth (int), .compute_dtype
+_global_lock = threading.Lock()   # guards the module-attribute swap
+_scope_count = 0                  # process-wide count of live scopes
+_saved: list = []                 # originals while any scope is live
 
 
 def _is_array(x) -> bool:
@@ -82,10 +95,20 @@ PATCHED_FP32 = [
 ]
 
 
-def _wrap_compute(fn, compute_dtype):
+def _active_dtype():
+    """The calling thread's compute dtype, or None if no scope is active
+    on this thread (other threads call through to the originals)."""
+    if getattr(_tls, "depth", 0) > 0:
+        return _tls.compute_dtype
+    return None
+
+
+def _wrap_compute(fn):
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
-        args, kwargs = _cast_tree(args, kwargs, _is_f32, compute_dtype)
+        dtype = _active_dtype()
+        if dtype is not None:
+            args, kwargs = _cast_tree(args, kwargs, _is_f32, dtype)
         return fn(*args, **kwargs)
 
     wrapped.__amp_patched__ = True
@@ -95,7 +118,9 @@ def _wrap_compute(fn, compute_dtype):
 def _wrap_fp32(fn):
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
-        args, kwargs = _cast_tree(args, kwargs, _is_low_float, jnp.float32)
+        if _active_dtype() is not None:
+            args, kwargs = _cast_tree(
+                args, kwargs, _is_low_float, jnp.float32)
         return fn(*args, **kwargs)
 
     wrapped.__amp_patched__ = True
@@ -105,22 +130,31 @@ def _wrap_fp32(fn):
 @contextlib.contextmanager
 def amp_patch_scope(compute_dtype=jnp.bfloat16):
     """Patch jax entry points per the O1 cast lists for the duration of
-    the block (trace-time; see module docstring)."""
-    saved = []
+    the block (trace-time; thread-safe — see module docstring)."""
+    global _scope_count
+    with _global_lock:
+        if _scope_count == 0:
+            for mod, name in PATCHED_COMPUTE:
+                orig = getattr(mod, name)
+                _saved.append((mod, name, orig))
+                setattr(mod, name, _wrap_compute(orig))
+            for mod, name in PATCHED_FP32:
+                orig = getattr(mod, name)
+                _saved.append((mod, name, orig))
+                setattr(mod, name, _wrap_fp32(orig))
+        _scope_count += 1
+    prev_depth = getattr(_tls, "depth", 0)
+    prev_dtype = getattr(_tls, "compute_dtype", None)
+    _tls.depth = prev_depth + 1
+    _tls.compute_dtype = compute_dtype
     try:
-        for mod, name in PATCHED_COMPUTE:
-            orig = getattr(mod, name)
-            if getattr(orig, "__amp_patched__", False):
-                continue  # re-entrant use
-            saved.append((mod, name, orig))
-            setattr(mod, name, _wrap_compute(orig, compute_dtype))
-        for mod, name in PATCHED_FP32:
-            orig = getattr(mod, name)
-            if getattr(orig, "__amp_patched__", False):
-                continue
-            saved.append((mod, name, orig))
-            setattr(mod, name, _wrap_fp32(orig))
         yield
     finally:
-        for mod, name, orig in saved:
-            setattr(mod, name, orig)
+        _tls.depth = prev_depth
+        _tls.compute_dtype = prev_dtype
+        with _global_lock:
+            _scope_count -= 1
+            if _scope_count == 0:
+                while _saved:
+                    mod, name, orig = _saved.pop()
+                    setattr(mod, name, orig)

@@ -13,6 +13,7 @@ the vectorized-numpy implementation when the toolchain is unavailable
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -22,20 +23,57 @@ import numpy as np
 _SRC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), "csrc", "permutation_search.cpp")
-_LIB = os.path.join(os.path.dirname(_SRC), "libpermsearch.so")
 
 _lock = threading.Lock()
 _lib = None
 _tried = False
 
 
-def _build() -> bool:
+def _cache_dir() -> str:
+    # Prefer the package's csrc/ dir; fall back to a per-user cache when
+    # the install is read-only (e.g. root-owned site-packages).
+    pkg_dir = os.path.dirname(_SRC)
+    if os.access(pkg_dir, os.W_OK):
+        return pkg_dir
+    import tempfile
+    d = os.path.join(tempfile.gettempdir(),
+                     f"apex_tpu-permsearch-{os.getuid()}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _lib_path() -> str:
+    # Cache keyed on a hash of the source (mtimes do not survive a git
+    # checkout); the .so itself is never committed.
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    cache = _cache_dir()
+    # Prune caches left by previous source revisions.
+    for stale in os.listdir(cache):
+        if (stale.startswith("libpermsearch-") and stale.endswith(".so")
+                and stale != f"libpermsearch-{digest}.so"):
+            try:
+                os.remove(os.path.join(cache, stale))
+            except OSError:
+                pass
+    return os.path.join(cache, f"libpermsearch-{digest}.so")
+
+
+def _build(lib_path: str) -> bool:
+    # Compile to a temp name then rename: the build must be atomic so a
+    # concurrent process never CDLLs a half-written library.
+    tmp = f"{lib_path}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120)
+        os.replace(tmp, lib_path)
         return True
     except (OSError, subprocess.SubprocessError):
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -47,15 +85,21 @@ def _load():
         _tried = True
         if os.environ.get("APEX_TPU_DISABLE_NATIVE") == "1":
             return None
-        if not os.path.exists(_LIB) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
-            if not _build():
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError:
+        if not os.path.exists(_SRC):
             return None
+        lib_path = _lib_path()
+        if not os.path.exists(lib_path) and not _build(lib_path):
+            return None
+        try:
+            lib = ctypes.CDLL(lib_path)
+        except OSError:
+            # Stale/foreign-arch cache: rebuild once and retry.
+            if not _build(lib_path):
+                return None
+            try:
+                lib = ctypes.CDLL(lib_path)
+            except OSError:
+                return None
         f64, i64, i32p = ctypes.c_double, ctypes.c_int64, ctypes.POINTER(
             ctypes.c_int32)
         f32p, f64p = ctypes.POINTER(ctypes.c_float), ctypes.POINTER(f64)

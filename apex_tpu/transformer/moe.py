@@ -20,6 +20,7 @@ with dp/tp the same way the rest of the model does.
 
 from __future__ import annotations
 
+import math
 from typing import NamedTuple, Optional
 
 import jax
@@ -88,7 +89,7 @@ def switch_moe_mlp(
     """
     b, s, h = x.shape
     E = params["router"].shape[-1]
-    cap = max(1, int(top_k * s * capacity_factor / E))
+    cap = max(1, math.ceil(top_k * s * capacity_factor / E))
 
     logits = (x.astype(jnp.float32)
               @ params["router"].astype(jnp.float32))  # [b, s, E]

@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
 from apex_tpu.testing import skipFlakyTest, skipIfNoTPU, skipIfTPU
