@@ -180,18 +180,30 @@ def make_train_step(
             # microbatch's scaled grads are cast up before the add.
             # ``aux`` is reported from the LAST microbatch only (losses
             # are averaged; auxiliary outputs are not).
-            for v in jax.tree_util.tree_leaves(tuple(batch)):
+            def _is_prng_key(v):
+                # typed keys, or the legacy raw (2,) uint32 layout the
+                # dropout-enabled step signatures pass
+                if jax.dtypes.issubdtype(getattr(v, "dtype", None),
+                                         jax.dtypes.prng_key):
+                    return True
+                return (getattr(v, "dtype", None) == jnp.uint32
+                        and getattr(v, "shape", None) == (2,))
+
+            def _split_leaf(v):
+                # PRNG keys are not batch data: give each microbatch its
+                # own derived key instead of reshaping key words apart
+                if _is_prng_key(v):
+                    return jax.random.split(v, accum_steps)
                 if hasattr(v, "shape") and v.shape and (
                         v.shape[0] % accum_steps):
                     raise ValueError(
                         f"accum_steps={accum_steps} does not divide the "
                         f"leading batch dimension {v.shape[0]}; pad or "
                         f"resize the batch so every microbatch is equal.")
-            micro = jax.tree_util.tree_map(
-                lambda v: v.reshape(
-                    (accum_steps, v.shape[0] // accum_steps)
-                    + v.shape[1:]),
-                tuple(batch))
+                return v.reshape(
+                    (accum_steps, v.shape[0] // accum_steps) + v.shape[1:])
+
+            micro = jax.tree_util.tree_map(_split_leaf, tuple(batch))
 
             def one_micro(main_grad, mb):
                 g, (l, aux_mb) = jax.grad(

@@ -57,6 +57,7 @@ def make_gpt_train_step(
     *,
     seq_axis: Optional[str] = None,
     grad_postprocess: Optional[Callable] = None,
+    fsdp: bool = False,
 ):
     """GSPMD data/tensor/sequence-parallel AMP train step.
 
@@ -65,6 +66,13 @@ def make_gpt_train_step(
     ``step_fn(state, tokens, labels)`` is the full O2-style AMP step
     (scale → grad → unscale+finite-check → fused update → skip-on-overflow)
     with gradient mean over 'dp' handled by GSPMD sharding propagation.
+
+    ``fsdp=True`` (ZeRO-3) additionally shards every parameter — and,
+    through the state pytree, its fp32 master and optimizer moments —
+    over the 'dp' axis on top of the tp specs (parallel/fsdp.py
+    ``fsdp_augment_specs``); GSPMD inserts the per-layer all-gathers and
+    backward reduce-scatters.  Beyond the reference: apex stops at
+    ZeRO-2 (DistributedFusedAdam's optimizer-state sharding).
 
     Batch signature grows with the config: ``attn_mask_type='padding'``
     appends an ``attention_mask`` (True = masked) element, dropout appends
@@ -90,6 +98,12 @@ def make_gpt_train_step(
         params = init_gpt_params(rng, cfg)
         if mesh is not None:
             specs = gpt_param_specs(cfg)
+            if fsdp:
+                from apex_tpu.parallel.fsdp import fsdp_augment_specs
+
+                ndev = dict(zip(mesh.axis_names,
+                                mesh.devices.shape))["dp"]
+                specs = fsdp_augment_specs(specs, params, ndev)
             params = jax.device_put(
                 params,
                 jax.tree_util.tree_map(
@@ -97,6 +111,35 @@ def make_gpt_train_step(
                     is_leaf=lambda x: isinstance(x, P),
                 ),
             )
+            state = init_fn(params)
+            if fsdp:
+                # The optimizer moments and fp32 masters are created as
+                # fresh (replicated) arrays.  Every state subtree that
+                # mirrors the params structure (masters, bf16 copies,
+                # each Adam moment tree) is re-placed on the params'
+                # shardings — matched by tree structure, not by array
+                # shape, so equal-shape params with different specs
+                # cannot collide.
+                shardings = jax.tree_util.tree_map(
+                    lambda s: NamedSharding(mesh, s), specs,
+                    is_leaf=lambda x: isinstance(x, P))
+                pstruct = jax.tree_util.tree_structure(params)
+
+                def matches(sub):
+                    try:
+                        return (jax.tree_util.tree_structure(sub)
+                                == pstruct)
+                    except Exception:
+                        return False
+
+                def place(sub):
+                    if matches(sub):
+                        return jax.device_put(sub, shardings)
+                    return sub
+
+                state = jax.tree_util.tree_map(
+                    place, state, is_leaf=matches)
+            return state
         return init_fn(params)
 
     if mesh is None:

@@ -533,8 +533,14 @@ def _from_bh(x3, b, n):
 
 
 def _blocks(sq, sk):
-    bq = min(256, pl.cdiv(sq, _LANES) * _LANES)
-    bk = min(512, pl.cdiv(sk, _LANES) * _LANES)
+    """Block sizes tuned on v5e (round-3 sweep, BASELINE.md kernel
+    ledger): at sk>=1024 the 1024x1024 score tile amortizes per-grid-step
+    overhead and beats the old 256x512 default ~1.5x (fwd s1024 causal:
+    946us vs 1494us; s2048: 644us vs 964us); short sequences keep the
+    small tiles (256x512 best at s512).  1024x2048 fails to compile
+    (VMEM), so 1024 caps both dims."""
+    bq = min(1024 if sq >= 1024 else 256, pl.cdiv(sq, _LANES) * _LANES)
+    bk = min(1024 if sk >= 1024 else 512, pl.cdiv(sk, _LANES) * _LANES)
     return bq, bk
 
 

@@ -156,9 +156,20 @@ def _ln_bwd_kernel(rms: bool, affine: bool, has_bias: bool, *refs):
 
 
 def _pallas_ok(hidden: int, dtype) -> bool:
+    import os
+
     from apex_tpu.ops._pallas_utils import pallas_ok
 
-    return pallas_ok("fused_layer_norm", hidden, dtype)
+    if not pallas_ok("fused_layer_norm", hidden, dtype):
+        return False
+    # Measured on v5e (bench_kernels.py round 3): the Pallas forward wins
+    # for 16-bit inputs (bf16 16384x768: 36us vs 78us) but loses at fp32
+    # (74us vs 49us — fp32 doubles the VMEM tile traffic while XLA fuses
+    # the fp32 chain).  Interpret mode keeps every dtype for test
+    # coverage.
+    if os.environ.get("APEX_TPU_PALLAS_INTERPRET", "0") == "1":
+        return True
+    return dtype in (jnp.bfloat16, jnp.float16)
 
 
 def _pad_rows(x2, br):
@@ -317,6 +328,20 @@ def _norm_fwd(x, weight, bias, eps, rms, memory_efficient):
     return y2.reshape(shape), (saved_x, saved_y, weight, bias, mu, rs, shape)
 
 
+def _ln_bwd_use_pallas(hidden, dtype) -> bool:
+    """Backward backend gate. Measured on v5e (bench_kernels.py, round 3):
+    the XLA-composed backward beats the Pallas bwd kernel because XLA
+    fuses dx into neighboring ops while the kernel's revisited dγ/dβ
+    accumulator tile adds a serial pass (LN fwd+bwd 16384x768 bf16:
+    pallas 143us vs mixed pallas-fwd/xla-bwd 93us).  Forward stays
+    Pallas (35us vs 78us).  APEX_TPU_LN_BWD=pallas opts back in."""
+    import os
+
+    if os.environ.get("APEX_TPU_LN_BWD") == "pallas":
+        return _pallas_ok(hidden, dtype)
+    return False
+
+
 def _norm_bwd(eps, rms, memory_efficient, res, dy):
     saved_x, saved_y, weight, bias, mu, rs, shape = res
     hidden = shape[-1]
@@ -341,7 +366,7 @@ def _norm_bwd(eps, rms, memory_efficient, res, dy):
     else:
         x2 = saved_x
 
-    if _pallas_ok(hidden, x2.dtype):
+    if _ln_bwd_use_pallas(hidden, x2.dtype):
         dx, dw, db = _ln_bwd_pallas(
             dy2, x2, weight, mu, rs, rms, bias is not None
         )

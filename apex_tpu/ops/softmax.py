@@ -151,6 +151,17 @@ def _use_pallas(x, mask, causal):
     # broadcast strides instead, so route those to the reference path.
     if mask is not None and mask.shape != x.shape:
         return False
+    # Measured crossover on v5e (bench_kernels.py, round 3): the Pallas
+    # row kernel wins at sk<=512 (causal fwd 32x16x512x512: 0.65x) but
+    # loses to the XLA composition at sk=1024 (1.19x fwd) — the larger
+    # rows blow past the VMEM-friendly tile and XLA's fusion with the
+    # surrounding matmuls dominates.  APEX_TPU_SOFTMAX=pallas forces the
+    # kernel at any size.
+    import os
+
+    if (x.shape[-1] > 512
+            and os.environ.get("APEX_TPU_SOFTMAX") != "pallas"):
+        return False
     return _pallas_ok(x.shape[-1], x.dtype) and (
         not causal or x.shape[-2] == x.shape[-1]
     )

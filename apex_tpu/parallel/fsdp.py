@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["fsdp_spec", "fsdp_shardings"]
+__all__ = ["fsdp_spec", "fsdp_shardings", "fsdp_augment_specs"]
 
 
 def fsdp_spec(shape, ndev: int, axis: str = "dp") -> P:
@@ -46,6 +46,31 @@ def fsdp_spec(shape, ndev: int, axis: str = "dp") -> P:
     if best is None:
         return P()
     return P(*(axis if i == best else None for i in range(len(shape))))
+
+
+def fsdp_augment_specs(specs: Any, shapes: Any, ndev: int,
+                       axis: str = "dp"):
+    """Compose FSDP with an existing PartitionSpec tree (e.g. the tp
+    specs from ``gpt_param_specs``): shard the largest still-unsharded
+    divisible dim of every leaf over ``axis``, keeping the tensor-
+    parallel dims where they are.  ``shapes`` mirrors ``specs`` with the
+    actual array (or .shape-carrying) leaves."""
+
+    def one(spec: P, arr):
+        shape = getattr(arr, "shape", arr)
+        entries = list(spec) + [None] * (len(shape) - len(spec))
+        best = None
+        for i, d in enumerate(shape):
+            if entries[i] is None and d % ndev == 0 and (
+                    best is None or d > shape[best]):
+                best = i
+        if best is None:
+            return P(*entries)
+        entries[best] = axis
+        return P(*entries)
+
+    return jax.tree_util.tree_map(
+        one, specs, shapes, is_leaf=lambda x: isinstance(x, P))
 
 
 def fsdp_shardings(tree: Any, mesh: Mesh, axis: str = "dp"):

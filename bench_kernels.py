@@ -1,0 +1,316 @@
+"""Per-kernel perf ledger: fused kernels vs their XLA-composed equivalents.
+
+The reference's value proposition is per-kernel speed ("optimized for
+performance", /root/reference/README.md:3-6).  This microbenchmark times
+every fused op in :mod:`apex_tpu.ops` against the plain jnp composition
+XLA would produce (autodiff for backward) at the bench-matrix shapes, on
+the real chip.  The measured winners justify each op's default backend;
+BASELINE.md carries the resulting table per round.
+
+Methodology: each variant is chained through a `lax.fori_loop` *inside*
+one jit (the output of iteration i feeds iteration i+1), so the reported
+per-iteration time contains no host dispatch and no cross-iteration
+parallelism.  For fwd+bwd, the chained value is the gradient (same shape
+as the input).  Reported number = best of 5 timed calls / INNER.
+
+Usage:  PYTHONPATH=.:/root/.axon_site python bench_kernels.py [--json out]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INNER = (64, 256, 1024)  # chained iteration counts; reported time is the
+                         # least-squares slope over the points, which
+                         # cancels the ~67 ms host<->tunnel round-trip
+                         # per call and averages out its jitter
+REPS = 5                 # timed outer calls per point; best is used
+
+
+def _scalarize(tree):
+    """Cheap on-device scalar depending on every leaf — only a float
+    crosses the (slow) tunnel at sync time."""
+    return sum(jnp.ravel(leaf)[0].astype(jnp.float32)
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
+def _best_of(run, args):
+    out = run(*args)          # compile + warmup
+    float(np.asarray(out))
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = run(*args)
+        float(np.asarray(out))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _time(make_run, args, inner=None):
+    points = inner or INNER
+    times = [_best_of(make_run(n), args) for n in points]
+    slope = np.polyfit(points, times, 1)[0]
+    return max(float(slope), 1e-9)
+
+
+def chain_fwd(op, *args, inner=None):
+    """Time op(x, *rest) chained through x (op(x) must have x's shape)."""
+
+    def make_run(n):
+        @jax.jit
+        def run(x, *rest):
+            return _scalarize(jax.lax.fori_loop(
+                0, n, lambda i, t: op(t, *rest), x))
+        return run
+
+    return _time(make_run, args, inner)
+
+
+def chain_grad(op, argnums, *args, inner=None):
+    """Time jax.grad(sum-of-op) chained through the differentiated args."""
+    k = len(argnums)
+    g = jax.grad(
+        lambda *a: op(*a).astype(jnp.float32).sum(), argnums=argnums)
+
+    def make_run(n):
+        @jax.jit
+        def run(*a):
+            def body(i, diff):
+                return g(*diff, *a[k:])
+
+            return _scalarize(jax.lax.fori_loop(0, n, body, a[:k]))
+        return run
+
+    return _time(make_run, args, inner)
+
+
+def _fmt(name, pallas_s, xla_s):
+    ratio = pallas_s / xla_s
+    win = "pallas" if ratio < 1.0 else "xla"
+    print(f"  {name:<44} pallas {pallas_s*1e6:9.1f}us   "
+          f"xla {xla_s*1e6:9.1f}us   ratio {ratio:5.3f}  -> {win}")
+    return {"pallas_us": round(pallas_s * 1e6, 1),
+            "xla_us": round(xla_s * 1e6, 1),
+            "pallas_over_xla": round(ratio, 3), "winner": win}
+
+
+def bench_flash_attention(results):
+    from apex_tpu.ops.flash_attention import flash_attention, mha_reference
+
+    print("flash_attention (bf16, d=64)")
+    rng = np.random.RandomState(0)
+    for b, s, h, causal in ((8, 512, 12, True), (16, 1024, 12, True),
+                            (4, 2048, 12, True), (8, 512, 12, False)):
+        q = jnp.asarray(rng.randn(b, s, h, 64), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(b, s, h, 64), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(b, s, h, 64), jnp.bfloat16)
+        tag = f"b{b}xs{s}{'_causal' if causal else ''}"
+
+        fa = functools.partial(flash_attention, causal=causal)
+        ref = functools.partial(mha_reference, causal=causal)
+        results[f"flash_fwd_{tag}"] = _fmt(
+            f"fwd   {tag}", chain_fwd(fa, q, k, v, inner=(16, 48, 160)),
+            chain_fwd(ref, q, k, v, inner=(16, 48, 160)))
+        results[f"flash_fwdbwd_{tag}"] = _fmt(
+            f"fwd+bwd {tag}",
+            chain_grad(fa, (0, 1, 2), q, k, v, inner=(16, 48, 160)),
+            chain_grad(ref, (0, 1, 2), q, k, v, inner=(16, 48, 160)))
+
+
+def bench_layer_norm(results):
+    from apex_tpu.ops.layer_norm import (fused_layer_norm, fused_rms_norm,
+                                         layer_norm_ref, rms_norm_ref)
+
+    print("layer_norm / rms_norm")
+    rng = np.random.RandomState(0)
+    for rows, hidden, dtype in ((16384, 768, jnp.bfloat16),
+                                (16384, 1024, jnp.bfloat16),
+                                (16384, 768, jnp.float32)):
+        x = jnp.asarray(rng.randn(rows, hidden), dtype)
+        w = jnp.ones((hidden,), jnp.float32)
+        b = jnp.zeros((hidden,), jnp.float32)
+        tag = f"{rows}x{hidden}_{jnp.dtype(dtype).name}"
+
+        ln = lambda x, w, b: fused_layer_norm(x, w, b)
+        ref = lambda x, w, b: layer_norm_ref(x, w, b)
+        results[f"ln_fwd_{tag}"] = _fmt(
+            f"LN fwd   {tag}", chain_fwd(ln, x, w, b),
+            chain_fwd(ref, x, w, b))
+        results[f"ln_fwdbwd_{tag}"] = _fmt(
+            f"LN fwd+bwd {tag}",
+            chain_grad(ln, (0, 1, 2), x, w, b),
+            chain_grad(ref, (0, 1, 2), x, w, b))
+
+    x = jnp.asarray(rng.randn(16384, 768), jnp.bfloat16)
+    w = jnp.ones((768,), jnp.float32)
+    results["rms_fwdbwd_16384x768_bf16"] = _fmt(
+        "RMS fwd+bwd 16384x768_bf16",
+        chain_grad(lambda x, w: fused_rms_norm(x, w), (0, 1), x, w),
+        chain_grad(lambda x, w: rms_norm_ref(x, w), (0, 1), x, w))
+
+
+def bench_softmax(results):
+    from apex_tpu.ops import softmax as sm
+
+    print("scaled softmax (causal / plain)")
+    rng = np.random.RandomState(0)
+    for b, h, s in ((16, 12, 1024), (32, 16, 512)):
+        x = jnp.asarray(rng.randn(b, h, s, s), jnp.bfloat16)
+        tag = f"{b}x{h}x{s}x{s}"
+        causal = lambda x: sm.scaled_upper_triang_masked_softmax(x, 0.125)
+        causal_ref = lambda x: sm._softmax_fwd_ref(x, 0.125, None, True)
+        results[f"softmax_causal_fwd_{tag}"] = _fmt(
+            f"causal fwd {tag}", chain_fwd(causal, x),
+            chain_fwd(causal_ref, x))
+        results[f"softmax_causal_fwdbwd_{tag}"] = _fmt(
+            f"causal fwd+bwd {tag}",
+            chain_grad(causal, (0,), x),
+            chain_grad(causal_ref, (0,), x))
+
+
+def bench_xentropy(results):
+    from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+    print("xentropy (fused lse-saving vs naive log_softmax)")
+    rng = np.random.RandomState(0)
+    rows, v = 16384, 50304
+    logits = jnp.asarray(rng.randn(rows, v), jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, v, (rows,)), jnp.int32)
+
+    def naive(logits, labels):
+        ls = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(ls, labels[..., None], axis=-1)[..., 0]
+        return -picked
+
+    fused = lambda lg, lb: softmax_cross_entropy_loss(lg, lb, 0.0, -100)
+    results[f"xentropy_fwdbwd_{rows}x{v}"] = _fmt(
+        f"fwd+bwd {rows}x{v}",
+        chain_grad(fused, (0,), logits, labels),
+        chain_grad(naive, (0,), logits, labels))
+
+
+def bench_swiglu(results):
+    from apex_tpu.ops.swiglu import bias_swiglu_ref, fused_bias_swiglu
+
+    print("bias_swiglu (custom-vjp recompute vs autodiff)")
+    rng = np.random.RandomState(0)
+    rows, f2 = 16384, 6144
+    x = jnp.asarray(rng.randn(rows, f2), jnp.bfloat16)
+    b = jnp.asarray(rng.randn(f2) * 0.01, jnp.float32)
+    results[f"swiglu_fwdbwd_{rows}x{f2}"] = _fmt(
+        f"fwd+bwd {rows}x{f2}",
+        chain_grad(fused_bias_swiglu, (0, 1), x, b),
+        chain_grad(bias_swiglu_ref, (0, 1), x, b))
+
+
+def bench_rope(results):
+    from apex_tpu.ops.rope import fused_apply_rotary_pos_emb
+
+    print("rope (custom-vjp adjoint vs autodiff)")
+    rng = np.random.RandomState(0)
+    s, b, h, d = 1024, 16, 12, 64
+    t = jnp.asarray(rng.randn(s, b, h, d), jnp.bfloat16)
+    freqs = jnp.asarray(rng.randn(s, 1, 1, d), jnp.float32)
+
+    def naive(t, freqs):
+        f32 = freqs.astype(jnp.float32)
+        cos, sin = jnp.cos(f32), jnp.sin(f32)
+        t32 = t.astype(jnp.float32)
+        half = d // 2
+        rot = jnp.concatenate([-t32[..., half:], t32[..., :half]], axis=-1)
+        return (t32 * cos + rot * sin).astype(t.dtype)
+
+    results[f"rope_fwdbwd_s{s}b{b}"] = _fmt(
+        f"fwd+bwd s{s}b{b}h{h}d{d}",
+        chain_grad(fused_apply_rotary_pos_emb, (0,), t, freqs),
+        chain_grad(naive, (0,), t, freqs))
+
+
+def bench_adam(results):
+    """Flat-buffer Adam: the Pallas kernel vs a hand-rolled XLA update."""
+    from apex_tpu.ops.pallas_adam import adam_kernel_flat
+
+    print("flat Adam (88M fp32 buffer)")
+    n = 88_000_000
+    rng = np.random.RandomState(0)
+    g = jnp.asarray(rng.randn(n // 1000, 1000).reshape(-1)[:n] * 1e-3,
+                    jnp.float32)
+    p = jnp.asarray(rng.randn(n // 1000, 1000).reshape(-1)[:n] * 1e-2,
+                    jnp.float32)
+    scalars = jnp.asarray([1e-3, 0.9, 0.999, 1e-8, 0.01, 0.9, 0.999],
+                          jnp.float32)
+
+    def pallas_step(pmv, g, scalars):
+        p, m, v = pmv
+        u, m, v = adam_kernel_flat(g, p, m, v, scalars)
+        return (p + u, m, v)
+
+    def xla_step(pmv, g, scalars):
+        p, m, v = pmv
+        lr, b1, b2, eps, wd, bc1, bc2 = [scalars[i] for i in range(7)]
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        u = -lr * (m / bc1) / (jnp.sqrt(v / bc2) + eps) - lr * wd * p
+        return (p + u, m, v)
+
+    zeros = jnp.zeros_like(p)
+    times = {}
+    for name, step in (("pallas", pallas_step), ("xla", xla_step)):
+
+        def make_run(n, step=step):
+            @jax.jit
+            def run(p, m, v, g, scalars):
+                return _scalarize(jax.lax.fori_loop(
+                    0, n, lambda i, pmv: step(pmv, g, scalars),
+                    (p, m, v)))
+            return run
+
+        times[name] = _time(make_run, (p, zeros, zeros, g, scalars),
+                            inner=(16, 48, 160))
+    results["adam_flat_88m"] = _fmt(
+        "update 88M fp32", times["pallas"], times["xla"])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default="KERNEL_BENCH.json")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated subset of benchmark names")
+    args = ap.parse_args()
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})")
+    results = {}
+    benches = {
+        "flash_attention": bench_flash_attention,
+        "layer_norm": bench_layer_norm,
+        "softmax": bench_softmax,
+        "xentropy": bench_xentropy,
+        "swiglu": bench_swiglu,
+        "rope": bench_rope,
+        "adam": bench_adam,
+    }
+    only = set(args.only.split(",")) if args.only else None
+    for name, fn in benches.items():
+        if only and name not in only:
+            continue
+        try:
+            fn(results)
+        except Exception as e:
+            print(f"  {name} FAILED: {type(e).__name__}: {e}")
+            results[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
+    with open(args.json, "w") as f:
+        json.dump({"device": dev.device_kind, "inner": INNER,
+                   "results": results}, f, indent=1)
+    print(f"wrote {args.json}")
+
+
+if __name__ == "__main__":
+    main()

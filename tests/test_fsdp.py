@@ -68,3 +68,86 @@ class TestFsdpTraining:
         params = {"w": jnp.zeros((32, 64), jnp.float32)}
         sh = fsdp_shardings(params, mesh)["w"]
         assert sh.spec == P(None, "dp")
+
+
+class TestFsdpGpt:
+    """make_gpt_train_step(..., fsdp=True): the ZeRO-3 path on the real
+    GPT family (not a toy MLP), with per-device memory evidence."""
+
+    def _cfg(self, **kw):
+        from apex_tpu.models.config import TransformerConfig
+
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("hidden_size", 128)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_position_embeddings", 32)
+        kw.setdefault("compute_dtype", jnp.bfloat16)
+        return TransformerConfig(**kw)
+
+    def test_gpt_fsdp_trains_and_shards(self):
+        from apex_tpu.models.gpt import make_gpt_train_step
+
+        mesh = create_mesh()    # dp=8
+        cfg = self._cfg()
+        rs = np.random.RandomState(0)
+        tokens = jnp.asarray(rs.randint(0, cfg.vocab_size, (8, 32)),
+                             jnp.int32)
+        labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (8, 32)),
+                             jnp.int32)
+
+        init, step = make_gpt_train_step(
+            cfg, fused_adam(lr=1e-3), "O2", mesh, fsdp=True)
+        state = init(jax.random.PRNGKey(0))
+
+        # ZeRO-3 evidence: per-device bytes of masters + opt state is a
+        # fraction of the replicated total (all big leaves split 8-way).
+        def bytes_of(tree):
+            total = local = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                if not hasattr(leaf, "addressable_shards"):
+                    continue
+                total += leaf.size * leaf.dtype.itemsize
+                sh = leaf.addressable_shards[0].data
+                local += sh.size * sh.dtype.itemsize
+            return total, local
+
+        t_master, l_master = bytes_of(state.master_params)
+        t_opt, l_opt = bytes_of(state.opt_state)
+        assert l_master * 4 <= t_master, (l_master, t_master)
+        assert l_opt * 4 <= t_opt, (l_opt, t_opt)
+
+        losses = []
+        for i in range(3):
+            state, m = step(state, tokens, labels)
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+        # post-step state keeps the sharded layout (the optimizer update
+        # must not silently gather everything back)
+        t2, l2 = bytes_of(state.master_params)
+        assert l2 * 4 <= t2, (l2, t2)
+
+    def test_gpt_fsdp_matches_replicated(self):
+        from apex_tpu.models.gpt import make_gpt_train_step
+
+        mesh = create_mesh()
+        cfg = self._cfg(compute_dtype=jnp.float32)
+        rs = np.random.RandomState(1)
+        tokens = jnp.asarray(rs.randint(0, cfg.vocab_size, (8, 32)),
+                             jnp.int32)
+        labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (8, 32)),
+                             jnp.int32)
+
+        init_f, step_f = make_gpt_train_step(
+            cfg, fused_adam(lr=1e-3), "O2", mesh, fsdp=True)
+        init_r, step_r = make_gpt_train_step(
+            cfg, fused_adam(lr=1e-3), "O2")
+        sf = init_f(jax.random.PRNGKey(0))
+        sr = init_r(jax.random.PRNGKey(0))
+        for _ in range(2):
+            sf, mf = step_f(sf, tokens, labels)
+            sr, mr = step_r(sr, tokens, labels)
+        np.testing.assert_allclose(float(mf["loss"]), float(mr["loss"]),
+                                   rtol=1e-4)

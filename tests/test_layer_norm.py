@@ -143,6 +143,45 @@ def test_pallas_interpret_matches_ref(monkeypatch):
     np.testing.assert_allclose(np.asarray(gb), np.asarray(gb_r), atol=1e-4)
 
 
+def test_pallas_bwd_kernel_opt_in(monkeypatch):
+    """The Pallas backward kernel is opt-in since round 3 (the XLA
+    composition measured faster on v5e — BASELINE.md kernel ledger);
+    keep it covered so the opt-in path cannot rot."""
+    monkeypatch.setenv("APEX_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("APEX_TPU_LN_BWD", "pallas")
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(12, 256).astype(np.float32))
+    w = jnp.asarray((rng.rand(256) + 0.5).astype(np.float32))
+    b = jnp.asarray(rng.randn(256).astype(np.float32))
+    dy = jnp.asarray(rng.randn(12, 256).astype(np.float32))
+
+    def f(x_, w_, b_):
+        return jnp.sum(fused_layer_norm(x_, w_, b_) * dy)
+
+    gx, gw, gb = jax.grad(f, argnums=(0, 1, 2))(x, w, b)
+
+    monkeypatch.delenv("APEX_TPU_PALLAS_INTERPRET")
+    monkeypatch.delenv("APEX_TPU_LN_BWD")
+    gx_r, gw_r, gb_r = jax.grad(f, argnums=(0, 1, 2))(x, w, b)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_r), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_r), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(gb_r), atol=1e-4)
+
+    # RMS variant through the same opt-in
+    monkeypatch.setenv("APEX_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("APEX_TPU_LN_BWD", "pallas")
+
+    def fr(x_, w_):
+        return jnp.sum(fused_rms_norm(x_, w_) * dy)
+
+    rx, rw = jax.grad(fr, argnums=(0, 1))(x, w)
+    monkeypatch.delenv("APEX_TPU_PALLAS_INTERPRET")
+    monkeypatch.delenv("APEX_TPU_LN_BWD")
+    rx_r, rw_r = jax.grad(fr, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(rx), np.asarray(rx_r), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(rw), np.asarray(rw_r), atol=1e-4)
+
+
 def test_flax_modules():
     from apex_tpu.normalization import FusedLayerNorm, FusedRMSNorm
 
