@@ -196,6 +196,11 @@ def pipeline_packet(tokens_mb: jax.Array, labels_mb: jax.Array,
         "labels": labels_mb,
         "loss": jnp.zeros(tokens_mb.shape[:-2], jnp.float32),
     }
+    if cfg.num_experts:
+        # running MoE load-balance aux: every stage adds its layers'
+        # contribution as the packet rides the pipeline; the last stage
+        # folds it into the loss (gpt_loss semantics)
+        packet["aux"] = jnp.zeros(tokens_mb.shape[:-2], jnp.float32)
     if attention_mask_mb is not None:
         packet["attention_mask"] = attention_mask_mb
     if dropout_seeds is not None:
@@ -281,14 +286,20 @@ def make_gpt_pipeline_stage(cfg: TransformerConfig, n_stages: int,
     only the last stage pays their FLOPs — safe because all members of a
     tp group share one pp index, so the vocab-parallel collectives inside
     the branch cannot diverge across a tp group.
+
+    MoE configs compose with the pipeline since round 3: each stage runs
+    its experts *locally* (replicated within the stage — the packet
+    threads the running load-balance aux loss to the last stage, which
+    folds it into the CE like ``gpt_loss``).  Sharding experts over an
+    'ep' mesh axis *inside* shard_map would need hand-written
+    all-to-alls; that combination stays on the GSPMD path
+    (``make_gpt_train_step`` over a mesh with an 'ep' axis), where the
+    partitioner inserts them from the annotations.
     """
     if cfg.num_experts:
-        raise NotImplementedError(
-            "MoE configs are not supported on the shard_map pipeline "
-            "path yet: the stage fns do not thread the load-balance aux "
-            "loss, and expert sharding inside shard_map needs local-"
-            "shard routing. Use the GSPMD path (make_gpt_train_step "
-            "over a mesh with an 'ep' axis).")
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, moe_ep_axis=None)
     ctx = manual_ctx(tp, tp_axis) if tp > 1 else single_device_ctx()
 
     def stage_fn(sp: dict, packet: dict) -> dict:
@@ -335,15 +346,23 @@ def make_gpt_pipeline_stage(cfg: TransformerConfig, n_stages: int,
 
         # this stage's layer chunk: local leading pp dim of size 1
         layers = jax.tree_util.tree_map(lambda v: v[0], sp["layers"])
-        h = transformer_backbone({"layers": layers}, h, cfg, ctx,
-                                 attention_mask=mask, dropout_rng=rng,
-                                 apply_final_norm=False)
+        h, aux_local = transformer_backbone(
+            {"layers": layers}, h, cfg, ctx, attention_mask=mask,
+            dropout_rng=rng, apply_final_norm=False, with_aux=True)
+        aux = None
+        if cfg.num_experts:
+            aux = _pvary(packet["aux"], pp_axis) + aux_local
 
         def head_and_ce(h_in):
             h_final = apply_norm(cfg, h_in, sp["final_ln"]["scale"],
                                  sp["final_ln"]["bias"])
             logits = lm_head_logits(sp, h_final, cfg)
-            return lm_cross_entropy(logits, labels, ctx)
+            ce = lm_cross_entropy(logits, labels, ctx)
+            if cfg.num_experts:
+                # fold the accumulated load-balance term in exactly like
+                # gpt_loss (mean over layers)
+                ce = ce + cfg.moe_aux_loss_coeff * aux / cfg.num_layers
+            return ce
 
         # last stage only: the v/12h-per-stage FLOP tax of running the
         # head everywhere (round-1 design) is gone.  The false branch's
@@ -359,6 +378,8 @@ def make_gpt_pipeline_stage(cfg: TransformerConfig, n_stages: int,
             "labels": labels,
             "loss": loss,
         }
+        if aux is not None:
+            out["aux"] = aux
         if mask is not None:
             out["attention_mask"] = mask
         if seed is not None:
@@ -424,9 +445,11 @@ def make_gpt_vpp_stage(cfg: TransformerConfig, n_stages: int, vpp: int,
     from apex_tpu.utils.collectives import pvary as _pvary
 
     if cfg.num_experts:
-        raise NotImplementedError(
-            "MoE configs are not supported on the shard_map pipeline "
-            "path yet (see make_gpt_pipeline_stage); use the GSPMD path.")
+        # experts run locally per chunk; aux rides the packet — see
+        # make_gpt_pipeline_stage (EP×PP sharded routing is GSPMD-only)
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, moe_ep_axis=None)
     ctx = manual_ctx(tp, tp_axis) if tp > 1 else single_device_ctx()
     n_chunks = n_stages * vpp
     pp_axis = "pp"
@@ -455,15 +478,21 @@ def make_gpt_vpp_stage(cfg: TransformerConfig, n_stages: int, vpp: int,
         # this chunk's layer slice: leading dims already sliced down to
         # the local (per-chunk) stack by the schedule + shard_map
         layers = jax.tree_util.tree_map(lambda v: v[0], sp["layers"])
-        h = transformer_backbone({"layers": layers}, h, cfg, ctx,
-                                 attention_mask=mask, dropout_rng=rng,
-                                 apply_final_norm=False)
+        h, aux_local = transformer_backbone(
+            {"layers": layers}, h, cfg, ctx, attention_mask=mask,
+            dropout_rng=rng, apply_final_norm=False, with_aux=True)
+        aux = None
+        if cfg.num_experts:
+            aux = _pvary(packet["aux"], pp_axis) + aux_local
 
         def head_and_ce(h_in):
             h_final = apply_norm(cfg, h_in, sp["final_ln"]["scale"],
                                  sp["final_ln"]["bias"])
             logits = lm_head_logits(sp, h_final, cfg)
-            return lm_cross_entropy(logits, labels, ctx)
+            ce = lm_cross_entropy(logits, labels, ctx)
+            if cfg.num_experts:
+                ce = ce + cfg.moe_aux_loss_coeff * aux / cfg.num_layers
+            return ce
 
         loss = jax.lax.cond(
             last, head_and_ce,
@@ -475,6 +504,8 @@ def make_gpt_vpp_stage(cfg: TransformerConfig, n_stages: int, vpp: int,
             "labels": labels,
             "loss": loss,
         }
+        if aux is not None:
+            out["aux"] = aux
         if mask is not None:
             out["attention_mask"] = mask
         if seed is not None:

@@ -196,14 +196,13 @@ def init_gpt_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
         "ln2_bias": jnp.zeros((L, h), dt),
     }
     if cfg.num_experts:
-        if cfg.activation == "swiglu":
-            raise NotImplementedError(
-                "MoE layers currently pair with the gelu FFN")
         E = cfg.num_experts
+        # swiglu experts carry the concatenated [gate ‖ up] fc1 (2f)
+        f1 = 2 * f if cfg.activation == "swiglu" else f
         layers.update({
             "router_kernel": nrm(ks[3], (L, h, E), std),
-            "moe_fc1": nrm(ks[4], (L, E, h, f), std),
-            "moe_fc1_bias": jnp.zeros((L, E, f), dt),
+            "moe_fc1": nrm(ks[4], (L, E, h, f1), std),
+            "moe_fc1_bias": jnp.zeros((L, E, f1), dt),
             "moe_fc2": nrm(ks[7], (L, E, f, h), out_std),
             "moe_fc2_bias": jnp.zeros((L, E, h), dt),
         })
@@ -258,8 +257,12 @@ def gpt_param_specs(cfg: TransformerConfig, *, tp_axis: str = "tp",
         "ln2_bias": P(*pp, None, None),
     }
     if cfg.num_experts:
-        # experts shard over cfg.moe_ep_axis; the router stays replicated
-        ep = cfg.moe_ep_axis
+        # experts shard over cfg.moe_ep_axis under GSPMD; on the
+        # shard_map pipeline path (pp_axis set) the stage fns run their
+        # experts locally (make_gpt_pipeline_stage overrides
+        # moe_ep_axis=None), so the specs drop 'ep' to match — callers
+        # can feed these straight into shard_map in_specs
+        ep = None if pp_axis else cfg.moe_ep_axis
         layer_specs.update({
             "router_kernel": P(*pp, None, None, None),
             "moe_fc1": P(*pp, None, ep, None, None),
@@ -432,7 +435,8 @@ def _moe_mlp(cfg: TransformerConfig, lp: dict, x):
         moe_params, x,
         capacity_factor=cfg.moe_capacity_factor,
         top_k=cfg.moe_top_k,
-        ep_axis=cfg.moe_ep_axis)
+        ep_axis=cfg.moe_ep_axis,
+        activation=cfg.activation)
     return o.out, o.aux_loss
 
 
@@ -553,6 +557,13 @@ def transformer_backbone(params: dict, hidden, cfg: TransformerConfig,
     keys = jax.random.split(dropout_rng, n_layers) if needs_rng else None
 
     aux0 = jnp.float32(0.0)
+    # inside shard_map the per-layer aux inherits the hidden's varying
+    # axes (e.g. 'pp' in a pipeline stage) — the scan carry must start
+    # with the same type
+    for axis in getattr(jax.typeof(hidden), "vma", ()) or ():
+        from apex_tpu.utils.collectives import pvary as _pvary_
+
+        aux0 = _pvary_(aux0, axis)
     if cfg.scan_layers:
         (hidden, aux), _ = jax.lax.scan(
             step, (hidden, aux0), (params["layers"], keys))

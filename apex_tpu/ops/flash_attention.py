@@ -48,7 +48,8 @@ from jax.experimental import pallas as pl
 from apex_tpu.ops._pallas_utils import LANES as _LANES, out_struct
 from apex_tpu.utils.registry import on_tpu
 
-__all__ = ["flash_attention", "mha_reference"]
+__all__ = ["flash_attention", "flash_attention_packed", "mha_reference",
+           "segment_ids_from_cu_seqlens"]
 
 _NEG_INF = -1e30
 
@@ -132,7 +133,7 @@ def _keep_mask(seed, bh, q_start, k_start, shape, keep_prob):
 
 def mha_reference(q, k, v, *, causal=False, key_padding_mask=None,
                   mask=None, bias=None, scale=None, dropout_p=0.0,
-                  dropout_rng=None):
+                  dropout_rng=None, segment_ids=None):
     """Materialized softmax(QK^T)V in fp32 — numerics oracle for the kernel
     and the execution path for variants the kernel doesn't fuse."""
     b, sq, n, d = q.shape
@@ -144,6 +145,11 @@ def mha_reference(q, k, v, *, causal=False, key_padding_mask=None,
         s = s + bias.astype(jnp.float32)
     if mask is not None:
         s = jnp.where(mask, _NEG_INF, s)
+    if segment_ids is not None:
+        seg = segment_ids.astype(jnp.int32)
+        blocked = (seg[:, None, :, None] != seg[:, None, None, :]) | (
+            seg < 0)[:, None, None, :]
+        s = jnp.where(blocked, _NEG_INF, s)
     if key_padding_mask is not None:
         if key_padding_mask.dtype == jnp.bool_:
             s = jnp.where(key_padding_mask[:, None, None, :], _NEG_INF, s)
@@ -154,6 +160,12 @@ def mha_reference(q, k, v, *, causal=False, key_padding_mask=None,
         col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         s = jnp.where((col > row)[None, None], _NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
+    # fully-blocked rows (e.g. padding queries under segment_ids, or an
+    # all-masked key row): softmax of a constant -1e30 row is uniform —
+    # zero it to match the kernel's l==0 sentinel (no value/grad leaks
+    # across segments through pad slots)
+    any_open = jnp.max(s, axis=-1, keepdims=True) > _NEG_INF / 2
+    p = jnp.where(any_open, p, 0.0)
     if dropout_p > 0.0 and dropout_rng is not None:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_p, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
@@ -167,9 +179,11 @@ def mha_reference(q, k, v, *, causal=False, key_padding_mask=None,
 
 
 def _fwd_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
-                dropout_p, *refs):
+                has_seg, dropout_p, *refs):
     if dropout_p > 0.0:
         seed_ref, refs = refs[0], refs[1:]
+    if has_seg:
+        qseg_ref, kseg_ref, refs = refs[0], refs[1], refs[2:]
     if has_kpm:
         q_ref, k_ref, v_ref, kpm_ref, o_ref, lse_ref, acc, m_s, l_s = refs
     else:
@@ -201,6 +215,12 @@ def _fwd_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
             row = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             pred &= col <= row
+        if has_seg:
+            # packed multi-sequence rows: attend within a segment only
+            # (negative ids = padding slots, matching nothing)
+            qseg = qseg_ref[0].reshape(block_q, 1)
+            kseg = kseg_ref[0].reshape(1, block_k)
+            pred &= (qseg == kseg) & (kseg >= 0)
         s = jnp.where(pred, s, _NEG_INF)
 
         m_prev = m_s[:, :1]
@@ -224,11 +244,23 @@ def _fwd_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
             preferred_element_type=jnp.float32)
         m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
 
+    run = None
     if causal:
         # whole kv block above the diagonal → skip its FLOPs
-        pl.when(k_start <= q_start + block_q - 1)(_compute)
-    else:
+        run = k_start <= q_start + block_q - 1
+    if has_seg:
+        # block-sparse skip of fully-disjoint tiles: if any q/k segment
+        # ids match, the id ranges overlap — so disjoint ranges are a
+        # safe (conservative) skip regardless of id ordering
+        qseg = qseg_ref[0]
+        kseg = kseg_ref[0]
+        overlap = (jnp.min(kseg) <= jnp.max(qseg)) & (
+            jnp.max(kseg) >= jnp.min(qseg))
+        run = overlap if run is None else (run & overlap)
+    if run is None:
         _compute()
+    else:
+        pl.when(run)(_compute)
 
     @pl.when(kj == pl.num_programs(2) - 1)
     def _finalize():
@@ -241,7 +273,7 @@ def _fwd_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
             jnp.where(l == 0.0, _NEG_INF, lse), lse_ref.shape[1:])
 
 
-def _fwd_pallas(q3, k3, v3, kpm, seed, scale, causal, sk_real,
+def _fwd_pallas(q3, k3, v3, kpm, seg, seed, scale, causal, sk_real,
                 block_q, block_k, dropout_p, interpret, out_dtype=None):
     from jax.experimental.pallas import tpu as pltpu
 
@@ -258,6 +290,17 @@ def _fwd_pallas(q3, k3, v3, kpm, seed, scale, causal, sk_real,
     if dropout_p > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
+    if seg is not None:
+        # (seg_q, seg_k): [b, sqp]/[b, skp] int32, indexed by batch
+        heads = bh // seg[0].shape[0]
+        in_specs.append(pl.BlockSpec(
+            (1, block_q), lambda b, i, j, h=heads: (b // h, i),
+            memory_space=pltpu.VMEM))
+        args.append(seg[0])
+        in_specs.append(pl.BlockSpec(
+            (1, block_k), lambda b, i, j, h=heads: (b // h, j),
+            memory_space=pltpu.VMEM))
+        args.append(seg[1])
     in_specs += [q_spec, k_spec, k_spec]
     args += [q3, k3, v3]
     if kpm is not None:
@@ -281,7 +324,8 @@ def _fwd_pallas(q3, k3, v3, kpm, seed, scale, causal, sk_real,
     ]
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale, causal, sk_real,
-                          block_q, block_k, kpm is not None, dropout_p),
+                          block_q, block_k, kpm is not None,
+                          seg is not None, dropout_p),
         grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,
@@ -302,9 +346,11 @@ def _fwd_pallas(q3, k3, v3, kpm, seed, scale, causal, sk_real,
 
 
 def _bwd_dq_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
-                   dropout_p, *refs):
+                   has_seg, dropout_p, *refs):
     if dropout_p > 0.0:
         seed_ref, refs = refs[0], refs[1:]
+    if has_seg:
+        qseg_ref, kseg_ref, refs = refs[0], refs[1], refs[2:]
     if has_kpm:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kpm_ref,
          dq_ref, dq_acc) = refs
@@ -334,6 +380,10 @@ def _bwd_dq_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
             row = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             pred &= col <= row
+        if has_seg:
+            qseg = qseg_ref[0].reshape(block_q, 1)
+            kseg = kseg_ref[0].reshape(1, block_k)
+            pred &= (qseg == kseg) & (kseg >= 0)
         lse = lse_ref[0][:, :1]
         # fully-masked rows carry the -inf lse sentinel: s - lse would be
         # ~0 there (additive -1e30 mask == -1e30 sentinel), not -inf —
@@ -354,10 +404,18 @@ def _bwd_dq_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    run = None
     if causal:
-        pl.when(k_start <= q_start + block_q - 1)(_compute)
-    else:
+        run = k_start <= q_start + block_q - 1
+    if has_seg:
+        qs, ks = qseg_ref[0], kseg_ref[0]
+        overlap = (jnp.min(ks) <= jnp.max(qs)) & (
+            jnp.max(ks) >= jnp.min(qs))
+        run = overlap if run is None else (run & overlap)
+    if run is None:
         _compute()
+    else:
+        pl.when(run)(_compute)
 
     @pl.when(kj == pl.num_programs(2) - 1)
     def _finalize():
@@ -365,9 +423,11 @@ def _bwd_dq_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
 
 
 def _bwd_dkv_kernel(scale, causal, sq_real, sk_real, block_q, block_k,
-                    has_kpm, dropout_p, *refs):
+                    has_kpm, has_seg, dropout_p, *refs):
     if dropout_p > 0.0:
         seed_ref, refs = refs[0], refs[1:]
+    if has_seg:
+        qseg_ref, kseg_ref, refs = refs[0], refs[1], refs[2:]
     if has_kpm:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kpm_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
@@ -398,6 +458,10 @@ def _bwd_dkv_kernel(scale, causal, sq_real, sk_real, block_q, block_k,
         pred = (col < sk_real) & (row < sq_real)
         if causal:
             pred &= col <= row
+        if has_seg:
+            qseg = qseg_ref[0].reshape(block_q, 1)
+            kseg = kseg_ref[0].reshape(1, block_k)
+            pred &= (qseg == kseg) & (kseg >= 0)
         lse = lse_ref[0][:, :1]
         # see _bwd_dq_kernel: zero fully-masked rows (lse sentinel)
         pred &= lse > _NEG_INF / 2
@@ -424,10 +488,18 @@ def _bwd_dkv_kernel(scale, causal, sq_real, sk_real, block_q, block_k,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    run = None
     if causal:
-        pl.when(k_start <= q_start + block_q - 1)(_compute)
-    else:
+        run = k_start <= q_start + block_q - 1
+    if has_seg:
+        qs, ks = qseg_ref[0], kseg_ref[0]
+        overlap = (jnp.min(ks) <= jnp.max(qs)) & (
+            jnp.max(ks) >= jnp.min(qs))
+        run = overlap if run is None else (run & overlap)
+    if run is None:
         _compute()
+    else:
+        pl.when(run)(_compute)
 
     @pl.when(qi == pl.num_programs(2) - 1)
     def _finalize():
@@ -435,9 +507,9 @@ def _bwd_dkv_kernel(scale, causal, sq_real, sk_real, block_q, block_k,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, seed, scale, causal,
-                sq_real, sk_real, block_q, block_k, dropout_p, interpret,
-                out_dtype=None):
+def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, seg, seed, scale,
+                causal, sq_real, sk_real, block_q, block_k, dropout_p,
+                interpret, out_dtype=None):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, sqp, d = q3.shape
@@ -463,6 +535,16 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, seed, scale, causal,
     if dropout_p > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
+    if seg is not None:
+        heads = bh // seg[0].shape[0]
+        in_specs.append(pl.BlockSpec(
+            (1, block_q), lambda b, i, j, h=heads: (b // h, i),
+            memory_space=pltpu.VMEM))
+        args.append(seg[0])
+        in_specs.append(pl.BlockSpec(
+            (1, block_k), lambda b, i, j, h=heads: (b // h, j),
+            memory_space=pltpu.VMEM))
+        args.append(seg[1])
     in_specs += [qspec(qmap), kspec(kmap), kspec(kmap), qspec(qmap),
                  rowspec(qmap), rowspec(qmap)]
     args += [q3, k3, v3, do3, lse3, delta3]
@@ -474,7 +556,8 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, seed, scale, causal,
         args.append(kpm)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale, causal, sk_real,
-                          block_q, block_k, kpm is not None, dropout_p),
+                          block_q, block_k, kpm is not None,
+                          seg is not None, dropout_p),
         grid=(bh, sqp // block_q, skp // block_k),
         in_specs=in_specs,
         out_specs=qspec(qmap),
@@ -491,6 +574,16 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, seed, scale, causal,
     if dropout_p > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
+    if seg is not None:
+        heads = bh // seg[0].shape[0]
+        in_specs.append(pl.BlockSpec(
+            (1, block_q), lambda b, j, i, h=heads: (b // h, i),
+            memory_space=pltpu.VMEM))
+        args.append(seg[0])
+        in_specs.append(pl.BlockSpec(
+            (1, block_k), lambda b, j, i, h=heads: (b // h, j),
+            memory_space=pltpu.VMEM))
+        args.append(seg[1])
     in_specs += [qspec(qmap2), kspec(kmap2), kspec(kmap2), qspec(qmap2),
                  rowspec(qmap2), rowspec(qmap2)]
     args += [q3, k3, v3, do3, lse3, delta3]
@@ -503,7 +596,7 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, seed, scale, causal,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale, causal, sq_real,
                           sk_real, block_q, block_k, kpm is not None,
-                          dropout_p),
+                          seg is not None, dropout_p),
         grid=(bh, skp // block_k, sqp // block_q),
         in_specs=in_specs,
         out_specs=[kspec(kmap2), kspec(kmap2)],
@@ -544,13 +637,28 @@ def _blocks(sq, sk):
     return bq, bk
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _flash(q, k, v, kpm, seed, causal, scale, dropout_p):
-    o, _ = _flash_fwd(q, k, v, kpm, seed, causal, scale, dropout_p)
+def _seg_pads(seg, sqp, skp):
+    """[b, sq] int32 segment ids → padded (q_view, k_view), pad id −2
+    (matches nothing; negative ids are always-masked keys)."""
+    if seg is None:
+        return None
+    seg = seg.astype(jnp.int32)
+    segq = _pad_to(seg + 0, sqp, 1) + jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (1, sqp), 1) >= seg.shape[1],
+        jnp.int32(-2), 0)
+    segk = _pad_to(seg + 0, skp, 1) + jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (1, skp), 1) >= seg.shape[1],
+        jnp.int32(-2), 0)
+    return segq, segk
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _flash(q, k, v, kpm, seg, seed, causal, scale, dropout_p):
+    o, _ = _flash_fwd(q, k, v, kpm, seg, seed, causal, scale, dropout_p)
     return o
 
 
-def _flash_fwd(q, k, v, kpm, seed, causal, scale, dropout_p):
+def _flash_fwd(q, k, v, kpm, seg, seed, causal, scale, dropout_p):
     b, sq, n, d = q.shape
     sk = k.shape[1]
     block_q, block_k = _blocks(sq, sk)
@@ -561,16 +669,21 @@ def _flash_fwd(q, k, v, kpm, seed, causal, scale, dropout_p):
     v3 = _pad_to(_to_bh(v), skp, 1)
     kpm3 = (None if kpm is None
             else _pad_to(kpm.astype(jnp.float32)[:, None, :], skp, 2))
-    q3, k3, v3, kpm3, seed = _unify_vma(q3, k3, v3, kpm3, seed)
-    o3, lse = _fwd_pallas(q3, k3, v3, kpm3, seed, scale, causal, sk,
-                          block_q, block_k, dropout_p,
+    seg3 = _seg_pads(seg, sqp, skp)
+    q3, k3, v3, kpm3, seg3q, seg3k, seed = _unify_vma(
+        q3, k3, v3, kpm3,
+        None if seg3 is None else seg3[0],
+        None if seg3 is None else seg3[1], seed)
+    seg3 = None if seg3 is None else (seg3q, seg3k)
+    o3, lse = _fwd_pallas(q3, k3, v3, kpm3, seg3, seed, scale, causal,
+                          sk, block_q, block_k, dropout_p,
                           interpret=not on_tpu())
     o = _from_bh(o3, b, n)[:, :sq]
-    return o, (q, k, v, kpm, seed, o, lse)
+    return o, (q, k, v, kpm, seg, seed, o, lse)
 
 
 def _flash_bwd(causal, scale, dropout_p, res, do):
-    q, k, v, kpm, seed, o, lse = res
+    q, k, v, kpm, seg, seed, o, lse = res
     b, sq, n, d = q.shape
     sk = k.shape[1]
     block_q, block_k = _blocks(sq, sk)
@@ -586,11 +699,15 @@ def _flash_bwd(causal, scale, dropout_p, res, do):
                     axis=-1)
     kpm3 = (None if kpm is None
             else _pad_to(kpm.astype(jnp.float32)[:, None, :], skp, 2))
-    q3, k3, v3, do3, lse3, delta, kpm3, seed = _unify_vma(
-        q3, k3, v3, do3, lse3, delta, kpm3, seed)
+    seg3 = _seg_pads(seg, sqp, skp)
+    q3, k3, v3, do3, lse3, delta, kpm3, seg3q, seg3k, seed = _unify_vma(
+        q3, k3, v3, do3, lse3, delta, kpm3,
+        None if seg3 is None else seg3[0],
+        None if seg3 is None else seg3[1], seed)
+    seg3 = None if seg3 is None else (seg3q, seg3k)
     dq3, dk3, dv3 = _bwd_pallas(
-        q3, k3, v3, do3, lse3, delta, kpm3, seed, scale, causal, sq, sk,
-        block_q, block_k, dropout_p, interpret=not on_tpu())
+        q3, k3, v3, do3, lse3, delta, kpm3, seg3, seed, scale, causal,
+        sq, sk, block_q, block_k, dropout_p, interpret=not on_tpu())
     dq = _from_bh(dq3, b, n)[:, :sq]
     dk = _from_bh(dk3, b, n)[:, :sk]
     dv = _from_bh(dv3, b, n)[:, :sk]
@@ -599,8 +716,9 @@ def _flash_bwd(causal, scale, dropout_p, res, do):
     # Learned additive masks/biases belong on the differentiable XLA
     # ``bias`` path.
     dkpm = None if kpm is None else jnp.zeros_like(kpm)
+    dseg = None if seg is None else np.zeros(seg.shape, jax.dtypes.float0)
     dseed = np.zeros(seed.shape, jax.dtypes.float0)
-    return dq, dk, dv, dkpm, dseed
+    return dq, dk, dv, dkpm, dseg, dseed
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -627,18 +745,26 @@ def flash_attention(
     scale: Optional[float] = None,
     dropout_p: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Memory-efficient attention over [b, s, n, d] tensors.
 
     The Pallas blockwise kernel handles ``causal``, ``key_padding_mask``
     ([b, sk] bool True = masked, or additive float — the reference's
-    ``mask_additive`` MHA mode / the cu_seqlens analog of fmha_api.cpp:358)
-    and attention ``dropout`` (fused in-kernel, O(sq·d) memory — reference
-    multihead_attn philox.cuh analog).  A generic boolean ``mask`` or
-    additive ``bias`` falls back to the fused-softmax XLA composition.
+    ``mask_additive`` MHA mode), ``segment_ids`` ([b, s] int32 — packed
+    multi-sequence rows attend within their own segment only, with a
+    block-sparse skip of fully-disjoint tiles; negative ids mark padding
+    slots.  This is the cu_seqlens varlen mode of the reference fmha,
+    fmha_api.cpp:358 — see :func:`flash_attention_packed` for the
+    cu_seqlens-shaped wrapper) and attention ``dropout`` (fused
+    in-kernel, O(sq·d) memory — reference multihead_attn philox.cuh
+    analog).  A generic boolean ``mask`` or additive ``bias`` falls back
+    to the fused-softmax XLA composition.
     """
     if q.ndim != 4:
         raise ValueError(f"expected [b, s, n, d], got {q.shape}")
+    if segment_ids is not None and q.shape[1] != k.shape[1]:
+        raise ValueError("segment_ids requires sq == sk (packed rows)")
     d = q.shape[-1]
     scale = (1.0 / d ** 0.5) if scale is None else float(scale)
     generic = mask is not None or bias is not None
@@ -653,7 +779,7 @@ def flash_attention(
         return mha_reference(
             q, k, v, causal=causal, key_padding_mask=key_padding_mask,
             mask=mask, bias=bias, scale=scale, dropout_p=dropout_p,
-            dropout_rng=dropout_rng)
+            dropout_rng=dropout_rng, segment_ids=segment_ids)
     kpm = key_padding_mask
     if kpm is not None:
         if kpm.dtype == jnp.bool_:
@@ -661,8 +787,53 @@ def flash_attention(
         # the fused kernel does not differentiate the mask — learned
         # additive masks must use ``bias`` (XLA path) instead
         kpm = jax.lax.stop_gradient(kpm)
+    seg = (None if segment_ids is None
+           else jax.lax.stop_gradient(segment_ids.astype(jnp.int32)))
     use_dropout = dropout_p > 0.0 and dropout_rng is not None
     seed = (_seed_from_rng(dropout_rng) if use_dropout
             else jnp.zeros((1,), jnp.int32))
-    return _flash(q, k, v, kpm, seed, causal, scale,
+    return _flash(q, k, v, kpm, seg, seed, causal, scale,
                   float(dropout_p) if use_dropout else 0.0)
+
+
+def segment_ids_from_cu_seqlens(cu_seqlens: jax.Array,
+                                total: int) -> jax.Array:
+    """[b+1] cumulative sequence starts → [total] int32 segment ids
+    (the reference varlen descriptor, fmha_api.cpp:358).  Positions at or
+    beyond ``cu_seqlens[-1]`` get id −1 (padding: masked as keys)."""
+    pos = jnp.arange(total, dtype=jnp.int32)
+    seg = jnp.searchsorted(cu_seqlens.astype(jnp.int32), pos,
+                           side="right").astype(jnp.int32) - 1
+    n_seq = cu_seqlens.shape[0] - 1
+    return jnp.where(seg >= n_seq, -1, seg)
+
+
+def flash_attention_packed(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    cu_seqlens: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    dropout_p: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Varlen (THD) attention over ``[total, n, d]`` packed tensors.
+
+    The reference fmha's defining mode: multiple sequences packed into
+    one row with ``cu_seqlens`` boundaries and zero padding compute
+    (apex/contrib/fmha/fmha.py:33-60, fmha_api.cpp:358).  Pairs with
+    :func:`apex_tpu.ops.rope.fused_apply_rotary_pos_emb_thd` (same
+    cu_seqlens layout).  Internally runs the segment-id kernel on a
+    [1, total, n, d] view; cross-segment tiles are skipped blockwise.
+    """
+    if q.ndim != 3:
+        raise ValueError(f"expected packed [total, n, d], got {q.shape}")
+    total = q.shape[0]
+    seg = segment_ids_from_cu_seqlens(cu_seqlens, total)
+    out = flash_attention(
+        q[None], k[None], v[None], causal=causal,
+        segment_ids=seg[None], scale=scale, dropout_p=dropout_p,
+        dropout_rng=dropout_rng)
+    return out[0]

@@ -118,9 +118,9 @@ def _chunk_fwd(q3, k3, v3, scale, causal_mode, s_local, block_q, block_k,
             # f32 kernel outputs: chunk results feed the f32 lse merge /
             # traveling accumulators; rounding to bf16 per chunk would
             # compound error with ring size
-            o, lse = _fwd_pallas(q3, k3, v3, None, None, scale, causal,
-                                 s_local, block_q, block_k, 0.0, False,
-                                 out_dtype=jnp.float32)
+            o, lse = _fwd_pallas(q3, k3, v3, None, None, None, scale,
+                                 causal, s_local, block_q, block_k, 0.0,
+                                 False, out_dtype=jnp.float32)
             return o, lse
         return _chunk_fwd_ref(q3, k3, v3, scale, causal, s_local)
 
@@ -142,8 +142,8 @@ def _chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal_mode, s_local,
     def run(causal):
         if use_pallas:
             dq, dk, dv = _bwd_pallas(
-                q3, k3, v3, do3, lse, delta, None, None, scale, causal,
-                s_local, s_local, block_q, block_k, 0.0, False,
+                q3, k3, v3, do3, lse, delta, None, None, None, scale,
+                causal, s_local, s_local, block_q, block_k, 0.0, False,
                 out_dtype=jnp.float32)
             return dq, dk, dv
         return _chunk_bwd_ref(q3, k3, v3, do3, lse, delta, scale, causal,

@@ -46,9 +46,13 @@ def init_moe_params(
     *,
     init_std: float = 0.02,
     dtype=jnp.float32,
+    activation: str = "gelu",
 ) -> dict:
-    """Expert-stacked FFN params [E, ...] + router [h, E]."""
+    """Expert-stacked FFN params [E, ...] + router [h, E].  With
+    ``activation='swiglu'`` fc1 carries the concatenated [gate ‖ up]
+    columns (trailing dim 2f)."""
     k1, k2, k3 = jax.random.split(rng, 3)
+    f1 = 2 * ffn_hidden_size if activation == "swiglu" else ffn_hidden_size
 
     def nrm(k, shape):
         return (jax.random.normal(k, shape, jnp.float32)
@@ -56,8 +60,8 @@ def init_moe_params(
 
     return {
         "router": nrm(k1, (hidden_size, num_experts)),
-        "fc1": nrm(k2, (num_experts, hidden_size, ffn_hidden_size)),
-        "fc1_bias": jnp.zeros((num_experts, ffn_hidden_size), dtype),
+        "fc1": nrm(k2, (num_experts, hidden_size, f1)),
+        "fc1_bias": jnp.zeros((num_experts, f1), dtype),
         "fc2": nrm(k3, (num_experts, ffn_hidden_size, hidden_size)),
         "fc2_bias": jnp.zeros((num_experts, hidden_size), dtype),
     }
@@ -79,6 +83,7 @@ def switch_moe_mlp(
     top_k: int = 1,
     ep_axis: Optional[str] = "ep",
     router_noise_rng: Optional[jax.Array] = None,
+    activation: str = "gelu",
 ) -> MoEOutput:
     """Token-choice top-k MoE FFN over ``x`` [b, s, h].
 
@@ -86,6 +91,10 @@ def switch_moe_mlp(
     ``ceil(top_k * s * capacity_factor / E)`` token slots per batch row;
     tokens over capacity fall through with a zero update (the Switch
     drop-token rule) and are reported in ``dropped_fraction``.
+
+    ``activation='swiglu'`` expects ``fc1``/``fc1_bias`` with a doubled
+    trailing dim ``2f`` ([gate ‖ up] concatenated) and applies the fused
+    bias-SwiGLU epilogue (ops/swiglu.py) inside each expert.
     """
     b, s, h = x.shape
     E = params["router"].shape[-1]
@@ -133,10 +142,16 @@ def switch_moe_mlp(
     fc1 = _expert_constrain(params["fc1"], ep_axis)
     fc2 = _expert_constrain(params["fc2"], ep_axis)
     h1 = jnp.einsum("ebch,ehf->ebcf", expert_in, fc1.astype(x.dtype))
-    h1 = h1 + _expert_constrain(params["fc1_bias"], ep_axis)[
+    bias1 = _expert_constrain(params["fc1_bias"], ep_axis)[
         :, None, None, :].astype(x.dtype)
-    h1 = jax.nn.gelu(h1.astype(jnp.float32), approximate=False).astype(
-        x.dtype)
+    if activation == "swiglu":
+        from apex_tpu.ops.swiglu import fused_bias_swiglu
+
+        h1 = fused_bias_swiglu(h1 + bias1)
+    else:
+        h1 = h1 + bias1
+        h1 = jax.nn.gelu(h1.astype(jnp.float32),
+                         approximate=False).astype(x.dtype)
     h2 = jnp.einsum("ebcf,efh->ebch", h1, fc2.astype(x.dtype))
     h2 = h2 + _expert_constrain(params["fc2_bias"], ep_axis)[
         :, None, None, :].astype(x.dtype)

@@ -104,3 +104,25 @@ class TestRingBackward:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b_), atol=1e-4, rtol=1e-4,
                 err_msg=f"d{name}")
+
+
+def test_ring_kernel_call_signature_interpret():
+    """Regression (round-3 review): the ring path calls the flash
+    _fwd_pallas/_bwd_pallas wrappers positionally; run those exact call
+    shapes in interpret mode so a signature change breaks here on CPU
+    instead of only at TPU trace time."""
+    import jax.numpy as jnp
+    import numpy as np
+    from apex_tpu.ops.flash_attention import _bwd_pallas, _fwd_pallas
+
+    rng = np.random.RandomState(0)
+    bh, s, d = 2, 128, 32
+    q3 = jnp.asarray(rng.randn(bh, s, d), jnp.float32)
+    o, lse = _fwd_pallas(q3, q3, q3, None, None, None, 0.125, True,
+                         s, 128, 128, 0.0, True, out_dtype=jnp.float32)
+    assert o.shape == q3.shape
+    delta = jnp.sum(o * o, axis=-1)
+    dq, dk, dv = _bwd_pallas(
+        q3, q3, q3, o, lse, delta, None, None, None, 0.125, True,
+        s, s, 128, 128, 0.0, True, out_dtype=jnp.float32)
+    assert dq.shape == q3.shape and dk.shape == q3.shape
