@@ -1,0 +1,5 @@
+"""File-backed input pipelines (the examples/imagenet loader analog)."""
+
+from apex_tpu.data.image_folder import ImageFolderDataset, make_image_loader
+
+__all__ = ["ImageFolderDataset", "make_image_loader"]

@@ -1,0 +1,188 @@
+"""ImageFolder dataset + threaded loader over the Megatron samplers.
+
+Reference: examples/imagenet/main_amp.py:188-218 builds
+``torchvision.datasets.ImageFolder`` train/val datasets with
+RandomResizedCrop/flip transforms and feeds them through torch
+DataLoaders into the ``data_prefetcher``.  The torch-free TPU analog:
+
+- :class:`ImageFolderDataset` — same on-disk contract (one subdirectory
+  per class, sorted subdir names become contiguous class ids), PIL
+  decode, random-resized-crop + horizontal flip for train / resize +
+  center-crop for eval, ImageNet mean/std normalization, NHWC float32
+  (the channels-last layout the conv stack wants on TPU).
+- :func:`make_image_loader` — drives a
+  :class:`~apex_tpu.transformer._data._batchsampler.MegatronPretraining\
+RandomSampler` (or the sequential variant) over the dataset with a
+  thread pool doing the decodes (PIL releases the GIL around I/O and
+  codec work), yielding stacked ``(images, labels)`` numpy batches ready
+  for the example's device prefetcher.  Resumability comes from the
+  sampler's ``consumed_samples`` contract, exactly like Megatron.
+
+Determinism: every ``__getitem__`` draws its augmentation randomness
+from a private RandomState seeded by ``(seed, index, per-index visit
+count)`` — thread-interleaving inside the loader pool cannot change the
+crops, and repeated epochs still see fresh augmentations.
+
+The decode path stays uint8 end-to-end (decode → crop → resize) and
+normalizes to float32 exactly once; float ``.npy`` inputs keep full
+precision through a per-channel float resize.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Tuple
+
+import numpy as np
+
+__all__ = ["ImageFolderDataset", "make_image_loader"]
+
+_MEAN = np.array([0.485, 0.456, 0.406], np.float32)   # main_amp.py:200
+_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp", ".npy")
+
+
+def _resize(img: np.ndarray, size_wh) -> np.ndarray:
+    """Bilinear resize preserving dtype: uint8 via PIL RGB, float via
+    per-channel PIL 'F' mode (no 8-bit quantization of float inputs)."""
+    from PIL import Image
+
+    if img.dtype == np.uint8:
+        return np.asarray(
+            Image.fromarray(img).resize(size_wh, Image.BILINEAR))
+    chans = [np.asarray(Image.fromarray(img[..., c], mode="F").resize(
+        size_wh, Image.BILINEAR)) for c in range(img.shape[-1])]
+    return np.stack(chans, axis=-1)
+
+
+class ImageFolderDataset:
+    """``root/<class>/<image>`` tree → (image [H,W,3] f32 NHWC, label).
+
+    ``train=True`` applies random-resized-crop (scale 0.08–1.0) and
+    horizontal flip (transforms.RandomResizedCrop/RandomHorizontalFlip,
+    main_amp.py:196-199); eval resizes the short side to
+    ``image_size * 256 // 224`` and center-crops (:207-209).  ``.npy``
+    files (H, W, 3 uint8 or float arrays) are accepted alongside images
+    so tests and preprocessed datasets skip the codec.
+    """
+
+    def __init__(self, root: str, image_size: int = 224,
+                 train: bool = True, seed: int = 0):
+        self.root = root
+        self.image_size = image_size
+        self.train = train
+        self.seed = seed
+        classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"no class subdirectories under {root!r}")
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: list = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(_EXTS):
+                    self.samples.append(
+                        (os.path.join(cdir, fn), self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(f"no images found under {root!r}")
+        self._visit_lock = threading.Lock()
+        self._visits: dict = {}
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def _sample_rng(self, idx: int) -> np.random.RandomState:
+        """Private per-call RandomState: deterministic under any thread
+        interleaving (seeded by (seed, idx, visit#)), fresh each epoch."""
+        with self._visit_lock:
+            visit = self._visits.get(idx, 0)
+            self._visits[idx] = visit + 1
+        mix = (self.seed * 1_000_003 + idx * 9_176 + visit) % (2 ** 31)
+        return np.random.RandomState(mix)
+
+    def _decode(self, path: str) -> np.ndarray:
+        if path.endswith(".npy"):
+            return np.load(path)
+        from PIL import Image
+
+        with Image.open(path) as im:
+            return np.asarray(im.convert("RGB"))    # uint8 HWC
+
+    def _train_crop(self, img: np.ndarray,
+                    rng: np.random.RandomState) -> np.ndarray:
+        """RandomResizedCrop(size, scale=(0.08, 1.0)) + flip."""
+        h, w = img.shape[:2]
+        size = self.image_size
+        area = h * w
+        for _ in range(10):
+            target = area * rng.uniform(0.08, 1.0)
+            ratio = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+            cw = int(round(np.sqrt(target * ratio)))
+            ch = int(round(np.sqrt(target / ratio)))
+            if cw <= w and ch <= h:
+                y0 = rng.randint(0, h - ch + 1)
+                x0 = rng.randint(0, w - cw + 1)
+                img = img[y0:y0 + ch, x0:x0 + cw]
+                break
+        out = _resize(img, (size, size))
+        if rng.rand() < 0.5:
+            out = out[:, ::-1]
+        return out
+
+    def _eval_crop(self, img: np.ndarray) -> np.ndarray:
+        size = self.image_size
+        short = size * 256 // 224
+        h, w = img.shape[:2]
+        scale = short / min(h, w)
+        nh, nw = int(round(h * scale)), int(round(w * scale))
+        img = _resize(img, (nw, nh))
+        y0 = (nh - size) // 2
+        x0 = (nw - size) // 2
+        return img[y0:y0 + size, x0:x0 + size]
+
+    def __getitem__(self, idx: int) -> Tuple[np.ndarray, int]:
+        path, label = self.samples[idx]
+        img = self._decode(path)
+        if img.ndim == 2:
+            img = np.stack([img] * 3, axis=-1)
+        was_uint8 = img.dtype == np.uint8
+        if self.train:
+            img = self._train_crop(img, self._sample_rng(idx))
+        else:
+            img = self._eval_crop(img)
+        # single dtype conversion + normalization at the very end;
+        # float .npy inputs are expected in [0, 1] already
+        img = img.astype(np.float32)
+        if was_uint8:
+            img = img / 255.0
+        img = (img - _MEAN) / _STD
+        return np.ascontiguousarray(img, np.float32), label
+
+
+def make_image_loader(
+    dataset: ImageFolderDataset,
+    sampler,
+    num_workers: int = 4,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Yield ``(images [b,H,W,3] f32, labels [b] i32)`` batches for the
+    index batches the Megatron sampler emits.
+
+    The sampler owns ordering, data-parallel bucketing, and resume
+    (``consumed_samples``); this loader owns decode + collate, with a
+    thread pool overlapping the per-image work (the torch DataLoader
+    ``workers`` analog, main_amp.py:214).
+    """
+    pool = ThreadPoolExecutor(max_workers=max(1, num_workers))
+    try:
+        for batch_idx in sampler:
+            items = list(pool.map(dataset.__getitem__, batch_idx))
+            images = np.stack([im for im, _ in items])
+            labels = np.asarray([lb for _, lb in items], np.int32)
+            yield images, labels
+    finally:
+        pool.shutdown(wait=False)

@@ -64,6 +64,11 @@ class TransformerConfig:
     attention_backend: str = "flash"              # 'flash' | 'fused_softmax'
     remat: bool = False                           # jax.checkpoint each layer
     scan_layers: bool = True                      # lax.scan over the stack
+    # fuse the LM-head matmul into the CE loss, chunked over tokens, so
+    # the [tokens, vocab] logits never hit HBM (ops/lm_head_ce.py);
+    # applies to the training loss on the non-vocab-parallel path only
+    fused_head_ce: bool = False
+    head_ce_chunk: int = 2048
 
     def __post_init__(self):
         if self.ffn_hidden_size is None:

@@ -611,6 +611,28 @@ def gpt_loss(params: dict, tokens: jax.Array, labels: jax.Array,
     models; causal masking needs none.
     """
     ctx = ctx or single_device_ctx()
+    if cfg.fused_head_ce and not ctx.vocab_parallel:
+        # fused head+CE: stop before the head and chunk the vocab matmul
+        # into the loss (ops/lm_head_ce.py) — the [tokens, vocab] logits
+        # are never materialized
+        from apex_tpu.ops.lm_head_ce import lm_head_cross_entropy
+
+        h = ctx.constrain_hidden(embed_tokens(params["embedding"],
+                                              tokens, cfg, ctx))
+        h, aux = transformer_backbone(params, h, cfg, ctx,
+                                      attention_mask=attention_mask,
+                                      dropout_rng=dropout_rng,
+                                      with_aux=True)
+        head = (params["lm_head"]["kernel"]
+                if cfg.untie_embeddings_and_output_weights
+                else params["embedding"]["word"]).astype(cfg.compute_dtype)
+        losses = lm_head_cross_entropy(
+            h, head, labels, chunk=cfg.head_ce_chunk, ignore_index=-1)
+        n_valid = jnp.maximum(jnp.sum(labels != -1), 1)
+        loss = jnp.sum(losses) / n_valid.astype(jnp.float32)
+        if cfg.num_experts:
+            loss = loss + cfg.moe_aux_loss_coeff * aux / cfg.num_layers
+        return loss
     logits, aux = gpt_forward(params, tokens, cfg, ctx,
                               attention_mask=attention_mask,
                               dropout_rng=dropout_rng, with_aux=True)

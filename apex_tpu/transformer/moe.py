@@ -142,14 +142,15 @@ def switch_moe_mlp(
     fc1 = _expert_constrain(params["fc1"], ep_axis)
     fc2 = _expert_constrain(params["fc2"], ep_axis)
     h1 = jnp.einsum("ebch,ehf->ebcf", expert_in, fc1.astype(x.dtype))
-    bias1 = _expert_constrain(params["fc1_bias"], ep_axis)[
-        :, None, None, :].astype(x.dtype)
+    bias1 = _expert_constrain(params["fc1_bias"], ep_axis)
     if activation == "swiglu":
         from apex_tpu.ops.swiglu import fused_bias_swiglu
 
-        h1 = fused_bias_swiglu(h1 + bias1)
+        # vmap over experts so each expert's [2f] bias rides the op's
+        # own fp32 bias path (same precision contract as the dense FFN)
+        h1 = jax.vmap(fused_bias_swiglu)(h1, bias1)
     else:
-        h1 = h1 + bias1
+        h1 = h1 + bias1[:, None, None, :].astype(x.dtype)
         h1 = jax.nn.gelu(h1.astype(jnp.float32),
                          approximate=False).astype(x.dtype)
     h2 = jnp.einsum("ebcf,efh->ebch", h1, fc2.astype(x.dtype))

@@ -14,12 +14,19 @@ checkpoint save/resume).  Feature parity on TPU:
 - checkpoint save/restore + ADLR AutoResume requeue
   (utils/checkpoint.py; resume picks up at the saved step)
 
-Runs on synthetic data by default; swap ``synthetic_batches`` for a real
-input pipeline to train ImageNet.
+With ``--data-dir`` the trainer reads a real ImageFolder tree
+(``<dir>/<class>/<img>``) through :mod:`apex_tpu.data` — PIL decode +
+augmentation in a thread pool, batched by
+``MegatronPretrainingRandomSampler`` (per-rank buckets, epoch-seeded
+shuffles, ``consumed_samples`` resume — the torch DataLoader +
+DistributedSampler analog, main_amp.py:188-218).  Without it, synthetic
+batches keep the benchmark path dependency-free.
 
 Run:     python examples/imagenet_rn50.py [--batch 128] [--opt-level O2]
+Real:    python examples/imagenet_rn50.py --data-dir /data/imagenet/train
 Resume:  python examples/imagenet_rn50.py --ckpt-dir /tmp/rn50ckpt
-         (a second run with the same dir continues from the last save)
+         (a second run with the same dir continues from the last save,
+         and the sampler continues from the same consumed_samples)
 """
 
 import argparse
@@ -31,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu.models import make_resnet_train_step, resnet50
+from apex_tpu.models import make_resnet_train_step
 from apex_tpu.optimizers import fused_sgd
 from apex_tpu.parallel.mesh import create_mesh
 from apex_tpu.utils.checkpoint import (
@@ -48,6 +55,29 @@ def synthetic_batches(batch, hw=224, classes=1000, seed=0):
         x = rng.randn(batch, hw, hw, 3).astype(np.float32)
         y = rng.randint(0, classes, (batch,)).astype(np.int32)
         yield x, y
+
+
+def real_batches(data_dir, batch, hw, start_step):
+    """ImageFolder tree → endless resumable batches (see module doc)."""
+    from apex_tpu.data import ImageFolderDataset, make_image_loader
+    from apex_tpu.transformer._data import MegatronPretrainingRandomSampler
+
+    ds = ImageFolderDataset(data_dir, image_size=hw, train=True)
+    consumed = start_step * batch
+    while True:   # sampler iterates one epoch per pass; loop forever
+        sampler = MegatronPretrainingRandomSampler(
+            total_samples=len(ds),
+            consumed_samples=consumed,
+            local_minibatch_size=batch,
+            data_parallel_rank=0,
+            data_parallel_size=1,
+        )
+        for x, y in make_image_loader(ds, sampler):
+            # the sampler itself drops ragged tails (Megatron's
+            # last-batch rule), so every batch arrives full
+            assert x.shape[0] == batch, x.shape
+            consumed += x.shape[0]
+            yield x, y
 
 
 _DONE = object()
@@ -114,15 +144,26 @@ def main():
                     help="enable save/resume in this directory")
     ap.add_argument("--ckpt-every", type=int, default=25)
     ap.add_argument("--steps-per-epoch", type=int, default=5000)
+    ap.add_argument("--data-dir", default=None,
+                    help="ImageFolder root (class subdirs); synthetic "
+                         "data when omitted")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--arch", default="resnet50",
+                    help="resnet18/34/50/101/152 (reference --arch, "
+                         "main_amp.py:36)")
+    ap.add_argument("--num-classes", type=int, default=1000)
     args = ap.parse_args()
 
+    import apex_tpu.models as _models
+
     mesh = create_mesh() if len(jax.devices()) > 1 else None
-    model = resnet50(num_classes=1000)
+    model = getattr(_models, args.arch)(num_classes=args.num_classes)
     schedule = lambda step: lr_schedule(  # noqa: E731
         args.lr, step, args.steps_per_epoch)
     init, step = make_resnet_train_step(
         model, fused_sgd(lr=schedule, momentum=0.9, weight_decay=1e-4),
-        args.opt_level, mesh)
+        args.opt_level, mesh, image_shape=(args.image_size,
+                                           args.image_size, 3))
     state, stats = init(jax.random.PRNGKey(0))
 
     start = 0
@@ -137,11 +178,19 @@ def main():
     auto = AutoResume()
     auto.init()
 
-    batches = prefetcher(synthetic_batches(args.batch))
-    x, y = next(batches)
+    if args.data_dir:
+        source = real_batches(args.data_dir, args.batch,
+                              args.image_size, start)
+    else:
+        source = synthetic_batches(args.batch, hw=args.image_size)
+    batches = prefetcher(source)
     # compile-only warmup on a throwaway COPY (the step donates its
-    # inputs), so resumed runs don't accumulate uncounted optimizer
-    # updates across preemption cycles
+    # inputs) and a ZERO batch — drawing a real batch here would drop
+    # those samples from the epoch and skew the sampler's
+    # consumed_samples accounting across preemption/resume cycles
+    x = jnp.zeros((args.batch, args.image_size, args.image_size, 3),
+                  jnp.float32)
+    y = jnp.zeros((args.batch,), jnp.int32)
     warm = jax.tree_util.tree_map(
         lambda v: jnp.array(v, copy=True) if isinstance(v, jax.Array)
         else v, (state, stats))

@@ -121,6 +121,65 @@ class TestL1Traces:
         assert losses[-1] < losses[0]
 
 
+def run_trace_mesh(dp: int, tp: int, n_steps: int = N_STEPS):
+    """The same O0 trace under GSPMD dp/tp sharding on the 8-device
+    mesh — the reference tests/L1/cross_product_distributed analog
+    (run.sh repeats the convergence comparison under a 2-GPU launch)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apex_tpu.models.transformer_lm import gpt_param_specs, gspmd_ctx
+    from apex_tpu.parallel.mesh import create_mesh
+
+    cfg = _cfg()
+    mesh = create_mesh(dp=dp, tp=tp, pp=1, sp=1)
+    ctx = gspmd_ctx()
+    params = init_gpt_params(jax.random.PRNGKey(42), cfg)
+    params = jax.device_put(
+        params,
+        jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), gpt_param_specs(cfg),
+            is_leaf=lambda x: isinstance(x, P)))
+    tokens, labels = _data(cfg)
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P("dp")))
+    labels = jax.device_put(labels, NamedSharding(mesh, P("dp")))
+
+    def loss_fn(p, t, l):
+        return gpt_loss(p, t, l, cfg, ctx)
+
+    tx = _norm_tracking(fused_adam(lr=1e-3))
+    init_fn, step_fn = make_train_step(loss_fn, tx, "O0")
+    step_fn = jax.jit(step_fn)
+    losses, norms = [], []
+    with jax.set_mesh(mesh):
+        state = init_fn(params)
+        for _ in range(n_steps):
+            state, metrics = step_fn(state, tokens, labels)
+            losses.append(float(metrics["loss"]))
+            norms.append(float(state.opt_state.grad_norm))
+    return np.array(losses), np.array(norms)
+
+
+class TestL1TracesDistributed:
+    """Multi-device L1: the dp and dp×tp shardings must track the stored
+    single-device golden — same model, same batch, same trajectory."""
+
+    @pytest.mark.parametrize("dp,tp", [(8, 1), (4, 2)])
+    def test_sharded_trace_matches_golden(self, dp, tp):
+        if len(jax.devices()) < dp * tp:
+            pytest.skip("needs the 8-device mesh")
+        with open(GOLDEN) as f:
+            gold = json.load(f)
+        losses, norms = run_trace_mesh(dp, tp)
+        np.testing.assert_allclose(
+            losses, np.array(gold["loss"]), rtol=1e-4, atol=1e-5,
+            err_msg=f"dp={dp},tp={tp} loss trace drifted from the "
+                    "single-device golden")
+        np.testing.assert_allclose(
+            norms, np.array(gold["grad_norm"]), rtol=1e-3, atol=1e-4,
+            err_msg=f"dp={dp},tp={tp} grad-norm trace drifted from the "
+                    "single-device golden")
+
+
 if __name__ == "__main__":
     import sys
     if "--regen" in sys.argv:

@@ -1,0 +1,106 @@
+"""Chunked fused LM-head + cross-entropy vs the two-stage composition."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.lm_head_ce import lm_head_cross_entropy
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+
+def _case(n=70, h=32, v=97, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    hidden = jnp.asarray(rng.randn(n, h) * 0.5, dtype)
+    head = jnp.asarray(rng.randn(v, h) * 0.1, dtype)
+    labels = jnp.asarray(rng.randint(0, v, (n,)), jnp.int32)
+    return hidden, head, labels
+
+
+def _two_stage(hidden, head, labels, smoothing=0.0):
+    logits = jnp.einsum("nh,vh->nv", hidden, head.astype(hidden.dtype),
+                        preferred_element_type=jnp.float32)
+    return softmax_cross_entropy_loss(logits, labels, smoothing, None)
+
+
+class TestForward:
+    @pytest.mark.parametrize("chunk", [16, 64, 1024])
+    def test_matches_two_stage(self, chunk):
+        hidden, head, labels = _case()
+        got = lm_head_cross_entropy(hidden, head, labels, chunk=chunk)
+        want = _two_stage(hidden, head, labels)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_smoothing(self):
+        hidden, head, labels = _case(seed=1)
+        got = lm_head_cross_entropy(hidden, head, labels,
+                                    smoothing=0.1, chunk=32)
+        want = _two_stage(hidden, head, labels, smoothing=0.1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_ignore_index(self):
+        hidden, head, labels = _case(seed=2)
+        labels = labels.at[::7].set(-1)
+        got = lm_head_cross_entropy(hidden, head, labels, chunk=32,
+                                    ignore_index=-1)
+        assert float(jnp.max(jnp.abs(got[::7]))) == 0.0
+        want = _two_stage(hidden, head, jnp.maximum(labels, 0))
+        np.testing.assert_allclose(
+            np.asarray(got[1::7]), np.asarray(want[1::7]),
+            rtol=1e-5, atol=1e-6)
+
+    def test_leading_dims(self):
+        hidden, head, labels = _case(n=64, seed=3)
+        got = lm_head_cross_entropy(
+            hidden.reshape(4, 16, -1), head, labels.reshape(4, 16),
+            chunk=16)
+        assert got.shape == (4, 16)
+
+
+class TestBackward:
+    @pytest.mark.parametrize("chunk", [16, 1024])
+    def test_grads_match_two_stage(self, chunk):
+        hidden, head, labels = _case(seed=4)
+
+        def fused(hd, he):
+            return lm_head_cross_entropy(hd, he, labels,
+                                         chunk=chunk).mean()
+
+        def ref(hd, he):
+            return _two_stage(hd, he, labels).mean()
+
+        gf = jax.grad(fused, argnums=(0, 1))(hidden, head)
+        gr = jax.grad(ref, argnums=(0, 1))(hidden, head)
+        np.testing.assert_allclose(np.asarray(gf[0]), np.asarray(gr[0]),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(gf[1]), np.asarray(gr[1]),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_grads_with_ignore_and_smoothing(self):
+        hidden, head, labels = _case(seed=5)
+        labels = labels.at[::5].set(-1)
+
+        def fused(hd, he):
+            return lm_head_cross_entropy(
+                hd, he, labels, chunk=32, smoothing=0.05,
+                ignore_index=-1).sum()
+
+        def ref(hd, he):
+            losses = _two_stage(hd, he, jnp.maximum(labels, 0), 0.05)
+            return jnp.where(labels == -1, 0.0, losses).sum()
+
+        gf = jax.grad(fused, argnums=(0, 1))(hidden, head)
+        gr = jax.grad(ref, argnums=(0, 1))(hidden, head)
+        np.testing.assert_allclose(np.asarray(gf[0]), np.asarray(gr[0]),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(gf[1]), np.asarray(gr[1]),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_bf16_inputs(self):
+        hidden, head, labels = _case(seed=6, dtype=jnp.bfloat16)
+        g = jax.grad(lambda hd: lm_head_cross_entropy(
+            hd, head, labels, chunk=32).mean())(hidden)
+        assert g.dtype == jnp.bfloat16
+        assert np.isfinite(np.asarray(g, np.float32)).all()
