@@ -53,8 +53,11 @@ def _resize(img: np.ndarray, size_wh) -> np.ndarray:
     if img.dtype == np.uint8:
         return np.asarray(
             Image.fromarray(img).resize(size_wh, Image.BILINEAR))
-    chans = [np.asarray(Image.fromarray(img[..., c], mode="F").resize(
-        size_wh, Image.BILINEAR)) for c in range(img.shape[-1])]
+    # mode="F" reinterprets the buffer as float32 — convert first or
+    # float64 inputs resize to garbage
+    chans = [np.asarray(
+        Image.fromarray(img[..., c].astype(np.float32), mode="F").resize(
+            size_wh, Image.BILINEAR)) for c in range(img.shape[-1])]
     return np.stack(chans, axis=-1)
 
 
@@ -106,7 +109,7 @@ class ImageFolderDataset:
         return np.random.RandomState(mix)
 
     def _decode(self, path: str) -> np.ndarray:
-        if path.endswith(".npy"):
+        if path.lower().endswith(".npy"):
             return np.load(path)
         from PIL import Image
 
@@ -119,7 +122,21 @@ class ImageFolderDataset:
         h, w = img.shape[:2]
         size = self.image_size
         area = h * w
-        for _ in range(10):
+        for attempt in range(11):
+            if attempt == 10:
+                # torchvision fallback: center-crop at the clamped
+                # aspect ratio instead of squashing the whole image
+                in_ratio = w / h
+                if in_ratio < 3 / 4:
+                    cw, ch = w, min(h, int(round(w / (3 / 4))))
+                elif in_ratio > 4 / 3:
+                    cw, ch = min(w, int(round(h * (4 / 3)))), h
+                else:
+                    cw, ch = w, h
+                y0 = (h - ch) // 2
+                x0 = (w - cw) // 2
+                img = img[y0:y0 + ch, x0:x0 + cw]
+                break
             target = area * rng.uniform(0.08, 1.0)
             ratio = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
             cw = int(round(np.sqrt(target * ratio)))

@@ -515,12 +515,18 @@ def embed_tokens(emb: dict, tokens, cfg: TransformerConfig,
     return h
 
 
+def lm_head_weight(params: dict, cfg: TransformerConfig):
+    """Tied/untied output-head weight [v, h] (the single home for the
+    selection — reference parallel_lm_logits' tied-weight argument)."""
+    return (params["lm_head"]["kernel"]
+            if cfg.untie_embeddings_and_output_weights
+            else params["embedding"]["word"])
+
+
 def lm_head_logits(params: dict, hidden, cfg: TransformerConfig):
     """Final-hidden → vocab logits with tied/untied head selection
     (reference parallel_lm_logits, standalone_transformer_lm.py:1130)."""
-    head = (params["lm_head"]["kernel"]
-            if cfg.untie_embeddings_and_output_weights
-            else params["embedding"]["word"])
+    head = lm_head_weight(params, cfg)
     # [b,s,h] @ [v,h]^T; vocab dim sharded over tp in both modes
     return jnp.einsum(
         "bsh,vh->bsv", hidden, head.astype(cfg.compute_dtype),
@@ -592,13 +598,23 @@ def gpt_forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     ``vocab_parallel_cross_entropy``) and full under GSPMD.
     """
     ctx = ctx or single_device_ctx()
-    h = ctx.constrain_hidden(embed_tokens(params["embedding"], tokens,
-                                          cfg, ctx))
-    h, aux = transformer_backbone(params, h, cfg, ctx,
-                                  attention_mask=attention_mask,
-                                  dropout_rng=dropout_rng, with_aux=True)
+    h, aux = gpt_hidden(params, tokens, cfg, ctx,
+                        attention_mask=attention_mask,
+                        dropout_rng=dropout_rng)
     logits = lm_head_logits(params, h, cfg)
     return (logits, aux) if with_aux else logits
+
+
+def gpt_hidden(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+               ctx: TPContext, *, attention_mask=None, dropout_rng=None):
+    """Embed + decoder stack + final norm → (hidden [b,s,h], moe_aux).
+    The shared prologue of :func:`gpt_forward` and the fused head+CE
+    loss path."""
+    h = ctx.constrain_hidden(embed_tokens(params["embedding"], tokens,
+                                          cfg, ctx))
+    return transformer_backbone(params, h, cfg, ctx,
+                                attention_mask=attention_mask,
+                                dropout_rng=dropout_rng, with_aux=True)
 
 
 def gpt_loss(params: dict, tokens: jax.Array, labels: jax.Array,
@@ -617,15 +633,10 @@ def gpt_loss(params: dict, tokens: jax.Array, labels: jax.Array,
         # are never materialized
         from apex_tpu.ops.lm_head_ce import lm_head_cross_entropy
 
-        h = ctx.constrain_hidden(embed_tokens(params["embedding"],
-                                              tokens, cfg, ctx))
-        h, aux = transformer_backbone(params, h, cfg, ctx,
-                                      attention_mask=attention_mask,
-                                      dropout_rng=dropout_rng,
-                                      with_aux=True)
-        head = (params["lm_head"]["kernel"]
-                if cfg.untie_embeddings_and_output_weights
-                else params["embedding"]["word"]).astype(cfg.compute_dtype)
+        h, aux = gpt_hidden(params, tokens, cfg, ctx,
+                            attention_mask=attention_mask,
+                            dropout_rng=dropout_rng)
+        head = lm_head_weight(params, cfg).astype(cfg.compute_dtype)
         losses = lm_head_cross_entropy(
             h, head, labels, chunk=cfg.head_ce_chunk, ignore_index=-1)
         n_valid = jnp.maximum(jnp.sum(labels != -1), 1)

@@ -1,0 +1,200 @@
+"""Generate the API reference (docs/api/*.md) from live docstrings.
+
+The reference ships Sphinx RST covering every public class
+(/root/reference/docs/source/*.rst); apex_tpu generates the equivalent
+from the package itself so the reference can never drift from the code:
+
+    JAX_PLATFORMS=cpu python docs/gen_api.py
+
+Walks the public surface (every name in each module's ``__all__``, or
+its public functions/classes when ``__all__`` is absent), emits one
+markdown file per module group with signatures + docstrings, and an
+index.  CI can diff the output to catch undocumented additions.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import textwrap
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "api")
+
+# module path -> (page, section title)
+MODULES = [
+    # amp
+    ("apex_tpu.amp", "amp", "apex_tpu.amp — mixed precision"),
+    ("apex_tpu.amp.frontend", "amp", "amp.frontend — train-step factory"),
+    ("apex_tpu.amp.scaler", "amp", "amp.scaler — dynamic loss scaling"),
+    ("apex_tpu.amp.policy", "amp", "amp.policy — opt-level policies"),
+    ("apex_tpu.amp.patch", "amp", "amp.patch — O1 per-op cast engine"),
+    # optimizers
+    ("apex_tpu.optimizers", "optimizers",
+     "apex_tpu.optimizers — fused optimizers"),
+    ("apex_tpu.contrib.optimizers.distributed_fused_adam", "optimizers",
+     "contrib.optimizers — ZeRO DistributedFusedAdam"),
+    ("apex_tpu.contrib.optimizers.distributed_fused_lamb", "optimizers",
+     "contrib.optimizers — ZeRO DistributedFusedLAMB"),
+    # ops
+    ("apex_tpu.ops.flash_attention", "ops",
+     "ops.flash_attention — FlashAttention-2 kernels"),
+    ("apex_tpu.ops.layer_norm", "ops", "ops.layer_norm — LN/RMSNorm"),
+    ("apex_tpu.ops.softmax", "ops", "ops.softmax — scaled softmax family"),
+    ("apex_tpu.ops.xentropy", "ops", "ops.xentropy — fused CE"),
+    ("apex_tpu.ops.lm_head_ce", "ops",
+     "ops.lm_head_ce — chunked head+CE fusion"),
+    ("apex_tpu.ops.swiglu", "ops", "ops.swiglu — fused bias-SwiGLU"),
+    ("apex_tpu.ops.rope", "ops", "ops.rope — rotary embeddings"),
+    ("apex_tpu.ops.dense", "ops", "ops.dense — fused dense epilogues"),
+    ("apex_tpu.ops.pallas_adam", "ops", "ops.pallas_adam — flat Adam"),
+    # parallel
+    ("apex_tpu.parallel.mesh", "parallel", "parallel.mesh — device mesh"),
+    ("apex_tpu.parallel.distributed", "parallel",
+     "parallel.distributed — DDP"),
+    ("apex_tpu.parallel.sync_batchnorm", "parallel",
+     "parallel.sync_batchnorm — SyncBN"),
+    ("apex_tpu.parallel.fsdp", "parallel", "parallel.fsdp — ZeRO-3"),
+    ("apex_tpu.parallel.ring_attention", "parallel",
+     "parallel.ring_attention — context parallelism"),
+    ("apex_tpu.parallel.LARC", "parallel", "parallel.LARC"),
+    ("apex_tpu.parallel.clip_grad", "parallel", "parallel.clip_grad"),
+    # transformer (Megatron layer)
+    ("apex_tpu.transformer.parallel_state", "transformer",
+     "transformer.parallel_state — process groups"),
+    ("apex_tpu.transformer.tensor_parallel.layers", "transformer",
+     "tensor_parallel.layers — Vocab/Column/Row"),
+    ("apex_tpu.transformer.tensor_parallel.mappings", "transformer",
+     "tensor_parallel.mappings — collectives"),
+    ("apex_tpu.transformer.tensor_parallel.cross_entropy", "transformer",
+     "tensor_parallel.cross_entropy"),
+    ("apex_tpu.transformer.tensor_parallel.random", "transformer",
+     "tensor_parallel.random — RNG streams"),
+    ("apex_tpu.transformer.pipeline_parallel.schedules", "transformer",
+     "pipeline_parallel.schedules — 1F1B / interleaved"),
+    ("apex_tpu.transformer.pipeline_parallel.p2p_communication",
+     "transformer", "pipeline_parallel.p2p_communication"),
+    ("apex_tpu.transformer.microbatches", "transformer",
+     "transformer.microbatches"),
+    ("apex_tpu.transformer.moe", "transformer",
+     "transformer.moe — Switch MoE"),
+    ("apex_tpu.transformer._data", "transformer",
+     "transformer._data — batch samplers"),
+    # models
+    ("apex_tpu.models.config", "models", "models.config"),
+    ("apex_tpu.models.transformer_lm", "models",
+     "models.transformer_lm — decoder backbone"),
+    ("apex_tpu.models.gpt", "models", "models.gpt — GPT wiring"),
+    ("apex_tpu.models.bert", "models", "models.bert"),
+    ("apex_tpu.models.resnet", "models", "models.resnet"),
+    # data
+    ("apex_tpu.data.image_folder", "data",
+     "data.image_folder — file-backed input pipeline"),
+    # contrib
+    ("apex_tpu.contrib.multihead_attn", "contrib",
+     "contrib.multihead_attn"),
+    ("apex_tpu.contrib.transducer", "contrib", "contrib.transducer"),
+    ("apex_tpu.contrib.sparsity", "contrib", "contrib.sparsity — ASP"),
+    ("apex_tpu.contrib.focal_loss", "contrib", "contrib.focal_loss"),
+    ("apex_tpu.contrib.index_mul_2d", "contrib", "contrib.index_mul_2d"),
+    ("apex_tpu.contrib.conv_bias_relu", "contrib",
+     "contrib.conv_bias_relu"),
+    ("apex_tpu.contrib.peer_memory", "contrib",
+     "contrib.peer_memory — halo exchange"),
+    ("apex_tpu.contrib.bottleneck", "contrib", "contrib.bottleneck"),
+    # misc
+    ("apex_tpu.normalization", "misc", "apex_tpu.normalization"),
+    ("apex_tpu.fused_dense", "misc", "apex_tpu.fused_dense"),
+    ("apex_tpu.mlp", "misc", "apex_tpu.mlp"),
+    ("apex_tpu.RNN", "misc", "apex_tpu.RNN"),
+    ("apex_tpu.fp16_utils", "misc", "apex_tpu.fp16_utils"),
+    ("apex_tpu.multi_tensor", "misc", "apex_tpu.multi_tensor"),
+    ("apex_tpu.utils.registry", "misc", "utils.registry — op registry"),
+    ("apex_tpu.utils.checkpoint", "misc",
+     "utils.checkpoint — save/resume + AutoResume"),
+    ("apex_tpu.utils.collectives", "misc", "utils.collectives"),
+    ("apex_tpu.testing", "misc", "apex_tpu.testing"),
+]
+
+
+def _public_names(mod):
+    if hasattr(mod, "__all__"):
+        return list(mod.__all__)
+    return [n for n, obj in vars(mod).items()
+            if not n.startswith("_")
+            and (inspect.isfunction(obj) or inspect.isclass(obj))
+            and getattr(obj, "__module__", "").startswith("apex_tpu")]
+
+
+def _sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def _doc(obj, indent="") -> str:
+    doc = inspect.getdoc(obj) or "*(no docstring)*"
+    return textwrap.indent(doc, indent)
+
+
+def _emit_entry(lines, name, obj):
+    if inspect.isclass(obj):
+        lines.append(f"### class `{name}{_sig(obj)}`\n")
+        lines.append(_doc(obj) + "\n")
+        for mname, m in sorted(vars(obj).items()):
+            if mname.startswith("_") or not callable(m):
+                continue
+            if inspect.isfunction(m) and inspect.getdoc(m):
+                lines.append(f"- **`{mname}{_sig(m)}`** — "
+                             f"{(inspect.getdoc(m) or '').splitlines()[0]}")
+        lines.append("")
+    elif callable(obj):
+        lines.append(f"### `{name}{_sig(obj)}`\n")
+        lines.append(_doc(obj) + "\n")
+    else:
+        lines.append(f"### `{name}`\n")
+        lines.append(f"*(constant — {type(obj).__name__})*\n")
+
+
+def main(out_dir: str = OUT):
+    os.makedirs(out_dir, exist_ok=True)
+    pages: dict = {}
+    skipped = []
+    for mod_path, page, title in MODULES:
+        try:
+            mod = importlib.import_module(mod_path)
+        except Exception as e:
+            skipped.append((mod_path, str(e)))
+            continue
+        lines = pages.setdefault(page, [])
+        lines.append(f"\n## {title}\n")
+        head = (inspect.getdoc(mod) or "").strip()
+        if head:
+            lines.append(head.split("\n\n")[0] + "\n")
+        for name in _public_names(mod):
+            obj = getattr(mod, name, None)
+            if obj is None:
+                continue
+            _emit_entry(lines, name, obj)
+
+    index = ["# apex_tpu API reference",
+             "",
+             "Generated from docstrings by `docs/gen_api.py` "
+             "(regenerate after API changes).", ""]
+    for page in sorted(pages):
+        path = os.path.join(out_dir, f"{page}.md")
+        with open(path, "w") as f:
+            f.write(f"# apex_tpu API — {page}\n")
+            f.write("\n".join(pages[page]) + "\n")
+        index.append(f"- [{page}]({page}.md)")
+        print(f"wrote {path}")
+    with open(os.path.join(out_dir, "index.md"), "w") as f:
+        f.write("\n".join(index) + "\n")
+    if skipped:
+        print("skipped:", skipped)
+    return skipped
+
+
+if __name__ == "__main__":
+    main()

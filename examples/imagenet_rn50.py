@@ -63,6 +63,10 @@ def real_batches(data_dir, batch, hw, start_step):
     from apex_tpu.transformer._data import MegatronPretrainingRandomSampler
 
     ds = ImageFolderDataset(data_dir, image_size=hw, train=True)
+    if len(ds) < batch:
+        raise ValueError(
+            f"--batch {batch} exceeds the dataset size {len(ds)}; the "
+            f"sampler needs at least one full batch per epoch")
     consumed = start_step * batch
     while True:   # sampler iterates one epoch per pass; loop forever
         sampler = MegatronPretrainingRandomSampler(
