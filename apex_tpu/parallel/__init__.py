@@ -12,6 +12,10 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
     make_ddp_train_step,
 )
 from apex_tpu.parallel.LARC import LARC, larc  # noqa: F401
+from apex_tpu.parallel.launch import (  # noqa: F401
+    distributed_env,
+    init_distributed,
+)
 from apex_tpu.parallel.ring_attention import ring_attention  # noqa: F401
 from apex_tpu.parallel.mesh import (  # noqa: F401
     create_mesh,

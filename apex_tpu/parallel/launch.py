@@ -1,0 +1,95 @@
+"""Multi-host bootstrap — the ``torch.distributed.init_process_group``
+analog.
+
+The reference initializes NCCL/MPI process groups from launcher
+environment variables (apex/parallel/__init__.py DDP assumes
+``torch.distributed`` is initialized; the test launchers export
+MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE).  On TPU pods the runtime
+equivalent is ``jax.distributed.initialize``: every host connects to a
+coordinator, after which ``jax.devices()`` spans the whole pod and the
+same ``Mesh``/collective code scales from 1 chip to a multi-host slice
+with XLA moving data over ICI/DCN.
+
+:func:`init_distributed` resolves the coordinator/rank/world size from
+(in priority order) explicit arguments, the JAX-native variables
+(``COORDINATOR_ADDRESS``, ``PROCESS_ID``, ``NUM_PROCESSES``), or the
+torch-style ones the reference's launchers export (``MASTER_ADDR`` +
+``MASTER_PORT``, ``RANK``/``NODE_RANK``, ``WORLD_SIZE``) — so a
+torchrun-style wrapper script ports over unchanged.  On single-host
+(no env, no args) it is a no-op: GKE/Cloud-TPU metadata autodetection
+is left to ``jax.distributed.initialize()``'s own defaults via
+``force=True``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["init_distributed", "distributed_env"]
+
+
+def distributed_env(environ=None):
+    """Resolve (coordinator, process_id, num_processes) from the
+    environment; any field may come back None when unset."""
+    env = os.environ if environ is None else environ
+
+    coord = env.get("COORDINATOR_ADDRESS")
+    if coord is None and env.get("MASTER_ADDR"):
+        port = env.get("MASTER_PORT", "8476")
+        coord = f"{env['MASTER_ADDR']}:{port}"
+
+    # RANK (the global torchrun rank) outranks NODE_RANK: with multiple
+    # processes per node only RANK is unique across the job
+    pid = env.get("PROCESS_ID", env.get("RANK", env.get("NODE_RANK")))
+    nproc = env.get("NUM_PROCESSES", env.get("WORLD_SIZE"))
+    return (coord,
+            int(pid) if pid is not None else None,
+            int(nproc) if nproc is not None else None)
+
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    *,
+    force: bool = False,
+) -> int:
+    """Connect this host to the pod-wide JAX runtime; returns the number
+    of participating processes (1 when single-host).
+
+    Call once per process before any device use, exactly like the
+    reference's ``init_process_group`` contract.  Safe to call again
+    (no-op) and safe on single host with no launcher environment.
+    ``force=True`` calls ``jax.distributed.initialize`` even without an
+    explicit coordinator, letting JAX's cloud autodetection take over.
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count()
+
+    env_coord, env_pid, env_nproc = distributed_env()
+    coord = coordinator_address or env_coord
+    pid = process_id if process_id is not None else env_pid
+    nproc = num_processes if num_processes is not None else env_nproc
+
+    # Single-host no-ops do NOT latch _initialized: a later call with an
+    # explicit coordinator (e.g. after an early library-internal call
+    # found no env) must still be able to bootstrap the pod.
+    if coord is None and not force:
+        return 1
+    if nproc is not None and nproc <= 1 and not force:
+        return 1
+
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=nproc,
+        process_id=pid,
+    )
+    _initialized = True
+    return jax.process_count()

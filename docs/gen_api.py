@@ -50,6 +50,8 @@ MODULES = [
     ("apex_tpu.ops.pallas_adam", "ops", "ops.pallas_adam — flat Adam"),
     # parallel
     ("apex_tpu.parallel.mesh", "parallel", "parallel.mesh — device mesh"),
+    ("apex_tpu.parallel.launch", "parallel",
+     "parallel.launch — multi-host bootstrap"),
     ("apex_tpu.parallel.distributed", "parallel",
      "parallel.distributed — DDP"),
     ("apex_tpu.parallel.sync_batchnorm", "parallel",
@@ -142,11 +144,21 @@ def _emit_entry(lines, name, obj):
     if inspect.isclass(obj):
         lines.append(f"### class `{name}{_sig(obj)}`\n")
         lines.append(_doc(obj) + "\n")
-        for mname, m in sorted(vars(obj).items()):
-            if mname.startswith("_") or not callable(m):
+        for mname in sorted(vars(obj)):
+            if mname.startswith("_"):
                 continue
-            if inspect.isfunction(m) and inspect.getdoc(m):
-                lines.append(f"- **`{mname}{_sig(m)}`** — "
+            raw = inspect.getattr_static(obj, mname)
+            if isinstance(raw, property):
+                m, kind = raw.fget, "property "
+            elif isinstance(raw, (staticmethod, classmethod)):
+                m, kind = raw.__func__, ""
+            elif inspect.isroutine(raw):
+                m, kind = raw, ""
+            else:
+                continue
+            if m is not None and inspect.getdoc(m):
+                sig = "" if kind else _sig(m)
+                lines.append(f"- **{kind}`{mname}{sig}`** — "
                              f"{(inspect.getdoc(m) or '').splitlines()[0]}")
         lines.append("")
     elif callable(obj):

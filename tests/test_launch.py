@@ -1,0 +1,58 @@
+"""Multi-host bootstrap env resolution (init_process_group analog)."""
+
+import jax
+
+from apex_tpu.parallel.launch import distributed_env, init_distributed
+
+
+class TestDistributedEnv:
+    def test_jax_native_vars(self):
+        env = {"COORDINATOR_ADDRESS": "10.0.0.1:1234",
+               "PROCESS_ID": "3", "NUM_PROCESSES": "16"}
+        assert distributed_env(env) == ("10.0.0.1:1234", 3, 16)
+
+    def test_torch_style_vars(self):
+        env = {"MASTER_ADDR": "host0", "MASTER_PORT": "29500",
+               "RANK": "2", "WORLD_SIZE": "8"}
+        assert distributed_env(env) == ("host0:29500", 2, 8)
+
+    def test_torch_default_port_and_node_rank(self):
+        env = {"MASTER_ADDR": "host0", "NODE_RANK": "1",
+               "WORLD_SIZE": "4"}
+        coord, pid, nproc = distributed_env(env)
+        assert coord == "host0:8476" and pid == 1 and nproc == 4
+
+    def test_rank_beats_node_rank(self):
+        # torchrun, 2 nodes x 4 procs: only the global RANK is unique
+        env = {"MASTER_ADDR": "host0", "RANK": "5", "NODE_RANK": "1",
+               "WORLD_SIZE": "8"}
+        assert distributed_env(env)[1] == 5
+
+    def test_empty(self):
+        assert distributed_env({}) == (None, None, None)
+
+    def test_native_wins_over_torch(self):
+        env = {"COORDINATOR_ADDRESS": "c:1", "MASTER_ADDR": "m",
+               "PROCESS_ID": "0", "RANK": "9", "NUM_PROCESSES": "2",
+               "WORLD_SIZE": "99"}
+        assert distributed_env(env) == ("c:1", 0, 2)
+
+
+class TestInitDistributed:
+    def test_single_host_noop(self, monkeypatch):
+        for var in ("COORDINATOR_ADDRESS", "MASTER_ADDR", "RANK",
+                    "WORLD_SIZE", "PROCESS_ID", "NUM_PROCESSES"):
+            monkeypatch.delenv(var, raising=False)
+        import apex_tpu.parallel.launch as launch
+        monkeypatch.setattr(launch, "_initialized", False)
+        assert init_distributed() == 1
+        # idempotent
+        assert init_distributed() == jax.process_count()
+
+    def test_world_size_one_noop(self, monkeypatch):
+        import apex_tpu.parallel.launch as launch
+        monkeypatch.setattr(launch, "_initialized", False)
+        monkeypatch.setenv("MASTER_ADDR", "localhost")
+        monkeypatch.setenv("WORLD_SIZE", "1")
+        monkeypatch.setenv("RANK", "0")
+        assert init_distributed() == 1
