@@ -82,6 +82,14 @@ def init_distributed(
     # explicit coordinator (e.g. after an early library-internal call
     # found no env) must still be able to bootstrap the pod.
     if coord is None and not force:
+        if nproc is not None and nproc > 1:
+            # a multi-process launch without a reachable coordinator must
+            # fail loudly (the init_process_group contract) — silently
+            # training 8 independent single-host jobs is the worst outcome
+            raise RuntimeError(
+                f"WORLD_SIZE/NUM_PROCESSES={nproc} but no coordinator "
+                "address: set COORDINATOR_ADDRESS or MASTER_ADDR[:PORT], "
+                "or pass coordinator_address=")
         return 1
     if nproc is not None and nproc <= 1 and not force:
         return 1

@@ -15,6 +15,7 @@ index.  CI can diff the output to catch undocumented additions.
 from __future__ import annotations
 
 import importlib
+import re
 import inspect
 import os
 import textwrap
@@ -130,9 +131,12 @@ def _public_names(mod):
 
 def _sig(obj) -> str:
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return "(...)"
+    # default-value reprs can embed memory addresses (<function f at
+    # 0x7f...>) — strip them so regeneration is deterministic
+    return re.sub(r" at 0x[0-9a-f]+", "", sig)
 
 
 def _doc(obj, indent="") -> str:

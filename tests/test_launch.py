@@ -56,3 +56,25 @@ class TestInitDistributed:
         monkeypatch.setenv("WORLD_SIZE", "1")
         monkeypatch.setenv("RANK", "0")
         assert init_distributed() == 1
+
+    def test_latched_initialized_short_circuits(self, monkeypatch):
+        import apex_tpu.parallel.launch as launch
+        monkeypatch.setattr(launch, "_initialized", True)
+
+        def boom(*a, **k):
+            raise AssertionError("must not re-initialize")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        assert init_distributed("10.0.0.1:1", 8, 0) == jax.process_count()
+
+    def test_world_size_without_coordinator_raises(self, monkeypatch):
+        import pytest
+
+        import apex_tpu.parallel.launch as launch
+        monkeypatch.setattr(launch, "_initialized", False)
+        for var in ("COORDINATOR_ADDRESS", "MASTER_ADDR"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("WORLD_SIZE", "8")
+        monkeypatch.setenv("RANK", "2")
+        with pytest.raises(RuntimeError, match="no coordinator"):
+            init_distributed()

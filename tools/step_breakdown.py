@@ -1,0 +1,174 @@
+"""Attribute GPT train-step time: measured ablations + compiled roofline.
+
+The reference's perf workflow leans on nvprof/NVTX ranges; the TPU
+analog here combines three sources into one table:
+
+1. measured ablations on the real chip (full step, fwd+bwd, fwd,
+   backbone-only, head+CE, per-layer slope from a 6-vs-12-layer diff);
+2. the compiled step's ``cost_analysis()`` (XLA's own flop/byte counts)
+   turned into roofline lower bounds at the chip's peak FLOP/s and HBM
+   bandwidth;
+3. the delta between the two — the "unattributed" time that profiling
+   work should chase.
+
+Usage (on the real chip):
+    PYTHONPATH=.:/root/.axon_site python tools/step_breakdown.py \
+        [--batch 16] [--seq 1024] [--fused-head-ce]
+
+jax.named_scope ranges are already in the model (transformer_lm.py) for
+xprof sessions; this tool is the numbers-first view that works over the
+tunneled single chip where an interactive xprof UI does not.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.config import gpt_125m
+from apex_tpu.models.gpt import make_gpt_train_step
+from apex_tpu.models.transformer_lm import (
+    gpt_loss, init_gpt_params, lm_head_weight, single_device_ctx,
+    transformer_backbone)
+from apex_tpu.optimizers import fused_adam
+
+_PEAK_FLOPS = 197e12      # v5e bf16 dense
+_PEAK_BYTES = 819e9       # v5e HBM GB/s
+
+
+def _sync(x):
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    float(np.asarray(jnp.ravel(leaf)[0]))
+
+
+def timeit(fn, *args, iters=10):
+    out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters * 1e3   # ms
+
+
+def roofline(jitted, *args):
+    """(flops, bytes, bound_ms) from the compiled step's cost analysis."""
+    compiled = jitted.lower(*args).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    flops = float(ca.get("flops", 0.0))
+    byts = float(ca.get("bytes accessed", 0.0))
+    bound = max(flops / _PEAK_FLOPS, byts / _PEAK_BYTES) * 1e3
+    return flops, byts, bound
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--fused-head-ce", action="store_true")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+    B, S = args.batch, args.seq
+
+    cfg = gpt_125m(max_position_embeddings=S, remat=False,
+                   scan_layers=False, fused_head_ce=args.fused_head_ce)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)), jnp.int32)
+
+    init, step = make_gpt_train_step(cfg, fused_adam(lr=1e-4), "O2")
+    state = init(jax.random.PRNGKey(0))
+    # the step donates its state: thread it through the timing loop
+    state, m = step(state, tokens, labels)
+    _sync(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        state, m = step(state, tokens, labels)
+    _sync(m["loss"])
+    t_full = (time.perf_counter() - t0) / args.iters * 1e3
+
+    params_bf16 = jax.tree_util.tree_map(
+        lambda v: v.astype(jnp.bfloat16)
+        if v.dtype == jnp.float32 else v, state.master_params)
+
+    loss_f = lambda p: gpt_loss(p, tokens, labels, cfg)   # noqa: E731
+    grad_j = jax.jit(jax.grad(loss_f))
+    t_fwdbwd = timeit(grad_j, params_bf16, iters=args.iters)
+    fl, by, bound = roofline(grad_j, params_bf16)
+
+    fwd_j = jax.jit(loss_f)
+    t_fwd = timeit(fwd_j, params_bf16, iters=args.iters)
+
+    ctx = single_device_ctx()
+    hidden = jnp.asarray(rng.randn(B, S, cfg.hidden_size), jnp.bfloat16)
+
+    def backbone_loss(p, h):
+        out, _ = transformer_backbone(p, h, cfg, ctx, with_aux=True)
+        return out.astype(jnp.float32).mean()
+
+    t_bb = timeit(jax.jit(jax.grad(backbone_loss)), params_bf16, hidden,
+                  iters=args.iters)
+
+    def head_loss(p, h):
+        from apex_tpu.ops.lm_head_ce import lm_head_cross_entropy
+        head = lm_head_weight(p, cfg).astype(cfg.compute_dtype)
+        if args.fused_head_ce:
+            losses = lm_head_cross_entropy(h, head, labels,
+                                           chunk=cfg.head_ce_chunk)
+        else:
+            from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+            logits = jnp.einsum("bsh,vh->bsv", h, head,
+                                preferred_element_type=jnp.float32)
+            losses = softmax_cross_entropy_loss(
+                logits.reshape(-1, logits.shape[-1]),
+                labels.reshape(-1), padding_idx=None)
+        return losses.mean()
+
+    t_head = timeit(jax.jit(jax.grad(head_loss, argnums=(0, 1))),
+                    params_bf16, hidden, iters=args.iters)
+
+    cfg6 = dataclasses.replace(cfg, num_layers=6)
+    p6 = jax.tree_util.tree_map(
+        lambda v: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v,
+        init_gpt_params(jax.random.PRNGKey(0), cfg6))
+
+    def backbone6(p, h):
+        out, _ = transformer_backbone(p, h, cfg6, ctx, with_aux=True)
+        return out.astype(jnp.float32).mean()
+
+    t_bb6 = timeit(jax.jit(jax.grad(backbone6)), p6, hidden,
+                   iters=args.iters)
+
+    n_params = sum(
+        int(np.prod(v.shape))
+        for v in jax.tree_util.tree_leaves(state.master_params)
+        if hasattr(v, "dtype") and v.dtype == jnp.float32)
+    ideal_flops = (6 * n_params * B * S
+                   + 12 * cfg.num_layers * cfg.hidden_size * B * S * S)
+    ideal_ms = ideal_flops / _PEAK_FLOPS * 1e3
+    mfu = ideal_ms / t_full
+
+    print(f"config: b{B}xs{S}, fused_head_ce={args.fused_head_ce}")
+    print(f"full AMP O2 step:     {t_full:8.2f} ms   (MFU {mfu:.3f})")
+    print(f"  fwd+bwd:            {t_fwdbwd:8.2f} ms   "
+          f"-> opt/scaler/casts {t_full - t_fwdbwd:6.2f}")
+    print(f"  fwd only:           {t_fwd:8.2f} ms")
+    print(f"  backbone fwd+bwd:   {t_bb:8.2f} ms   "
+          f"-> embed+head+CE {t_fwdbwd - t_bb:6.2f}")
+    print(f"  head+CE fwd+bwd:    {t_head:8.2f} ms")
+    print(f"  per-layer fwd+bwd:  {(t_bb - t_bb6) / 6:8.2f} ms "
+          f"(12-vs-6-layer slope)")
+    print(f"roofline(fwd+bwd):    {bound:8.2f} ms  "
+          f"({fl/1e12:.2f} TFLOP, {by/1e9:.2f} GB compiled)")
+    print(f"unattributed vs roofline: {t_fwdbwd - bound:6.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
