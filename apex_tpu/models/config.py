@@ -39,7 +39,7 @@ class TransformerConfig:
 
     # architecture switches
     attn_mask_type: str = "causal"                # 'causal' | 'padding'
-    activation: str = "gelu"                      # 'gelu' | 'swiglu'
+    activation: str = "gelu"            # 'gelu' | 'gelu_tanh' | 'swiglu'
     position_embedding_type: str = "learned"      # 'learned' | 'rope'
     normalization: str = "layernorm"              # 'layernorm' | 'rmsnorm'
     untie_embeddings_and_output_weights: bool = False

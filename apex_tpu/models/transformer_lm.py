@@ -454,8 +454,12 @@ def _mlp(cfg: TransformerConfig, lp: dict, x, ctx: TPContext):
         y = xi @ lp["fc1_kernel"].astype(x.dtype) + lp["fc1_bias"].astype(
             x.dtype)
         y = ctx.constrain_col(y)
-        y = jax.nn.gelu(y.astype(jnp.float32), approximate=False).astype(
-            x.dtype)
+        # 'gelu_tanh' = the tanh approximation (HF gpt2's gelu_new) —
+        # needed for bit-comparable imports of reference-ecosystem
+        # checkpoints (tools/import_hf.py)
+        y = jax.nn.gelu(
+            y.astype(jnp.float32),
+            approximate=cfg.activation == "gelu_tanh").astype(x.dtype)
     out = y @ lp["fc2_kernel"].astype(x.dtype)
     out = ctx.reduce_out(out)
     return out + lp["fc2_bias"].astype(x.dtype)

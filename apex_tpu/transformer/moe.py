@@ -152,7 +152,8 @@ def switch_moe_mlp(
     else:
         h1 = h1 + bias1[:, None, None, :].astype(x.dtype)
         h1 = jax.nn.gelu(h1.astype(jnp.float32),
-                         approximate=False).astype(x.dtype)
+                         approximate=activation == "gelu_tanh"
+                         ).astype(x.dtype)
     h2 = jnp.einsum("ebcf,efh->ebch", h1, fc2.astype(x.dtype))
     h2 = h2 + _expert_constrain(params["fc2_bias"], ep_axis)[
         :, None, None, :].astype(x.dtype)

@@ -1,0 +1,146 @@
+"""Import HuggingFace/torch GPT-2 weights into the apex_tpu GPT layout.
+
+The migration story (docs/migration_from_apex.md) maps APIs; this tool
+maps *weights*: a user coming from the torch ecosystem loads their
+``GPT2LMHeadModel`` checkpoint and keeps training (or evaluates) on TPU
+with bit-comparable logits.  It doubles as a numerical architecture
+cross-check: tests/test_import_hf.py asserts our ``gpt_forward`` matches
+the torch forward of the same weights to float tolerance.
+
+Layout differences handled:
+- HF ``Conv1D`` stores [in, out] — same orientation as our kernels.
+- HF packs QKV as [Q(all heads) | K | V] on the output dim; our
+  ``qkv_kernel`` is reshaped [b,s,nh,3*dh] then split, i.e. per-head
+  (q|k|v) interleaving — the importer permutes columns accordingly.
+- HF vocab (50257) is padded to our tp-divisible table (50304 default)
+  with zero rows; logits beyond the true vocab are garbage by contract.
+- HF ``gelu_new`` is the tanh approximation — use
+  ``activation='gelu_tanh'`` in the TransformerConfig.
+
+Usage::
+
+    from transformers import GPT2LMHeadModel
+    from apex_tpu.models.config import TransformerConfig
+    from tools.import_hf import config_from_hf, params_from_hf
+
+    hf = GPT2LMHeadModel.from_pretrained("gpt2")
+    cfg = config_from_hf(hf.config)
+    params = params_from_hf(hf.state_dict(), cfg)
+    logits = gpt_forward(params, tokens, cfg)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+_HF_ACTS = {
+    # HF activation_function -> apex_tpu cfg.activation
+    "gelu_new": "gelu_tanh",
+    "gelu_pytorch_tanh": "gelu_tanh",
+    "gelu": "gelu",
+}
+
+
+def config_from_hf(hf_config, **overrides):
+    """TransformerConfig mirroring a ``transformers.GPT2Config``."""
+    from apex_tpu.models.config import TransformerConfig
+
+    act_hf = getattr(hf_config, "activation_function", "gelu_new")
+    if act_hf not in _HF_ACTS:
+        raise ValueError(
+            f"unsupported HF activation_function {act_hf!r}; "
+            f"supported: {sorted(_HF_ACTS)}")
+    if not getattr(hf_config, "tie_word_embeddings", True):
+        raise ValueError(
+            "untied GPT-2 output heads are not supported by the "
+            "importer yet (the checkpoint's lm_head.weight would be "
+            "silently dropped)")
+    pad_to = overrides.pop("vocab_pad_multiple", 128)
+    vocab = -(-hf_config.vocab_size // pad_to) * pad_to
+    kw = dict(
+        num_layers=hf_config.n_layer,
+        hidden_size=hf_config.n_embd,
+        num_attention_heads=hf_config.n_head,
+        vocab_size=vocab,
+        max_position_embeddings=hf_config.n_positions,
+        activation=_HF_ACTS[act_hf],
+        position_embedding_type="learned",
+        normalization="layernorm",
+        layernorm_epsilon=hf_config.layer_norm_epsilon,
+        attn_mask_type="causal",
+        untie_embeddings_and_output_weights=False,   # GPT-2 ties
+        # keep the checkpoint's regularization for continued training
+        hidden_dropout=getattr(hf_config, "resid_pdrop", 0.0),
+        attention_dropout=getattr(hf_config, "attn_pdrop", 0.0),
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def _permute_qkv(w, nh, dh):
+    """[h, 3h] with [Q|K|V] blocks → per-head (q|k|v) interleaved."""
+    h3 = w.shape[-1]
+    # [..., 3, nh, dh] -> [..., nh, 3, dh] -> [..., nh*3*dh]
+    parts = w.reshape(w.shape[:-1] + (3, nh, dh))
+    parts = np.moveaxis(parts, -3, -2)
+    return parts.reshape(w.shape[:-1] + (h3,))
+
+
+def params_from_hf(state_dict, cfg) -> dict:
+    """apex_tpu GPT param tree from a GPT2LMHeadModel ``state_dict``."""
+    sd = {k: np.asarray(v.detach().cpu().numpy()
+                        if hasattr(v, "detach") else v)
+          for k, v in state_dict.items()}
+    h = cfg.hidden_size
+    nh = cfg.num_attention_heads
+    dh = h // nh
+    L = cfg.num_layers
+
+    wte = sd["transformer.wte.weight"].astype(np.float32)
+    pad = cfg.vocab_size - wte.shape[0]
+    if pad < 0:
+        raise ValueError(
+            f"cfg.vocab_size {cfg.vocab_size} smaller than the "
+            f"checkpoint vocab {wte.shape[0]}")
+    if pad:
+        wte = np.concatenate(
+            [wte, np.zeros((pad, h), np.float32)], axis=0)
+
+    def stack(fmt, transform=None):
+        mats = []
+        for i in range(L):
+            m = sd[fmt.format(i)].astype(np.float32)
+            mats.append(transform(m) if transform else m)
+        return np.stack(mats)
+
+    layers = {
+        "ln1_scale": stack("transformer.h.{}.ln_1.weight"),
+        "ln1_bias": stack("transformer.h.{}.ln_1.bias"),
+        "qkv_kernel": stack("transformer.h.{}.attn.c_attn.weight",
+                            lambda w: _permute_qkv(w, nh, dh)),
+        "qkv_bias": stack("transformer.h.{}.attn.c_attn.bias",
+                          lambda b: _permute_qkv(b, nh, dh)),
+        "proj_kernel": stack("transformer.h.{}.attn.c_proj.weight"),
+        "proj_bias": stack("transformer.h.{}.attn.c_proj.bias"),
+        "ln2_scale": stack("transformer.h.{}.ln_2.weight"),
+        "ln2_bias": stack("transformer.h.{}.ln_2.bias"),
+        "fc1_kernel": stack("transformer.h.{}.mlp.c_fc.weight"),
+        "fc1_bias": stack("transformer.h.{}.mlp.c_fc.bias"),
+        "fc2_kernel": stack("transformer.h.{}.mlp.c_proj.weight"),
+        "fc2_bias": stack("transformer.h.{}.mlp.c_proj.bias"),
+    }
+    params = {
+        "embedding": {
+            "word": wte,
+            "position": sd["transformer.wpe.weight"].astype(np.float32),
+        },
+        "layers": layers,
+        "final_ln": {
+            "scale": sd["transformer.ln_f.weight"].astype(np.float32),
+            "bias": sd["transformer.ln_f.bias"].astype(np.float32),
+        },
+    }
+    return jax.tree_util.tree_map(jnp.asarray, params)
