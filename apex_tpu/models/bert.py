@@ -149,7 +149,8 @@ def make_bert_train_step(
     """(init_fn, step_fn); step(state, tokens, mlm_labels, nsp_labels,
     tokentype_ids, attention_mask[, rng]). The BASELINE config pairs this
     with optimizers.fused_lamb."""
-    has_dropout = cfg.hidden_dropout > 0 or cfg.attention_dropout > 0
+    has_dropout = (cfg.hidden_dropout > 0 or cfg.attention_dropout > 0
+                   or cfg.drop_path_rate > 0)
 
     def loss_fn(params, tokens, mlm_labels, nsp_labels, tokentype_ids,
                 attention_mask, *rest):

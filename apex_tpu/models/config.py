@@ -44,6 +44,9 @@ class TransformerConfig:
     normalization: str = "layernorm"              # 'layernorm' | 'rmsnorm'
     untie_embeddings_and_output_weights: bool = False
     layernorm_epsilon: float = 1e-5
+    # take the residual from the LN output instead of the block input
+    # (reference standalone_transformer_lm.py:620,707,738)
+    apply_residual_connection_post_layernorm: bool = False
 
     # mixture-of-experts (beyond the reference; transformer/moe.py)
     num_experts: "Optional[int]" = None           # None = dense FFN
@@ -55,6 +58,9 @@ class TransformerConfig:
     # regularization
     hidden_dropout: float = 0.0
     attention_dropout: float = 0.0
+    # stochastic depth on the residual branches (reference drop_path,
+    # standalone_transformer_lm.py:712-728 DropPath)
+    drop_path_rate: float = 0.0
     init_method_std: float = 0.02
 
     # numerics / TPU execution

@@ -79,7 +79,8 @@ def make_gpt_train_step(
     a PRNG key — ``step(state, tokens, labels[, mask][, rng])``.
     """
     ctx = gspmd_ctx(seq_axis=seq_axis) if mesh is not None else None
-    has_dropout = cfg.hidden_dropout > 0 or cfg.attention_dropout > 0
+    has_dropout = (cfg.hidden_dropout > 0 or cfg.attention_dropout > 0
+                   or cfg.drop_path_rate > 0)
     has_mask = cfg.attn_mask_type == "padding"
 
     def loss_fn(params, tokens, labels, *rest):
@@ -315,8 +316,8 @@ def make_gpt_pipeline_stage(cfg: TransformerConfig, n_stages: int,
                 "attn_mask_type='padding' needs the key-padding mask in "
                 "the packet: pipeline_packet(..., attention_mask_mb=...)"
             )
-        if (cfg.hidden_dropout > 0 or cfg.attention_dropout > 0) \
-                and seed is None:
+        if (cfg.hidden_dropout > 0 or cfg.attention_dropout > 0
+                or cfg.drop_path_rate > 0) and seed is None:
             raise ValueError(
                 "dropout is enabled but the packet carries no "
                 "dropout_seed: pipeline_packet(..., dropout_seeds=...) "
@@ -325,7 +326,8 @@ def make_gpt_pipeline_stage(cfg: TransformerConfig, n_stages: int,
             )
         rng = None
         if seed is not None and (
-                cfg.hidden_dropout > 0 or cfg.attention_dropout > 0):
+                cfg.hidden_dropout > 0 or cfg.attention_dropout > 0
+                or cfg.drop_path_rate > 0):
             # distinct stream per (stage, microbatch): the seed is
             # per-microbatch, each stage folds in its pp index (attention
             # additionally folds the tp index in — see _attention)
@@ -464,7 +466,8 @@ def make_gpt_vpp_stage(cfg: TransformerConfig, n_stages: int, vpp: int,
         seed = packet.get("dropout_seed")
         rng = None
         if seed is not None and (
-                cfg.hidden_dropout > 0 or cfg.attention_dropout > 0):
+                cfg.hidden_dropout > 0 or cfg.attention_dropout > 0
+                or cfg.drop_path_rate > 0):
             rng = jax.random.fold_in(jax.random.PRNGKey(seed),
                                      cid.astype(jnp.int32))
 

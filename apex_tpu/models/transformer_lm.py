@@ -325,6 +325,17 @@ def _dropout(x, rate, rng):
     return jnp.where(keep, x / (1.0 - rate), 0).astype(x.dtype)
 
 
+def _drop_path(x, rate, rng):
+    """Stochastic depth: drop a sample's whole residual branch
+    (reference DropPath, standalone_transformer_lm.py:712-728 — applied
+    to the post-dropout branch output, scaled by 1/keep_prob)."""
+    if rate == 0.0 or rng is None:
+        return x
+    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    keep = jax.random.bernoulli(rng, 1.0 - rate, shape)
+    return jnp.where(keep, x / (1.0 - rate), 0).astype(x.dtype)
+
+
 def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
                     dropout_rng):
     """softmax(QK^T/sqrt(d)) V (reference CoreAttention,
@@ -474,12 +485,18 @@ def _layer(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
     ``prof`` flag, distributed.py:193; SURVEY.md §5) — they label the
     profiler trace in xprof/TensorBoard without touching the compute.
     """
-    r1, r2, r3 = rngs if rngs is not None else (None, None, None)
+    r1, r2, r3, r4, r5 = (rngs if rngs is not None
+                          else (None,) * 5)
     with jax.named_scope("ln1"):
         h = apply_norm(cfg, x, lp["ln1_scale"], lp["ln1_bias"])
     with jax.named_scope("attention"):
         a = _attention(cfg, lp, h, ctx, attention_mask, rope, r1)
-    x = x + _dropout(a, cfg.hidden_dropout, r2)
+    # residual source: block input, or the LN output under the
+    # apply_residual_connection_post_layernorm flag (reference
+    # standalone_transformer_lm.py:707-710)
+    res = h if cfg.apply_residual_connection_post_layernorm else x
+    x = res + _drop_path(_dropout(a, cfg.hidden_dropout, r2),
+                         cfg.drop_path_rate, r4)
     with jax.named_scope("ln2"):
         h = apply_norm(cfg, x, lp["ln2_scale"], lp["ln2_bias"])
     with jax.named_scope("mlp"):
@@ -488,7 +505,9 @@ def _layer(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
         else:
             m = _mlp(cfg, lp, h, ctx)
             aux = jnp.float32(0.0)
-    x = x + _dropout(m, cfg.hidden_dropout, r3)
+    res = h if cfg.apply_residual_connection_post_layernorm else x
+    x = res + _drop_path(_dropout(m, cfg.hidden_dropout, r3),
+                         cfg.drop_path_rate, r5)
     return ctx.constrain_hidden(x), aux
 
 
@@ -556,14 +575,15 @@ def transformer_backbone(params: dict, hidden, cfg: TransformerConfig,
     def body(carry, layer_in):
         x, aux_acc = carry
         lp, key = layer_in
-        rngs = jax.random.split(key, 3) if key is not None else None
+        rngs = jax.random.split(key, 5) if key is not None else None
         x, aux = _layer(cfg, lp, x, ctx, attention_mask, rope, rngs)
         return (x, aux_acc + aux), None
 
     step = jax.checkpoint(body) if cfg.remat else body
 
     needs_rng = dropout_rng is not None and (
-        cfg.hidden_dropout > 0 or cfg.attention_dropout > 0)
+        cfg.hidden_dropout > 0 or cfg.attention_dropout > 0
+        or cfg.drop_path_rate > 0)
     keys = jax.random.split(dropout_rng, n_layers) if needs_rng else None
 
     aux0 = jnp.float32(0.0)

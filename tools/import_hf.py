@@ -58,6 +58,13 @@ def config_from_hf(hf_config, **overrides):
             "untied GPT-2 output heads are not supported by the "
             "importer yet (the checkpoint's lm_head.weight would be "
             "silently dropped)")
+    for flag in ("scale_attn_by_inverse_layer_idx",
+                 "reorder_and_upcast_attn"):
+        if getattr(hf_config, flag, False):
+            raise ValueError(
+                f"GPT2Config.{flag}=True is not supported: the apex_tpu "
+                "attention applies plain 1/sqrt(d) scaling, so logits "
+                "would silently diverge from the torch forward")
     pad_to = overrides.pop("vocab_pad_multiple", 128)
     vocab = -(-hf_config.vocab_size // pad_to) * pad_to
     kw = dict(
@@ -66,6 +73,8 @@ def config_from_hf(hf_config, **overrides):
         num_attention_heads=hf_config.n_head,
         vocab_size=vocab,
         max_position_embeddings=hf_config.n_positions,
+        ffn_hidden_size=getattr(hf_config, "n_inner", None)
+        or 4 * hf_config.n_embd,
         activation=_HF_ACTS[act_hf],
         position_embedding_type="learned",
         normalization="layernorm",
