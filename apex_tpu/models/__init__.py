@@ -27,6 +27,11 @@ from apex_tpu.models.resnet import (  # noqa: F401
     resnet101,
     resnet152,
 )
+from apex_tpu.models.generate import (  # noqa: F401
+    decode_step,
+    generate,
+    init_kv_cache,
+)
 from apex_tpu.models.gpt import (  # noqa: F401
     gpt_pipeline_loss_and_grads,
     make_gpt_pipeline_stage,

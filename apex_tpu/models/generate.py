@@ -1,0 +1,212 @@
+"""Autoregressive GPT decoding with a KV cache.
+
+Beyond the reference: apex is a training-acceleration library with no
+generation runtime (its GPT exists for scaling tests,
+standalone_gpt.py), but a complete framework needs the inference half of
+the model family.  TPU-native design:
+
+- the whole decode loop is ONE ``lax.scan`` under jit (no per-token
+  dispatch); static shapes throughout — the cache is pre-allocated at
+  ``max_len`` and masked by position;
+- the per-step attention is dense over the cache (sq=1 never benefits
+  from the flash kernel's tiling) with fp32 accumulation on the MXU;
+- parameters are the exact training pytree (init_gpt_params /
+  tools/import_hf.py), so a trained or imported model generates without
+  conversion; numerics follow transformer_lm.py layer-for-layer
+  (pre-LN or the post-LN-residual flag, gelu/gelu_tanh/swiglu FFNs,
+  learned or rope positions).
+
+Teacher-forcing parity with ``gpt_forward`` is tested to float
+tolerance (tests/test_generate.py), which pins the cached attention
+against the training forward.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.transformer_lm import (
+    apply_norm, lm_head_weight, rope_cos_sin)
+
+__all__ = ["init_kv_cache", "decode_step", "generate"]
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int):
+    """[L, b, max_len, nh, dh] k/v buffers + position counter."""
+    nh = cfg.num_attention_heads
+    dh = cfg.kv_channels
+    shape = (cfg.num_layers, batch, max_len, nh, dh)
+    return {
+        "k": jnp.zeros(shape, cfg.compute_dtype),
+        "v": jnp.zeros(shape, cfg.compute_dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
+    """One layer, one token: x [b, 1, h] + cache slice [b, T, nh, dh]."""
+    b = x.shape[0]
+    nh = cfg.num_attention_heads
+    dh = cfg.kv_channels
+
+    h = apply_norm(cfg, x, lp["ln1_scale"], lp["ln1_bias"])
+    qkv = h @ lp["qkv_kernel"].astype(x.dtype) + lp["qkv_bias"].astype(
+        x.dtype)
+    qkv = qkv.reshape(b, 1, nh, 3 * dh)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    if rope is not None:
+        cos, sin = rope          # [max_len, d]
+        cos_t = jax.lax.dynamic_slice_in_dim(cos, pos, 1)[None, :, None]
+        sin_t = jax.lax.dynamic_slice_in_dim(sin, pos, 1)[None, :, None]
+        from apex_tpu.ops.rope import fused_apply_rotary_pos_emb_cached
+
+        q = fused_apply_rotary_pos_emb_cached(q, cos_t, sin_t)
+        k = fused_apply_rotary_pos_emb_cached(k, cos_t, sin_t)
+
+    cache_k = jax.lax.dynamic_update_slice_in_dim(
+        cache_k, k.astype(cache_k.dtype), pos, axis=1)
+    cache_v = jax.lax.dynamic_update_slice_in_dim(
+        cache_v, v.astype(cache_v.dtype), pos, axis=1)
+
+    # dense attention over the (masked) cache
+    scale = 1.0 / dh ** 0.5
+    s = jnp.einsum("bqnd,btnd->bnqt", q, cache_k,
+                   preferred_element_type=jnp.float32) * scale
+    t_idx = jnp.arange(cache_k.shape[1])
+    s = jnp.where((t_idx <= pos)[None, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    ctxv = jnp.einsum("bnqt,btnd->bqnd", p.astype(cache_v.dtype), cache_v,
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+    a = ctxv.reshape(b, 1, nh * dh) @ lp["proj_kernel"].astype(x.dtype)
+    a = a + lp["proj_bias"].astype(x.dtype)
+
+    res = h if cfg.apply_residual_connection_post_layernorm else x
+    x = res + a
+    h = apply_norm(cfg, x, lp["ln2_scale"], lp["ln2_bias"])
+    from apex_tpu.models.transformer_lm import _mlp, single_device_ctx
+
+    m = _mlp(cfg, lp, h, single_device_ctx())
+    res = h if cfg.apply_residual_connection_post_layernorm else x
+    return res + m, cache_k, cache_v
+
+
+def decode_step(params: dict, token: jax.Array, cache: dict,
+                cfg: TransformerConfig):
+    """One decoding step: token [b] int32 at position ``cache['pos']`` →
+    (logits [b, v], updated cache)."""
+    if cfg.num_experts:
+        raise ValueError(
+            "KV-cache decoding does not support MoE configs yet")
+    if cfg.attn_mask_type != "causal":
+        raise ValueError(
+            "KV-cache decoding is causal by construction; "
+            f"attn_mask_type={cfg.attn_mask_type!r} would silently "
+            "decode with the wrong mask")
+    cd = cfg.compute_dtype
+    pos = cache["pos"]
+    x = jnp.take(params["embedding"]["word"].astype(cd), token,
+                 axis=0)[:, None]
+    if cfg.position_embedding_type == "learned":
+        pe = jax.lax.dynamic_slice_in_dim(
+            params["embedding"]["position"], pos, 1)
+        x = x + pe.astype(cd)[None]
+    rope = None
+    if cfg.position_embedding_type == "rope":
+        rope = rope_cos_sin(cache["k"].shape[2], cfg.kv_channels)
+
+    # one compiled layer body scanned over the stacked layer params
+    # (transformer_backbone's shape — compile time constant in depth)
+    def body(x, layer_in):
+        lp, ck, cv = layer_in
+        x, ck, cv = _layer_decode(cfg, lp, x, ck, cv, pos, rope)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+
+    x = apply_norm(cfg, x, params["final_ln"]["scale"],
+                   params["final_ln"]["bias"])
+    logits = jnp.einsum(
+        "bsh,vh->bsv", x, lm_head_weight(params, cfg).astype(cd),
+        preferred_element_type=jnp.float32)[:, 0]
+    cache = {"k": new_k, "v": new_v, "pos": pos + 1}
+    return logits, cache
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "cfg", "max_new_tokens", "temperature", "top_k", "vocab_limit"))
+def generate(
+    params: dict,
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    rng: Optional[jax.Array] = None,
+    vocab_limit: Optional[int] = None,
+) -> jax.Array:
+    """Decode ``max_new_tokens`` past ``prompt`` [b, s] → [b, s+new].
+
+    ``temperature=0`` is greedy; otherwise softmax sampling with an
+    optional ``top_k`` cutoff.  The prompt is consumed through the same
+    cached step (prefill == decode path, so the parity test covers both).
+
+    ``vocab_limit`` masks logits at and beyond that id — REQUIRED
+    knowledge for padded vocab tables (tools/import_hf.py pads GPT-2's
+    50257 to 50304; the zero-logit pad ids would otherwise be sampleable
+    and can even win argmax when all real logits are negative).
+    """
+    b, s = prompt.shape
+    total = s + max_new_tokens
+    if (cfg.position_embedding_type == "learned"
+            and total > cfg.max_position_embeddings):
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_position_embeddings ({cfg.max_position_embeddings}); "
+            "the learned position lookup would silently clamp")
+    cache = init_kv_cache(cfg, b, total)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def pick(logits, key):
+        if vocab_limit is not None:
+            over = jnp.arange(logits.shape[-1]) >= vocab_limit
+            logits = jnp.where(over[None], -1e30, logits)
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        return jax.random.categorical(key, logits).astype(jnp.int32)
+
+    def body(carry, i):
+        cache, tokens, key = carry
+        token = jax.lax.dynamic_index_in_dim(
+            tokens, i, axis=1, keepdims=False)
+        logits, cache = decode_step(params, token, cache, cfg)
+        key, sub = jax.random.split(key)
+        nxt = pick(logits, sub)
+        # only write past the prompt (positions < s-1 feed the prefill)
+        write_at = i + 1
+        keep = write_at >= s
+        cur = jax.lax.dynamic_index_in_dim(
+            tokens, jnp.minimum(write_at, total - 1), axis=1,
+            keepdims=False)
+        out = jnp.where(keep, nxt, cur)
+        tokens = jax.lax.dynamic_update_slice_in_dim(
+            tokens, out[:, None], jnp.minimum(write_at, total - 1),
+            axis=1)
+        return (cache, tokens, key), None
+
+    tokens = jnp.concatenate(
+        [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1)
+    (cache, tokens, _), _ = jax.lax.scan(
+        body, (cache, tokens, rng), jnp.arange(total - 1))
+    return tokens

@@ -88,6 +88,8 @@ MODULES = [
     ("apex_tpu.models.transformer_lm", "models",
      "models.transformer_lm — decoder backbone"),
     ("apex_tpu.models.gpt", "models", "models.gpt — GPT wiring"),
+    ("apex_tpu.models.generate", "models",
+     "models.generate — KV-cache decoding"),
     ("apex_tpu.models.bert", "models", "models.bert"),
     ("apex_tpu.models.resnet", "models", "models.resnet"),
     # data
@@ -141,6 +143,8 @@ def _sig(obj) -> str:
 
 def _doc(obj, indent="") -> str:
     doc = inspect.getdoc(obj) or "*(no docstring)*"
+    # docstrings can embed object reprs with process-local addresses
+    doc = re.sub(r" at 0x[0-9a-f]+", "", doc)
     return textwrap.indent(doc, indent)
 
 
