@@ -146,7 +146,10 @@ def make_train_step(
             params=model_params,
             master_params=master,
             opt_state=opt_state,
-            loss_scale_state=amp_state.loss_scale_state,
+            # own() here too: amp_state is shared by every init() from
+            # this factory, and a donated step would otherwise delete the
+            # shared scale buffers out from under later init() calls
+            loss_scale_state=own(amp_state.loss_scale_state),
         )
 
     def step_fn(state: TrainState, *batch):

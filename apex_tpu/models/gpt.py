@@ -133,9 +133,20 @@ def make_gpt_train_step(
                     except Exception:
                         return False
 
+                replicated = NamedSharding(mesh, P())
+
                 def place(sub):
                     if matches(sub):
                         return jax.device_put(sub, shardings)
+                    if isinstance(sub, jax.Array) and sub.ndim == 0:
+                        # scalar state ONLY (step counter, loss scale):
+                        # explicitly mesh-replicated, so checkpoint
+                        # restore cannot pin it to one device while the
+                        # masters span the mesh.  Non-scalar arrays in
+                        # exotic optimizer-state structures are left
+                        # alone — force-replicating a param-sized moment
+                        # buffer would silently defeat ZeRO-3.
+                        return jax.device_put(sub, replicated)
                     return sub
 
                 state = jax.tree_util.tree_map(

@@ -163,8 +163,22 @@ def _chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal_mode, s_local,
 def _ring_blocks(s_local):
     """One block size for q AND kv: the padded shard length (a block_q
     multiple) must divide the kernels' kv grid exactly, or trailing real
-    keys would be silently dropped."""
-    b = min(256, pl.cdiv(s_local, 128) * 128)
+    keys would be silently dropped.
+
+    Block choice minimizes padded work per ring step: cost ~ padded^2 /
+    tile_throughput(b), with relative tile throughputs from the round-3
+    v5e sweep (fwd s1024: 256-blocks 1494us, 512 1186us, 1024 946us —
+    BASELINE.md kernel ledger).  A flat >=1024 cap would pad e.g.
+    s_local=1280 to 2048 (2.56x the score elements) and lose more to
+    padding than the bigger tile wins."""
+    rel = {256: 1.0, 512: 1.26, 1024: 1.58}
+    best, best_cost = None, None
+    for b, thr in rel.items():
+        padded = pl.cdiv(max(s_local, 1), b) * b
+        cost = padded * padded / thr
+        if best_cost is None or cost < best_cost:
+            best, best_cost = b, cost
+    b = min(best, pl.cdiv(s_local, 128) * 128)
     return b, b
 
 

@@ -301,3 +301,27 @@ class TestMainGradAccumulation:
             np.testing.assert_array_equal(
                 np.asarray(s1.master_params[k]),
                 np.asarray(s0.master_params[k]))
+
+
+def test_second_init_survives_donated_step():
+    """Regression (round 3): init_fn must not alias the factory-shared
+    loss-scale buffers — a donated step would delete them out from under
+    every later init() from the same factory."""
+    import numpy as np
+
+    rs = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rs.randn(16, 16) * 0.1, jnp.float32)}
+    x = jnp.asarray(rs.randn(8, 16), jnp.float32)
+
+    def loss_fn(p, x):
+        return jnp.mean((x @ p["w"].astype(x.dtype)) ** 2)
+
+    from apex_tpu.optimizers import fused_adam
+
+    init, step = make_train_step(loss_fn, fused_adam(lr=1e-3), "O2")
+    step = jax.jit(step, donate_argnums=0)
+    s1 = init(params)
+    s1, _ = step(s1, x)                   # donates s1's buffers
+    s2 = init(params)                     # must be fully fresh
+    s2, m = step(s2, x)
+    assert np.isfinite(float(m["loss"]))
