@@ -1,0 +1,97 @@
+"""On-chip smoke: drive the round-3 kernel/model changes on the real TPU.
+
+Run when the axon tunnel is available:
+    PYTHONPATH=.:/root/.axon_site python tools/smoke_tpu.py
+
+Covers: retuned flash-attention blocks (grad parity at s512/1024/2048),
+mixed-backend LayerNorm grads, the softmax size gate, and the FSDP GPT
+train step.  Complements bench.py / bench_kernels.py (numbers) and
+tests/test_on_tpu_kernels.py (the marked pytest pass).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+print("devices:", jax.devices())
+
+# 1) Flash attention with the retuned blocks: train-style fwd+bwd parity
+#    vs the dense oracle at all three bench lengths.
+from apex_tpu.ops.flash_attention import flash_attention, mha_reference
+
+rng = np.random.RandomState(0)
+for s in (512, 1024, 2048):
+    q, k, v = (jnp.asarray(rng.randn(2, s, 4, 64), jnp.bfloat16)
+               for _ in range(3))
+
+    def loss_fa(q, k, v):
+        return flash_attention(q, k, v, causal=True).astype(
+            jnp.float32).sum()
+
+    def loss_ref(q, k, v):
+        return mha_reference(q, k, v, causal=True).astype(
+            jnp.float32).sum()
+
+    gfa = jax.jit(jax.grad(loss_fa, argnums=(0, 1, 2)))(q, k, v)
+    gref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip("qkv", gfa, gref):
+        err = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                    - b.astype(jnp.float32))))
+        assert err < 0.5, (s, name, err)
+    print(f"flash s{s}: grad parity ok")
+
+# 2) LayerNorm: pallas fwd + XLA bwd default — numerics vs autodiff ref.
+from apex_tpu.ops.layer_norm import fused_layer_norm, layer_norm_ref
+
+x = jnp.asarray(rng.randn(512, 768), jnp.bfloat16)
+w = jnp.asarray(1 + 0.1 * rng.randn(768), jnp.float32)
+b = jnp.asarray(0.1 * rng.randn(768), jnp.float32)
+g1 = jax.jit(jax.grad(
+    lambda x, w, b: fused_layer_norm(x, w, b).astype(jnp.float32).sum(),
+    argnums=(0, 1, 2)))(x, w, b)
+g2 = jax.jit(jax.grad(
+    lambda x, w, b: layer_norm_ref(x, w, b).astype(jnp.float32).sum(),
+    argnums=(0, 1, 2)))(x, w, b)
+for name, a, bb in zip(["dx", "dw", "db"], g1, g2):
+    err = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                - bb.astype(jnp.float32))))
+    assert err < 0.3, (name, err)
+print("layer_norm mixed-backend grads ok")
+
+# 3) Softmax gate: >512 rows route to XLA, <=512 to pallas; both correct.
+from apex_tpu.ops.softmax import scaled_upper_triang_masked_softmax
+
+for s in (512, 1024):
+    xs = jnp.asarray(rng.randn(2, 4, s, s), jnp.bfloat16)
+    y = jax.jit(lambda x: scaled_upper_triang_masked_softmax(x, 0.5))(xs)
+    row_sums = jnp.sum(y.astype(jnp.float32), axis=-1)
+    assert float(jnp.max(jnp.abs(row_sums - 1.0))) < 1e-2
+    tri_ok = float(jnp.max(jnp.abs(
+        jnp.triu(y[0, 0].astype(jnp.float32), 1))))
+    assert tri_ok == 0.0, tri_ok
+print("softmax causal gate ok at 512 and 1024")
+
+# 4) GPT FSDP train step on the real chip (2 virtual devices not
+#    available here — single-chip mesh degenerates but must still run).
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.gpt import make_gpt_train_step
+from apex_tpu.optimizers import fused_adam
+from apex_tpu.parallel.mesh import create_mesh
+
+cfg = TransformerConfig(num_layers=2, hidden_size=128,
+                        num_attention_heads=4, vocab_size=256,
+                        max_position_embeddings=32,
+                        compute_dtype=jnp.bfloat16)
+mesh = create_mesh()
+init, step = make_gpt_train_step(cfg, fused_adam(lr=1e-3), "O2", mesh,
+                                 fsdp=True)
+state = init(jax.random.PRNGKey(0))
+tokens = jnp.asarray(rng.randint(0, 256, (4, 32)), jnp.int32)
+labels = jnp.asarray(rng.randint(0, 256, (4, 32)), jnp.int32)
+losses = []
+for _ in range(5):
+    state, m = step(state, tokens, labels)
+    losses.append(float(m["loss"]))
+assert losses[-1] < losses[0], losses
+print("gpt fsdp step on-chip ok, loss", [round(l, 3) for l in losses])
+
+print("ALL PERF-BATCH VERIFY CHECKS PASSED")
