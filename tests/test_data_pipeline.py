@@ -18,6 +18,18 @@ from apex_tpu.transformer._data import (
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _example_env():
+    """Subprocess env for the example runs.  PYTHONPATH must be exactly
+    the repo: inheriting the driver's axon sitecustomize would re-pin
+    the subprocess to the TPU tunnel (and hang when the tunnel is
+    unavailable)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO
+    return env
+
+
 @pytest.fixture(scope="module")
 def image_tree(tmp_path_factory):
     """12 PNGs in 3 class dirs (odd sizes to exercise crops)."""
@@ -90,20 +102,35 @@ class TestLoaderOverSamplers:
 
 class TestExampleEndToEnd:
     def test_imagenet_example_trains_on_files(self, image_tree, tmp_path):
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env.pop("XLA_FLAGS", None)
-        # PYTHONPATH must be exactly the repo: inheriting the driver's
-        # axon sitecustomize would re-pin the subprocess to the TPU
-        # tunnel (and hang when the tunnel is unavailable)
-        env["PYTHONPATH"] = REPO
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, "examples",
                                           "imagenet_rn50.py"),
              "--data-dir", image_tree, "--batch", "4", "--steps", "2",
              "--image-size", "32", "--steps-per-epoch", "4",
              "--arch", "resnet18", "--num-classes", "3"],
-            env=env, cwd=REPO, capture_output=True, text=True,
-            timeout=900)
+            env=_example_env(), cwd=REPO, capture_output=True,
+            text=True, timeout=900)
         assert out.returncode == 0, out.stderr[-2000:]
         assert "loss" in out.stdout and "prec@1" in out.stdout, out.stdout
+
+
+class TestGptLmExample:
+    def test_trains_on_text_and_samples(self, tmp_path):
+        text = (
+            "the quick brown fox jumps over the lazy dog. " * 200
+        ).encode()
+        f = tmp_path / "corpus.txt"
+        f.write_bytes(text)
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "examples", "gpt_lm.py"),
+             "--data", str(f), "--steps", "80", "--batch", "8",
+             "--seq", "64", "--layers", "2", "--hidden", "64",
+             "--heads", "4", "--sample-tokens", "16", "--lr", "2e-3"],
+            env=_example_env(), cwd=REPO, capture_output=True,
+            text=True, timeout=900)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert ("final loss" in out.stdout
+                and "sample" in out.stdout), out.stdout
+        # byte-level model on highly repetitive text must learn fast
+        loss = float(out.stdout.split("final loss")[1].split()[0])
+        assert loss < 3.0, out.stdout
