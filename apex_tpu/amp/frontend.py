@@ -183,19 +183,20 @@ def make_train_step(
             # microbatch's scaled grads are cast up before the add.
             # ``aux`` is reported from the LAST microbatch only (losses
             # are averaged; auxiliary outputs are not).
-            def _is_prng_key(v):
-                # typed keys, or the legacy raw (2,) uint32 layout the
-                # dropout-enabled step signatures pass
-                if jax.dtypes.issubdtype(getattr(v, "dtype", None),
-                                         jax.dtypes.prng_key):
-                    return True
-                return (getattr(v, "dtype", None) == jnp.uint32
-                        and getattr(v, "shape", None) == (2,))
-
-            def _split_leaf(v):
+            def _split_leaf(v, allow_raw_key=False):
                 # PRNG keys are not batch data: give each microbatch its
-                # own derived key instead of reshaping key words apart
-                if _is_prng_key(v):
+                # own derived key instead of reshaping key words apart.
+                # Typed keys are unambiguous anywhere; the legacy raw
+                # (2,) uint32 layout is only recognized in the trailing
+                # batch arg (the rng position the dropout-enabled step
+                # signatures append), so a genuine (2,)-uint32 data leaf
+                # elsewhere hits the divisibility error instead of being
+                # silently re-split.
+                if jax.dtypes.issubdtype(getattr(v, "dtype", None),
+                                         jax.dtypes.prng_key) or (
+                        allow_raw_key
+                        and getattr(v, "dtype", None) == jnp.uint32
+                        and getattr(v, "shape", None) == (2,)):
                     return jax.random.split(v, accum_steps)
                 if hasattr(v, "shape") and v.shape and (
                         v.shape[0] % accum_steps):
@@ -206,7 +207,13 @@ def make_train_step(
                 return v.reshape(
                     (accum_steps, v.shape[0] // accum_steps) + v.shape[1:])
 
-            micro = jax.tree_util.tree_map(_split_leaf, tuple(batch))
+            batch_t = tuple(batch)
+            micro = tuple(
+                jax.tree_util.tree_map(
+                    lambda v, last=(i == len(batch_t) - 1):
+                        _split_leaf(v, allow_raw_key=last),
+                    elem)
+                for i, elem in enumerate(batch_t))
 
             def one_micro(main_grad, mb):
                 g, (l, aux_mb) = jax.grad(

@@ -30,6 +30,7 @@ precision through a per-channel float resize.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -105,7 +106,10 @@ class ImageFolderDataset:
         with self._visit_lock:
             visit = self._visits.get(idx, 0)
             self._visits[idx] = visit + 1
-        mix = (self.seed * 1_000_003 + idx * 9_176 + visit) % (2 ** 31)
+        mix = int.from_bytes(
+            hashlib.blake2s(
+                f"{self.seed}/{idx}/{visit}".encode()).digest()[:4],
+            "little")
         return np.random.RandomState(mix)
 
     def _decode(self, path: str) -> np.ndarray:

@@ -102,8 +102,14 @@ def make_gpt_train_step(
             if fsdp:
                 from apex_tpu.parallel.fsdp import fsdp_augment_specs
 
-                ndev = dict(zip(mesh.axis_names,
-                                mesh.devices.shape))["dp"]
+                axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+                if "dp" not in axes:
+                    raise ValueError(
+                        "make_gpt_train_step(fsdp=True) shards master "
+                        "params over the 'dp' mesh axis, but this mesh "
+                        f"has axes {tuple(mesh.axis_names)}; add a 'dp' "
+                        "axis (e.g. create_mesh(dp=N)).")
+                ndev = axes["dp"]
                 specs = fsdp_augment_specs(specs, params, ndev)
             params = jax.device_put(
                 params,

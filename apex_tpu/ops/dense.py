@@ -14,7 +14,7 @@ exist to give reference users the same call surface, keep the math in
 ``preferred_element_type=float32`` (the MXU accumulates fp32), and anchor
 the numerics tests. The custom kernel layer the reference needs does not
 earn its keep here; profiling on v5e shows XLA emits single fused kernels
-for these shapes (see tests/test_dense.py benchmarks note).
+for these shapes (coverage: tests/test_rope_swiglu_xentropy.py:228).
 """
 
 from __future__ import annotations

@@ -146,9 +146,14 @@ def mha_reference(q, k, v, *, causal=False, key_padding_mask=None,
     if mask is not None:
         s = jnp.where(mask, _NEG_INF, s)
     if segment_ids is not None:
-        seg = segment_ids.astype(jnp.int32)
-        blocked = (seg[:, None, :, None] != seg[:, None, None, :]) | (
-            seg < 0)[:, None, None, :]
+        # a (seg_q, seg_k) pair supports rectangular (cross-attention)
+        # grids; a single [b, s] array is the packed self-attention case
+        if isinstance(segment_ids, tuple):
+            seg_q, seg_k = (x.astype(jnp.int32) for x in segment_ids)
+        else:
+            seg_q = seg_k = segment_ids.astype(jnp.int32)
+        blocked = (seg_q[:, None, :, None] != seg_k[:, None, None, :]) | (
+            seg_k < 0)[:, None, None, :]
         s = jnp.where(blocked, _NEG_INF, s)
     if key_padding_mask is not None:
         if key_padding_mask.dtype == jnp.bool_:
@@ -763,11 +768,18 @@ def flash_attention(
     """
     if q.ndim != 4:
         raise ValueError(f"expected [b, s, n, d], got {q.shape}")
-    if segment_ids is not None and q.shape[1] != k.shape[1]:
-        raise ValueError("segment_ids requires sq == sk (packed rows)")
+    seg_pair = isinstance(segment_ids, tuple)
+    if segment_ids is not None and not seg_pair and (
+            q.shape[1] != k.shape[1]):
+        raise ValueError(
+            "a single segment_ids array requires sq == sk (packed "
+            "self-attention rows); pass a (seg_q, seg_k) pair for "
+            "cross-attention shapes (runs on the XLA path)")
     d = q.shape[-1]
     scale = (1.0 / d ** 0.5) if scale is None else float(scale)
-    generic = mask is not None or bias is not None
+    # per-side segment ids are beyond the fused kernel (it walks one
+    # packed diagonal) — the XLA composition handles them exactly
+    generic = mask is not None or bias is not None or seg_pair
     # Off-TPU inside shard_map (vma non-empty): the Pallas HLO
     # interpreter's internal while-loop cannot carry mixed varying-axes
     # buffers (jax 0.9 check) — run the XLA composition instead.  On
@@ -827,6 +839,11 @@ def flash_attention_packed(
     :func:`apex_tpu.ops.rope.fused_apply_rotary_pos_emb_thd` (same
     cu_seqlens layout).  Internally runs the segment-id kernel on a
     [1, total, n, d] view; cross-segment tiles are skipped blockwise.
+
+    Self-attention only (one ``cu_seqlens`` describes both sides, the
+    layout of the reference's ``FMHAFun``); for rectangular cross-
+    attention grids call :func:`flash_attention` with a
+    ``(seg_q, seg_k)`` pair, which runs the XLA composition.
     """
     if q.ndim != 3:
         raise ValueError(f"expected packed [total, n, d], got {q.shape}")

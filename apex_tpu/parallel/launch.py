@@ -93,6 +93,23 @@ def init_distributed(
         return 1
     if nproc is not None and nproc <= 1 and not force:
         return 1
+    if (coordinator_address is None and env_coord is not None
+            and os.environ.get("COORDINATOR_ADDRESS") is None
+            and (nproc is None or pid is None) and not force):
+        # The opposite failure of the missing-coordinator case above,
+        # scoped to torch-style resolution: MASTER_ADDR came from a
+        # launcher that always exports RANK/WORLD_SIZE too, so their
+        # absence is a broken launch — initialize(coord, None, None)
+        # would hang or die with an opaque runtime error.  An explicit
+        # coordinator_address= argument or COORDINATOR_ADDRESS env still
+        # passes through: on Cloud TPU/Slurm/MPI, jax auto-detects the
+        # missing fields.
+        raise RuntimeError(
+            f"MASTER_ADDR resolved coordinator {coord!r} but "
+            f"WORLD_SIZE/RANK gave num_processes={nproc} / "
+            f"process_id={pid}: a torch-style launcher exports all "
+            "three; set WORLD_SIZE and RANK, or pass "
+            "num_processes=/process_id=")
 
     jax.distributed.initialize(
         coordinator_address=coord,
