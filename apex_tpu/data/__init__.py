@@ -1,5 +1,6 @@
 """File-backed input pipelines (the examples/imagenet loader analog)."""
 
 from apex_tpu.data.image_folder import ImageFolderDataset, make_image_loader
+from apex_tpu.data.prefetch import device_prefetch
 
-__all__ = ["ImageFolderDataset", "make_image_loader"]
+__all__ = ["ImageFolderDataset", "make_image_loader", "device_prefetch"]

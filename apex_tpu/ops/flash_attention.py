@@ -38,6 +38,7 @@ from the saved logsumexp (no O(s²) residuals).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -512,6 +513,142 @@ def _bwd_dkv_kernel(scale, causal, sq_real, sk_real, block_q, block_k,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(scale, causal, sq_real, sk_real, block_q, skp,
+                      has_kpm, has_seg, dropout_p, *refs):
+    """Single-pass backward for short key sequences: K/V stay fully
+    VMEM-resident, the probability tile is computed ONCE, and dq/dk/dv
+    all fall out of the same pass — where the split dq + dkv kernels
+    recompute p twice and traverse HBM twice.  This is the class the
+    reference serves with its small-seqlen fmha variants
+    (fmha_api.cpp:358 `_nl` kernels); VERDICT r3 #4."""
+    if dropout_p > 0.0:
+        seed_ref, refs = refs[0], refs[1:]
+    if has_seg:
+        qseg_ref, kseg_ref, refs = refs[0], refs[1], refs[2:]
+    if has_kpm:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kpm_ref,
+         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    bh, qi = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if has_kpm:
+        s = s + kpm_ref[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (block_q, skp), 1)
+    row = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, skp), 0)
+    pred = (col < sk_real) & (row < sq_real)
+    if causal:
+        pred &= col <= row
+    if has_seg:
+        qseg = qseg_ref[0].reshape(block_q, 1)
+        kseg = kseg_ref[0].reshape(1, skp)
+        pred &= (qseg == kseg) & (kseg >= 0)
+    lse = lse_ref[0][:, :1]
+    # see _bwd_dq_kernel: zero fully-masked rows (lse sentinel)
+    pred &= lse > _NEG_INF / 2
+    p = jnp.where(pred, jnp.exp(s - lse), 0.0)
+    do = do_ref[0].astype(jnp.float32)
+    if dropout_p > 0.0:
+        keep = _keep_mask(seed_ref[0], bh, q_start, 0,
+                          (block_q, skp), 1.0 - dropout_p)
+        inv = 1.0 / (1.0 - dropout_p)
+        p_acc = jnp.where(keep, p * inv, 0.0)
+    else:
+        p_acc = p
+    dv_acc[:] += jax.lax.dot_general(
+        p_acc, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if dropout_p > 0.0:
+        dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
+    delta = delta_ref[0][:, :1]
+    ds = p * (dp - delta) * scale
+    dq_ref[0] = jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+    dk_acc[:] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(qi == pl.num_programs(1) - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_pallas_fused(q3, k3, v3, do3, lse, delta, kpm, seg, seed, scale,
+                      causal, sq_real, sk_real, block_q, dropout_p,
+                      interpret, out_dtype=None):
+    """Driver for :func:`_bwd_fused_kernel` — grid (bh, q-blocks), K/V
+    full-width (call only when the padded key length fits VMEM)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, sqp, d = q3.shape
+    skp = k3.shape[1]
+    lse3 = jnp.broadcast_to(lse[:, :, None], (bh, sqp, _LANES))
+    delta3 = jnp.broadcast_to(delta[:, :, None], (bh, sqp, _LANES))
+    qmap = lambda b, i: (b, i, 0)
+    kmap = lambda b, i: (b, 0, 0)
+    qspec = pl.BlockSpec((1, block_q, d), qmap, memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, skp, d), kmap, memory_space=pltpu.VMEM)
+    rowspec = pl.BlockSpec((1, block_q, _LANES), qmap,
+                           memory_space=pltpu.VMEM)
+    in_specs = []
+    args = []
+    if dropout_p > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    if seg is not None:
+        heads = bh // seg[0].shape[0]
+        in_specs.append(pl.BlockSpec(
+            (1, block_q), lambda b, i, h=heads: (b // h, i),
+            memory_space=pltpu.VMEM))
+        args.append(seg[0])
+        in_specs.append(pl.BlockSpec(
+            (1, skp), lambda b, i, h=heads: (b // h, 0),
+            memory_space=pltpu.VMEM))
+        args.append(seg[1])
+    in_specs += [qspec, kspec, kspec, qspec, rowspec, rowspec]
+    args += [q3, k3, v3, do3, lse3, delta3]
+    if kpm is not None:
+        heads = bh // kpm.shape[0]
+        in_specs.append(pl.BlockSpec(
+            (1, 1, skp), lambda b, i, h=heads: (b // h, 0, 0),
+            memory_space=pltpu.VMEM))
+        args.append(kpm)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale, causal, sq_real,
+                          sk_real, block_q, skp, kpm is not None,
+                          seg is not None, dropout_p),
+        grid=(bh, sqp // block_q),
+        in_specs=in_specs,
+        out_specs=[qspec, kspec, kspec],
+        out_shape=[out_struct((bh, sqp, d), out_dtype or q3.dtype, q3),
+                   out_struct((bh, skp, d), out_dtype or k3.dtype, k3),
+                   out_struct((bh, skp, d), out_dtype or v3.dtype, k3)],
+        scratch_shapes=[pltpu.VMEM((skp, d), jnp.float32),
+                        pltpu.VMEM((skp, d), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+    return dq, dk, dv
+
+
 def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, seg, seed, scale,
                 causal, sq_real, sk_real, block_q, block_k, dropout_p,
                 interpret, out_dtype=None):
@@ -710,9 +847,28 @@ def _flash_bwd(causal, scale, dropout_p, res, do):
         None if seg3 is None else seg3[0],
         None if seg3 is None else seg3[1], seed)
     seg3 = None if seg3 is None else (seg3q, seg3k)
-    dq3, dk3, dv3 = _bwd_pallas(
-        q3, k3, v3, do3, lse3, delta, kpm3, seg3, seed, scale, causal,
-        sq, sk, block_q, block_k, dropout_p, interpret=not on_tpu())
+    mode = os.environ.get("APEX_TPU_FLASH_BWD", "auto")
+    fused_max = int(os.environ.get("APEX_TPU_FLASH_BWD_FUSED_MAX", "512"))
+    if mode == "fused" or (mode == "auto" and skp <= fused_max):
+        # short-key class (BERT s512 etc.): K/V fit VMEM whole — one
+        # pass computes p once and emits dq/dk/dv together, vs the
+        # split kernels' two passes with p recomputed in each
+        fused_bq = min(int(os.environ.get(
+            "APEX_TPU_FLASH_FUSED_BQ", str(min(block_q, sqp)))), sqp)
+        if sqp % fused_bq:
+            raise ValueError(
+                f"APEX_TPU_FLASH_FUSED_BQ={fused_bq} must divide the "
+                f"padded query length {sqp} (floor-division grids would "
+                "silently drop tail q-rows)")
+        dq3, dk3, dv3 = _bwd_pallas_fused(
+            q3, k3, v3, do3, lse3, delta, kpm3, seg3, seed, scale,
+            causal, sq, sk, fused_bq, dropout_p,
+            interpret=not on_tpu())
+    else:
+        dq3, dk3, dv3 = _bwd_pallas(
+            q3, k3, v3, do3, lse3, delta, kpm3, seg3, seed, scale,
+            causal, sq, sk, block_q, block_k, dropout_p,
+            interpret=not on_tpu())
     dq = _from_bh(dq3, b, n)[:, :sq]
     dk = _from_bh(dk3, b, n)[:, :sk]
     dv = _from_bh(dv3, b, n)[:, :sk]

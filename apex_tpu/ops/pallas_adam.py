@@ -31,8 +31,20 @@ from apex_tpu.ops._pallas_utils import out_struct
 
 __all__ = ["flat_adam_update", "adam_kernel_flat"]
 
+import os
+
 _LANES = 128
-_BLOCK_ROWS = 512  # (512, 128) f32 tile = 256 KiB per operand in VMEM
+# (rows, 128) f32 tile per operand in VMEM; 7 blocked operands double-
+# buffered = 14 tiles live, so 1024 rows = 512 KiB/tile = 7 MiB total
+# (fits v5e's 16 MiB).  APEX_TPU_ADAM_BLOCK_ROWS overrides (read at
+# trace time so on-chip sweeps can vary it; VERDICT r3 #4: the flat
+# kernel measured 2.01x XLA at 512 rows — suspected per-grid-step
+# overhead at the small tile).
+_BLOCK_ROWS = 1024
+
+
+def _block_rows() -> int:
+    return int(os.environ.get("APEX_TPU_ADAM_BLOCK_ROWS", _BLOCK_ROWS))
 
 
 def _adam_body(adam_w_mode, s_ref, g_ref, p_ref, m_ref, v_ref,
@@ -86,7 +98,7 @@ def adam_kernel_flat(
         return jnp.pad(x, (0, pad)).reshape(rows, _LANES)
 
     g2, p2, m2, v2 = to2d(g), to2d(p), to2d(m), to2d(v)
-    block = min(_BLOCK_ROWS, rows)
+    block = min(_block_rows(), rows)
     grid = (pl.cdiv(rows, block),)
 
     tile = pl.BlockSpec(

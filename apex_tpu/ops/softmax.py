@@ -175,7 +175,20 @@ def _scaled_softmax(x, mask, scale, causal):
 
 
 def _scaled_softmax_fwd(x, mask, scale, causal):
-    y = _scaled_softmax(x, mask, scale, causal)
+    # Under differentiation the XLA composition wins outright: the bwd is
+    # pure elementwise+reduce that XLA fuses across the fwd/bwd boundary,
+    # and an opaque Pallas fwd call in the middle forces the y tensor
+    # through HBM twice (measured 1.96x the XLA chain at 512^2 causal —
+    # BASELINE.md round-3 ledger; VERDICT r3 #4).  The Pallas row kernel
+    # stays the primal (fwd-only) path, where it measures 0.65x.
+    # APEX_TPU_SOFTMAX=pallas forces the kernel here too.
+    import os
+
+    if (os.environ.get("APEX_TPU_SOFTMAX") == "pallas"
+            and _use_pallas(x, mask, causal)):
+        y = _softmax_fwd_pallas(x, scale, mask, causal)
+    else:
+        y = _softmax_fwd_ref(x, scale, mask, causal)
     return y, y
 
 

@@ -95,6 +95,8 @@ MODULES = [
     # data
     ("apex_tpu.data.image_folder", "data",
      "data.image_folder — file-backed input pipeline"),
+    ("apex_tpu.data.prefetch", "data",
+     "data.prefetch — device prefetch (data_prefetcher analog)"),
     # contrib
     ("apex_tpu.contrib.multihead_attn", "contrib",
      "contrib.multihead_attn"),

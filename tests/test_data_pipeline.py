@@ -134,3 +134,43 @@ class TestGptLmExample:
         # byte-level model on highly repetitive text must learn fast
         loss = float(out.stdout.split("final loss")[1].split()[0])
         assert loss < 3.0, out.stdout
+
+
+class TestDevicePrefetch:
+    def test_order_and_placement(self):
+        import jax
+        from apex_tpu.data import device_prefetch
+
+        batches = [(np.full((2, 3), i, np.float32), np.array([i]))
+                   for i in range(7)]
+        out = list(device_prefetch(iter(batches), size=3))
+        assert len(out) == 7
+        for i, (im, lb) in enumerate(out):
+            assert isinstance(im, jax.Array)   # actually on device
+            assert float(np.asarray(im)[0, 0]) == i
+            assert int(np.asarray(lb)[0]) == i
+
+    def test_sharded_placement_over_mesh(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from apex_tpu.data import device_prefetch
+        from apex_tpu.parallel.mesh import create_mesh
+
+        mesh = create_mesh(dp=8)
+        sh = NamedSharding(mesh, P("dp"))
+        batches = [(np.arange(16, dtype=np.float32).reshape(16, 1),)
+                   for _ in range(3)]
+        out = list(device_prefetch(iter(batches), size=2, sharding=sh))
+        assert len(out) == 3
+        (im,) = out[0]
+        assert im.sharding == sh
+        assert len(im.addressable_shards) == 8
+        np.testing.assert_array_equal(
+            np.asarray(im), batches[0][0])
+
+    def test_size_validation(self):
+        from apex_tpu.data import device_prefetch
+
+        with pytest.raises(ValueError):
+            list(device_prefetch(iter([]), size=0))

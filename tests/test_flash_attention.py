@@ -426,3 +426,54 @@ class TestTHDIntegration:
                 np.asarray(out[sl]), np.asarray(want),
                 atol=5e-5, rtol=5e-5)
             start += L
+
+
+class TestBackwardModeRouting:
+    """The auto route sends every padded key length <=512 through the
+    fused single-pass backward, which covers all the small test shapes —
+    the split dq/dkv kernels (still the production backward for s>512,
+    e.g. GPT s1024) must keep their own coverage pinned."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_split_backward_matches_reference(self, monkeypatch, causal):
+        monkeypatch.setenv("APEX_TPU_FLASH_BWD", "split")
+        q, k, v = make_qkv(2, 128, 2, 64, seed=11)
+        kpm = jnp.asarray(
+            np.arange(128)[None, :] >= np.array([96, 128])[:, None])
+        g1 = jax.grad(lambda *a: jnp.sum(flash_attention(
+            *a, causal=causal, key_padding_mask=kpm)),
+            argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(mha_reference(
+            *a, causal=causal, key_padding_mask=kpm)),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g1, g2, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4,
+                err_msg=f"split d{name}")
+
+    def test_fused_backward_rejects_non_divisor_bq(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_FLASH_BWD", "fused")
+        monkeypatch.setenv("APEX_TPU_FLASH_FUSED_BQ", "96")
+        q, k, v = make_qkv(1, 256, 2, 32, seed=12)
+        with pytest.raises(ValueError, match="must divide"):
+            jax.grad(lambda *a: jnp.sum(
+                flash_attention(*a, causal=True)))(q, k, v)
+
+    def test_fused_segment_ids_match_split(self, monkeypatch):
+        seg = jnp.asarray(
+            np.repeat(np.arange(4), 32)[None].repeat(2, 0), jnp.int32)
+        q, k, v = make_qkv(2, 128, 2, 32, seed=13)
+
+        def grads():
+            return jax.grad(lambda *a: jnp.sum(flash_attention(
+                *a, causal=True, segment_ids=seg)),
+                argnums=(0, 1, 2))(q, k, v)
+
+        monkeypatch.setenv("APEX_TPU_FLASH_BWD", "fused")
+        g_fused = grads()
+        monkeypatch.setenv("APEX_TPU_FLASH_BWD", "split")
+        g_split = grads()
+        for a, b, name in zip(g_fused, g_split, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5,
+                err_msg=f"d{name}")

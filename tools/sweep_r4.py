@@ -1,0 +1,192 @@
+"""Round-4 on-chip kernel sweeps — the four losing entries of the
+BASELINE.md per-kernel ledger (VERDICT r3 #4), measured with the same
+chained-fori_loop methodology as bench_kernels.py.
+
+Each knob is read at trace time, so one process sweeps every variant:
+
+- flash s512 fwd+bwd: split (round-3 default) vs the new fused
+  single-pass backward (``APEX_TPU_FLASH_BWD``) x fused q-block size
+  (``APEX_TPU_FLASH_FUSED_BQ`` 128/256/512);
+- flat Adam 88M: ``APEX_TPU_ADAM_BLOCK_ROWS`` 512/1024/2048/4096 vs the
+  XLA fused tree update;
+- LN bwd 16384x768 bf16: Pallas bwd (``APEX_TPU_LN_BWD=pallas``) vs the
+  round-3 XLA default;
+- softmax causal 512^2: confirms the grad path now routes to XLA
+  (expected ratio ~1.0) while fwd-only keeps the Pallas win.
+
+Usage:  PYTHONPATH=.:/root/.axon_site python tools/sweep_r4.py [--json f]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench_kernels import chain_fwd, chain_grad
+
+
+def _report(results, key, name, pallas_s, xla_s):
+    ratio = pallas_s / xla_s
+    print(f"  {name:<52} pallas {pallas_s*1e6:9.1f}us   "
+          f"xla {xla_s*1e6:9.1f}us   ratio {ratio:5.3f}", flush=True)
+    results[key] = {"pallas_us": round(pallas_s * 1e6, 1),
+                    "xla_us": round(xla_s * 1e6, 1),
+                    "ratio": round(ratio, 3)}
+
+
+def sweep_flash_s512(results):
+    from apex_tpu.ops.flash_attention import flash_attention, mha_reference
+
+    print("flash s512 bwd: split vs fused single-pass", flush=True)
+    rng = np.random.RandomState(0)
+    b, s, h, d = 8, 512, 12, 64
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+    for causal in (True, False):
+        tag = f"b{b}xs{s}{'_causal' if causal else ''}"
+        ref = functools.partial(mha_reference, causal=causal)
+        xla = chain_grad(ref, (0, 1, 2), q, k, v, inner=(16, 48, 160))
+        fa = functools.partial(flash_attention, causal=causal)
+        for mode, bq in (("split", 0), ("fused", 128), ("fused", 256),
+                         ("fused", 512)):
+            os.environ["APEX_TPU_FLASH_BWD"] = mode
+            if bq:
+                os.environ["APEX_TPU_FLASH_FUSED_BQ"] = str(bq)
+            got = chain_grad(fa, (0, 1, 2), q, k, v, inner=(16, 48, 160))
+            label = mode if mode == "split" else f"{mode}_bq{bq}"
+            _report(results, f"flash_fwdbwd_{tag}_{label}",
+                    f"fwd+bwd {tag} {label}", got, xla)
+        os.environ.pop("APEX_TPU_FLASH_BWD", None)
+        os.environ.pop("APEX_TPU_FLASH_FUSED_BQ", None)
+
+
+def _time_adam(update, g, p, m, v):
+    """Chain the full (p, m, v) state through a fori_loop so BOTH sides
+    must materialize every output each iteration (returning only a
+    scalar-dependent value would let XLA dead-code the moment writes and
+    flatter the baseline)."""
+    from bench_kernels import _time
+
+    def make_run(n):
+        @jax.jit
+        def run(g, p, m, v):
+            def body(i, c):
+                p_, m_, v_ = c
+                u, m2, v2 = update(g, p_, m_, v_)
+                return (p_ + u, m2, v2)
+
+            p2, m2, v2 = jax.lax.fori_loop(0, n, body, (p, m, v))
+            return p2[0] + m2[0] + v2[0]
+        return run
+
+    return _time(make_run, (g, p, m, v), inner=(8, 24, 80))
+
+
+def sweep_flat_adam(results):
+    from apex_tpu.ops.pallas_adam import adam_kernel_flat
+
+    print("flat Adam 88M fp32: Pallas block sweep vs XLA", flush=True)
+    rng = np.random.RandomState(0)
+    n = 88_000_000
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    p = jnp.zeros((n,), jnp.float32)
+    m = jnp.zeros((n,), jnp.float32)
+    v = jnp.ones((n,), jnp.float32)
+    scalars = jnp.asarray([1e-3, 0.9, 0.999, 1e-8, 0.01, 0.9, 0.999],
+                          jnp.float32)
+
+    def xla_update(g, p, m, v):
+        m2 = 0.9 * m + 0.1 * g
+        v2 = 0.999 * v + 0.001 * g * g
+        u = -1e-3 * (m2 / 0.9) / (jnp.sqrt(v2 / 0.999) + 1e-8) \
+            - 1e-3 * 0.01 * p
+        return u, m2, v2
+
+    xla = _time_adam(xla_update, g, p, m, v)
+    for rows in (512, 1024, 2048, 4096):
+        os.environ["APEX_TPU_ADAM_BLOCK_ROWS"] = str(rows)
+        # the kernel wrapper is itself jitted: drop its trace cache or
+        # the env knob is ignored after the first variant
+        adam_kernel_flat.clear_cache()
+
+        def pallas_update(g, p, m, v):
+            return adam_kernel_flat(g, p, m, v, scalars)
+
+        try:
+            got = _time_adam(pallas_update, g, p, m, v)
+        except Exception as e:
+            print(f"  rows={rows}: {type(e).__name__}: {e}"[:120],
+                  flush=True)
+            continue
+        _report(results, f"flat_adam_88m_rows{rows}",
+                f"flat adam 88M rows={rows}", got, xla)
+    os.environ.pop("APEX_TPU_ADAM_BLOCK_ROWS", None)
+
+
+def sweep_ln_bwd(results):
+    from apex_tpu.ops.layer_norm import fused_layer_norm, layer_norm_ref
+
+    print("LN fwd+bwd 16384x768 bf16: Pallas bwd vs XLA bwd", flush=True)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16384, 768), jnp.bfloat16)
+    w = jnp.ones((768,), jnp.float32)
+    b = jnp.zeros((768,), jnp.float32)
+    ln = lambda x, w, b: fused_layer_norm(x, w, b)
+    ref = lambda x, w, b: layer_norm_ref(x, w, b)
+    xla_chain = chain_grad(ref, (0, 1, 2), x, w, b)
+    os.environ["APEX_TPU_LN_BWD"] = "pallas"
+    pallas_bwd = chain_grad(ln, (0, 1, 2), x, w, b)
+    os.environ.pop("APEX_TPU_LN_BWD", None)
+    default_bwd = chain_grad(ln, (0, 1, 2), x, w, b)
+    _report(results, "ln_fwdbwd_pallasbwd", "LN fwd+bwd pallas-bwd",
+            pallas_bwd, xla_chain)
+    _report(results, "ln_fwdbwd_default", "LN fwd+bwd default(XLA bwd)",
+            default_bwd, xla_chain)
+
+
+def sweep_softmax(results):
+    from apex_tpu.ops import softmax as sm
+
+    print("softmax causal 512^2: grad path now XLA-routed", flush=True)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(32, 16, 512, 512), jnp.bfloat16)
+    op = lambda x: sm.scaled_upper_triang_masked_softmax(x, 0.125)
+    ref = lambda x: sm._softmax_fwd_ref(x, 0.125, None, True)
+    _report(results, "softmax_causal_fwd_512", "causal fwd 512^2",
+            chain_fwd(op, x), chain_fwd(ref, x))
+    _report(results, "softmax_causal_fwdbwd_512", "causal fwd+bwd 512^2",
+            chain_grad(op, (0,), x), chain_grad(ref, (0,), x))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated subset: flash,adam,ln,softmax")
+    args = ap.parse_args()
+    print(f"devices: {jax.devices()}", flush=True)
+    results = {}
+    sweeps = {"flash": sweep_flash_s512, "adam": sweep_flat_adam,
+              "ln": sweep_ln_bwd, "softmax": sweep_softmax}
+    only = set(args.only.split(",")) if args.only else set(sweeps)
+    for name, fn in sweeps.items():
+        if name in only:
+            fn(results)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
+    print(json.dumps({k: v["ratio"] for k, v in results.items()}))
+
+
+if __name__ == "__main__":
+    main()
