@@ -1,12 +1,15 @@
-"""Device prefetch: overlap host→device transfer with compute.
+"""Device prefetch: overlap host batch prep + H2D transfer with compute.
 
 The reference's input pipeline hides H2D copies behind compute with
 pinned-memory + a side CUDA stream (examples/imagenet/main_amp.py
 ``data_prefetcher``: ``cuda.Stream`` + ``record_stream``).  The TPU
-analog needs no stream juggling: ``jax.device_put`` is asynchronous, so
-keeping a small deque of already-transferred batches ahead of the
-consumer gives the same overlap — the transfer of batch ``i+k`` rides
-under the step computation of batch ``i``.
+analog needs no stream juggling: a background thread pulls the next
+batches from the host iterator (decode/collate run off the consumer
+thread) and ``jax.device_put``s them into a bounded queue — the
+transfer of batch ``i+k`` and its host prep both ride under the step
+computation of batch ``i``.  A sentinel marks exhaustion and pipeline
+exceptions are re-raised in the consumer, so finite iterators end the
+epoch instead of hanging.
 
 Passing ``sharding=`` (e.g. ``NamedSharding(mesh, P('dp'))``) places
 each batch over the mesh for single-process data parallelism.  On a
@@ -18,12 +21,15 @@ batches to this prefetcher, and leave ``sharding=None`` here.
 
 from __future__ import annotations
 
-import collections
+import queue
+import threading
 from typing import Iterable, Iterator, Optional
 
 import jax
 
 __all__ = ["device_prefetch"]
+
+_DONE = object()
 
 
 def device_prefetch(
@@ -31,32 +37,34 @@ def device_prefetch(
     size: int = 2,
     sharding: Optional[jax.sharding.Sharding] = None,
 ) -> Iterator:
-    """Yield batches already resident on device, ``size`` ahead.
+    """Yield batches already resident on device, up to ``size`` ahead.
 
     ``batches`` yields pytrees of host arrays (e.g. ``(images, labels)``
-    from :func:`apex_tpu.data.make_image_loader`).  Each is moved with
-    ``jax.device_put`` (async) as soon as a slot frees up, so the copy
-    of the next batch overlaps the caller's compute on the current one —
-    the ``data_prefetcher`` contract without streams.
-
-    With ``sharding`` (e.g. ``NamedSharding(mesh, P('dp'))``) every
-    batch is placed as a sharded global array instead of a single-device
-    one.
+    from :func:`apex_tpu.data.make_image_loader`).  A daemon producer
+    thread iterates it and moves each batch with ``jax.device_put``
+    (pytree-aware, async), so both the host-side prep and the copy of
+    the next batch overlap the caller's compute on the current one —
+    the ``data_prefetcher`` contract without streams.  Producer
+    exceptions propagate to the consumer; exhaustion ends the iterator.
     """
     if size < 1:
         raise ValueError(f"prefetch size must be >= 1, got {size}")
 
-    def _put(batch):
-        # device_put handles pytrees natively and batches the transfers
-        return jax.device_put(batch, sharding)
+    q: "queue.Queue" = queue.Queue(maxsize=size)
 
-    queue = collections.deque()
-    it = iter(batches)
-    try:
-        while True:
-            while len(queue) < size:
-                queue.append(_put(next(it)))
-            yield queue.popleft()
-    except StopIteration:
-        while queue:
-            yield queue.popleft()
+    def worker():
+        try:
+            for batch in batches:
+                q.put(jax.device_put(batch, sharding))
+            q.put(_DONE)
+        except BaseException as e:  # surface pipeline errors downstream
+            q.put(e)
+
+    threading.Thread(target=worker, daemon=True).start()
+    while True:
+        item = q.get()
+        if item is _DONE:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item

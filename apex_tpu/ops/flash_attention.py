@@ -848,6 +848,9 @@ def _flash_bwd(causal, scale, dropout_p, res, do):
         None if seg3 is None else seg3[1], seed)
     seg3 = None if seg3 is None else (seg3q, seg3k)
     mode = os.environ.get("APEX_TPU_FLASH_BWD", "auto")
+    if mode not in ("auto", "fused", "split"):
+        raise ValueError(
+            f"APEX_TPU_FLASH_BWD={mode!r}: expected auto|fused|split")
     fused_max = int(os.environ.get("APEX_TPU_FLASH_BWD_FUSED_MAX", "512"))
     if mode == "fused" or (mode == "auto" and skp <= fused_max):
         # short-key class (BERT s512 etc.): K/V fit VMEM whole — one

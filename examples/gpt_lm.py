@@ -18,14 +18,13 @@ Run:   python examples/gpt_lm.py --data my.txt --steps 200
 """
 
 import argparse
-import queue
-import threading
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.data import device_prefetch
 from apex_tpu.models.config import TransformerConfig
 from apex_tpu.models.generate import generate
 from apex_tpu.models.gpt import make_gpt_train_step
@@ -44,18 +43,6 @@ def batches(data: np.ndarray, batch: int, seq: int, seed: int = 0):
         tok = np.stack([data[s:s + seq] for s in starts])
         lab = np.stack([data[s + 1:s + seq + 1] for s in starts])
         yield tok.astype(np.int32), lab.astype(np.int32)
-
-
-def prefetch(it, depth=2):
-    q: "queue.Queue" = queue.Queue(maxsize=depth)
-
-    def worker():
-        for item in it:
-            q.put(jax.device_put(item))
-
-    threading.Thread(target=worker, daemon=True).start()
-    while True:
-        yield q.get()
 
 
 def main():
@@ -99,7 +86,7 @@ def main():
             start = last
             print(f"resumed from step {start}")
 
-    stream = prefetch(batches(data, args.batch, args.seq, seed=start))
+    stream = device_prefetch(batches(data, args.batch, args.seq, seed=start))
     t0 = time.perf_counter()
     m = None
     for i in range(start, args.steps):

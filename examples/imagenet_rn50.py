@@ -30,14 +30,13 @@ Resume:  python examples/imagenet_rn50.py --ckpt-dir /tmp/rn50ckpt
 """
 
 import argparse
-import queue
-import threading
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.data import device_prefetch
 from apex_tpu.models import make_resnet_train_step
 from apex_tpu.optimizers import fused_sgd
 from apex_tpu.parallel.mesh import create_mesh
@@ -84,34 +83,6 @@ def real_batches(data_dir, batch, hw, start_step):
             yield x, y
 
 
-_DONE = object()
-
-
-def prefetcher(it, depth=2):
-    """Background-thread prefetch: the host prepares + transfers the next
-    batch while the device runs the current step (reference
-    data_prefetcher, examples/imagenet/main_amp.py:256).  A sentinel
-    marks exhaustion (or a pipeline exception) so finite iterators end
-    the epoch instead of hanging the consumer."""
-    q: "queue.Queue" = queue.Queue(maxsize=depth)
-
-    def worker():
-        try:
-            for item in it:
-                q.put(jax.device_put(item))
-            q.put(_DONE)
-        except BaseException as e:  # surface pipeline errors downstream
-            q.put(e)
-
-    t = threading.Thread(target=worker, daemon=True)
-    t.start()
-    while True:
-        item = q.get()
-        if item is _DONE:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
 
 
 def accuracy(logits, labels, topk=(1, 5)):
@@ -187,7 +158,7 @@ def main():
                               args.image_size, start)
     else:
         source = synthetic_batches(args.batch, hw=args.image_size)
-    batches = prefetcher(source)
+    batches = device_prefetch(source)
     # compile-only warmup on a throwaway COPY (the step donates its
     # inputs) and a ZERO batch — drawing a real batch here would drop
     # those samples from the epoch and skew the sampler's

@@ -101,6 +101,7 @@ class TestLoaderOverSamplers:
 
 
 class TestExampleEndToEnd:
+    @pytest.mark.slow   # e2e example; CI slow job
     def test_imagenet_example_trains_on_files(self, image_tree, tmp_path):
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, "examples",
@@ -115,6 +116,7 @@ class TestExampleEndToEnd:
 
 
 class TestGptLmExample:
+    @pytest.mark.slow   # e2e example; CI slow job
     def test_trains_on_text_and_samples(self, tmp_path):
         text = (
             "the quick brown fox jumps over the lazy dog. " * 200

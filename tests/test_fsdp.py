@@ -1,5 +1,6 @@
 """FSDP (ZeRO-3-style full parameter sharding) under GSPMD."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -85,6 +86,7 @@ class TestFsdpGpt:
         kw.setdefault("compute_dtype", jnp.bfloat16)
         return TransformerConfig(**kw)
 
+    @pytest.mark.slow   # dryrun fsdp phase covers sharded AMP step
     def test_gpt_fsdp_trains_and_shards(self):
         from apex_tpu.models.gpt import make_gpt_train_step
 

@@ -163,7 +163,10 @@ class TestL1TracesDistributed:
     """Multi-device L1: the dp and dp×tp shardings must track the stored
     single-device golden — same model, same batch, same trajectory."""
 
-    @pytest.mark.parametrize("dp,tp", [(8, 1), (4, 2)])
+    # one mixed dp x tp layout in the default tier; the pure-dp variant
+    # (same golden trace, different factoring) rides the slow tier
+    @pytest.mark.parametrize(
+        "dp,tp", [pytest.param(8, 1, marks=pytest.mark.slow), (4, 2)])
     def test_sharded_trace_matches_golden(self, dp, tp):
         if len(jax.devices()) < dp * tp:
             pytest.skip("needs the 8-device mesh")

@@ -5,6 +5,7 @@ a PyTorch-composed (here: jnp-composed) reference at dropout=0, plus
 norm-add residual behavior, additive masks, and dropout statistics.
 """
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -113,6 +114,7 @@ class TestSelfMultiheadAttn:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(expect), atol=1e-4, rtol=1e-4)
 
+    @pytest.mark.slow   # statistical; CI slow job
     def test_dropout_training_stochastic_and_unbiased(self):
         mod, params, x = self._mk(dropout=0.3)
         dense, _ = mod.apply(params, x, is_training=False)

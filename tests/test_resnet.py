@@ -9,6 +9,7 @@ two_gpu_unit_test.py: multi-rank BN == single-rank BN on the full batch).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from apex_tpu.models.resnet import (
     make_resnet_train_step,
@@ -27,6 +28,7 @@ def data(b=8, hw=32, classes=10, seed=0):
 
 
 class TestForward:
+    @pytest.mark.slow   # rn18 forward + rn50 train-step tests cover the block stack
     def test_resnet50_shapes(self):
         model = resnet50(num_classes=10)
         x, _ = data(b=2)

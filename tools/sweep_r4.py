@@ -31,16 +31,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bench_kernels import chain_fwd, chain_grad
+from bench_kernels import _fmt, chain_fwd, chain_grad
 
 
 def _report(results, key, name, pallas_s, xla_s):
-    ratio = pallas_s / xla_s
-    print(f"  {name:<52} pallas {pallas_s*1e6:9.1f}us   "
-          f"xla {xla_s*1e6:9.1f}us   ratio {ratio:5.3f}", flush=True)
-    results[key] = {"pallas_us": round(pallas_s * 1e6, 1),
-                    "xla_us": round(xla_s * 1e6, 1),
-                    "ratio": round(ratio, 3)}
+    results[key] = _fmt(name, pallas_s, xla_s)
 
 
 def sweep_flash_s512(results):
@@ -185,7 +180,8 @@ def main():
     if args.json:
         with open(args.json, "w") as f:
             json.dump(results, f, indent=1)
-    print(json.dumps({k: v["ratio"] for k, v in results.items()}))
+    print(json.dumps(
+        {k: v["pallas_over_xla"] for k, v in results.items()}))
 
 
 if __name__ == "__main__":
