@@ -56,6 +56,7 @@ def make_gpt_train_step(
     mesh: Optional[Mesh] = None,
     *,
     seq_axis: Optional[str] = None,
+    context_parallel: bool = False,
     grad_postprocess: Optional[Callable] = None,
     fsdp: bool = False,
 ):
@@ -77,8 +78,33 @@ def make_gpt_train_step(
     Batch signature grows with the config: ``attn_mask_type='padding'``
     appends an ``attention_mask`` (True = masked) element, dropout appends
     a PRNG key — ``step(state, tokens, labels[, mask][, rng])``.
+
+    ``context_parallel=True`` (requires ``seq_axis``) runs core
+    attention as ring attention over the sequence axis — the
+    long-context mode: per-device attention memory stays O(s_local)
+    instead of the gathered O(s_global).  The ring kernels cover the
+    flagship patterns only: ``attn_mask_type='padding'`` and
+    ``attention_dropout > 0`` are rejected up front (they would
+    silently fall back to the gathered path and OOM at exactly the
+    lengths the flag exists for); ``hidden_dropout`` is fine.
     """
-    ctx = gspmd_ctx(seq_axis=seq_axis) if mesh is not None else None
+    if context_parallel:
+        if cfg.attn_mask_type == "padding":
+            raise ValueError(
+                "context_parallel=True does not support "
+                "attn_mask_type='padding': the ring kernels have no "
+                "sharded-mask path, so masked configs would silently "
+                "gather K/V (O(s_global) memory). Pack sequences with "
+                "segment-free causal rows instead.")
+        if cfg.attention_dropout > 0:
+            raise ValueError(
+                "context_parallel=True does not support "
+                "attention_dropout > 0 (the ring kernels run without "
+                "in-kernel dropout); set attention_dropout=0 — "
+                "hidden_dropout is unaffected.")
+    ctx = (gspmd_ctx(seq_axis=seq_axis,
+                     context_parallel=context_parallel)
+           if mesh is not None else None)
     has_dropout = (cfg.hidden_dropout > 0 or cfg.attention_dropout > 0
                    or cfg.drop_path_rate > 0)
     has_mask = cfg.attn_mask_type == "padding"

@@ -28,6 +28,7 @@ TPU-native redesign — *one functional core, two parallel modes*:
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, NamedTuple, Optional
 
 import jax
@@ -91,6 +92,15 @@ class TPContext(NamedTuple):
     constrain_hidden: Callable[[jax.Array], jax.Array]
     constrain_col: Callable[[jax.Array], jax.Array]
     vocab_parallel: bool
+    # context parallelism: when set, core attention runs as ring
+    # attention over this mesh axis (K/V chunks ppermute around the
+    # ring, O(s_local) per-device memory — parallel/ring_attention.py).
+    # The reference has no such axis (SURVEY §5); this is the TPU-native
+    # long-context path, first-class in the flagship model.  cp_qkv_spec
+    # is the [b, s, n, d] partitioning the shard_map wrapper pins so the
+    # batch (dp) and head (tp) shardings survive the manual region.
+    cp_axis: Optional[str] = None
+    cp_qkv_spec: Optional[P] = None
 
 
 def _constrain(x, spec: P):
@@ -110,11 +120,21 @@ def _constrain(x, spec: P):
 
 
 def gspmd_ctx(batch_axis: str = "dp", tp_axis: str = "tp",
-              seq_axis: Optional[str] = None) -> TPContext:
+              seq_axis: Optional[str] = None,
+              context_parallel: bool = False) -> TPContext:
     """Constraint-based context: annotate, let XLA partition.
 
-    ``seq_axis`` shards activations along sequence (Megatron SP / context
-    parallelism under GSPMD)."""
+    ``seq_axis`` shards activations along sequence (Megatron SP under
+    GSPMD).  ``context_parallel=True`` additionally runs core attention
+    as ring attention over ``seq_axis`` — without it, XLA's default
+    strategy all-gathers K/V per device, whose O(s_global) activations
+    cap the sequence length; with it, attention memory stays
+    O(s_local)."""
+    if context_parallel and seq_axis is None:
+        raise ValueError(
+            "context_parallel=True requires seq_axis (the mesh axis the "
+            "sequence is sharded over)")
+
     def hidden(x):
         return _constrain(x, P(batch_axis, seq_axis, *([None] * (x.ndim - 2))))
 
@@ -130,6 +150,9 @@ def gspmd_ctx(batch_axis: str = "dp", tp_axis: str = "tp",
         constrain_hidden=hidden,
         constrain_col=col,
         vocab_parallel=False,
+        cp_axis=seq_axis if context_parallel else None,
+        cp_qkv_spec=(P(batch_axis, seq_axis, tp_axis, None)
+                     if context_parallel else None),
     )
 
 
@@ -337,13 +360,15 @@ def _drop_path(x, rate, rng):
 
 
 def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
-                    dropout_rng):
+                    dropout_rng, ctx: Optional[TPContext] = None):
     """softmax(QK^T/sqrt(d)) V (reference CoreAttention,
     standalone_transformer_lm.py:213 → FusedScaleMaskSoftmax →
     csrc/megatron/scaled_*_softmax).
 
-    Backend: the Pallas flash-attention kernel when the pattern allows
-    (causal / unmasked / key-padding, attention dropout fused in-kernel);
+    Backend: ring attention over ``ctx.cp_axis`` under context
+    parallelism (sequence stays sharded through attention); else the
+    Pallas flash-attention kernel when the pattern allows (causal /
+    unmasked / key-padding, attention dropout fused in-kernel);
     otherwise the fused-softmax family on materialized scores (generic
     4-D masks).
     """
@@ -351,6 +376,11 @@ def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
     scale = 1.0 / hd ** 0.5
     use_dropout = cfg.attention_dropout > 0 and dropout_rng is not None
     causal = cfg.attn_mask_type == "causal"
+    if ctx is not None and ctx.cp_axis is not None:
+        cp = _ring_core_attention(ctx, q, k, v, causal, scale,
+                                  attention_mask, use_dropout)
+        if cp is not None:
+            return cp
     # a 2-D [b, s_k] mask means key padding (True = masked key) — the
     # fused kernels handle it in-kernel without materializing [b,n,sq,sk]
     kpm = None
@@ -396,6 +426,38 @@ def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
     return ctxv
 
 
+def _ring_core_attention(ctx, q, k, v, causal, scale, attention_mask,
+                         use_dropout):
+    """Run core attention as ring attention over ``ctx.cp_axis``, or
+    return None when the pattern forces the gather path.
+
+    The ring kernels cover the flagship patterns (causal / full, no
+    mask, no attention dropout).  Masked or attention-dropout configs
+    fall back to the dense core — correct, but K/V get gathered, so
+    long-context training should keep those off (hidden dropout is
+    unaffected; it rides the sequence-sharded regions)."""
+    if attention_mask is not None or use_dropout:
+        return None
+    axis = ctx.cp_axis
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty or axis not in mesh.axis_names:
+        return None   # single-device run of a cp-configured model
+    if int(mesh.shape[axis]) == 1:
+        return None
+    from apex_tpu.parallel.ring_attention import ring_attention
+
+    # keep batch (dp) and head (tp) shardings through the manual region;
+    # axes absent from the mesh drop to replicated, like _constrain
+    names = set(mesh.axis_names)
+    spec = P(*(a if (a is None or a in names) else None
+               for a in ctx.cp_qkv_spec))
+    f = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis, causal=causal,
+                          scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return f(q, k, v)
+
+
 def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
                attention_mask, rope, dropout_rng):
     """ParallelAttention (reference :358): column-parallel fused QKV,
@@ -421,7 +483,8 @@ def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
         dropout_rng = jax.random.fold_in(
             dropout_rng, jax.lax.axis_index(ctx.tp_axis))
     with jax.named_scope("core_attention"):
-        ctxv = _core_attention(cfg, q, k, v, attention_mask, dropout_rng)
+        ctxv = _core_attention(cfg, q, k, v, attention_mask, dropout_rng,
+                               ctx)
     ctxv = ctxv.reshape(b, s, -1)
     out = ctxv @ lp["proj_kernel"].astype(x.dtype)
     out = ctx.reduce_out(out)

@@ -70,14 +70,10 @@ def _unify_vma(*arrays):
     union = set().union(*vmas) if vmas else set()
     if not union:
         return arrays
-    out = []
-    for a in arrays:
-        if a is None:
-            out.append(None)
-            continue
-        missing = tuple(union - set(getattr(jax.typeof(a), "vma", ())))
-        out.append(jax.lax.pvary(a, missing) if missing else a)
-    return tuple(out)
+    from apex_tpu.utils.collectives import match_vma
+
+    return tuple(None if a is None else match_vma(a, tuple(union))
+                 for a in arrays)
 
 
 def _pad_to(x, size, axis):

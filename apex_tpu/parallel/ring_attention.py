@@ -47,7 +47,7 @@ from apex_tpu.ops.flash_attention import (
     _pad_to,
     _to_bh,
 )
-from apex_tpu.utils.collectives import pvary
+from apex_tpu.utils.collectives import match_vma, vma_of
 from apex_tpu.utils.registry import on_tpu
 
 __all__ = ["ring_attention"]
@@ -107,8 +107,8 @@ def _chunk_bwd_ref(q3, k3, v3, do3, lse, delta, scale, causal, s_local):
     return dq, dk, dv
 
 
-def _chunk_fwd(q3, k3, v3, scale, causal_mode, s_local, block_q, block_k,
-               axis_name):
+def _chunk_fwd(q3, k3, v3, scale, causal_mode, s_local, block_q,
+               block_k):
     """One (q-shard, kv-chunk) flash forward. causal_mode: 0 full,
     1 diagonal (causal), 2 skip."""
     use_pallas = on_tpu()
@@ -125,10 +125,11 @@ def _chunk_fwd(q3, k3, v3, scale, causal_mode, s_local, block_q, block_k,
         return _chunk_fwd_ref(q3, k3, v3, scale, causal, s_local)
 
     def skip(_):
-        # pvary: match the shard_map vma typing of the kernel branches
-        return pvary(
+        # match the full vma typing of the kernel branches
+        return match_vma(
             (jnp.zeros(q3.shape, jnp.float32),
-             jnp.full(q3.shape[:2], _NEG_INF, jnp.float32)), axis_name)
+             jnp.full(q3.shape[:2], _NEG_INF, jnp.float32)),
+            vma_of(q3))
 
     return jax.lax.switch(
         causal_mode, [lambda _: run(False), lambda _: run(True), skip],
@@ -136,7 +137,7 @@ def _chunk_fwd(q3, k3, v3, scale, causal_mode, s_local, block_q, block_k,
 
 
 def _chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal_mode, s_local,
-               block_q, block_k, axis_name):
+               block_q, block_k):
     use_pallas = on_tpu()
 
     def run(causal):
@@ -150,10 +151,10 @@ def _chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal_mode, s_local,
                               s_local)
 
     def skip(_):
-        return pvary(
+        return match_vma(
             (jnp.zeros(q3.shape, jnp.float32),
              jnp.zeros(k3.shape, jnp.float32),
-             jnp.zeros(v3.shape, jnp.float32)), axis_name)
+             jnp.zeros(v3.shape, jnp.float32)), vma_of(q3))
 
     return jax.lax.switch(
         causal_mode, [lambda _: run(False), lambda _: run(True), skip],
@@ -218,15 +219,15 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
         src = (my - t) % ndev                 # global chunk id held now
         mode = _mode(my, src, causal)
         o_c, lse_c = _chunk_fwd(q3, k_cur, v_cur, scale, mode, s_local,
-                                block_q, block_k, axis_name)
+                                block_q, block_k)
         o_acc, lse_acc = _merge(o_acc, lse_acc, o_c, lse_c)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return k_nxt, v_nxt, o_acc, lse_acc
 
-    o0, lse0 = pvary(
+    o0, lse0 = match_vma(
         (jnp.zeros(q3.shape, jnp.float32),
-         jnp.full(q3.shape[:2], _NEG_INF, jnp.float32)), axis_name)
+         jnp.full(q3.shape[:2], _NEG_INF, jnp.float32)), vma_of(q3))
     _, _, o_acc, lse = jax.lax.fori_loop(
         0, ndev, step, (k3, v3, o0, lse0))
 
@@ -262,7 +263,7 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
         mode = _mode(my, src, causal)
         dq_c, dk_c, dv_c = _chunk_bwd(
             q3, k_cur, v_cur, do3, lse, delta, scale, mode, s_local,
-            block_q, block_k, axis_name)
+            block_q, block_k)
         dq_acc = dq_acc + dq_c
         dk_cur = dk_cur + dk_c
         dv_cur = dv_cur + dv_c
@@ -273,8 +274,8 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
         dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
         return k_nxt, v_nxt, dk_nxt, dv_nxt, dq_acc
 
-    z3, zq = pvary((jnp.zeros(k3.shape, jnp.float32),
-                    jnp.zeros(q3.shape, jnp.float32)), axis_name)
+    z3, zq = match_vma((jnp.zeros(k3.shape, jnp.float32),
+                        jnp.zeros(q3.shape, jnp.float32)), vma_of(q3))
     _, _, dk3, dv3, dq3 = jax.lax.fori_loop(
         0, ndev, step, (k3, v3, z3, z3, zq))
     # after ndev rotations the accumulators are home again

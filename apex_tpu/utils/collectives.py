@@ -24,7 +24,9 @@ __all__ = [
     "grad_sum",
     "flag_and",
     "flag_or",
+    "match_vma",
     "pvary",
+    "vma_of",
 ]
 
 
@@ -44,6 +46,29 @@ def pvary(tree, axis_name: str):
         except NameError:
             # axis not bound (outside shard_map) — nothing to type
             return v
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def vma_of(x) -> tuple:
+    """The manual axes ``x`` is typed as varying over (empty outside
+    shard_map / for untyped tracers)."""
+    return tuple(getattr(jax.typeof(x), "vma", ()) or ())
+
+
+def match_vma(tree, axes):
+    """Promote every leaf to vary over each of ``axes`` it doesn't
+    already — the one home for the pcast-to-varying dance when a target
+    vma set is known (fresh constants entering a lax.switch/scan next to
+    shard_map-varying operands, Pallas calls with mixed-vma inputs)."""
+    axes = tuple(axes)
+    if not axes:
+        return tree
+
+    def leaf(v):
+        have = set(vma_of(v))
+        missing = tuple(a for a in axes if a not in have)
+        return jax.lax.pcast(v, missing, to="varying") if missing else v
 
     return jax.tree_util.tree_map(leaf, tree)
 
