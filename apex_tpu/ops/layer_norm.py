@@ -112,8 +112,12 @@ def _ln_fwd_kernel(rms: bool, affine: bool, has_bias: bool, eps: float,
     rs_ref[:] = rs
 
 
-def _ln_bwd_kernel(rms: bool, affine: bool, has_bias: bool, *refs):
-    """dx plus accumulated dγ/dβ partials (output tiles revisited)."""
+def _ln_bwd_kernel(rms: bool, affine: bool, has_bias: bool, split: bool,
+                   *refs):
+    """dx plus dγ/dβ: either accumulated into one revisited tile
+    (``split=False``, the round-3 kernel) or written as per-block
+    partials a trailing XLA sum reduces (``split=True`` — removes the
+    serial revisit dependency; VERDICT r3 #4 LN candidate)."""
     if affine:
         if has_bias:
             (dy_ref, x_ref, w_ref, mu_ref, rs_ref,
@@ -142,17 +146,22 @@ def _ln_bwd_kernel(rms: bool, affine: bool, has_bias: bool, *refs):
     dx_ref[:] = dx.astype(dx_ref.dtype)
 
     if affine:
-        first = pl.program_id(0) == 0
-
-        @pl.when(first)
-        def _init():
-            dw_ref[:] = jnp.zeros_like(dw_ref)
+        if split:
+            dw_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
             if has_bias:
-                db_ref[:] = jnp.zeros_like(db_ref)
+                db_ref[:] = jnp.sum(dy, axis=0, keepdims=True)
+        else:
+            first = pl.program_id(0) == 0
 
-        dw_ref[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
-        if has_bias:
-            db_ref[:] += jnp.sum(dy, axis=0, keepdims=True)
+            @pl.when(first)
+            def _init():
+                dw_ref[:] = jnp.zeros_like(dw_ref)
+                if has_bias:
+                    db_ref[:] = jnp.zeros_like(db_ref)
+
+            dw_ref[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+            if has_bias:
+                db_ref[:] += jnp.sum(dy, axis=0, keepdims=True)
 
 
 def _pallas_ok(hidden: int, dtype) -> bool:
@@ -218,7 +227,8 @@ def _ln_fwd_pallas(x2, weight, bias, eps, rms):
     return y[:rows], mu[:rows], rs[:rows]
 
 
-def _ln_bwd_pallas(dy2, x2, weight, mu, rs, rms, has_bias):
+def _ln_bwd_pallas(dy2, x2, weight, mu, rs, rms, has_bias,
+                   split_partials=False):
     from jax.experimental.pallas import tpu as pltpu
 
     hidden = x2.shape[1]
@@ -251,17 +261,28 @@ def _ln_bwd_pallas(dy2, x2, weight, mu, rs, rms, has_bias):
     in_specs += [stat_tile, stat_tile]
     args += [mu, rs]
 
+    n_blocks = grid[0]
+    if split_partials:
+        # per-block partial rows, reduced by XLA below (no revisit)
+        part_tile = pl.BlockSpec((1, hidden), lambda i: (i, 0),
+                                 memory_space=pltpu.VMEM)
+        acc_tile, acc_rows = part_tile, n_blocks
+    else:
+        acc_rows = 1
+
     out_specs = [row_tile]
     out_shape = [out_struct((prows, hidden), x2.dtype, x2)]
     if affine:
         out_specs.append(acc_tile)
-        out_shape.append(out_struct((1, hidden), jnp.float32, x2))
+        out_shape.append(out_struct((acc_rows, hidden), jnp.float32, x2))
         if has_bias:
             out_specs.append(acc_tile)
-            out_shape.append(out_struct((1, hidden), jnp.float32, x2))
+            out_shape.append(
+                out_struct((acc_rows, hidden), jnp.float32, x2))
 
     outs = pl.pallas_call(
-        functools.partial(_ln_bwd_kernel, rms, affine, has_bias),
+        functools.partial(_ln_bwd_kernel, rms, affine, has_bias,
+                          split_partials),
         grid=grid,
         in_specs=in_specs,
         out_specs=tuple(out_specs),
@@ -271,11 +292,15 @@ def _ln_bwd_pallas(dy2, x2, weight, mu, rs, rms, has_bias):
     if not affine:
         dx = outs[0] if isinstance(outs, (tuple, list)) else outs
         return dx[:rows], None, None
+
+    def red(t):
+        return t.sum(axis=0) if split_partials else t.reshape(-1)
+
     if has_bias:
         dx, dw, db = outs
-        return dx[:rows], dw.reshape(-1), db.reshape(-1)
+        return dx[:rows], red(dw), red(db)
     dx, dw = outs
-    return dx[:rows], dw.reshape(-1), None
+    return dx[:rows], red(dw), None
 
 
 # ----------------------------------------------------------------------------
@@ -328,18 +353,21 @@ def _norm_fwd(x, weight, bias, eps, rms, memory_efficient):
     return y2.reshape(shape), (saved_x, saved_y, weight, bias, mu, rs, shape)
 
 
-def _ln_bwd_use_pallas(hidden, dtype) -> bool:
+def _ln_bwd_mode(hidden, dtype) -> Optional[str]:
     """Backward backend gate. Measured on v5e (bench_kernels.py, round 3):
-    the XLA-composed backward beats the Pallas bwd kernel because XLA
-    fuses dx into neighboring ops while the kernel's revisited dγ/dβ
-    accumulator tile adds a serial pass (LN fwd+bwd 16384x768 bf16:
-    pallas 143us vs mixed pallas-fwd/xla-bwd 93us).  Forward stays
-    Pallas (35us vs 78us).  APEX_TPU_LN_BWD=pallas opts back in."""
+    the XLA-composed backward beats the round-3 Pallas bwd kernel
+    because XLA fuses dx into neighboring ops while the kernel's
+    revisited dγ/dβ accumulator tile adds a serial pass (LN fwd+bwd
+    16384x768 bf16: pallas 143us vs mixed pallas-fwd/xla-bwd 93us).
+    Forward stays Pallas (35us vs 78us).  APEX_TPU_LN_BWD=pallas opts
+    the revisit kernel back in; =pallas_split selects the round-4
+    per-block-partials variant (sweep_r4 measures all three)."""
     import os
 
-    if os.environ.get("APEX_TPU_LN_BWD") == "pallas":
-        return _pallas_ok(hidden, dtype)
-    return False
+    mode = os.environ.get("APEX_TPU_LN_BWD")
+    if mode in ("pallas", "pallas_split") and _pallas_ok(hidden, dtype):
+        return mode
+    return None
 
 
 def _norm_bwd(eps, rms, memory_efficient, res, dy):
@@ -366,9 +394,11 @@ def _norm_bwd(eps, rms, memory_efficient, res, dy):
     else:
         x2 = saved_x
 
-    if _ln_bwd_use_pallas(hidden, x2.dtype):
+    bwd_mode = _ln_bwd_mode(hidden, x2.dtype)
+    if bwd_mode is not None:
         dx, dw, db = _ln_bwd_pallas(
-            dy2, x2, weight, mu, rs, rms, bias is not None
+            dy2, x2, weight, mu, rs, rms, bias is not None,
+            split_partials=(bwd_mode == "pallas_split")
         )
     else:
         dy32 = dy2.astype(jnp.float32)

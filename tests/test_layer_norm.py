@@ -143,17 +143,22 @@ def test_pallas_interpret_matches_ref(monkeypatch):
     np.testing.assert_allclose(np.asarray(gb), np.asarray(gb_r), atol=1e-4)
 
 
-def test_pallas_bwd_kernel_opt_in(monkeypatch):
-    """The Pallas backward kernel is opt-in since round 3 (the XLA
+@pytest.mark.parametrize("mode", ["pallas", "pallas_split"])
+def test_pallas_bwd_kernel_opt_in(monkeypatch, mode):
+    """The Pallas backward kernels are opt-in since round 3 (the XLA
     composition measured faster on v5e — BASELINE.md kernel ledger);
-    keep it covered so the opt-in path cannot rot."""
+    keep both opt-in paths (revisit accumulator and round-4 per-block
+    partials) covered so they cannot rot."""
     monkeypatch.setenv("APEX_TPU_PALLAS_INTERPRET", "1")
-    monkeypatch.setenv("APEX_TPU_LN_BWD", "pallas")
+    monkeypatch.setenv("APEX_TPU_LN_BWD", mode)
     rng = np.random.RandomState(11)
-    x = jnp.asarray(rng.randn(12, 256).astype(np.float32))
+    # >512 rows -> multiple grid blocks (_rows_block(256, 8) = 512): the
+    # split mode must actually write per-block partials and reduce them,
+    # not degenerate to the single-block case where both modes coincide
+    x = jnp.asarray(rng.randn(1040, 256).astype(np.float32))
     w = jnp.asarray((rng.rand(256) + 0.5).astype(np.float32))
     b = jnp.asarray(rng.randn(256).astype(np.float32))
-    dy = jnp.asarray(rng.randn(12, 256).astype(np.float32))
+    dy = jnp.asarray(rng.randn(1040, 256).astype(np.float32))
 
     def f(x_, w_, b_):
         return jnp.sum(fused_layer_norm(x_, w_, b_) * dy)
@@ -169,7 +174,7 @@ def test_pallas_bwd_kernel_opt_in(monkeypatch):
 
     # RMS variant through the same opt-in
     monkeypatch.setenv("APEX_TPU_PALLAS_INTERPRET", "1")
-    monkeypatch.setenv("APEX_TPU_LN_BWD", "pallas")
+    monkeypatch.setenv("APEX_TPU_LN_BWD", mode)
 
     def fr(x_, w_):
         return jnp.sum(fused_rms_norm(x_, w_) * dy)

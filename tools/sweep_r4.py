@@ -9,8 +9,9 @@ Each knob is read at trace time, so one process sweeps every variant:
   (``APEX_TPU_FLASH_FUSED_BQ`` 128/256/512);
 - flat Adam 88M: ``APEX_TPU_ADAM_BLOCK_ROWS`` 512/1024/2048/4096 vs the
   XLA fused tree update;
-- LN bwd 16384x768 bf16: Pallas bwd (``APEX_TPU_LN_BWD=pallas``) vs the
-  round-3 XLA default;
+- LN bwd 16384x768 bf16: the round-3 revisit-accumulator kernel
+  (``APEX_TPU_LN_BWD=pallas``), the round-4 per-block-partials variant
+  (``=pallas_split``), and the XLA default, all vs the XLA chain;
 - softmax causal 512^2: confirms the grad path now routes to XLA
   (expected ratio ~1.0) while fwd-only keeps the Pallas win.
 
@@ -139,14 +140,16 @@ def sweep_ln_bwd(results):
     ln = lambda x, w, b: fused_layer_norm(x, w, b)
     ref = lambda x, w, b: layer_norm_ref(x, w, b)
     xla_chain = chain_grad(ref, (0, 1, 2), x, w, b)
-    os.environ["APEX_TPU_LN_BWD"] = "pallas"
-    pallas_bwd = chain_grad(ln, (0, 1, 2), x, w, b)
+    for mode in ("pallas", "pallas_split", None):
+        if mode is None:
+            os.environ.pop("APEX_TPU_LN_BWD", None)
+        else:
+            os.environ["APEX_TPU_LN_BWD"] = mode
+        got = chain_grad(ln, (0, 1, 2), x, w, b)
+        tag = mode or "default_xla_bwd"
+        _report(results, f"ln_fwdbwd_{tag}", f"LN fwd+bwd {tag}",
+                got, xla_chain)
     os.environ.pop("APEX_TPU_LN_BWD", None)
-    default_bwd = chain_grad(ln, (0, 1, 2), x, w, b)
-    _report(results, "ln_fwdbwd_pallasbwd", "LN fwd+bwd pallas-bwd",
-            pallas_bwd, xla_chain)
-    _report(results, "ln_fwdbwd_default", "LN fwd+bwd default(XLA bwd)",
-            default_bwd, xla_chain)
 
 
 def sweep_softmax(results):
