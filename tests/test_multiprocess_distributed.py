@@ -62,34 +62,28 @@ def _free_port() -> int:
     sock.close()
     return port
 
-
-def test_two_process_init_mesh_and_reduce(tmp_path):
+def _run_two_ranks(script_text, tmp_path, timeout=240):
+    """Spawn two ranks of ``script_text`` with the torch-style rendezvous
+    env and return their outputs; asserts both exit 0."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "child.py"
-    script.write_text(_CHILD.format(repo=repo))
+    script.write_text(script_text.format(repo=repo))
     port = _free_port()
     procs = []
     for rank in range(2):
         env = dict(os.environ)
-        env.update(
-            MASTER_ADDR="127.0.0.1",
-            MASTER_PORT=str(port),
-            RANK=str(rank),
-            WORLD_SIZE="2",
-            JAX_PLATFORMS="cpu",
-        )
+        env.update(MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port),
+                   RANK=str(rank), WORLD_SIZE="2", JAX_PLATFORMS="cpu")
         # the suite's 8-virtual-device flag must not leak into the
         # children: each contributes exactly one CPU device to the pod
         env.pop("XLA_FLAGS", None)
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, str(script)], env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=150)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     finally:
         for p in procs:
@@ -97,6 +91,13 @@ def test_two_process_init_mesh_and_reduce(tmp_path):
                 p.kill()
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    return outs
+
+
+
+def test_two_process_init_mesh_and_reduce(tmp_path):
+    outs = _run_two_ranks(_CHILD, tmp_path, timeout=150)
+    for rank, out in enumerate(outs):
         assert f"rank {rank} OK sum=12.0" in out, out
 
 
@@ -130,3 +131,64 @@ def test_two_process_missing_coordinator_fails_loudly(tmp_path):
         text=True, timeout=120)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "raised as expected" in out.stdout
+
+
+_TRAIN_CHILD = textwrap.dedent(
+    """
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    from apex_tpu.parallel.launch import init_distributed
+
+    assert init_distributed() == 2
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from apex_tpu.amp.frontend import make_train_step
+    from apex_tpu.optimizers import fused_adam
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2), ("dp",))
+    rank = jax.process_index()
+    rng = np.random.RandomState(0)           # same seed on both ranks
+    params = {{"w": jnp.asarray(rng.randn(16, 16) * 0.1, jnp.float32)}}
+    W_true = rng.randn(16, 16).astype(np.float32)
+    x_all = rng.randn(8, 16).astype(np.float32)
+    y_all = x_all @ W_true
+
+    def loss_fn(p, x, y):
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    init, step = make_train_step(loss_fn, fused_adam(lr=1e-2), "O2")
+    state = init(params)
+    sh = NamedSharding(mesh, P("dp"))
+
+    def put(a):                              # each rank feeds its shard
+        local = jnp.asarray(a[rank * 4:(rank + 1) * 4])
+        return jax.make_array_from_single_device_arrays(
+            a.shape, sh, [jax.device_put(local, jax.local_devices()[0])])
+
+    with jax.set_mesh(mesh):
+        jstep = jax.jit(step)
+        for _ in range(5):
+            state, m = jstep(state, put(x_all), put(y_all))
+    loss = float(np.asarray(m["loss"].addressable_data(0)))
+    w = np.asarray(state.master_params["w"].addressable_data(0))
+    print(f"rank {{rank}} loss {{loss:.6f}} wsum {{float(w.sum()):.6f}}",
+          flush=True)
+    jax.distributed.shutdown()
+    """
+)
+
+
+def test_two_process_amp_train_step(tmp_path):
+    """The full MultiProcessTestCase analog: two OS processes rendezvous
+    via the torch-style env, build a global dp mesh, run 5 AMP O2 train
+    steps on rank-local batch shards (gradient mean crosses the process
+    boundary through GSPMD), and must agree bit-for-bit on the loss and
+    the fp32 master weights."""
+    outs = _run_two_ranks(_TRAIN_CHILD, tmp_path)
+    res = [[ln for ln in o.splitlines() if "loss" in ln][0].split()
+           for o in outs]
+    # same loss and same master-weight sum on both ranks
+    assert res[0][2:] == res[1][2:], res
