@@ -33,9 +33,10 @@ __all__ = [
 def pvary(tree, axis_name: str):
     """Type values as varying over ``axis_name`` (jax≥0.9 vma typing).
 
-    No-op for leaves already varying or outside a mapped context. This is
-    the single home for the pcast-to-varying dance (used by the TP mappings
-    and the pipeline scan carries).
+    No-op for leaves already varying or outside a mapped context (used
+    by the TP mappings and the pipeline scan carries, where the target
+    is one known axis).  When the target is a *set* of axes derived from
+    another value, use :func:`match_vma` + :func:`vma_of` instead.
     """
 
     def leaf(v):

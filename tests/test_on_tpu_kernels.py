@@ -284,6 +284,12 @@ def test_ln_backward_split_partials_on_chip(monkeypatch):
         lambda x_, w_, b_: jnp.sum(
             layer_norm_ref(x_, w_, b_).astype(jnp.float32)),
         argnums=(0, 1, 2))(x, w, b)
+    # per-gradient tolerances: dx elements are ~0.1 (a blanket atol=0.5
+    # would pass an all-zero dx); dw/db are ~row-count sums where rtol
+    # dominates and bf16 accumulation needs the absolute slack
+    tols = {"dx": dict(atol=1e-2, rtol=2e-2),
+            "dw": dict(atol=0.5, rtol=2e-2),
+            "db": dict(atol=0.5, rtol=2e-2)}
     for mode in ("pallas", "pallas_split"):
         monkeypatch.setenv("APEX_TPU_LN_BWD", mode)
         g = jax.grad(f, argnums=(0, 1, 2))(x, w, b)
@@ -291,7 +297,7 @@ def test_ln_backward_split_partials_on_chip(monkeypatch):
         for a, r, nm in zip(g, g_ref, ("dx", "dw", "db")):
             np.testing.assert_allclose(
                 np.asarray(a, np.float32), np.asarray(r, np.float32),
-                atol=0.5, rtol=2e-2, err_msg=f"{mode} {nm}")
+                err_msg=f"{mode} {nm}", **tols[nm])
 
 
 def test_ring_attention_on_chip():
