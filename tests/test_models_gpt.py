@@ -65,7 +65,12 @@ class TestSingleDevice:
         # random init ⇒ loss ≈ log(vocab)
         assert abs(float(loss) - np.log(cfg.vocab_size)) < 1.0
 
-    @pytest.mark.parametrize("variant", ["rope_swiglu_rms", "untied"])
+    # rope/swiglu/rms have default-tier kernel coverage; their combo
+    # rides the slow tier. untied embeddings have no other coverage
+    # anywhere, so that variant stays default.
+    @pytest.mark.parametrize("variant", [
+        pytest.param("rope_swiglu_rms", marks=pytest.mark.slow),
+        "untied"])
     def test_variants(self, variant):
         if variant == "rope_swiglu_rms":
             cfg = tiny_cfg(position_embedding_type="rope",
@@ -225,10 +230,13 @@ class TestGSPMD:
 
 
 class TestPipeline:
-    # tp=1 (the spec-stripping path) is the slower compile; it rides the
-    # slow tier (CI runs it every push), tp=2 stays default
+    # both params ride the slow tier (CI every push): these are
+    # single-shot loss/grad parity assertions, exactly what the dryrun
+    # pipeline phase re-asserts on every driver run; the schedule logic
+    # keeps default-tier coverage via test_pipeline.py's toy stages
     @pytest.mark.parametrize(
-        "tp", [pytest.param(1, marks=pytest.mark.slow), 2])
+        "tp", [pytest.param(1, marks=pytest.mark.slow),
+               pytest.param(2, marks=pytest.mark.slow)])
     def test_pipeline_loss_and_grads_match_sequential(self, tp):
         pp, n_micro, mb = 2, 4, 2
         cfg = tiny_cfg(num_layers=4, remat=False)

@@ -152,6 +152,7 @@ class TestPipelineMatchesSequential:
         np.testing.assert_allclose(np.asarray(grads["b"]),
                                    np.asarray(grads_ref["b"]), atol=1e-5)
 
+    @pytest.mark.slow   # dryrun vpp phase covers interleaved parity on the GPT model
     def test_interleaved_loss_and_grads_match_sequential(self):
         """vpp=2 on pp=2: 4 chunks total, chunk c on device c%2, slot c//2.
         Model = same 4 stages; sequential reference unchanged."""

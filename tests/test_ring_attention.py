@@ -176,6 +176,7 @@ class TestContextParallelGPT:
             vocab_size=128, max_position_embeddings=64,
             compute_dtype=jnp.float32)
 
+    @pytest.mark.slow   # dryrun gspmd-cp phase asserts the same fp32 parity
     def test_loss_and_grads_match_single_device(self):
         from apex_tpu.models.transformer_lm import (
             gpt_loss, gspmd_ctx, init_gpt_params)
