@@ -2,6 +2,8 @@ from apex_tpu.contrib.transducer.transducer import (  # noqa: F401
     TransducerJoint,
     TransducerLoss,
     joint_mask,
+    pack_joint_output,
     transducer_joint,
     transducer_loss,
+    unpack_joint,
 )

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["transducer_joint", "joint_mask", "transducer_loss",
+           "pack_joint_output", "unpack_joint",
            "TransducerJoint", "TransducerLoss"]
 
 _NEG = -1e30
@@ -63,6 +64,61 @@ def transducer_joint(
         h = jnp.where(keep, h / (1.0 - dropout_prob), 0.0)
     mask = joint_mask(f_len, g_len, T, U)
     return jnp.where(mask[..., None], h, 0.0).astype(f.dtype)
+
+
+def pack_joint_output(h: jax.Array, f_len: jax.Array, g_len: jax.Array,
+                      max_tokens: int):
+    """Compact the dense joint [B, T, U, ...] into packed rows.
+
+    The reference's ``pack_output`` removes the don't-care cells with a
+    data-dependent output size (transducer_joint_kernel.cu packed
+    layout); XLA needs static shapes, so — like the MoE capacity
+    factor — the caller supplies a static ``max_tokens`` capacity.
+    Cell (b, t, u) is valid iff ``t < f_len[b]`` and ``u <= g_len[b]``
+    (:func:`joint_mask` semantics) and lands at
+    ``offsets[b] + t·(g_len[b]+1) + u`` — the reference's batch_offset
+    layout.
+
+    Returns ``(packed [max_tokens, ...], offsets [B+1], n_valid [])``;
+    slots past ``n_valid`` are zero.  Cells beyond capacity are DROPPED
+    (check ``n_valid <= max_tokens``, e.g. with
+    ``jax.experimental.checkify`` or a host assert, when capacity is not
+    provably sufficient: ``max_tokens >= B·T·U`` never drops).
+    """
+    B, T, U = h.shape[:3]
+    rows_per_b = f_len * (g_len + 1)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(rows_per_b.astype(jnp.int32))])
+    valid = joint_mask(f_len, g_len, T, U)
+    t = jnp.arange(T)[None, :, None]
+    u = jnp.arange(U)[None, None, :]
+    pos = (offsets[:-1][:, None, None]
+           + t * (g_len[:, None, None] + 1) + u)
+    dest = jnp.where(valid, pos, max_tokens).reshape(-1)
+    feat_shape = h.shape[3:]
+    flat = h.reshape((B * T * U,) + feat_shape)
+    packed = jnp.zeros((max_tokens + 1,) + feat_shape, h.dtype)
+    packed = packed.at[dest].set(flat, mode="drop")
+    return packed[:max_tokens], offsets, offsets[-1]
+
+
+def unpack_joint(packed: jax.Array, offsets: jax.Array,
+                 f_len: jax.Array, g_len: jax.Array, T: int, U: int,
+                 fill: float = 0.0) -> jax.Array:
+    """Inverse of :func:`pack_joint_output`: packed rows → dense
+    [B, T, U, ...] with invalid cells set to ``fill``."""
+    B = offsets.shape[0] - 1
+    valid = joint_mask(f_len, g_len, T, U)
+    t = jnp.arange(T)[None, :, None]
+    u = jnp.arange(U)[None, None, :]
+    pos = (offsets[:-1][:, None, None]
+           + t * (g_len[:, None, None] + 1) + u)
+    idx = jnp.where(valid, pos, 0).reshape(-1)
+    dense = packed[idx].reshape((B, T, U) + packed.shape[1:])
+    return jnp.where(
+        valid.reshape(B, T, U, *([1] * (dense.ndim - 3))), dense,
+        jnp.asarray(fill, dense.dtype))
 
 
 def transducer_loss(
@@ -135,33 +191,61 @@ def transducer_loss(
 
 class TransducerJoint:
     """Reference-API module shim (apex/contrib/transducer/transducer.py:5).
-    ``pack_output`` is rejected: packing needs dynamic shapes; use the
-    dense output with :func:`joint_mask`."""
+
+    ``pack_output=True`` needs a static ``max_tokens`` capacity (XLA has
+    no data-dependent shapes; this is the capacity-factor contract —
+    ``max_tokens = B·T·U`` is always lossless) and returns
+    ``(packed, offsets, n_valid)`` instead of the dense joint."""
 
     def __init__(self, pack_output=False, relu=False, dropout=False,
-                 dropout_prob=0.0, **_ignored):
-        if pack_output:
-            raise NotImplementedError(
-                "pack_output produces data-dependent shapes, which XLA "
-                "cannot compile; use the dense output + joint_mask")
+                 dropout_prob=0.0, max_tokens=None, **_ignored):
+        if pack_output and max_tokens is None:
+            raise ValueError(
+                "pack_output=True requires max_tokens (a static packed "
+                "capacity; B*T*U is always enough): XLA cannot compile "
+                "the reference's data-dependent packed shape")
+        self.pack_output = pack_output
+        self.max_tokens = max_tokens
         self.relu = relu
         self.dropout = dropout
         self.dropout_prob = dropout_prob
 
     def __call__(self, f, g, f_len, g_len, dropout_rng=None):
-        return transducer_joint(
+        h = transducer_joint(
             f, g, f_len, g_len, relu=self.relu,
             dropout_prob=self.dropout_prob if self.dropout else 0.0,
             dropout_rng=dropout_rng)
+        if not self.pack_output:
+            return h
+        return pack_joint_output(h, f_len, g_len, self.max_tokens)
 
 
 class TransducerLoss:
-    """Reference-API module shim (apex/contrib/transducer/transducer.py:68)."""
+    """Reference-API module shim (apex/contrib/transducer/transducer.py:68).
+
+    ``packed_input=True`` consumes :class:`TransducerJoint`'s packed
+    layout: ``__call__(packed, label, f_len, y_len, offsets,
+    max_f_len, max_g_len)``.  The packed rows are scattered back to the
+    dense lattice before the anti-diagonal scan — the packing saves
+    memory in the joint and whatever runs between joint and loss, not in
+    the loss itself (whose lattice is inherently dense)."""
 
     def __init__(self, packed_input=False, **_ignored):
-        if packed_input:
-            raise NotImplementedError(
-                "packed_input needs dynamic shapes; pass the dense joint")
+        self.packed_input = packed_input
 
-    def __call__(self, x, label, f_len, y_len, blank_idx=0):
+    def __call__(self, x, label, f_len, y_len, blank_idx=0, *,
+                 offsets=None, max_f_len=None, max_g_len=None):
+        if self.packed_input:
+            if offsets is None or max_f_len is None or max_g_len is None:
+                raise ValueError(
+                    "packed_input=True requires offsets (from "
+                    "TransducerJoint pack_output) plus static "
+                    "max_f_len/max_g_len lattice bounds")
+            # recover the packed stride from the offsets themselves
+            # (rows_per_b = f_len·(g_len+1)) so this matches whatever
+            # g_len convention the joint was packed with
+            g_len_packed = ((offsets[1:] - offsets[:-1])
+                            // jnp.maximum(f_len, 1)) - 1
+            x = unpack_joint(x, offsets, f_len, g_len_packed, max_f_len,
+                             max_g_len, fill=0.0)
         return transducer_loss(x, label, f_len, y_len, blank_idx)
