@@ -15,7 +15,7 @@ tests/L0/run_transformer/run_gpt_minimal_test.py. Two composition modes:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -56,7 +56,7 @@ def make_gpt_train_step(
     mesh: Optional[Mesh] = None,
     *,
     seq_axis: Optional[str] = None,
-    context_parallel: bool = False,
+    context_parallel: Union[bool, str] = False,
     grad_postprocess: Optional[Callable] = None,
     fsdp: bool = False,
 ):
@@ -79,29 +79,44 @@ def make_gpt_train_step(
     appends an ``attention_mask`` (True = masked) element, dropout appends
     a PRNG key — ``step(state, tokens, labels[, mask][, rng])``.
 
-    ``context_parallel=True`` (requires ``seq_axis``) runs core
-    attention as ring attention over the sequence axis — the
-    long-context mode: per-device attention memory stays O(s_local)
-    instead of the gathered O(s_global).  The ring kernels cover the
-    flagship patterns only: ``attn_mask_type='padding'`` and
-    ``attention_dropout > 0`` are rejected up front (they would
-    silently fall back to the gathered path and OOM at exactly the
-    lengths the flag exists for); ``hidden_dropout`` is fine.
+    ``context_parallel`` (requires ``seq_axis``) keeps core attention
+    sequence-sharded — the long-context mode.  ``True``/``"ring"``
+    selects ring attention (per-device attention memory O(s_local));
+    ``"ulysses"`` selects all-to-all head re-sharding (one
+    full-sequence flash call per head group; needs heads divisible by
+    the axis size).  Both cover the flagship patterns only:
+    ``attn_mask_type='padding'`` and ``attention_dropout > 0`` are
+    rejected up front (they would silently fall back to the gathered
+    path and OOM at exactly the lengths the flag exists for);
+    ``hidden_dropout`` is fine.
     """
     if context_parallel:
         if cfg.attn_mask_type == "padding":
             raise ValueError(
-                "context_parallel=True does not support "
+                "context_parallel does not support "
                 "attn_mask_type='padding': the ring kernels have no "
                 "sharded-mask path, so masked configs would silently "
                 "gather K/V (O(s_global) memory). Pack sequences with "
                 "segment-free causal rows instead.")
         if cfg.attention_dropout > 0:
             raise ValueError(
-                "context_parallel=True does not support "
-                "attention_dropout > 0 (the ring kernels run without "
+                "context_parallel does not support attention_dropout "
+                "> 0 (the sequence-sharded attention paths run without "
                 "in-kernel dropout); set attention_dropout=0 — "
                 "hidden_dropout is unaffected.")
+        if context_parallel == "ulysses" and mesh is not None:
+            axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            sp_size = axes.get(seq_axis, 1) if seq_axis else 1
+            tp_size = axes.get("tp", 1)
+            heads = cfg.num_attention_heads
+            if heads % tp_size or (heads // tp_size) % sp_size:
+                raise ValueError(
+                    f"context_parallel='ulysses' needs num_attention_"
+                    f"heads ({heads}) divisible by tp ({tp_size}) and "
+                    f"the per-tp-rank heads ({heads // max(tp_size, 1)}) "
+                    f"divisible by the '{seq_axis}' axis size "
+                    f"({sp_size}); use context_parallel='ring' for "
+                    "head counts that don't factor.")
     ctx = (gspmd_ctx(seq_axis=seq_axis,
                      context_parallel=context_parallel)
            if mesh is not None else None)

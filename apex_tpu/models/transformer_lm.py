@@ -29,7 +29,7 @@ TPU-native redesign — *one functional core, two parallel modes*:
 from __future__ import annotations
 
 import functools
-from typing import Callable, NamedTuple, Optional
+from typing import Callable, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -92,15 +92,19 @@ class TPContext(NamedTuple):
     constrain_hidden: Callable[[jax.Array], jax.Array]
     constrain_col: Callable[[jax.Array], jax.Array]
     vocab_parallel: bool
-    # context parallelism: when set, core attention runs as ring
-    # attention over this mesh axis (K/V chunks ppermute around the
-    # ring, O(s_local) per-device memory — parallel/ring_attention.py).
-    # The reference has no such axis (SURVEY §5); this is the TPU-native
+    # context parallelism: when set, core attention stays
+    # sequence-sharded over this mesh axis.  cp_mode picks the
+    # algorithm: "ring" (K/V chunks ppermute around the ring,
+    # O(s_local·n·d) memory — parallel/ring_attention.py) or "ulysses"
+    # (all-to-all head re-sharding, one full-sequence flash call per
+    # head group, O(s_global·n/sp·d) — parallel/ulysses.py).  The
+    # reference has neither (SURVEY §5); this is the TPU-native
     # long-context path, first-class in the flagship model.  cp_qkv_spec
     # is the [b, s, n, d] partitioning the shard_map wrapper pins so the
     # batch (dp) and head (tp) shardings survive the manual region.
     cp_axis: Optional[str] = None
     cp_qkv_spec: Optional[P] = None
+    cp_mode: str = "ring"
 
 
 def _constrain(x, spec: P):
@@ -121,19 +125,26 @@ def _constrain(x, spec: P):
 
 def gspmd_ctx(batch_axis: str = "dp", tp_axis: str = "tp",
               seq_axis: Optional[str] = None,
-              context_parallel: bool = False) -> TPContext:
+              context_parallel: Union[bool, str] = False) -> TPContext:
     """Constraint-based context: annotate, let XLA partition.
 
     ``seq_axis`` shards activations along sequence (Megatron SP under
-    GSPMD).  ``context_parallel=True`` additionally runs core attention
-    as ring attention over ``seq_axis`` — without it, XLA's default
+    GSPMD).  ``context_parallel`` additionally keeps core attention
+    sequence-sharded over ``seq_axis`` — without it, XLA's default
     strategy all-gathers K/V per device, whose O(s_global) activations
-    cap the sequence length; with it, attention memory stays
-    O(s_local)."""
+    cap the sequence length.  ``True`` or ``"ring"`` selects ring
+    attention (O(s_local) memory); ``"ulysses"`` selects all-to-all
+    head re-sharding (one full-sequence flash call per head group —
+    needs num_heads divisible by the axis size)."""
     if context_parallel and seq_axis is None:
         raise ValueError(
-            "context_parallel=True requires seq_axis (the mesh axis the "
+            "context_parallel requires seq_axis (the mesh axis the "
             "sequence is sharded over)")
+    if context_parallel not in (False, True, "ring", "ulysses"):
+        raise ValueError(
+            f"context_parallel={context_parallel!r}: expected "
+            "False | True | 'ring' | 'ulysses'")
+    cp_mode = "ulysses" if context_parallel == "ulysses" else "ring"
 
     def hidden(x):
         return _constrain(x, P(batch_axis, seq_axis, *([None] * (x.ndim - 2))))
@@ -153,6 +164,7 @@ def gspmd_ctx(batch_axis: str = "dp", tp_axis: str = "tp",
         cp_axis=seq_axis if context_parallel else None,
         cp_qkv_spec=(P(batch_axis, seq_axis, tp_axis, None)
                      if context_parallel else None),
+        cp_mode=cp_mode,
     )
 
 
@@ -377,8 +389,8 @@ def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
     use_dropout = cfg.attention_dropout > 0 and dropout_rng is not None
     causal = cfg.attn_mask_type == "causal"
     if ctx is not None and ctx.cp_axis is not None:
-        cp = _ring_core_attention(ctx, q, k, v, causal, scale,
-                                  attention_mask, use_dropout)
+        cp = _cp_core_attention(ctx, q, k, v, causal, scale,
+                                attention_mask, use_dropout)
         if cp is not None:
             return cp
     # a 2-D [b, s_k] mask means key padding (True = masked key) — the
@@ -426,16 +438,17 @@ def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
     return ctxv
 
 
-def _ring_core_attention(ctx, q, k, v, causal, scale, attention_mask,
-                         use_dropout):
-    """Run core attention as ring attention over ``ctx.cp_axis``, or
-    return None when the pattern forces the gather path.
+def _cp_core_attention(ctx, q, k, v, causal, scale, attention_mask,
+                       use_dropout):
+    """Run core attention sequence-sharded over ``ctx.cp_axis`` (ring
+    or Ulysses per ``ctx.cp_mode``), or return None when the pattern
+    forces the gather path.
 
-    The ring kernels cover the flagship patterns (causal / full, no
-    mask, no attention dropout).  Masked or attention-dropout configs
-    fall back to the dense core — correct, but K/V get gathered, so
-    long-context training should keep those off (hidden dropout is
-    unaffected; it rides the sequence-sharded regions)."""
+    Both modes cover the flagship patterns (causal / full, no mask, no
+    attention dropout).  Masked or attention-dropout configs fall back
+    to the dense core — correct, but K/V get gathered, so long-context
+    training should keep those off (hidden dropout is unaffected; it
+    rides the sequence-sharded regions)."""
     if attention_mask is not None or use_dropout:
         return None
     axis = ctx.cp_axis
@@ -444,7 +457,10 @@ def _ring_core_attention(ctx, q, k, v, causal, scale, attention_mask,
         return None   # single-device run of a cp-configured model
     if int(mesh.shape[axis]) == 1:
         return None
-    from apex_tpu.parallel.ring_attention import ring_attention
+    if ctx.cp_mode == "ulysses":
+        from apex_tpu.parallel.ulysses import ulysses_attention as cp_fn
+    else:
+        from apex_tpu.parallel.ring_attention import ring_attention as cp_fn
 
     # keep batch (dp) and head (tp) shardings through the manual region;
     # axes absent from the mesh drop to replicated, like _constrain
@@ -452,7 +468,7 @@ def _ring_core_attention(ctx, q, k, v, causal, scale, attention_mask,
     spec = P(*(a if (a is None or a in names) else None
                for a in ctx.cp_qkv_spec))
     f = jax.shard_map(
-        functools.partial(ring_attention, axis_name=axis, causal=causal,
+        functools.partial(cp_fn, axis_name=axis, causal=causal,
                           scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return f(q, k, v)

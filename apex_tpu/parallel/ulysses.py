@@ -1,0 +1,74 @@
+"""Ulysses-style all-to-all sequence parallelism for attention.
+
+The second long-context mode next to :mod:`ring_attention` (the brief's
+"ring attention or all-to-all sequence/context parallelism"; the
+reference has neither — SURVEY.md §5).  Where the ring keeps K/V
+rotating and per-device memory at O(s_local·d·n), Ulysses re-shards
+*heads* across the sequence axis for the duration of attention:
+
+    [b, s_local, n, d]  --all_to_all-->  [b, s_global, n/sp, d]
+
+Each device then runs ordinary (flash) attention over the FULL sequence
+for its own head subset — no per-step collectives, one stacked
+all-to-all in (q/k/v together), one out — and memory is
+O(s_global·d·n/sp).  The trade (DeepSpeed
+Ulysses, arXiv:2309.14509): all-to-alls move O(b·s_local·n·d) per
+device like the ring's total ppermute traffic, but in 3 large
+transfers that overlap poorly vs the ring's ndev small ones that
+overlap with compute; the ring wins when s_global·n/sp activations
+don't fit, Ulysses wins at moderate lengths where the single flash
+call over the full sequence beats ndev chunked calls.
+
+Requires ``num_heads % axis_size == 0`` and equal sequence shards.
+Call inside ``jax.shard_map`` with q/k/v sharded along sequence, like
+:func:`ring_attention` — or let the flagship model do it:
+``make_gpt_train_step(..., seq_axis="sp", context_parallel="ulysses")``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ulysses_attention"]
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention over sequence-sharded [b, s_local, n, d] tensors via
+    head re-sharding.  Must run inside a ``jax.shard_map`` whose mesh
+    has ``axis_name``; shard i owns global positions
+    [i·s_local, (i+1)·s_local)."""
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    if q.ndim != 4:
+        raise ValueError(f"expected [b, s_local, n, d], got {q.shape}")
+    if q.shape != k.shape or k.shape != v.shape:
+        raise ValueError("ulysses requires equal q/k/v shard shapes")
+    sp = jax.lax.axis_size(axis_name)
+    n = q.shape[2]
+    if n % sp != 0:
+        raise ValueError(
+            f"ulysses_attention needs num_heads ({n}) divisible by the "
+            f"'{axis_name}' axis size ({sp}); use ring_attention for "
+            "head counts that don't factor")
+
+    # one stacked collective for q/k/v: [3, b, s_local, n, d] ->
+    # [3, b, s_global, n/sp, d] (fewer collective launches than three)
+    qkv = jnp.stack([q, k, v])
+    qkv = jax.lax.all_to_all(
+        qkv, axis_name, split_axis=3, concat_axis=2, tiled=True)
+    out = flash_attention(qkv[0], qkv[1], qkv[2], causal=causal,
+                          scale=scale)
+    # [b, s_global, n/sp, d] -> [b, s_local, n, d]
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True)

@@ -59,7 +59,9 @@ MODULES = [
      "parallel.sync_batchnorm — SyncBN"),
     ("apex_tpu.parallel.fsdp", "parallel", "parallel.fsdp — ZeRO-3"),
     ("apex_tpu.parallel.ring_attention", "parallel",
-     "parallel.ring_attention — context parallelism"),
+     "parallel.ring_attention — context parallelism (ring)"),
+    ("apex_tpu.parallel.ulysses", "parallel",
+     "parallel.ulysses — context parallelism (all-to-all)"),
     ("apex_tpu.parallel.LARC", "parallel", "parallel.LARC"),
     ("apex_tpu.parallel.clip_grad", "parallel", "parallel.clip_grad"),
     # transformer (Megatron layer)

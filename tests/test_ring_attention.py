@@ -250,3 +250,106 @@ class TestContextParallelGPT:
                 make_gpt_train_step(
                     cfg, fused_adam(lr=1e-3), "O2", mesh, seq_axis="sp",
                     context_parallel=True)
+
+
+class TestUlysses:
+    """All-to-all sequence parallelism (the second long-context mode)."""
+
+    def test_matches_single_device(self):
+        import functools
+
+        from apex_tpu.parallel.ulysses import ulysses_attention
+
+        b, s, n, d = 2, 256, 8, 32
+        q, k, v = data(b, s, n, d, seed=21)
+        mesh = create_mesh(sp=4)
+        for causal in (False, True):
+            f = jax.jit(jax.shard_map(
+                functools.partial(ulysses_attention, axis_name="sp",
+                                  causal=causal),
+                mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+                out_specs=P(None, "sp")))
+            got = f(q, k, v)
+            want = mha_reference(q, k, v, causal=causal)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5,
+                err_msg=f"causal={causal}")
+
+    def test_grads_match_single_device(self):
+        import functools
+
+        from apex_tpu.parallel.ulysses import ulysses_attention
+
+        b, s, n, d = 1, 128, 4, 32
+        q, k, v = data(b, s, n, d, seed=22)
+        mesh = create_mesh(sp=4)
+
+        def shard_loss(*a):
+            f = jax.shard_map(
+                functools.partial(ulysses_attention, axis_name="sp",
+                                  causal=True),
+                mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+                out_specs=P(None, "sp"))
+            o = f(*a)
+            return jnp.sum(o * (1.0 + 0.1 * o))
+
+        g = jax.jit(jax.grad(shard_loss, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(
+            lambda *a: (lambda o: jnp.sum(o * (1.0 + 0.1 * o)))(
+                mha_reference(*a, causal=True)),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, r, nm in zip(g, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(r), atol=1e-4, rtol=1e-4,
+                err_msg=f"d{nm}")
+
+    def test_head_divisibility_error(self):
+        import functools
+
+        from apex_tpu.parallel.ulysses import ulysses_attention
+
+        q, k, v = data(1, 64, 3, 16, seed=23)   # 3 heads, sp=4
+        mesh = create_mesh(sp=4)
+        f = jax.shard_map(
+            functools.partial(ulysses_attention, axis_name="sp"),
+            mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"))
+        with pytest.raises(ValueError, match="divisible"):
+            f(q, k, v)
+
+    def test_gpt_ulysses_head_check_up_front(self):
+        from apex_tpu.models.config import TransformerConfig
+        from apex_tpu.models.gpt import make_gpt_train_step
+        from apex_tpu.optimizers import fused_adam
+
+        cfg = TransformerConfig(
+            num_layers=2, hidden_size=64, num_attention_heads=4,
+            vocab_size=128, max_position_embeddings=64)
+        mesh = create_mesh(sp=8)
+        with pytest.raises(ValueError, match="divisible"):
+            make_gpt_train_step(
+                cfg, fused_adam(lr=1e-3), "O2", mesh, seq_axis="sp",
+                context_parallel="ulysses")
+
+    def test_gpt_train_step_ulysses(self):
+        from apex_tpu.models.config import TransformerConfig
+        from apex_tpu.models.gpt import make_gpt_train_step
+        from apex_tpu.optimizers import fused_adam
+
+        cfg = TransformerConfig(
+            num_layers=2, hidden_size=64, num_attention_heads=4,
+            vocab_size=128, max_position_embeddings=64,
+            compute_dtype=jnp.float32)
+        mesh = create_mesh(dp=2, sp=4)
+        init, step = make_gpt_train_step(
+            cfg, fused_adam(lr=1e-3), "O2", mesh, seq_axis="sp",
+            context_parallel="ulysses")
+        state = init(jax.random.PRNGKey(0))
+        rng = np.random.RandomState(3)
+        tokens = jnp.asarray(rng.randint(0, 128, (2, 64)), jnp.int32)
+        labels = jnp.asarray(rng.randint(0, 128, (2, 64)), jnp.int32)
+        losses = []
+        for _ in range(3):
+            state, m = step(state, tokens, labels)
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
