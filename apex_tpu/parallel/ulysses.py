@@ -13,7 +13,7 @@ for its own head subset — no per-step collectives, one stacked
 all-to-all in (q/k/v together), one out — and memory is
 O(s_global·d·n/sp).  The trade (DeepSpeed
 Ulysses, arXiv:2309.14509): all-to-alls move O(b·s_local·n·d) per
-device like the ring's total ppermute traffic, but in 3 large
+device like the ring's total ppermute traffic, but in 2 large
 transfers that overlap poorly vs the ring's ndev small ones that
 overlap with compute; the ring wins when s_global·n/sp activations
 don't fit, Ulysses wins at moderate lengths where the single flash

@@ -223,6 +223,30 @@ class TestContextParallelGPT:
         assert all(np.isfinite(losses)), losses
         assert losses[-1] < losses[0], losses
 
+    def test_cp_composes_with_remat_and_scan(self):
+        """The long-context production shape uses remat + scanned
+        layers (the bench s8192 config): both cp modes must compose
+        with them (shard_map inside a remat'd lax.scan body)."""
+        from apex_tpu.models.config import TransformerConfig
+        from apex_tpu.models.gpt import make_gpt_train_step
+        from apex_tpu.optimizers import fused_adam
+
+        cfg = TransformerConfig(
+            num_layers=2, hidden_size=64, num_attention_heads=4,
+            vocab_size=128, max_position_embeddings=64,
+            compute_dtype=jnp.bfloat16, remat=True, scan_layers=True)
+        mesh = create_mesh(dp=2, sp=4)
+        rng = np.random.RandomState(9)
+        tokens = jnp.asarray(rng.randint(0, 128, (2, 64)), jnp.int32)
+        labels = jnp.asarray(rng.randint(0, 128, (2, 64)), jnp.int32)
+        for mode in ("ring", "ulysses"):
+            init, step = make_gpt_train_step(
+                cfg, fused_adam(lr=1e-3), "O2", mesh, seq_axis="sp",
+                context_parallel=mode)
+            state = init(jax.random.PRNGKey(0))
+            state, m = step(state, tokens, labels)
+            assert np.isfinite(float(m["loss"])), mode
+
     def test_requires_seq_axis(self):
         from apex_tpu.models.transformer_lm import gspmd_ctx
 
