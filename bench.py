@@ -193,6 +193,42 @@ def bench_gpt_longctx(on_tpu):
     }
 
 
+def bench_decode(on_tpu):
+    """Autoregressive KV-cache decode throughput (beyond-reference row:
+    apex ships no generation path; ours is models/generate.py)."""
+    from apex_tpu.models.generate import generate
+    from apex_tpu.models.transformer_lm import init_gpt_params
+
+    if on_tpu:
+        batch, prompt, new = 8, 32, 128
+        cfg = gpt_125m(max_position_embeddings=prompt + new)
+    else:
+        batch, prompt, new = 2, 8, 8
+        cfg = gpt_125m(num_layers=2, hidden_size=128,
+                       num_attention_heads=4, vocab_size=1024,
+                       max_position_embeddings=prompt + new)
+    rng = np.random.RandomState(0)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, prompt)),
+                         jnp.int32)
+
+    def run(_):
+        out = generate(params, tokens, cfg, max_new_tokens=new)
+        return (out, out)
+
+    sec = _time_fn(run, n_warmup=1, iters=5 if on_tpu else 2)
+    # generate() feeds the prompt through the same per-token cached
+    # decode loop (one position per step), so the honest denominator is
+    # every decoded step, not just the new tokens
+    steps = prompt + new - 1
+    return {
+        "decode_tokens_per_sec": round(batch * steps / sec, 1),
+        "ms_per_token": round(sec / steps * 1e3, 3),
+        "batch": batch, "prompt": prompt, "new_tokens": new,
+        "decode_steps": steps,
+    }
+
+
 def bench_resnet50(on_tpu):
     from apex_tpu.models.resnet import make_resnet_train_step, resnet50
 
@@ -409,9 +445,16 @@ def _probe_backend(timeout_s: int = 150):
     The probe runs in a SUBPROCESS because a dead tunnel blocks backend
     init inside C++ where in-process signal handlers never fire.
     """
+    import os
     import subprocess
     import sys
 
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # explicit CPU request (smoke runs): the axon sitecustomize
+        # overrides the env var via jax config, so pin it back and skip
+        # the subprocess probe — nothing can hang on CPU
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu"
     try:
         out = subprocess.run(
             [sys.executable, "-c",
@@ -445,6 +488,7 @@ def main():
         ("resnet50", bench_resnet50),
         ("bert_large", bench_bert),
         ("rnnt_transducer", bench_transducer),
+        ("gpt2_125m_decode", bench_decode),
         ("gpt_moe_8e", bench_gpt_moe),
         ("mlp_fused_adam", bench_mlp_adam),
     ):
