@@ -42,6 +42,7 @@ from apex_tpu.optimizers import fused_sgd
 from apex_tpu.parallel.mesh import create_mesh
 from apex_tpu.utils.checkpoint import (
     AutoResume,
+    async_saver,
     latest_step,
     restore_checkpoint,
     save_checkpoint,
@@ -157,7 +158,8 @@ def main():
         source = real_batches(args.data_dir, args.batch,
                               args.image_size, start)
     else:
-        source = synthetic_batches(args.batch, hw=args.image_size)
+        source = synthetic_batches(args.batch, hw=args.image_size,
+                                   classes=args.num_classes)
     batches = device_prefetch(source)
     # compile-only warmup on a throwaway COPY (the step donates its
     # inputs) and a ZERO batch — drawing a real batch here would drop
@@ -175,21 +177,34 @@ def main():
 
     t0 = time.perf_counter()
     done = 0
-    for i in range(start, args.steps):
-        x, y = next(batches)
-        state, stats, m = step(state, stats, x, y)
-        done += 1
-        if args.ckpt_dir and (i + 1) % args.ckpt_every == 0:
-            float(m["loss"])                         # drain the device
-            save_checkpoint(args.ckpt_dir, i + 1, (state, stats))
-        if auto.termination_requested():
-            # cluster wants the slot back: checkpoint + requeue
-            float(m["loss"])
-            if args.ckpt_dir:
-                save_checkpoint(args.ckpt_dir, i + 1, (state, stats))
-            auto.request_resume()
-            print(f"AutoResume: checkpointed at step {i + 1}, requeued")
-            return
+    # periodic saves are async: the snapshot is taken immediately, the
+    # disk write overlaps the next training steps (requeue saves stay
+    # synchronous — durability before releasing the slot)
+    saver = async_saver() if args.ckpt_dir else None
+    try:
+        for i in range(start, args.steps):
+            x, y = next(batches)
+            state, stats, m = step(state, stats, x, y)
+            done += 1
+            saved_here = False
+            if saver is not None and (i + 1) % args.ckpt_every == 0:
+                saver.save(args.ckpt_dir, i + 1, (state, stats))
+                saved_here = True
+            if auto.termination_requested():
+                # cluster wants the slot back: checkpoint + requeue
+                float(m["loss"])
+                if saver is not None:
+                    saver.wait()
+                    if not saved_here:   # async save already covers i+1
+                        save_checkpoint(args.ckpt_dir, i + 1,
+                                        (state, stats))
+                auto.request_resume()
+                print(f"AutoResume: checkpointed at step {i + 1}, "
+                      "requeued")
+                return
+    finally:
+        if saver is not None:
+            saver.close()
     loss = float(m["loss"])                          # device sync
     dt = (time.perf_counter() - t0) / max(done, 1)
 
