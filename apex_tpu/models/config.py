@@ -32,6 +32,12 @@ class TransformerConfig:
     num_layers: int = 2
     hidden_size: int = 128
     num_attention_heads: int = 8
+    # grouped-query attention (beyond the reference, whose Megatron-era
+    # model is MHA-only): K/V get num_query_groups heads shared by
+    # num_attention_heads/groups queries each (GQA, arXiv:2305.13245;
+    # groups=1 is MQA).  None = num_attention_heads = classic MHA.  The
+    # decode KV cache stores only the group heads — the main win.
+    num_query_groups: Optional[int] = None
     ffn_hidden_size: Optional[int] = None         # default 4*h (2/3*4h swiglu)
     kv_channels: Optional[int] = None             # default h // nh
     vocab_size: int = 1024                        # padded to tp divisibility
@@ -94,10 +100,38 @@ class TransformerConfig:
                 self, "kv_channels",
                 self.hidden_size // self.num_attention_heads,
             )
+        if self.num_query_groups is not None:
+            if (self.num_query_groups < 1
+                    or self.num_attention_heads % self.num_query_groups):
+                raise ValueError(
+                    f"num_query_groups ({self.num_query_groups}) must "
+                    f"be a positive divisor of num_attention_heads "
+                    f"({self.num_attention_heads})")
 
     @property
     def projection_size(self) -> int:
         return self.kv_channels * self.num_attention_heads
+
+    @property
+    def kv_groups(self) -> int:
+        """Number of K/V heads (== num_attention_heads for MHA)."""
+        return (self.num_query_groups
+                if self.num_query_groups is not None
+                else self.num_attention_heads)
+
+    @property
+    def kv_projection_size(self) -> int:
+        return self.kv_channels * self.kv_groups
+
+    @property
+    def is_gqa(self) -> bool:
+        """True when K/V heads differ from query heads (grouped-query).
+
+        Selects the block qkv layout ([q | k | v] concatenated) instead
+        of the legacy per-head-interleaved layout, which is kept
+        bit-identical for MHA (golden traces + HF import depend on
+        it)."""
+        return self.kv_groups != self.num_attention_heads
 
 
 def gpt_tiny(**kw) -> TransformerConfig:

@@ -37,8 +37,12 @@ __all__ = ["init_kv_cache", "decode_step", "generate"]
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int):
-    """[L, b, max_len, nh, dh] k/v buffers + position counter."""
-    nh = cfg.num_attention_heads
+    """[L, b, max_len, kv_groups, dh] k/v buffers + position counter.
+
+    Under GQA the cache holds only the group heads — the persistent
+    per-token memory shrinks by num_attention_heads/num_query_groups
+    (the principal GQA/MQA serving win, arXiv:2305.13245)."""
+    nh = cfg.kv_groups
     dh = cfg.kv_channels
     shape = (cfg.num_layers, batch, max_len, nh, dh)
     return {
@@ -57,8 +61,12 @@ def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
     h = apply_norm(cfg, x, lp["ln1_scale"], lp["ln1_bias"])
     qkv = h @ lp["qkv_kernel"].astype(x.dtype) + lp["qkv_bias"].astype(
         x.dtype)
-    qkv = qkv.reshape(b, 1, nh, 3 * dh)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    if cfg.is_gqa:
+        from apex_tpu.models.transformer_lm import split_qkv_gqa
+        q, k, v = split_qkv_gqa(cfg, qkv, b, 1, nh)
+    else:
+        qkv = qkv.reshape(b, 1, nh, 3 * dh)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
     if rope is not None:
         cos, sin = rope          # [max_len, d]
         cos_t = jax.lax.dynamic_slice_in_dim(cos, pos, 1)[None, :, None]
@@ -73,14 +81,20 @@ def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
     cache_v = jax.lax.dynamic_update_slice_in_dim(
         cache_v, v.astype(cache_v.dtype), pos, axis=1)
 
-    # dense attention over the (masked) cache
+    # dense attention over the (masked) cache; under GQA the query
+    # heads fold as [groups, rep] against the group-width cache — no
+    # repeated K/V is ever materialized
     scale = 1.0 / dh ** 0.5
-    s = jnp.einsum("bqnd,btnd->bnqt", q, cache_k,
+    g = cfg.kv_groups
+    rep = nh // g
+    qg = q.reshape(b, 1, g, rep, dh)
+    s = jnp.einsum("bqgrd,btgd->bgrqt", qg, cache_k,
                    preferred_element_type=jnp.float32) * scale
     t_idx = jnp.arange(cache_k.shape[1])
-    s = jnp.where((t_idx <= pos)[None, None, None, :], s, -1e30)
+    s = jnp.where((t_idx <= pos)[None, None, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    ctxv = jnp.einsum("bnqt,btnd->bqnd", p.astype(cache_v.dtype), cache_v,
+    ctxv = jnp.einsum("bgrqt,btgd->bqgrd", p.astype(cache_v.dtype),
+                      cache_v,
                       preferred_element_type=jnp.float32).astype(x.dtype)
     a = ctxv.reshape(b, 1, nh * dh) @ lp["proj_kernel"].astype(x.dtype)
     a = a + lp["proj_bias"].astype(x.dtype)

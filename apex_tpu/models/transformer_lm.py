@@ -223,8 +223,12 @@ def init_gpt_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
     layers = {
         "ln1_scale": jnp.ones((L, h), dt),
         "ln1_bias": jnp.zeros((L, h), dt),
-        "qkv_kernel": nrm(ks[1], (L, h, 3 * p), std),
-        "qkv_bias": jnp.zeros((L, 3 * p), dt),
+        # MHA keeps the legacy per-head-interleaved 3p layout (golden
+        # traces + the HF importer depend on it); GQA uses the block
+        # [q (p) | k (kvp) | v (kvp)] layout
+        "qkv_kernel": nrm(ks[1], (L, h, p + 2 * cfg.kv_projection_size),
+                          std),
+        "qkv_bias": jnp.zeros((L, p + 2 * cfg.kv_projection_size), dt),
         "proj_kernel": nrm(ks[2], (L, p, h), out_std),
         "proj_bias": jnp.zeros((L, h), dt),
         "ln2_scale": jnp.ones((L, h), dt),
@@ -474,6 +478,20 @@ def _cp_core_attention(ctx, q, k, v, causal, scale, attention_mask,
     return f(q, k, v)
 
 
+def split_qkv_gqa(cfg: TransformerConfig, qkv, b, s, nh):
+    """Split the GQA block layout [q (p) | k (kvp) | v (kvp)] into
+    per-head tensors — THE one definition of the layout; the training
+    forward and the KV-cache decode both use it, so they cannot drift
+    apart (only the cache-parity test would catch that otherwise)."""
+    p = cfg.projection_size
+    kvp = cfg.kv_projection_size
+    dh = cfg.kv_channels
+    q = qkv[..., :p].reshape(b, s, nh, dh)
+    k = qkv[..., p:p + kvp].reshape(b, s, cfg.kv_groups, dh)
+    v = qkv[..., p + kvp:].reshape(b, s, cfg.kv_groups, dh)
+    return q, k, v
+
+
 def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
                attention_mask, rope, dropout_rng):
     """ParallelAttention (reference :358): column-parallel fused QKV,
@@ -485,12 +503,30 @@ def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
     qkv = xi @ lp["qkv_kernel"].astype(x.dtype) + lp["qkv_bias"].astype(
         x.dtype)
     qkv = ctx.constrain_col(qkv)
-    qkv = qkv.reshape(b, s, nh, -1)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    if cfg.is_gqa:
+        # block layout [q (p) | k (kvp) | v (kvp)]; a contiguous tp
+        # chunking of that axis would mix the sections, so GQA runs
+        # under GSPMD (global shapes, XLA reshards) or single device
+        if ctx.tp > 1:
+            raise ValueError(
+                "GQA (num_query_groups) is not supported with the "
+                "manual shard_map tensor-parallel context; use the "
+                "GSPMD context (make_gpt_train_step over a mesh)")
+        q, k, v = split_qkv_gqa(cfg, qkv, b, s, nh)
+    else:
+        qkv = qkv.reshape(b, s, nh, -1)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
     if rope is not None:
         cos, sin = rope
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
+    if cfg.is_gqa:
+        # broadcast the group heads up to the query heads for the core
+        # kernels (standard GQA trick; the decode path keeps the cache
+        # at group width — that persistent memory is the GQA win)
+        rep = nh // cfg.kv_groups
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     if dropout_rng is not None and ctx.tp > 1:
         # attention probs are head-sharded over tp: each tp rank needs its
         # own dropout stream (the reference's model-parallel RNG,
