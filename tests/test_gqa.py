@@ -157,6 +157,7 @@ class TestGQATraining:
             losses.append(float(m["loss"]))
         assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
 
+    @pytest.mark.slow   # compile-heavy GQA x cp; CI slow job
     def test_context_parallel_composes(self):
         from apex_tpu.models.gpt import make_gpt_train_step
         from apex_tpu.optimizers import fused_adam

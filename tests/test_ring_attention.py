@@ -203,6 +203,7 @@ class TestContextParallelGPT:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4)
 
+    @pytest.mark.slow   # gate asserts ring parity every driver run
     def test_train_step_context_parallel(self):
         from apex_tpu.models.gpt import make_gpt_train_step
         from apex_tpu.optimizers import fused_adam
@@ -223,6 +224,7 @@ class TestContextParallelGPT:
         assert all(np.isfinite(losses)), losses
         assert losses[-1] < losses[0], losses
 
+    @pytest.mark.slow   # compile-heavy; CI slow job
     def test_cp_composes_with_remat_and_scan(self):
         """The long-context production shape uses remat + scanned
         layers (the bench s8192 config): both cp modes must compose
@@ -355,6 +357,7 @@ class TestUlysses:
                 cfg, fused_adam(lr=1e-3), "O2", mesh, seq_axis="sp",
                 context_parallel="ulysses")
 
+    @pytest.mark.slow   # gate asserts ulysses parity every driver run
     def test_gpt_train_step_ulysses(self):
         from apex_tpu.models.config import TransformerConfig
         from apex_tpu.models.gpt import make_gpt_train_step
