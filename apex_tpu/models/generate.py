@@ -162,14 +162,18 @@ def generate(
     max_new_tokens: int = 32,
     temperature: float = 0.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
     vocab_limit: Optional[int] = None,
 ) -> jax.Array:
     """Decode ``max_new_tokens`` past ``prompt`` [b, s] → [b, s+new].
 
     ``temperature=0`` is greedy; otherwise softmax sampling with an
-    optional ``top_k`` cutoff.  The prompt is consumed through the same
-    cached step (prefill == decode path, so the parity test covers both).
+    optional ``top_k`` cutoff and/or nucleus ``top_p`` cutoff (keep the
+    smallest prefix of probability-sorted tokens whose mass reaches
+    ``top_p``; both given = intersection, top_k first).  The prompt is
+    consumed through the same cached step (prefill == decode path, so
+    the parity test covers both).
 
     ``vocab_limit`` masks logits at and beyond that id — REQUIRED
     knowledge for padded vocab tables (tools/import_hf.py pads GPT-2's
@@ -195,9 +199,30 @@ def generate(
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         logits = logits / temperature
+        if top_k is not None or top_p is not None:
+            # one descending sort serves both cutoffs (pick() runs every
+            # scan step; a second O(v log v) sort per token is real money
+            # at GPT-2's 50k vocab)
+            sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
         if top_k is not None:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            kth = sorted_l[:, top_k - 1][:, None]
             logits = jnp.where(logits < kth, -1e30, logits)
+            # reflect the cutoff in sorted space so the nucleus mass
+            # below is computed over the top_k-filtered distribution
+            pos = jnp.arange(sorted_l.shape[-1])[None]
+            sorted_l = jnp.where(pos >= top_k, -1e30, sorted_l)
+        if top_p is not None:
+            # nucleus: drop tokens outside the smallest prob-sorted
+            # prefix reaching mass top_p; n_keep clamps to 1 so the
+            # head token always stays (top_p<=0 means near-greedy, not
+            # a silent no-op)
+            probs = jax.nn.softmax(sorted_l, axis=-1)
+            csum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = (csum - probs) < top_p
+            n_keep = jnp.maximum(jnp.sum(keep_sorted, axis=-1), 1)
+            cutoff = jnp.take_along_axis(
+                sorted_l, (n_keep - 1)[:, None], axis=-1)
+            logits = jnp.where(logits < cutoff, -1e30, logits)
         return jax.random.categorical(key, logits).astype(jnp.int32)
 
     def body(carry, i):

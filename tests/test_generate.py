@@ -147,3 +147,48 @@ class TestGenerate:
         with pytest.raises(ValueError, match="causal"):
             decode_step(params2, jnp.asarray([1], jnp.int32),
                         init_kv_cache(cfg2, 1, 4), cfg2)
+
+
+class TestTopP:
+    def test_nucleus_restricts_support(self):
+        """top_p at its degenerate limit must behave greedily — even at
+        temperature 1.0, where a no-op filter would sample the whole
+        distribution and diverge from argmax almost surely."""
+        from apex_tpu.models.config import TransformerConfig
+        from apex_tpu.models.generate import generate
+        from apex_tpu.models.transformer_lm import init_gpt_params
+
+        cfg = TransformerConfig(
+            num_layers=1, hidden_size=32, num_attention_heads=2,
+            vocab_size=32, max_position_embeddings=16,
+            compute_dtype=jnp.float32)
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.asarray([[1, 2]], jnp.int32)
+
+        greedy = generate(params, prompt, cfg, max_new_tokens=6)
+        for tp in (0.0, 1e-6):
+            # full temperature: only the nucleus filter itself can make
+            # this match argmax — a no-op regression fails loudly
+            nucleus = generate(params, prompt, cfg, max_new_tokens=6,
+                               temperature=1.0, top_p=tp,
+                               rng=jax.random.PRNGKey(3))
+            np.testing.assert_array_equal(
+                np.asarray(greedy), np.asarray(nucleus),
+                err_msg=f"top_p={tp}")
+
+    def test_top_p_with_top_k_composes(self):
+        from apex_tpu.models.config import TransformerConfig
+        from apex_tpu.models.generate import generate
+        from apex_tpu.models.transformer_lm import init_gpt_params
+
+        cfg = TransformerConfig(
+            num_layers=1, hidden_size=32, num_attention_heads=2,
+            vocab_size=32, max_position_embeddings=16,
+            compute_dtype=jnp.float32)
+        params = init_gpt_params(jax.random.PRNGKey(1), cfg)
+        prompt = jnp.asarray([[3, 4, 5]], jnp.int32)
+        out = generate(params, prompt, cfg, max_new_tokens=5,
+                       temperature=0.8, top_k=8, top_p=0.9,
+                       rng=jax.random.PRNGKey(7))
+        assert out.shape == (1, 8)
+        assert bool(jnp.all((out >= 0) & (out < cfg.vocab_size)))
