@@ -1,6 +1,5 @@
 """KV-cache decoding: teacher-forcing parity with the training forward."""
 
-import dataclasses
 
 import jax
 import jax.numpy as jnp

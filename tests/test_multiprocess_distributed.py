@@ -18,7 +18,6 @@ import subprocess
 import sys
 import textwrap
 
-import pytest
 
 _CHILD = textwrap.dedent(
     """
