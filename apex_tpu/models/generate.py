@@ -188,6 +188,10 @@ def generate(
             f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"max_position_embeddings ({cfg.max_position_embeddings}); "
             "the learned position lookup would silently clamp")
+    if top_k is not None and top_k < 1:
+        raise ValueError(
+            f"top_k={top_k}: pass None (not 0) to disable the cutoff — "
+            "a zero-width cutoff would silently break the nucleus mask")
     cache = init_kv_cache(cfg, b, total)
     if rng is None:
         rng = jax.random.PRNGKey(0)

@@ -193,20 +193,27 @@ def bench_gpt_longctx(on_tpu):
     }
 
 
-def bench_decode(on_tpu):
+def bench_decode(on_tpu, query_groups=None):
     """Autoregressive KV-cache decode throughput (beyond-reference row:
-    apex ships no generation path; ours is models/generate.py)."""
+    apex ships no generation path; ours is models/generate.py).
+    ``query_groups`` enables the GQA variant — the cache shrinks by
+    heads/groups, the decode bandwidth story GQA exists for."""
     from apex_tpu.models.generate import generate
     from apex_tpu.models.transformer_lm import init_gpt_params
 
     if on_tpu:
         batch, prompt, new = 8, 32, 128
-        cfg = gpt_125m(max_position_embeddings=prompt + new)
+        cfg = gpt_125m(max_position_embeddings=prompt + new,
+                       num_query_groups=query_groups)
     else:
         batch, prompt, new = 2, 8, 8
+        # the smoke config has 4 heads: clamp groups so the GQA code
+        # path (kv_groups != heads) actually runs off-TPU too
+        smoke_groups = 2 if query_groups else None
         cfg = gpt_125m(num_layers=2, hidden_size=128,
                        num_attention_heads=4, vocab_size=1024,
-                       max_position_embeddings=prompt + new)
+                       max_position_embeddings=prompt + new,
+                       num_query_groups=smoke_groups)
     rng = np.random.RandomState(0)
     params = init_gpt_params(jax.random.PRNGKey(0), cfg)
     tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, prompt)),
@@ -221,12 +228,15 @@ def bench_decode(on_tpu):
     # decode loop (one position per step), so the honest denominator is
     # every decoded step, not just the new tokens
     steps = prompt + new - 1
-    return {
+    out = {
         "decode_tokens_per_sec": round(batch * steps / sec, 1),
         "ms_per_token": round(sec / steps * 1e3, 3),
         "batch": batch, "prompt": prompt, "new_tokens": new,
         "decode_steps": steps,
     }
+    if query_groups is not None:
+        out["num_query_groups"] = cfg.kv_groups
+    return out
 
 
 def bench_resnet50(on_tpu):
@@ -489,6 +499,8 @@ def main():
         ("bert_large", bench_bert),
         ("rnnt_transducer", bench_transducer),
         ("gpt2_125m_decode", bench_decode),
+        ("gpt2_125m_gqa4_decode",
+         lambda t: bench_decode(t, query_groups=4)),
         ("gpt_moe_8e", bench_gpt_moe),
         ("mlp_fused_adam", bench_mlp_adam),
     ):

@@ -58,6 +58,11 @@ def main():
     ap.add_argument("--opt-level", default="O2")
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument("--sample-tokens", type=int, default=120)
+    ap.add_argument("--top-k", type=int, default=40,
+                    help="0 disables the top-k cutoff")
+    ap.add_argument("--top-p", type=float, default=None,
+                    help="nucleus sampling mass (composes with --top-k)")
+    ap.add_argument("--temperature", type=float, default=0.8)
     args = ap.parse_args()
 
     data = np.frombuffer(open(args.data, "rb").read(), np.uint8)
@@ -109,8 +114,10 @@ def main():
         np.frombuffer(bytes(data[: min(32, args.seq)]), np.uint8)[None],
         jnp.int32)
     out = generate(state.params, prompt, cfg,
-                   max_new_tokens=args.sample_tokens, temperature=0.8,
-                   top_k=40, rng=jax.random.PRNGKey(1),
+                   max_new_tokens=args.sample_tokens,
+                   temperature=args.temperature,
+                   top_k=args.top_k or None,
+                   top_p=args.top_p, rng=jax.random.PRNGKey(1),
                    vocab_limit=256)
     text = bytes(np.asarray(out[0], np.uint8)).decode(
         "utf-8", errors="replace")
