@@ -1,0 +1,42 @@
+"""Killable out-of-process JAX backend probes.
+
+On a dead axon tunnel ``jax.devices()`` hangs inside C++ where Python
+signal handlers never fire, so any code that must *decide* whether a
+backend is reachable (bench.py's skip path, the dryrun gate's
+virtual-CPU fallback) probes in a subprocess with a kill timeout
+instead of initializing its own backend.  One helper serves both so the
+timeout/parse/error-surfacing recipe cannot drift between callers.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from typing import Optional
+
+__all__ = ["probe_jax"]
+
+
+def probe_jax(expr: str, timeout_s: int = 120,
+              label: str = "jax backend probe") -> Optional[str]:
+    """Evaluate ``expr`` (a Python expression over an imported ``jax``)
+    in a subprocess; return its str() result, or None on failure.
+
+    Failures (timeout, crash) print the child's tail of stderr with the
+    ``label`` so a healthy-host misconfiguration does not silently read
+    as an outage."""
+    code = f"import jax; print('PROBE=' + str({expr}))"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"[{label}] timed out after {timeout_s}s "
+              "(backend unreachable)", flush=True)
+        return None
+    for line in out.stdout.splitlines():
+        if line.startswith("PROBE="):
+            return line.split("=", 1)[1]
+    tail = (out.stderr or out.stdout).strip()[-400:]
+    print(f"[{label}] failed rc={out.returncode}: {tail}", flush=True)
+    return None

@@ -456,8 +456,8 @@ def _probe_backend(timeout_s: int = 150):
     init inside C++ where in-process signal handlers never fire.
     """
     import os
-    import subprocess
-    import sys
+
+    from apex_tpu.utils.probe import probe_jax
 
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         # explicit CPU request (smoke runs): the axon sitecustomize
@@ -465,24 +465,16 @@ def _probe_backend(timeout_s: int = 150):
         # the subprocess probe — nothing can hang on CPU
         jax.config.update("jax_platforms", "cpu")
         return "cpu"
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=timeout_s)
-        for line in out.stdout.splitlines():
-            if line.startswith("PLATFORM="):
-                return line.split("=", 1)[1]
-        raise RuntimeError(
-            f"backend probe rc={out.returncode}: "
-            f"{(out.stderr or out.stdout).strip()[-160:]}")
-    except Exception as e:
+    platform = probe_jax("jax.devices()[0].platform", timeout_s,
+                         label="bench backend probe")
+    if platform is None:
         print(json.dumps({
             "metric": _HEADLINE,
             "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
-            "skipped": f"no tpu backend ({type(e).__name__}: {e})"[:200],
+            "skipped": "no tpu backend (probe failed or timed out; "
+                       "see probe log line above)",
         }))
-        return None
+    return platform
 
 
 def main():
