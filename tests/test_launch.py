@@ -78,3 +78,23 @@ class TestInitDistributed:
         monkeypatch.setenv("RANK", "2")
         with pytest.raises(RuntimeError, match="no coordinator"):
             init_distributed()
+
+
+class TestProbeJax:
+    """The killable subprocess probe both gates depend on
+    (utils/probe.py — a dead tunnel hangs jax.devices() in C++)."""
+
+    def test_probe_returns_value(self):
+        from apex_tpu.utils.probe import probe_jax
+
+        # conftest pins the child env to CPU: a real jax evaluates
+        assert probe_jax("1 + 1", timeout_s=120) == "2"
+
+    def test_probe_failure_returns_none_and_reports(self, capsys):
+        from apex_tpu.utils.probe import probe_jax
+
+        got = probe_jax("jax.nonexistent_attr_xyz", timeout_s=120,
+                        label="unit probe")
+        assert got is None
+        err = capsys.readouterr().out
+        assert "unit probe" in err and "failed" in err
