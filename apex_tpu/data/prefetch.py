@@ -51,20 +51,44 @@ def device_prefetch(
         raise ValueError(f"prefetch size must be >= 1, got {size}")
 
     q: "queue.Queue" = queue.Queue(maxsize=size)
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        # Bounded put that re-checks the stop flag: an abandoned consumer
+        # (break / exception / GC) would otherwise leave this thread
+        # blocked on a full queue forever, pinning size+1 device batches.
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker():
         try:
             for batch in batches:
-                q.put(jax.device_put(batch, sharding))
-            q.put(_DONE)
+                if stop.is_set() or not _put(jax.device_put(batch, sharding)):
+                    return
+            _put(_DONE)
         except BaseException as e:  # surface pipeline errors downstream
-            q.put(e)
+            _put(e)
 
     threading.Thread(target=worker, daemon=True).start()
-    while True:
-        item = q.get()
-        if item is _DONE:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        # Runs on exhaustion, consumer exception, and GeneratorExit alike:
+        # release the producer, then drop queued device batches.
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break

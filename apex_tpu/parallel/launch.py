@@ -24,11 +24,24 @@ is left to ``jax.distributed.initialize()``'s own defaults via
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Optional
 
 import jax
 
 __all__ = ["init_distributed", "distributed_env"]
+
+# Markers of managed-cluster launches where jax.distributed.initialize
+# auto-detects rank/world-size itself and MASTER_ADDR may be exported
+# incidentally (e.g. by a site profile) rather than by a torch launcher.
+_CLUSTER_ENV_MARKERS = (
+    "SLURM_JOB_ID", "SLURM_PROCID", "OMPI_COMM_WORLD_RANK",
+    "PMI_RANK", "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID",
+)
+
+
+def _in_managed_cluster(env) -> bool:
+    return any(env.get(k) is not None for k in _CLUSTER_ENV_MARKERS)
 
 
 def distributed_env(environ=None):
@@ -102,14 +115,31 @@ def init_distributed(
         # absence is a broken launch — initialize(coord, None, None)
         # would hang or die with an opaque runtime error.  An explicit
         # coordinator_address= argument or COORDINATOR_ADDRESS env still
-        # passes through: on Cloud TPU/Slurm/MPI, jax auto-detects the
-        # missing fields.
-        raise RuntimeError(
-            f"MASTER_ADDR resolved coordinator {coord!r} but "
-            f"WORLD_SIZE/RANK gave num_processes={nproc} / "
-            f"process_id={pid}: a torch-style launcher exports all "
-            "three; set WORLD_SIZE and RANK, or pass "
-            "num_processes=/process_id=")
+        # passes through.  On managed clusters (Slurm/MPI/Cloud TPU)
+        # MASTER_ADDR is often exported incidentally by a site profile
+        # while jax auto-detects rank/world-size from the cluster env —
+        # there the torch-launcher inference is wrong, so warn and let
+        # initialize() resolve the missing fields itself.
+        if _in_managed_cluster(os.environ):
+            warnings.warn(
+                f"MASTER_ADDR resolved coordinator {coord!r} without "
+                "WORLD_SIZE/RANK, but a managed-cluster environment "
+                "(Slurm/MPI/Cloud TPU) is present; ignoring the "
+                "incidental MASTER_ADDR and deferring fully to "
+                "jax.distributed.initialize autodetection",
+                RuntimeWarning, stacklevel=2)
+            # An incidental MASTER_ADDR is untrustworthy (often
+            # localhost from a site profile): drop it entirely so the
+            # cluster plugin resolves the coordinator too — passing it
+            # through would point every node at its own localhost.
+            coord = None
+        else:
+            raise RuntimeError(
+                f"MASTER_ADDR resolved coordinator {coord!r} but "
+                f"WORLD_SIZE/RANK gave num_processes={nproc} / "
+                f"process_id={pid}: a torch-style launcher exports all "
+                "three; set WORLD_SIZE and RANK, or pass "
+                "num_processes=/process_id=")
 
     jax.distributed.initialize(
         coordinator_address=coord,

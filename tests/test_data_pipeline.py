@@ -176,3 +176,29 @@ class TestDevicePrefetch:
 
         with pytest.raises(ValueError):
             list(device_prefetch(iter([]), size=0))
+
+    def test_abandoned_consumer_releases_producer(self):
+        # An early break must unblock the producer thread instead of
+        # leaving it parked on q.put for the process lifetime (ADVICE r4).
+        import threading
+
+        from apex_tpu.data import device_prefetch
+
+        produced = []
+
+        def source():
+            i = 0
+            while True:
+                produced.append(i)
+                yield (np.full((2,), i, np.float32),)
+                i += 1
+
+        before = set(threading.enumerate())
+        it = device_prefetch(source(), size=2)
+        next(it)
+        workers = [t for t in threading.enumerate() if t not in before]
+        assert len(workers) == 1, workers
+        it.close()  # GeneratorExit → finally → stop event + drain
+        workers[0].join(timeout=10)
+        assert not workers[0].is_alive(), "producer still running after close"
+        assert len(produced) <= 6  # bounded: ~size+in-flight, not unbounded

@@ -79,6 +79,44 @@ class TestInitDistributed:
         with pytest.raises(RuntimeError, match="no coordinator"):
             init_distributed()
 
+    def test_master_addr_without_ranks_raises(self, monkeypatch):
+        import pytest
+
+        import apex_tpu.parallel.launch as launch
+        monkeypatch.setattr(launch, "_initialized", False)
+        for var in ("COORDINATOR_ADDRESS", "WORLD_SIZE", "RANK",
+                    "NODE_RANK", "PROCESS_ID", "NUM_PROCESSES",
+                    *launch._CLUSTER_ENV_MARKERS):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("MASTER_ADDR", "host0")
+        with pytest.raises(RuntimeError, match="WORLD_SIZE"):
+            init_distributed()
+
+    def test_master_addr_under_cluster_warns_and_defers(self, monkeypatch):
+        import warnings
+
+        import apex_tpu.parallel.launch as launch
+        monkeypatch.setattr(launch, "_initialized", False)
+        for var in ("COORDINATOR_ADDRESS", "WORLD_SIZE", "RANK",
+                    "NODE_RANK", "PROCESS_ID", "NUM_PROCESSES",
+                    *launch._CLUSTER_ENV_MARKERS):
+            monkeypatch.delenv(var, raising=False)
+        # Slurm host where a site profile incidentally exports MASTER_ADDR:
+        # must NOT abort, and must NOT pass the untrustworthy coordinator
+        # through (it is often localhost — every node would connect to
+        # itself); jax's cluster plugin autodetects all three fields.
+        monkeypatch.setenv("MASTER_ADDR", "host0")
+        monkeypatch.setenv("SLURM_JOB_ID", "1234")
+        calls = []
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: calls.append(kw))
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            init_distributed()
+        assert any("managed-cluster" in str(w.message) for w in caught)
+        assert calls == [{"coordinator_address": None,
+                          "num_processes": None, "process_id": None}]
+
 
 class TestProbeJax:
     """The killable subprocess probe both gates depend on

@@ -21,6 +21,7 @@ Usage:  PYTHONPATH=.:/root/.axon_site python tools/sweep_r4.py [--json f]
 from __future__ import annotations
 
 import argparse
+import contextlib
 import functools
 import json
 import os
@@ -39,6 +40,27 @@ def _report(results, key, name, pallas_s, xla_s):
     results[key] = _fmt(name, pallas_s, xla_s)
 
 
+@contextlib.contextmanager
+def _knobs(**env):
+    """Set APEX_TPU_* sweep knobs, restoring prior values even when a
+    variant raises — a mid-sweep exception must not leak a knob into the
+    later sweeps of the same process (ADVICE r4)."""
+    saved = {k: os.environ.get(k) for k in env}
+    try:
+        for k, v in env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        yield
+    finally:
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+
 def sweep_flash_s512(results):
     from apex_tpu.ops.flash_attention import flash_attention, mha_reference
 
@@ -55,15 +77,13 @@ def sweep_flash_s512(results):
         fa = functools.partial(flash_attention, causal=causal)
         for mode, bq in (("split", 0), ("fused", 128), ("fused", 256),
                          ("fused", 512)):
-            os.environ["APEX_TPU_FLASH_BWD"] = mode
-            if bq:
-                os.environ["APEX_TPU_FLASH_FUSED_BQ"] = str(bq)
-            got = chain_grad(fa, (0, 1, 2), q, k, v, inner=(16, 48, 160))
+            with _knobs(APEX_TPU_FLASH_BWD=mode,
+                        APEX_TPU_FLASH_FUSED_BQ=bq or None):
+                got = chain_grad(fa, (0, 1, 2), q, k, v,
+                                 inner=(16, 48, 160))
             label = mode if mode == "split" else f"{mode}_bq{bq}"
             _report(results, f"flash_fwdbwd_{tag}_{label}",
                     f"fwd+bwd {tag} {label}", got, xla)
-        os.environ.pop("APEX_TPU_FLASH_BWD", None)
-        os.environ.pop("APEX_TPU_FLASH_FUSED_BQ", None)
 
 
 def _time_adam(update, g, p, m, v):
@@ -110,23 +130,22 @@ def sweep_flat_adam(results):
 
     xla = _time_adam(xla_update, g, p, m, v)
     for rows in (512, 1024, 2048, 4096):
-        os.environ["APEX_TPU_ADAM_BLOCK_ROWS"] = str(rows)
-        # the kernel wrapper is itself jitted: drop its trace cache or
-        # the env knob is ignored after the first variant
-        adam_kernel_flat.clear_cache()
+        with _knobs(APEX_TPU_ADAM_BLOCK_ROWS=rows):
+            # the kernel wrapper is itself jitted: drop its trace cache
+            # or the env knob is ignored after the first variant
+            adam_kernel_flat.clear_cache()
 
-        def pallas_update(g, p, m, v):
-            return adam_kernel_flat(g, p, m, v, scalars)
+            def pallas_update(g, p, m, v):
+                return adam_kernel_flat(g, p, m, v, scalars)
 
-        try:
-            got = _time_adam(pallas_update, g, p, m, v)
-        except Exception as e:
-            print(f"  rows={rows}: {type(e).__name__}: {e}"[:120],
-                  flush=True)
-            continue
+            try:
+                got = _time_adam(pallas_update, g, p, m, v)
+            except Exception as e:
+                print(f"  rows={rows}: {type(e).__name__}: {e}"[:120],
+                      flush=True)
+                continue
         _report(results, f"flat_adam_88m_rows{rows}",
                 f"flat adam 88M rows={rows}", got, xla)
-    os.environ.pop("APEX_TPU_ADAM_BLOCK_ROWS", None)
 
 
 def sweep_ln_bwd(results):
@@ -141,15 +160,11 @@ def sweep_ln_bwd(results):
     ref = lambda x, w, b: layer_norm_ref(x, w, b)
     xla_chain = chain_grad(ref, (0, 1, 2), x, w, b)
     for mode in ("pallas", "pallas_split", None):
-        if mode is None:
-            os.environ.pop("APEX_TPU_LN_BWD", None)
-        else:
-            os.environ["APEX_TPU_LN_BWD"] = mode
-        got = chain_grad(ln, (0, 1, 2), x, w, b)
+        with _knobs(APEX_TPU_LN_BWD=mode):
+            got = chain_grad(ln, (0, 1, 2), x, w, b)
         tag = mode or "default_xla_bwd"
         _report(results, f"ln_fwdbwd_{tag}", f"LN fwd+bwd {tag}",
                 got, xla_chain)
-    os.environ.pop("APEX_TPU_LN_BWD", None)
 
 
 def sweep_softmax(results):
