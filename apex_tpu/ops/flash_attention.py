@@ -847,7 +847,13 @@ def _flash_bwd(causal, scale, dropout_p, res, do):
     if mode not in ("auto", "fused", "split"):
         raise ValueError(
             f"APEX_TPU_FLASH_BWD={mode!r}: expected auto|fused|split")
-    fused_max = int(os.environ.get("APEX_TPU_FLASH_BWD_FUSED_MAX", "512"))
+    # auto currently resolves to the split pair everywhere: the fused
+    # single-pass backward has only ever run in interpret mode (the
+    # round-4 chip outage), and the repo's policy is that defaults are
+    # measured winners.  When tools/sweep_r4.py measures a fused win on
+    # silicon, raise FUSED_MAX back to the measured crossover (512 was
+    # the projected value for the short-key / BERT class).
+    fused_max = int(os.environ.get("APEX_TPU_FLASH_BWD_FUSED_MAX", "0"))
     if mode == "fused" or (mode == "auto" and skp <= fused_max):
         # short-key class (BERT s512 etc.): K/V fit VMEM whole — one
         # pass computes p once and emits dq/dk/dv together, vs the
