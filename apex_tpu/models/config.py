@@ -127,10 +127,11 @@ class TransformerConfig:
     def is_gqa(self) -> bool:
         """True when K/V heads differ from query heads (grouped-query).
 
-        Selects the block qkv layout ([q | k | v] concatenated) instead
-        of the legacy per-head-interleaved layout, which is kept
-        bit-identical for MHA (golden traces + HF import depend on
-        it)."""
+        Selects the group-major qkv layout — per query group
+        ``[q x rep | k | v]`` heads (see ``split_qkv_gqa``, the one
+        layout definition) — instead of the legacy per-head-interleaved
+        layout, which is kept bit-identical for MHA (golden traces + HF
+        import depend on it)."""
         return self.kv_groups != self.num_attention_heads
 
 

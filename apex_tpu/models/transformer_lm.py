@@ -29,6 +29,8 @@ TPU-native redesign — *one functional core, two parallel modes*:
 from __future__ import annotations
 
 import functools
+import os
+import warnings
 from typing import Callable, NamedTuple, Optional, Union
 
 import jax
@@ -224,8 +226,12 @@ def init_gpt_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
         "ln1_scale": jnp.ones((L, h), dt),
         "ln1_bias": jnp.zeros((L, h), dt),
         # MHA keeps the legacy per-head-interleaved 3p layout (golden
-        # traces + the HF importer depend on it); GQA uses the block
-        # [q (p) | k (kvp) | v (kvp)] layout
+        # traces + the HF importer depend on it); GQA uses the
+        # group-major layout — per query group [q x rep | k | v] — the
+        # direct generalization of the MHA per-head [q|k|v] (rep=1),
+        # chosen so a contiguous tp chunk of this axis holds whole
+        # groups and manual tensor parallelism stays legal (see
+        # split_qkv_gqa)
         "qkv_kernel": nrm(ks[1], (L, h, p + 2 * cfg.kv_projection_size),
                           std),
         "qkv_bias": jnp.zeros((L, p + 2 * cfg.kv_projection_size), dt),
@@ -442,6 +448,30 @@ def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
     return ctxv
 
 
+_cp_fallback_warned = False
+
+
+def _cp_degraded_fallback(reason: str) -> None:
+    """A context-parallel-configured model is about to take the gathered
+    dense path: numerically correct, but K/V get all-gathered across the
+    cp axis — the exact memory blowup context parallelism exists to
+    avoid.  Loud once-per-process warning (trace-time, so it fires at
+    compile, before the step OOMs); ``APEX_TPU_CP_STRICT=1`` raises."""
+    global _cp_fallback_warned
+    msg = (
+        f"context parallelism DEGRADED: {reason}, which the ring/Ulysses "
+        "kernels do not cover — falling back to dense attention with "
+        "K/V all-gathered over the cp axis. At long context this is the "
+        "memory blowup cp exists to avoid (OOM or crawl). Drop the mask "
+        "/ attention dropout for cp training, or set APEX_TPU_CP_STRICT=1 "
+        "to make this an error.")
+    if os.environ.get("APEX_TPU_CP_STRICT", "") not in ("", "0"):
+        raise ValueError(msg)
+    if not _cp_fallback_warned:
+        _cp_fallback_warned = True
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+
 def _cp_core_attention(ctx, q, k, v, causal, scale, attention_mask,
                        use_dropout):
     """Run core attention sequence-sharded over ``ctx.cp_axis`` (ring
@@ -452,14 +482,20 @@ def _cp_core_attention(ctx, q, k, v, causal, scale, attention_mask,
     attention dropout).  Masked or attention-dropout configs fall back
     to the dense core — correct, but K/V get gathered, so long-context
     training should keep those off (hidden dropout is unaffected; it
-    rides the sequence-sharded regions)."""
-    if attention_mask is not None or use_dropout:
-        return None
+    rides the sequence-sharded regions).  The fallback warns once per
+    process (it is the exact memory blowup cp exists to avoid — at
+    s8192 it means OOM-or-crawl with no hint why); set
+    ``APEX_TPU_CP_STRICT=1`` to make it a hard error instead."""
     axis = ctx.cp_axis
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty or axis not in mesh.axis_names:
         return None   # single-device run of a cp-configured model
     if int(mesh.shape[axis]) == 1:
+        return None   # cp degree 1: the dense path gathers nothing
+    if attention_mask is not None or use_dropout:
+        _cp_degraded_fallback(
+            "attention_mask is set" if attention_mask is not None
+            else "attention dropout is active")
         return None
     if ctx.cp_mode == "ulysses":
         from apex_tpu.parallel.ulysses import ulysses_attention as cp_fn
@@ -479,16 +515,27 @@ def _cp_core_attention(ctx, q, k, v, causal, scale, attention_mask,
 
 
 def split_qkv_gqa(cfg: TransformerConfig, qkv, b, s, nh):
-    """Split the GQA block layout [q (p) | k (kvp) | v (kvp)] into
-    per-head tensors — THE one definition of the layout; the training
-    forward and the KV-cache decode both use it, so they cannot drift
-    apart (only the cache-parity test would catch that otherwise)."""
-    p = cfg.projection_size
-    kvp = cfg.kv_projection_size
+    """Split the GQA group-major layout — per query group
+    ``[q x rep | k | v]`` heads — into per-head tensors; THE one
+    definition of the layout: the training forward and the KV-cache
+    decode both use it, so they cannot drift apart (only the
+    cache-parity test would catch that otherwise).
+
+    Group-major (not the block ``[q|k|v]`` sections) so that a
+    contiguous tp chunk of the fused axis holds whole groups: the same
+    function serves the global view (``nh`` = all query heads) and a
+    manual-TP rank's local view (``nh`` = heads/tp, requiring
+    ``kv_groups % tp == 0``).  With ``rep == 1`` this degenerates to the
+    MHA per-head ``[q|k|v]`` interleave.  Query head ``h`` belongs to
+    group ``h // rep`` in both views — the decode path's
+    ``q.reshape(b, 1, g, rep, dh)`` fold depends on that ordering."""
     dh = cfg.kv_channels
-    q = qkv[..., :p].reshape(b, s, nh, dh)
-    k = qkv[..., p:p + kvp].reshape(b, s, cfg.kv_groups, dh)
-    v = qkv[..., p + kvp:].reshape(b, s, cfg.kv_groups, dh)
+    rep = cfg.num_attention_heads // cfg.kv_groups
+    g = nh // rep   # local group count (nh may be per-rank heads/tp)
+    blk = qkv.reshape(b, s, g, rep + 2, dh)
+    q = blk[..., :rep, :].reshape(b, s, nh, dh)
+    k = blk[..., rep, :]
+    v = blk[..., rep + 1, :]
     return q, k, v
 
 
@@ -504,14 +551,18 @@ def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
         x.dtype)
     qkv = ctx.constrain_col(qkv)
     if cfg.is_gqa:
-        # block layout [q (p) | k (kvp) | v (kvp)]; a contiguous tp
-        # chunking of that axis would mix the sections, so GQA runs
-        # under GSPMD (global shapes, XLA reshards) or single device
-        if ctx.tp > 1:
+        # group-major layout (per group [q x rep | k | v]): a contiguous
+        # tp chunk holds whole groups, so manual TP is legal whenever
+        # each rank gets an integral number of groups
+        if ctx.tp > 1 and cfg.kv_groups % ctx.tp:
             raise ValueError(
-                "GQA (num_query_groups) is not supported with the "
-                "manual shard_map tensor-parallel context; use the "
-                "GSPMD context (make_gpt_train_step over a mesh)")
+                f"GQA with num_query_groups={cfg.kv_groups} cannot "
+                f"shard over the manual shard_map tensor-parallel "
+                f"context with tp={ctx.tp}: tp must divide the group "
+                "count (each rank needs whole [q x rep | k | v] "
+                "groups). Use a tp that divides num_query_groups, or "
+                "the GSPMD context (make_gpt_train_step over a mesh), "
+                "which replicates KV heads as needed")
         q, k, v = split_qkv_gqa(cfg, qkv, b, s, nh)
     else:
         qkv = qkv.reshape(b, s, nh, -1)
@@ -523,8 +574,10 @@ def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
     if cfg.is_gqa:
         # broadcast the group heads up to the query heads for the core
         # kernels (standard GQA trick; the decode path keeps the cache
-        # at group width — that persistent memory is the GQA win)
-        rep = nh // cfg.kv_groups
+        # at group width — that persistent memory is the GQA win).
+        # rep is the GLOBAL queries-per-group ratio: under manual TP
+        # both nh and the local group count are already divided by tp.
+        rep = cfg.num_attention_heads // cfg.kv_groups
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     if dropout_rng is not None and ctx.tp > 1:

@@ -1,8 +1,10 @@
 """Grouped-query attention (cfg.num_query_groups) — beyond the
 reference (whose Megatron-era model is MHA-only; GQA per
 arXiv:2305.13245).  MHA keeps the legacy interleaved qkv layout
-bit-identical (golden traces, HF import); these tests pin the GQA block
-layout, the group-width KV cache, and the composition surfaces."""
+bit-identical (golden traces, HF import); these tests pin the GQA
+group-major layout (per group [q x rep | k | v]), the group-width KV
+cache, and the composition surfaces — including manual TP, which the
+group-major layout makes legal whenever tp divides the group count."""
 
 import jax
 import jax.numpy as jnp
@@ -77,7 +79,11 @@ class TestGQAForward:
         with pytest.raises(ValueError, match="divisor"):
             _cfg(num_query_groups=bad)
 
-    def test_manual_tp_rejected(self):
+    def test_manual_tp_loss_matches_single_device(self):
+        """The group-major qkv layout makes a contiguous tp chunk hold
+        whole [q x rep | k | v] groups, so the manual shard_map TP path
+        (the pipeline's per-stage context) trains GQA when tp divides
+        the group count."""
         import functools
 
         from jax.sharding import PartitionSpec as P
@@ -85,7 +91,65 @@ class TestGQAForward:
         from apex_tpu.models.transformer_lm import gpt_param_specs
         from apex_tpu.parallel.mesh import create_mesh
 
-        cfg = _cfg()
+        cfg = _cfg()   # 8 heads, 2 groups; tp=2 → 1 group per rank
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        tokens, labels = _data(cfg)
+        ref = float(gpt_loss(params, tokens, labels, cfg))
+        mesh = create_mesh(tp=2)
+        specs = gpt_param_specs(cfg)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=(specs, P(), P()),
+            out_specs=P())
+        def run(p, t, y):
+            return gpt_loss(p, t, y, cfg, manual_ctx(2))
+
+        got = float(run(params, tokens, labels))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    @pytest.mark.slow   # loss variant keeps default-tier coverage
+    def test_manual_tp_grads_match_single_device(self):
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.models.transformer_lm import gpt_param_specs
+        from apex_tpu.parallel.mesh import create_mesh
+
+        cfg = _cfg(num_query_groups=4)   # 2 groups per rank
+        params = init_gpt_params(jax.random.PRNGKey(3), cfg)
+        tokens, labels = _data(cfg, seed=9)
+        ref_grads = jax.grad(gpt_loss)(params, tokens, labels, cfg)
+        mesh = create_mesh(tp=2)
+        specs = gpt_param_specs(cfg)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=(specs, P(), P()),
+            out_specs=specs)
+        def run(p, t, y):
+            return jax.grad(gpt_loss)(p, t, y, cfg, manual_ctx(2))
+
+        grads = run(params, tokens, labels)
+        for path in [("layers", "qkv_kernel"), ("layers", "proj_kernel"),
+                     ("embedding", "word")]:
+            g, r = grads, ref_grads
+            for k in path:
+                g, r = g[k], r[k]
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), atol=2e-4,
+                err_msg=str(path))
+
+    def test_manual_tp_rejected_when_tp_exceeds_groups(self):
+        """MQA (1 group) cannot hand each of 2 tp ranks a whole group —
+        that config still needs GSPMD (which replicates KV heads)."""
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.models.transformer_lm import gpt_param_specs
+        from apex_tpu.parallel.mesh import create_mesh
+
+        cfg = _cfg(num_query_groups=1)
         params = init_gpt_params(jax.random.PRNGKey(0), cfg)
         tokens, labels = _data(cfg)
         mesh = create_mesh(tp=2)
@@ -97,7 +161,7 @@ class TestGQAForward:
         def run(p, t, y):
             return gpt_loss(p, t, y, cfg, manual_ctx(2))
 
-        with pytest.raises(ValueError, match="shard_map"):
+        with pytest.raises(ValueError, match="divide the group"):
             run(params, tokens, labels)
 
 
@@ -137,6 +201,59 @@ class TestGQADecode:
         out = generate(params, prompt, cfg, max_new_tokens=6)
         assert out.shape == (1, 10)
         assert bool(jnp.all((out >= 0) & (out < cfg.vocab_size)))
+
+
+class TestGQAPipeline:
+    """GQA through the 1F1B pipeline — the round-4 gap: the pipeline's
+    per-stage manual context could not run GQA at all.  The group-major
+    layout closes it for pp alone (single-device stages) and for pp x tp
+    (tp dividing the group count)."""
+
+    @pytest.mark.parametrize(
+        "tp", [1, pytest.param(2, marks=pytest.mark.slow)])
+    def test_pipeline_loss_matches_sequential(self, tp):
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.models.gpt import (
+            gpt_pipeline_loss_and_grads, make_gpt_pipeline_stage,
+            pipeline_packet, stack_pipeline_params)
+        from apex_tpu.models.transformer_lm import gpt_param_specs
+        from apex_tpu.parallel.mesh import create_mesh
+
+        pp, n_micro, mb = 2, 2, 2
+        cfg = _cfg(num_layers=4)
+        params = init_gpt_params(jax.random.PRNGKey(6), cfg)
+        tokens, labels = _data(cfg, b=n_micro * mb, seed=11)
+        ref_loss, ref_grads = jax.value_and_grad(gpt_loss)(
+            params, tokens, labels, cfg)
+
+        stacked = stack_pipeline_params(params, cfg, pp)
+        packets = pipeline_packet(
+            tokens.reshape(n_micro, mb, -1), labels.reshape(n_micro, mb, -1),
+            cfg)
+        mesh = create_mesh(pp=pp, tp=tp)
+        stage_fn = make_gpt_pipeline_stage(cfg, pp, tp)
+        pspecs = gpt_param_specs(cfg, pp_axis="pp")
+        if tp == 1:
+            pspecs = jax.tree_util.tree_map(
+                lambda s: P(*(a if a != "tp" else None for a in s)),
+                pspecs, is_leaf=lambda x: isinstance(x, P))
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(pspecs, P()), out_specs=(P(), pspecs))
+        def run(p, mbs):
+            return gpt_pipeline_loss_and_grads(
+                stage_fn, p, mbs, n_micro=n_micro)
+
+        loss, grads = run(stacked, packets)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        g = grads["layers"]["qkv_kernel"]
+        r = stack_pipeline_params(ref_grads, cfg, pp)["layers"]["qkv_kernel"]
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=3e-4)
 
 
 class TestGQATraining:

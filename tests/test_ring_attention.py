@@ -255,6 +255,68 @@ class TestContextParallelGPT:
         with pytest.raises(ValueError, match="requires seq_axis"):
             gspmd_ctx(context_parallel=True)
 
+    def test_degraded_fallback_warns_once(self, monkeypatch):
+        """A cp-configured forward whose pattern forces the gathered
+        dense path (mask / attention dropout) must say so loudly: the
+        all-gathered K/V is the memory blowup cp exists to avoid, and
+        at s8192 the silent version is an unexplained OOM."""
+        import warnings
+
+        import apex_tpu.models.transformer_lm as tlm
+
+        monkeypatch.delenv("APEX_TPU_CP_STRICT", raising=False)
+        monkeypatch.setattr(tlm, "_cp_fallback_warned", False)
+        ctx = tlm.gspmd_ctx(seq_axis="sp", context_parallel=True)
+        q = jnp.zeros((2, 8, 4, 8), jnp.float32)
+        mask = jnp.zeros((2, 1, 8, 8), bool)
+        # no active mesh (single-device debug run of the cp config):
+        # the dense path gathers nothing, so no alarm may fire
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RuntimeWarning)
+            assert tlm._cp_core_attention(
+                ctx, q, q, q, True, 1.0, mask, False) is None
+        with jax.set_mesh(create_mesh(dp=2, sp=4)):
+            with pytest.warns(RuntimeWarning, match="DEGRADED"):
+                out = tlm._cp_core_attention(
+                    ctx, q, q, q, True, 1.0, mask, False)
+            assert out is None  # caller takes the dense path
+            with warnings.catch_warnings():  # once per process, not per call
+                warnings.simplefilter("error")
+                assert tlm._cp_core_attention(
+                    ctx, q, q, q, True, 1.0, mask, False) is None
+
+    def test_degraded_fallback_strict_raises(self, monkeypatch):
+        import apex_tpu.models.transformer_lm as tlm
+
+        monkeypatch.setenv("APEX_TPU_CP_STRICT", "1")
+        monkeypatch.setattr(tlm, "_cp_fallback_warned", False)
+        ctx = tlm.gspmd_ctx(seq_axis="sp", context_parallel=True)
+        q = jnp.zeros((2, 8, 4, 8), jnp.float32)
+        with jax.set_mesh(create_mesh(dp=2, sp=4)):
+            with pytest.raises(ValueError, match="DEGRADED"):
+                # attention dropout active → the kernels don't cover it
+                tlm._cp_core_attention(ctx, q, q, q, True, 1.0, None, True)
+
+    def test_clean_cp_path_does_not_warn(self, monkeypatch):
+        """The supported pattern (causal, no mask, no attention dropout)
+        must stay warning-free — the fallback alarm may not cry wolf."""
+        import warnings
+
+        import apex_tpu.models.transformer_lm as tlm
+
+        monkeypatch.delenv("APEX_TPU_CP_STRICT", raising=False)
+        monkeypatch.setattr(tlm, "_cp_fallback_warned", False)
+        ctx = tlm.gspmd_ctx(seq_axis="sp", context_parallel=True)
+        mesh = create_mesh(dp=2, sp=4)
+        rng = np.random.RandomState(3)
+        q = jnp.asarray(rng.randn(2, 16, 4, 8), jnp.float32)
+        with jax.set_mesh(mesh):
+            with warnings.catch_warnings():
+                warnings.simplefilter("error", RuntimeWarning)
+                out = jax.jit(lambda q: tlm._cp_core_attention(
+                    ctx, q, q, q, True, 1.0, None, False))(q)
+        assert out is not None and out.shape == q.shape
+
     def test_rejects_unsupported_configs(self):
         from apex_tpu.models.config import TransformerConfig
         from apex_tpu.models.gpt import make_gpt_train_step
