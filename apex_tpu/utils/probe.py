@@ -6,25 +6,98 @@ backend is reachable (bench.py's skip path, the dryrun gate's
 virtual-CPU fallback) probes in a subprocess with a kill timeout
 instead of initializing its own backend.  One helper serves both so the
 timeout/parse/error-surfacing recipe cannot drift between callers.
+
+Outage economics (VERDICT r4 #7): every gate used to pay its own full
+timeout on a dead tunnel (120s dryrun + 150s bench per driver run).
+Two levers fix that: the default timeout drops to 45s (a healthy TPU
+init answers in a few seconds; only a hang rides the timeout out), and
+results are cached in a temp file for a short TTL so the second gate of
+the same driver invocation reuses the first one's verdict instead of
+re-hanging.  ``APEX_TPU_PROBE_CACHE_TTL=0`` disables the cache (the
+unit tests do); the TTL stays under the probe cron's period so a
+returning tunnel is never masked for long.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import subprocess
 import sys
+import tempfile
+import time
 from typing import Optional
 
-__all__ = ["probe_jax"]
+__all__ = ["probe_jax", "probe_backend_info"]
+
+# uid-suffixed: /tmp is world-shared, and a fixed name would (a) break
+# the cache for the second user on a host (0600 file, silent open
+# failures) and (b) let any user pre-seed verdicts other users trust
+_CACHE_PATH = os.path.join(
+    tempfile.gettempdir(),
+    f"apex_tpu_probe_cache_{os.getuid() if hasattr(os, 'getuid') else 0}"
+    ".json")
+_MISS = object()
 
 
-def probe_jax(expr: str, timeout_s: int = 120,
+def _cache_ttl() -> float:
+    try:
+        return float(os.environ.get("APEX_TPU_PROBE_CACHE_TTL", "270"))
+    except ValueError:
+        return 0.0
+
+
+def _cache_get(expr: str):
+    ttl = _cache_ttl()
+    if ttl <= 0:
+        return _MISS
+    try:
+        with open(_CACHE_PATH) as f:
+            entry = json.load(f).get(expr)
+        if (isinstance(entry, dict)
+                and isinstance(entry.get("t"), (int, float))
+                and isinstance(entry.get("val"), (str, type(None)))
+                and time.time() - entry["t"] <= ttl):
+            return entry["val"]   # may be None: a cached outage verdict
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    return _MISS
+
+
+def _cache_put(expr: str, val: Optional[str]) -> None:
+    if _cache_ttl() <= 0:
+        return
+    try:
+        try:
+            with open(_CACHE_PATH) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            data = {}
+        data[expr] = {"t": time.time(), "val": val}
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(_CACHE_PATH))
+        with os.fdopen(fd, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, _CACHE_PATH)
+    except OSError:
+        pass   # cache is best-effort; the probe result is already known
+
+
+def probe_jax(expr: str, timeout_s: int = 45,
               label: str = "jax backend probe") -> Optional[str]:
     """Evaluate ``expr`` (a Python expression over an imported ``jax``)
     in a subprocess; return its str() result, or None on failure.
 
     Failures (timeout, crash) print the child's tail of stderr with the
     ``label`` so a healthy-host misconfiguration does not silently read
-    as an outage."""
+    as an outage.  Results (including failures) are shared across
+    processes for a short TTL via a temp-file cache — see the module
+    docstring."""
+    cached = _cache_get(expr)
+    if cached is not _MISS:
+        print(f"[{label}] using cached probe result "
+              f"(APEX_TPU_PROBE_CACHE_TTL={_cache_ttl():g}s): "
+              f"{cached!r}", flush=True)
+        return cached
     code = f"import jax; print('PROBE=' + str({expr}))"
     try:
         out = subprocess.run(
@@ -33,10 +106,35 @@ def probe_jax(expr: str, timeout_s: int = 120,
     except subprocess.TimeoutExpired:
         print(f"[{label}] timed out after {timeout_s}s "
               "(backend unreachable)", flush=True)
+        _cache_put(expr, None)
         return None
     for line in out.stdout.splitlines():
         if line.startswith("PROBE="):
-            return line.split("=", 1)[1]
+            val = line.split("=", 1)[1]
+            _cache_put(expr, val)
+            return val
     tail = (out.stderr or out.stdout).strip()[-400:]
     print(f"[{label}] failed rc={out.returncode}: {tail}", flush=True)
+    _cache_put(expr, None)
     return None
+
+
+def probe_backend_info(timeout_s: int = 45, label: str = "backend probe"):
+    """(platform, device_count) via ONE probed expression, or None.
+
+    Both gates (bench.py backend check, dryrun device count) call this
+    so a single cached verdict serves the whole driver invocation — two
+    distinct expressions would each pay the outage timeout."""
+    got = probe_jax("jax.devices()[0].platform + ':' + str(len("
+                    "jax.devices()))", timeout_s, label=label)
+    if got is None:
+        return None
+    try:
+        platform, _, count = got.partition(":")
+        return platform, int(count)
+    except ValueError:
+        # malformed (e.g. corrupted cache entry): the gates built to
+        # degrade through outages must not crash on it
+        print(f"[{label}] unparseable probe result {got!r}; "
+              "treating as unreachable", flush=True)
+        return None

@@ -446,7 +446,7 @@ def bench_mlp_adam(on_tpu):
     }
 
 
-def _probe_backend(timeout_s: int = 150):
+def _probe_backend(timeout_s: int = 45):
     """Initialize the JAX backend with a hard timeout.
 
     A tunnel outage must not read as a broken repo (VERDICT r3 #2): if the
@@ -457,7 +457,7 @@ def _probe_backend(timeout_s: int = 150):
     """
     import os
 
-    from apex_tpu.utils.probe import probe_jax
+    from apex_tpu.utils.probe import probe_backend_info
 
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         # explicit CPU request (smoke runs): the axon sitecustomize
@@ -465,8 +465,8 @@ def _probe_backend(timeout_s: int = 150):
         # the subprocess probe — nothing can hang on CPU
         jax.config.update("jax_platforms", "cpu")
         return "cpu"
-    platform = probe_jax("jax.devices()[0].platform", timeout_s,
-                         label="bench backend probe")
+    info = probe_backend_info(timeout_s, label="bench backend probe")
+    platform = None if info is None else info[0]
     if platform is None:
         print(json.dumps({
             "metric": _HEADLINE,

@@ -122,17 +122,104 @@ class TestProbeJax:
     """The killable subprocess probe both gates depend on
     (utils/probe.py — a dead tunnel hangs jax.devices() in C++)."""
 
-    def test_probe_returns_value(self):
+    def test_probe_returns_value(self, monkeypatch):
         from apex_tpu.utils.probe import probe_jax
 
+        monkeypatch.setenv("APEX_TPU_PROBE_CACHE_TTL", "0")
         # conftest pins the child env to CPU: a real jax evaluates
         assert probe_jax("1 + 1", timeout_s=120) == "2"
 
-    def test_probe_failure_returns_none_and_reports(self, capsys):
+    def test_probe_failure_returns_none_and_reports(self, monkeypatch,
+                                                    capsys):
         from apex_tpu.utils.probe import probe_jax
 
+        monkeypatch.setenv("APEX_TPU_PROBE_CACHE_TTL", "0")
         got = probe_jax("jax.nonexistent_attr_xyz", timeout_s=120,
                         label="unit probe")
         assert got is None
         err = capsys.readouterr().out
         assert "unit probe" in err and "failed" in err
+
+    def test_probe_backend_info_shared_expression(self, monkeypatch,
+                                                  tmp_path):
+        """bench and the dryrun gate probe the SAME expression, so one
+        cached outage verdict covers both gates of a driver run."""
+        import apex_tpu.utils.probe as probe
+
+        # the probe child must not load the axon sitecustomize (it
+        # overrides JAX_PLATFORMS and would hang on a dead tunnel —
+        # in production that hang IS the signal; in this unit test we
+        # want the CPU answer)
+        monkeypatch.delenv("PYTHONPATH", raising=False)
+        monkeypatch.setattr(probe, "_CACHE_PATH",
+                            str(tmp_path / "cache.json"))
+        monkeypatch.setenv("APEX_TPU_PROBE_CACHE_TTL", "300")
+        got = probe.probe_backend_info(timeout_s=120)
+        assert got is not None
+        platform, count = got
+        assert platform == "cpu" and count >= 1   # conftest pins CPU
+        # the second gate's call must be served from the cache
+        import subprocess as sp
+
+        def boom(*a, **kw):
+            raise AssertionError("second gate must not re-probe")
+
+        monkeypatch.setattr(sp, "run", boom)
+        assert probe.probe_backend_info(timeout_s=120) == (platform, count)
+
+    def test_probe_backend_info_malformed_cache_degrades(self, monkeypatch,
+                                                         tmp_path, capsys):
+        """A corrupted/foreign cache entry must read as unreachable, not
+        crash the outage-degradation gates."""
+        import json as _json
+        import time as _time
+
+        import apex_tpu.utils.probe as probe
+
+        path = tmp_path / "cache.json"
+        expr = ("jax.devices()[0].platform + ':' + str(len("
+                "jax.devices()))")
+        path.write_text(_json.dumps(
+            {expr: {"t": _time.time(), "val": "cpu:not_a_number"}}))
+        monkeypatch.setattr(probe, "_CACHE_PATH", str(path))
+        monkeypatch.setenv("APEX_TPU_PROBE_CACHE_TTL", "300")
+        assert probe.probe_backend_info(timeout_s=120) is None
+        assert "unparseable" in capsys.readouterr().out
+        # wrong-type entries are ignored entirely (cache miss, no crash)
+        path.write_text(_json.dumps({expr: {"t": "yesterday", "val": 7}}))
+        assert probe._cache_get(expr) is probe._MISS
+
+    def test_probe_cache_shares_verdicts(self, monkeypatch, tmp_path,
+                                         capsys):
+        """An outage verdict (None) is reused within the TTL so the
+        second gate of a driver invocation does not re-pay the hang
+        timeout (VERDICT r4 #7); TTL=0 opts out."""
+        import subprocess as sp
+
+        import apex_tpu.utils.probe as probe
+
+        monkeypatch.setattr(probe, "_CACHE_PATH",
+                            str(tmp_path / "cache.json"))
+        monkeypatch.setenv("APEX_TPU_PROBE_CACHE_TTL", "300")
+        runs = []
+        real_run = sp.run
+
+        def counting_run(*a, **kw):
+            runs.append(1)
+            return real_run(*a, **kw)
+
+        monkeypatch.setattr(sp, "run", counting_run)
+        assert probe.probe_jax("40 + 2", timeout_s=120) == "42"
+        assert probe.probe_jax("40 + 2", timeout_s=120) == "42"
+        assert len(runs) == 1   # second call served from the cache
+        assert "cached" in capsys.readouterr().out
+        # failures cache too — the expensive case on a dead tunnel
+        assert probe.probe_jax("jax.nope_xyz", timeout_s=120,
+                               label="p1") is None
+        assert probe.probe_jax("jax.nope_xyz", timeout_s=120,
+                               label="p2") is None
+        assert len(runs) == 2
+        # expired entries re-probe
+        monkeypatch.setenv("APEX_TPU_PROBE_CACHE_TTL", "0")
+        assert probe.probe_jax("40 + 2", timeout_s=120) == "42"
+        assert len(runs) == 3
