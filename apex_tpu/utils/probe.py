@@ -53,7 +53,8 @@ def _cache_get(expr: str):
         return _MISS
     try:
         with open(_CACHE_PATH) as f:
-            entry = json.load(f).get(expr)
+            data = json.load(f)
+        entry = data.get(expr) if isinstance(data, dict) else None
         if (isinstance(entry, dict)
                 and isinstance(entry.get("t"), (int, float))
                 and isinstance(entry.get("val"), (str, type(None)))
@@ -72,6 +73,8 @@ def _cache_put(expr: str, val: Optional[str]) -> None:
             with open(_CACHE_PATH) as f:
                 data = json.load(f)
         except (OSError, ValueError):
+            data = {}
+        if not isinstance(data, dict):
             data = {}
         data[expr] = {"t": time.time(), "val": val}
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(_CACHE_PATH))

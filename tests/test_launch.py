@@ -188,6 +188,12 @@ class TestProbeJax:
         # wrong-type entries are ignored entirely (cache miss, no crash)
         path.write_text(_json.dumps({expr: {"t": "yesterday", "val": 7}}))
         assert probe._cache_get(expr) is probe._MISS
+        # a non-dict top-level document is a miss on read and replaced
+        # on write, not a crash in either gate
+        path.write_text(_json.dumps(["garbage"]))
+        assert probe._cache_get(expr) is probe._MISS
+        probe._cache_put(expr, "cpu:1")
+        assert probe._cache_get(expr) == "cpu:1"
 
     def test_probe_cache_shares_verdicts(self, monkeypatch, tmp_path,
                                          capsys):
