@@ -1,0 +1,173 @@
+"""One-command on-chip measurement campaign (VERDICT r4 #1).
+
+First chip contact is an interrupt: this script runs the whole queued
+campaign in dependency order, logs each stage, and finishes by printing
+the decision checklist BASELINE.md commits to — which defaults flip to
+the measured winner and which still-losing kernels get deleted.
+
+    PYTHONPATH=.:/root/.axon_site python tools/measure_all.py
+
+Stages (each its own subprocess so one failure cannot strand the rest;
+logs land in measure_logs/):
+
+1. ``tools/sweep_r4.py --json SWEEP_r4.json`` — the four round-3 losing
+   kernels (fused flash bwd x bq, flat Adam block rows, LN bwd variants,
+   softmax grad-path confirmation).
+2. ``bench_kernels.py --json KERNEL_BENCH.json`` — refresh the full
+   per-kernel ledger at the round-3 methodology.
+3. ``bench.py`` — the BASELINE.md workload matrix (GPT/RN50/BERT/RNN-T/
+   MoE/decode/long-context/cp-compare rows), one JSON line.
+4. ``APEX_TPU_TEST_ON_TPU=1 pytest tests/test_on_tpu_kernels.py -m tpu``
+   — the 14 Mosaic-compile hardware tests (interpret-green != Mosaic-
+   green).
+5. ``tools/step_breakdown.py --model resnet50`` — the ablation/roofline
+   profile that must precede the RN50 MFU attack (VERDICT r4 #3).
+
+Decision rules printed at the end (from BASELINE.md round-4 note):
+- flash bwd: if any fused variant beats split at s512, set
+  ``APEX_TPU_FLASH_BWD_FUSED_MAX`` to the measured crossover; else
+  delete the fused kernel + knob.
+- flat Adam: if no block-rows setting beats XLA, delete the kernel and
+  switch distributed_fused_adam to the XLA flat update.
+- LN bwd: if both pallas variants still lose, delete the bwd kernel +
+  ``APEX_TPU_LN_BWD``.
+- softmax: confirm grad-path ratio ~1.0 (fusion-barrier fix held).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOGS = os.path.join(ROOT, "measure_logs")
+
+
+def _run(name, cmd, env_extra=None, timeout=7200):
+    os.makedirs(LOGS, exist_ok=True)
+    log = os.path.join(LOGS, f"{name}.log")
+    env = dict(os.environ)
+    env.setdefault("PYTHONPATH", ".:/root/.axon_site")
+    if env_extra:
+        env.update(env_extra)
+    t0 = time.time()
+    print(f"[measure_all] {name}: {' '.join(cmd)} (log: {log})",
+          flush=True)
+    try:
+        with open(log, "w") as f:
+            rc = subprocess.run(cmd, cwd=ROOT, env=env, stdout=f,
+                                stderr=subprocess.STDOUT,
+                                timeout=timeout).returncode
+    except subprocess.TimeoutExpired:
+        # one hung stage (the axon failure mode) must not strand the
+        # rest of the campaign or the decision checklist
+        print(f"[measure_all] {name}: TIMED OUT after {timeout}s",
+              flush=True)
+        return 124
+    dt = time.time() - t0
+    status = "ok" if rc == 0 else f"FAILED rc={rc}"
+    print(f"[measure_all] {name}: {status} in {dt:.0f}s", flush=True)
+    return rc
+
+
+def _flash_decision(sweep):
+    rows = {k: v for k, v in sweep.items() if k.startswith("flash_fwdbwd")}
+    out = []
+    for tag in ("b8xs512_causal", "b8xs512"):
+        split = rows.get(f"flash_fwdbwd_{tag}_split", {})
+        fused = {k: v for k, v in rows.items()
+                 if k.startswith(f"flash_fwdbwd_{tag}_fused")}
+        if not split or not fused:
+            continue
+        best_k, best = min(fused.items(),
+                           key=lambda kv: kv[1]["pallas_over_xla"])
+        verdict = ("FLIP: set APEX_TPU_FLASH_BWD_FUSED_MAX=512 "
+                   f"(winner {best_k})"
+                   if best["pallas_over_xla"] < split["pallas_over_xla"]
+                   else "DELETE the fused kernel + knob (split wins)")
+        out.append(f"  flash {tag}: split={split['pallas_over_xla']:.2f} "
+                   f"best-fused={best['pallas_over_xla']:.2f} -> {verdict}")
+    return out
+
+
+def _simple_decision(sweep, prefix, keep_msg, delete_msg,
+                     value_strip=None):
+    rows = {k: v["pallas_over_xla"] for k, v in sweep.items()
+            if k.startswith(prefix)}
+    if not rows:
+        # an empty sweep is NOT a pass: sweep_r4 continues past
+        # per-variant failures, so silence here would read as covered
+        return [f"  {prefix}: NO measurements in SWEEP_r4.json — every "
+                "variant failed; check measure_logs/sweep_r4.log (per "
+                "BASELINE rules an unmeasurable kernel is a delete)"]
+    best_k = min(rows, key=rows.get)
+    wins = rows[best_k] < 1.0
+    # value_strip maps the sweep key to the literal knob value the
+    # checklist should name (flat_adam_88m_rows2048 -> 2048,
+    # ln_fwdbwd_pallas_split -> pallas_split)
+    best_val = (best_k[len(value_strip):] if value_strip
+                and best_k.startswith(value_strip) else best_k)
+    return [f"  {prefix}: best {best_k}={rows[best_k]:.2f} -> "
+            + (keep_msg.format(best=best_val) if wins else delete_msg)]
+
+
+def main():
+    from apex_tpu.utils.probe import probe_backend_info
+
+    info = probe_backend_info(60, label="measure_all probe")
+    if info is None or info[0] != "tpu":
+        print(f"[measure_all] no TPU backend (probe: {info}); campaign "
+              "needs the chip — aborting without touching artifacts")
+        return 1
+    print(f"[measure_all] TPU up: {info[1]} device(s). Campaign start.")
+    results = {}
+    results["sweep_r4"] = _run(
+        "sweep_r4", [sys.executable, "tools/sweep_r4.py", "--json",
+                     "SWEEP_r4.json"])
+    results["bench_kernels"] = _run(
+        "bench_kernels", [sys.executable, "bench_kernels.py", "--json",
+                          "KERNEL_BENCH.json"])
+    results["bench"] = _run("bench", [sys.executable, "bench.py"])
+    results["tpu_tier"] = _run(
+        "tpu_tier", [sys.executable, "-m", "pytest",
+                     "tests/test_on_tpu_kernels.py", "-m", "tpu", "-q"],
+        env_extra={"APEX_TPU_TEST_ON_TPU": "1"})
+    results["rn50_breakdown"] = _run(
+        "rn50_breakdown", [sys.executable, "tools/step_breakdown.py",
+                           "--model", "resnet50"])
+
+    print("\n[measure_all] stage results:", json.dumps(results))
+    sweep_path = os.path.join(ROOT, "SWEEP_r4.json")
+    if os.path.exists(sweep_path) and results.get("sweep_r4") == 0:
+        with open(sweep_path) as f:
+            sweep = json.load(f)
+        print("[measure_all] DECISION CHECKLIST (BASELINE.md rules):")
+        for line in _flash_decision(sweep):
+            print(line)
+        for line in _simple_decision(
+                sweep, "flat_adam_88m",
+                "flip APEX_TPU_ADAM_BLOCK_ROWS default to {best}",
+                "DELETE adam_kernel_flat + APEX_TPU_ADAM_BLOCK_ROWS "
+                "(XLA wins); switch distributed_fused_adam to XLA flat",
+                value_strip="flat_adam_88m_rows"):
+            print(line)
+        for line in _simple_decision(
+                sweep, "ln_fwdbwd_pallas",
+                "flip APEX_TPU_LN_BWD default to {best}",
+                "DELETE the LN bwd kernels + APEX_TPU_LN_BWD (XLA wins)",
+                value_strip="ln_fwdbwd_"):
+            print(line)
+        sm = sweep.get("softmax_causal_fwdbwd_512")
+        if sm:
+            print(f"  softmax grad-path: {sm['pallas_over_xla']:.2f} "
+                  "(expect ~1.0 after the fusion-barrier fix)")
+        print("[measure_all] then: update BASELINE.md ledger, flip "
+              "defaults, delete losers, re-run bench.py for BENCH_r05.")
+    return 1 if any(rc != 0 for rc in results.values()) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
