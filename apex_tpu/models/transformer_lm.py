@@ -398,8 +398,18 @@ def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
     scale = 1.0 / hd ** 0.5
     use_dropout = cfg.attention_dropout > 0 and dropout_rng is not None
     causal = cfg.attn_mask_type == "causal"
+
+    def full_kv():
+        # broadcast grouped (GQA) k/v up to the query heads for paths
+        # that need equal head counts (XLA dense scores, the cp kernels)
+        if k.shape[2] != q.shape[2]:
+            rep = q.shape[2] // k.shape[2]
+            return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+        return k, v
+
     if ctx is not None and ctx.cp_axis is not None:
-        cp = _cp_core_attention(ctx, q, k, v, causal, scale,
+        kf, vf = full_kv()
+        cp = _cp_core_attention(ctx, q, kf, vf, causal, scale,
                                 attention_mask, use_dropout)
         if cp is not None:
             return cp
@@ -415,6 +425,7 @@ def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
             q, k, v, causal=causal, key_padding_mask=kpm, scale=scale,
             dropout_p=cfg.attention_dropout if use_dropout else 0.0,
             dropout_rng=dropout_rng if use_dropout else None)
+    k, v = full_kv()
     if kpm is not None:
         attention_mask = kpm[:, None, None, :]   # broadcastable 4-D
     # [b, s, n, d] x [b, t, n, d] -> [b, n, s, t]
@@ -571,15 +582,12 @@ def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
         cos, sin = rope
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
-    if cfg.is_gqa:
-        # broadcast the group heads up to the query heads for the core
-        # kernels (standard GQA trick; the decode path keeps the cache
-        # at group width — that persistent memory is the GQA win).
-        # rep is the GLOBAL queries-per-group ratio: under manual TP
-        # both nh and the local group count are already divided by tp.
-        rep = cfg.num_attention_heads // cfg.kv_groups
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # Under GQA, k/v stay at group width here: the flash kernel consumes
+    # them directly (its index maps broadcast each group head to its rep
+    # query heads — the repeated tensor never exists in HBM); the paths
+    # that need full-width heads (XLA dense, context parallel) broadcast
+    # inside _core_attention.  The decode path keeps the cache at group
+    # width too — that persistent memory is the GQA win.
     if dropout_rng is not None and ctx.tp > 1:
         # attention probs are head-sharded over tp: each tp rank needs its
         # own dropout stream (the reference's model-parallel RNG,

@@ -132,7 +132,15 @@ def mha_reference(q, k, v, *, causal=False, key_padding_mask=None,
                   mask=None, bias=None, scale=None, dropout_p=0.0,
                   dropout_rng=None, segment_ids=None):
     """Materialized softmax(QK^T)V in fp32 — numerics oracle for the kernel
-    and the execution path for variants the kernel doesn't fuse."""
+    and the execution path for variants the kernel doesn't fuse.
+
+    Accepts grouped K/V (fewer heads than Q, GQA/MQA): the group heads
+    are broadcast up to the query heads, the semantics the fused kernel
+    implements via its index maps without materializing the repeat."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     b, sq, n, d = q.shape
     sk = k.shape[1]
     scale = (1.0 / d ** 0.5) if scale is None else scale
@@ -275,8 +283,16 @@ def _fwd_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
             jnp.where(l == 0.0, _NEG_INF, lse), lse_ref.shape[1:])
 
 
+def _kv_of(bq_flat, n, g):
+    """Flat kv-head row for flat q-head row ``bq_flat`` under GQA: the
+    [b, s, heads, d] → [b*heads, s, d] flattening is batch-major, so
+    batch = bq // n and the q head's group is (bq % n) // (n // g)."""
+    return (bq_flat // n) * g + (bq_flat % n) // (n // g)
+
+
 def _fwd_pallas(q3, k3, v3, kpm, seg, seed, scale, causal, sk_real,
-                block_q, block_k, dropout_p, interpret, out_dtype=None):
+                block_q, block_k, dropout_p, interpret, out_dtype=None,
+                gqa=None):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, sqp, d = q3.shape
@@ -285,8 +301,17 @@ def _fwd_pallas(q3, k3, v3, kpm, seg, seed, scale, causal, sk_real,
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                           memory_space=pltpu.VMEM)
-    k_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
-                          memory_space=pltpu.VMEM)
+    if gqa is not None:
+        # grouped K/V (GQA): the index map broadcasts each group head to
+        # its rep query heads — the repeated tensor never exists in HBM
+        n, g = gqa
+        k_spec = pl.BlockSpec(
+            (1, block_k, d),
+            lambda b, i, j, n=n, g=g: (_kv_of(b, n, g), j, 0),
+            memory_space=pltpu.VMEM)
+    else:
+        k_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                              memory_space=pltpu.VMEM)
     in_specs = []
     args = []
     if dropout_p > 0.0:
@@ -425,7 +450,7 @@ def _bwd_dq_kernel(scale, causal, sk_real, block_q, block_k, has_kpm,
 
 
 def _bwd_dkv_kernel(scale, causal, sq_real, sk_real, block_q, block_k,
-                    has_kpm, has_seg, dropout_p, *refs):
+                    has_kpm, has_seg, dropout_p, gqa, *refs):
     if dropout_p > 0.0:
         seed_ref, refs = refs[0], refs[1:]
     if has_seg:
@@ -436,9 +461,23 @@ def _bwd_dkv_kernel(scale, causal, sq_real, sk_real, block_q, block_k,
     else:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
-    bh, kj, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    if gqa is not None:
+        # grid (b*g, kv, rep, q): one dk/dv row accumulates all rep query
+        # heads of its group; bh reconstructs the flat q-head row so the
+        # dropout hash matches the forward bit-for-bit
+        n, g = gqa
+        rep = n // g
+        bkv, kj = pl.program_id(0), pl.program_id(1)
+        r, qi = pl.program_id(2), pl.program_id(3)
+        bh = (bkv // g) * n + (bkv % g) * rep + r
+        first = (r == 0) & (qi == 0)
+        last = (r == rep - 1) & (qi == pl.num_programs(3) - 1)
+    else:
+        bh, kj, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+        first = qi == 0
+        last = qi == pl.num_programs(2) - 1
 
-    @pl.when(qi == 0)
+    @pl.when(first)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -503,7 +542,7 @@ def _bwd_dkv_kernel(scale, causal, sq_real, sk_real, block_q, block_k,
     else:
         pl.when(run)(_compute)
 
-    @pl.when(qi == pl.num_programs(2) - 1)
+    @pl.when(last)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -647,7 +686,7 @@ def _bwd_pallas_fused(q3, k3, v3, do3, lse, delta, kpm, seg, seed, scale,
 
 def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, seg, seed, scale,
                 causal, sq_real, sk_real, block_q, block_k, dropout_p,
-                interpret, out_dtype=None):
+                interpret, out_dtype=None, gqa=None):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, sqp, d = q3.shape
@@ -665,9 +704,15 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, seg, seed, scale,
         return pl.BlockSpec((1, block_q, _LANES), f,
                             memory_space=pltpu.VMEM)
 
+    if gqa is not None:
+        n, g = gqa   # bound once for both the dq and dkv sections
+
     # --- dq: grid (bh, q, kv) ------------------------------------------
     qmap = lambda b, i, j: (b, i, 0)
-    kmap = lambda b, i, j: (b, j, 0)
+    if gqa is not None:
+        kmap = lambda b, i, j, n=n, g=g: (_kv_of(b, n, g), j, 0)
+    else:
+        kmap = lambda b, i, j: (b, j, 0)
     in_specs = []
     args = []
     if dropout_p > 0.0:
@@ -704,42 +749,61 @@ def _bwd_pallas(q3, k3, v3, do3, lse, delta, kpm, seg, seed, scale,
         interpret=interpret,
     )(*args)
 
-    # --- dk/dv: grid (bh, kv, q) ---------------------------------------
-    qmap2 = lambda b, j, i: (b, i, 0)
-    kmap2 = lambda b, j, i: (b, j, 0)
+    # --- dk/dv ---------------------------------------------------------
+    # Classic: grid (bh, kv, q), one q-head per dk/dv row.  GQA: grid
+    # (b*g, kv, rep, q) — the rep query heads of a group are a grid dim
+    # OUTSIDE the q-block dim, so the (b*g)-row dk/dv output block stays
+    # fixed across (rep × q-blocks) consecutive steps while the kernel
+    # accumulates all of the group's query heads into it; the repeated
+    # dk/dv tensor (and the jnp.repeat forward tensor whose autodiff
+    # would sum it) never exists in HBM.
+    if gqa is not None:
+        rep = n // g
+        qmap2 = lambda b, j, r, i, n=n, g=g, rp=rep: (
+            (b // g) * n + (b % g) * rp + r, i, 0)
+        kmap2 = lambda b, j, r, i: (b, j, 0)
+        grid2 = (k3.shape[0], skp // block_k, rep, sqp // block_q)
+        seg_qmap = lambda b, j, r, i, g=g: (b // g, i)
+        seg_kmap = lambda b, j, r, i, g=g: (b // g, j)
+        kpm_map = lambda b, j, r, i, g=g: (b // g, 0, j)
+    else:
+        qmap2 = lambda b, j, i: (b, i, 0)
+        kmap2 = lambda b, j, i: (b, j, 0)
+        grid2 = (bh, skp // block_k, sqp // block_q)
+        heads_s = bh // seg[0].shape[0] if seg is not None else 1
+        seg_qmap = lambda b, j, i, h=heads_s: (b // h, i)
+        seg_kmap = lambda b, j, i, h=heads_s: (b // h, j)
+        heads_m = bh // kpm.shape[0] if kpm is not None else 1
+        kpm_map = lambda b, j, i, h=heads_m: (b // h, 0, j)
     in_specs = []
     args = []
     if dropout_p > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
     if seg is not None:
-        heads = bh // seg[0].shape[0]
         in_specs.append(pl.BlockSpec(
-            (1, block_q), lambda b, j, i, h=heads: (b // h, i),
-            memory_space=pltpu.VMEM))
+            (1, block_q), seg_qmap, memory_space=pltpu.VMEM))
         args.append(seg[0])
         in_specs.append(pl.BlockSpec(
-            (1, block_k), lambda b, j, i, h=heads: (b // h, j),
-            memory_space=pltpu.VMEM))
+            (1, block_k), seg_kmap, memory_space=pltpu.VMEM))
         args.append(seg[1])
     in_specs += [qspec(qmap2), kspec(kmap2), kspec(kmap2), qspec(qmap2),
                  rowspec(qmap2), rowspec(qmap2)]
     args += [q3, k3, v3, do3, lse3, delta3]
     if kpm is not None:
-        heads = bh // kpm.shape[0]
         in_specs.append(pl.BlockSpec(
-            (1, 1, block_k), lambda b, j, i, h=heads: (b // h, 0, j),
-            memory_space=pltpu.VMEM))
+            (1, 1, block_k), kpm_map, memory_space=pltpu.VMEM))
         args.append(kpm)
+    nkv = k3.shape[0]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale, causal, sq_real,
                           sk_real, block_q, block_k, kpm is not None,
-                          seg is not None, dropout_p),
-        grid=(bh, skp // block_k, sqp // block_q),
+                          seg is not None, dropout_p, gqa),
+        grid=grid2,
         in_specs=in_specs,
         out_specs=[kspec(kmap2), kspec(kmap2)],
-        out_shape=[out_struct((bh, skp, d), out_dtype or k3.dtype, k3),
-                   out_struct((bh, skp, d), out_dtype or v3.dtype, k3)],
+        out_shape=[out_struct((nkv, skp, d), out_dtype or k3.dtype, k3),
+                   out_struct((nkv, skp, d), out_dtype or v3.dtype, k3)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
@@ -799,6 +863,8 @@ def _flash(q, k, v, kpm, seg, seed, causal, scale, dropout_p):
 def _flash_fwd(q, k, v, kpm, seg, seed, causal, scale, dropout_p):
     b, sq, n, d = q.shape
     sk = k.shape[1]
+    g = k.shape[2]
+    gqa = (n, g) if g != n else None
     block_q, block_k = _blocks(sq, sk)
     sqp = pl.cdiv(sq, block_q) * block_q
     skp = pl.cdiv(sk, block_k) * block_k
@@ -815,7 +881,7 @@ def _flash_fwd(q, k, v, kpm, seg, seed, causal, scale, dropout_p):
     seg3 = None if seg3 is None else (seg3q, seg3k)
     o3, lse = _fwd_pallas(q3, k3, v3, kpm3, seg3, seed, scale, causal,
                           sk, block_q, block_k, dropout_p,
-                          interpret=not on_tpu())
+                          interpret=not on_tpu(), gqa=gqa)
     o = _from_bh(o3, b, n)[:, :sq]
     return o, (q, k, v, kpm, seg, seed, o, lse)
 
@@ -824,6 +890,8 @@ def _flash_bwd(causal, scale, dropout_p, res, do):
     q, k, v, kpm, seg, seed, o, lse = res
     b, sq, n, d = q.shape
     sk = k.shape[1]
+    g = k.shape[2]
+    gqa = (n, g) if g != n else None
     block_q, block_k = _blocks(sq, sk)
     sqp = pl.cdiv(sq, block_q) * block_q
     skp = pl.cdiv(sk, block_k) * block_k
@@ -854,6 +922,11 @@ def _flash_bwd(causal, scale, dropout_p, res, do):
     # silicon, raise FUSED_MAX back to the measured crossover (512 was
     # the projected value for the short-key / BERT class).
     fused_max = int(os.environ.get("APEX_TPU_FLASH_BWD_FUSED_MAX", "0"))
+    if gqa is not None:
+        # the fused single-pass kernel accumulates dk/dv per q-head row;
+        # grouped K/V takes the split pair (whose dkv grid accumulates a
+        # whole group per row) until a grouped fused variant is measured
+        mode = "split"
     if mode == "fused" or (mode == "auto" and skp <= fused_max):
         # short-key class (BERT s512 etc.): K/V fit VMEM whole — one
         # pass computes p once and emits dq/dk/dv together, vs the
@@ -873,10 +946,10 @@ def _flash_bwd(causal, scale, dropout_p, res, do):
         dq3, dk3, dv3 = _bwd_pallas(
             q3, k3, v3, do3, lse3, delta, kpm3, seg3, seed, scale,
             causal, sq, sk, block_q, block_k, dropout_p,
-            interpret=not on_tpu())
+            interpret=not on_tpu(), gqa=gqa)
     dq = _from_bh(dq3, b, n)[:, :sq]
-    dk = _from_bh(dk3, b, n)[:, :sk]
-    dv = _from_bh(dv3, b, n)[:, :sk]
+    dk = _from_bh(dk3, b, g)[:, :sk]
+    dv = _from_bh(dv3, b, g)[:, :sk]
     # The kernel treats the (float) mask as a constant: the wrapper
     # stop-gradients it, so a zero cotangent is the user-visible truth.
     # Learned additive masks/biases belong on the differentiable XLA
@@ -929,6 +1002,18 @@ def flash_attention(
     """
     if q.ndim != 4:
         raise ValueError(f"expected [b, s, n, d], got {q.shape}")
+    if k.shape[2] != q.shape[2]:
+        # grouped K/V (GQA/MQA): each of the g kv heads serves
+        # n//g query heads via kernel index maps — the repeated
+        # [b, s, n, d] K/V never materializes in HBM
+        if v.shape[2] != k.shape[2]:
+            raise ValueError(
+                f"grouped K/V head counts differ: k has {k.shape[2]}, "
+                f"v has {v.shape[2]}")
+        if q.shape[2] % k.shape[2]:
+            raise ValueError(
+                f"query heads ({q.shape[2]}) must be a multiple of the "
+                f"K/V group count ({k.shape[2]})")
     seg_pair = isinstance(segment_ids, tuple)
     if segment_ids is not None and not seg_pair and (
             q.shape[1] != k.shape[1]):

@@ -18,7 +18,7 @@ logs land in measure_logs/):
 3. ``bench.py`` — the BASELINE.md workload matrix (GPT/RN50/BERT/RNN-T/
    MoE/decode/long-context/cp-compare rows), one JSON line.
 4. ``APEX_TPU_TEST_ON_TPU=1 pytest tests/test_on_tpu_kernels.py -m tpu``
-   — the 14 Mosaic-compile hardware tests (interpret-green != Mosaic-
+   — the 15 Mosaic-compile hardware tests (interpret-green != Mosaic-
    green).
 5. ``tools/step_breakdown.py --model resnet50`` — the ablation/roofline
    profile that must precede the RN50 MFU attack (VERDICT r4 #3).
