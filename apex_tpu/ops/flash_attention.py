@@ -1002,14 +1002,14 @@ def flash_attention(
     """
     if q.ndim != 4:
         raise ValueError(f"expected [b, s, n, d], got {q.shape}")
+    if v.shape[2] != k.shape[2]:
+        raise ValueError(
+            f"K/V head counts differ: k has {k.shape[2]}, "
+            f"v has {v.shape[2]}")
     if k.shape[2] != q.shape[2]:
         # grouped K/V (GQA/MQA): each of the g kv heads serves
         # n//g query heads via kernel index maps — the repeated
         # [b, s, n, d] K/V never materializes in HBM
-        if v.shape[2] != k.shape[2]:
-            raise ValueError(
-                f"grouped K/V head counts differ: k has {k.shape[2]}, "
-                f"v has {v.shape[2]}")
         if q.shape[2] % k.shape[2]:
             raise ValueError(
                 f"query heads ({q.shape[2]}) must be a multiple of the "

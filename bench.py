@@ -84,7 +84,15 @@ def _time_fn(fn, n_warmup=2, iters=10):
     return (time.perf_counter() - t0) / iters
 
 
-def bench_gpt(on_tpu, size="125m"):
+def bench_gpt(on_tpu, size="125m", query_groups=None, baseline=True):
+    """``query_groups`` runs the same geometry with grouped K/V through
+    the GQA-aware flash kernels (round 5): vs the MHA row this measures
+    the rep-x K/V HBM-traffic reduction plus the smaller qkv projection
+    (param counts differ, so compare per-row MFU, not tokens/s).
+    ``baseline=False`` skips the fp32+unfused reference half (chip-time
+    saver for variant rows)."""
+    if query_groups and not on_tpu:
+        return {"skipped": "tpu-only row"}
     if on_tpu:
         # measured sweep (round 2, v5e): unrolled layers beat the scanned
         # stack ~7% (XLA fuses across layer boundaries), b16 the best
@@ -101,7 +109,8 @@ def bench_gpt(on_tpu, size="125m"):
         else:
             batch, seq, iters = 16, 1024, 20
             cfg = gpt_125m(max_position_embeddings=seq, remat=False,
-                           scan_layers=False, fused_head_ce=True)
+                           scan_layers=False, fused_head_ce=True,
+                           num_query_groups=query_groups)
     else:
         if size == "350m":
             # no meaningful CPU smoke distinct from the 125m row
@@ -128,32 +137,38 @@ def bench_gpt(on_tpu, size="125m"):
     fused_s = _time_fn(one, iters=iters)
     del state
 
-    # baseline: fp32 everywhere, unfused per-tensor Adam (eager analog)
-    import optax
-    cfg_fp32 = dataclasses.replace(cfg, compute_dtype=jnp.float32)
-    init0, step0 = make_gpt_train_step(cfg_fp32, optax.adam(1e-4), "O0")
-    state0 = init0(jax.random.PRNGKey(0))
+    base_s = None
+    if baseline:
+        # baseline: fp32 everywhere, unfused per-tensor Adam (eager analog)
+        import optax
+        cfg_fp32 = dataclasses.replace(cfg, compute_dtype=jnp.float32)
+        init0, step0 = make_gpt_train_step(cfg_fp32, optax.adam(1e-4), "O0")
+        state0 = init0(jax.random.PRNGKey(0))
 
-    def one0(carry):
-        s = carry[0] if carry else state0
-        s, m = step0(s, tokens, labels)
-        return s, m["loss"]
+        def one0(carry):
+            s = carry[0] if carry else state0
+            s, m = step0(s, tokens, labels)
+            return s, m["loss"]
 
-    base_s = _time_fn(one0, iters=max(2, iters // 2))
-    del state0
+        base_s = _time_fn(one0, iters=max(2, iters // 2))
+        del state0
 
     tokens_per_s = batch * seq / fused_s
     # train FLOPs/token: 6N matmul + 12·L·d_model·s attention (fwd+bwd)
     flops_per_tok = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
     mfu = tokens_per_s * flops_per_tok / _chip_peak_flops()
-    return {
+    out = {
         "tokens_per_sec_per_chip": round(tokens_per_s, 1),
         "step_ms": round(fused_s * 1e3, 2),
-        "speedup_vs_fp32_unfused": round(base_s / fused_s, 3),
         "mfu": round(mfu, 4),
         "params": n_params,
         "batch": batch, "seq": seq,
     }
+    if base_s is not None:
+        out["speedup_vs_fp32_unfused"] = round(base_s / fused_s, 3)
+    if query_groups:
+        out["query_groups"] = query_groups
+    return out
 
 
 def bench_gpt_longctx(on_tpu):
@@ -546,6 +561,8 @@ def main():
     for name, fn in (
         ("gpt2_125m", bench_gpt),
         ("gpt2_350m", lambda t: bench_gpt(t, size="350m")),
+        ("gpt2_125m_gqa4",
+         lambda t: bench_gpt(t, query_groups=4, baseline=False)),
         ("gpt2_125m_s8192_longctx", bench_gpt_longctx),
         ("gpt2_125m_s8192_cp_ring_vs_ulysses", bench_longctx_cp_compare),
         ("resnet50", bench_resnet50),
