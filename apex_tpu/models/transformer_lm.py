@@ -400,16 +400,13 @@ def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
     causal = cfg.attn_mask_type == "causal"
 
     def full_kv():
-        # broadcast grouped (GQA) k/v up to the query heads for paths
-        # that need equal head counts (XLA dense scores, the cp kernels)
-        if k.shape[2] != q.shape[2]:
-            rep = q.shape[2] // k.shape[2]
-            return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
-        return k, v
+        return _broadcast_kv(q, k, v)
 
     if ctx is not None and ctx.cp_axis is not None:
-        kf, vf = full_kv()
-        cp = _cp_core_attention(ctx, q, kf, vf, causal, scale,
+        # k/v may still be grouped (GQA): _cp_core_attention keeps them
+        # at group width where the mode supports it (ring — rep-x
+        # smaller ppermute messages) and broadcasts otherwise
+        cp = _cp_core_attention(ctx, q, k, v, causal, scale,
                                 attention_mask, use_dropout)
         if cp is not None:
             return cp
@@ -457,6 +454,17 @@ def _core_attention(cfg: TransformerConfig, q, k, v, attention_mask,
         preferred_element_type=jnp.float32,
     ).astype(v.dtype)
     return ctxv
+
+
+def _broadcast_kv(q, k, v):
+    """Broadcast grouped (GQA) k/v up to the query head count — THE one
+    model-side definition of the repeat, for paths that need equal head
+    counts (XLA dense scores, Ulysses, tp-incompatible ring shards); the
+    flash/ring kernels broadcast via index maps instead."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+    return k, v
 
 
 _cp_fallback_warned = False
@@ -510,14 +518,24 @@ def _cp_core_attention(ctx, q, k, v, causal, scale, attention_mask,
         return None
     if ctx.cp_mode == "ulysses":
         from apex_tpu.parallel.ulysses import ulysses_attention as cp_fn
+        grouped_ok = False   # the all-to-all reshards the head axis
     else:
         from apex_tpu.parallel.ring_attention import ring_attention as cp_fn
+        grouped_ok = True    # groups ride the ring (rep-x smaller msgs)
 
     # keep batch (dp) and head (tp) shardings through the manual region;
     # axes absent from the mesh drop to replicated, like _constrain
     names = set(mesh.axis_names)
     spec = P(*(a if (a is None or a in names) else None
                for a in ctx.cp_qkv_spec))
+    if k.shape[2] != q.shape[2]:
+        # grouped K/V: legal only when the mode supports it AND the
+        # head-axis sharding still divides the group count
+        head_ax = ctx.cp_qkv_spec[2]
+        head_shards = (int(mesh.shape[head_ax])
+                       if head_ax in names else 1)
+        if not grouped_ok or k.shape[2] % head_shards:
+            k, v = _broadcast_kv(q, k, v)
     f = jax.shard_map(
         functools.partial(cp_fn, axis_name=axis, causal=causal,
                           scale=scale),

@@ -77,6 +77,32 @@ def _chunk_mask(s, causal, s_local):
     return pred
 
 
+def _expand_groups(x3, gqa):
+    """[b*g, s, d] → [b*n, s, d] matching the batch-major _to_bh layout
+    (row b·n+h reads group h // rep) — reference-path analog of the
+    kernels' grouped index maps."""
+    if gqa is None:
+        return x3
+    n, g = gqa
+    rep = n // g
+    bg, s, d = x3.shape
+    b = bg // g
+    return jnp.repeat(x3.reshape(b, g, s, d), rep, axis=1).reshape(
+        b * n, s, d)
+
+
+def _reduce_groups(x3, gqa):
+    """[b*n, s, d] gradient → [b*g, s, d] by summing each group's rep
+    query-head contributions (the transpose of _expand_groups)."""
+    if gqa is None:
+        return x3
+    n, g = gqa
+    rep = n // g
+    bn, s, d = x3.shape
+    b = bn // n
+    return x3.reshape(b, g, rep, s, d).sum(axis=2).reshape(b * g, s, d)
+
+
 def _chunk_fwd_ref(q3, k3, v3, scale, causal, s_local):
     """Closed-form (o, lse) for one chunk — XLA path used off-TPU, where
     the Pallas interpreter cannot run under shard_map vma typing."""
@@ -108,9 +134,11 @@ def _chunk_bwd_ref(q3, k3, v3, do3, lse, delta, scale, causal, s_local):
 
 
 def _chunk_fwd(q3, k3, v3, scale, causal_mode, s_local, block_q,
-               block_k):
+               block_k, gqa=None):
     """One (q-shard, kv-chunk) flash forward. causal_mode: 0 full,
-    1 diagonal (causal), 2 skip."""
+    1 diagonal (causal), 2 skip.  ``gqa=(n, g)`` keeps the chunk at
+    group width: the kernels broadcast via index maps, the reference
+    path via an explicit expand."""
     use_pallas = on_tpu()
 
     def run(causal):
@@ -120,9 +148,11 @@ def _chunk_fwd(q3, k3, v3, scale, causal_mode, s_local, block_q,
             # compound error with ring size
             o, lse = _fwd_pallas(q3, k3, v3, None, None, None, scale,
                                  causal, s_local, block_q, block_k, 0.0,
-                                 False, out_dtype=jnp.float32)
+                                 False, out_dtype=jnp.float32, gqa=gqa)
             return o, lse
-        return _chunk_fwd_ref(q3, k3, v3, scale, causal, s_local)
+        return _chunk_fwd_ref(q3, _expand_groups(k3, gqa),
+                              _expand_groups(v3, gqa), scale, causal,
+                              s_local)
 
     def skip(_):
         # match the full vma typing of the kernel branches
@@ -137,7 +167,7 @@ def _chunk_fwd(q3, k3, v3, scale, causal_mode, s_local, block_q,
 
 
 def _chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal_mode, s_local,
-               block_q, block_k):
+               block_q, block_k, gqa=None):
     use_pallas = on_tpu()
 
     def run(causal):
@@ -145,10 +175,12 @@ def _chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal_mode, s_local,
             dq, dk, dv = _bwd_pallas(
                 q3, k3, v3, do3, lse, delta, None, None, None, scale,
                 causal, s_local, s_local, block_q, block_k, 0.0, False,
-                out_dtype=jnp.float32)
+                out_dtype=jnp.float32, gqa=gqa)
             return dq, dk, dv
-        return _chunk_bwd_ref(q3, k3, v3, do3, lse, delta, scale, causal,
-                              s_local)
+        dq, dk, dv = _chunk_bwd_ref(
+            q3, _expand_groups(k3, gqa), _expand_groups(v3, gqa), do3,
+            lse, delta, scale, causal, s_local)
+        return dq, _reduce_groups(dk, gqa), _reduce_groups(dv, gqa)
 
     def skip(_):
         return match_vma(
@@ -204,6 +236,7 @@ def _ring(q, k, v, axis_name, causal, scale):
 
 def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
     b, s_local, n, d = q.shape
+    gqa = (n, k.shape[2]) if k.shape[2] != n else None
     ndev = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     block_q, block_k = _ring_blocks(s_local)
@@ -219,7 +252,7 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
         src = (my - t) % ndev                 # global chunk id held now
         mode = _mode(my, src, causal)
         o_c, lse_c = _chunk_fwd(q3, k_cur, v_cur, scale, mode, s_local,
-                                block_q, block_k)
+                                block_q, block_k, gqa=gqa)
         o_acc, lse_acc = _merge(o_acc, lse_acc, o_c, lse_c)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
@@ -243,6 +276,7 @@ def _ring_vjp_fwd(q, k, v, axis_name, causal, scale):
 def _ring_vjp_bwd(axis_name, causal, scale, res, do):
     q, k, v, o, lse = res
     b, s_local, n, d = q.shape
+    gqa = (n, k.shape[2]) if k.shape[2] != n else None
     ndev = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     block_q, block_k = _ring_blocks(s_local)
@@ -263,7 +297,7 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
         mode = _mode(my, src, causal)
         dq_c, dk_c, dv_c = _chunk_bwd(
             q3, k_cur, v_cur, do3, lse, delta, scale, mode, s_local,
-            block_q, block_k)
+            block_q, block_k, gqa=gqa)
         dq_acc = dq_acc + dq_c
         dk_cur = dk_cur + dk_c
         dv_cur = dv_cur + dv_c
@@ -281,8 +315,8 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
     # after ndev rotations the accumulators are home again
 
     dq = _from_bh(dq3.astype(q.dtype), b, n)[:, :s_local]
-    dk = _from_bh(dk3.astype(k.dtype), b, n)[:, :s_local]
-    dv = _from_bh(dv3.astype(v.dtype), b, n)[:, :s_local]
+    dk = _from_bh(dk3.astype(k.dtype), b, k.shape[2])[:, :s_local]
+    dv = _from_bh(dv3.astype(v.dtype), b, v.shape[2])[:, :s_local]
     return dq, dk, dv
 
 
@@ -303,11 +337,25 @@ def ring_attention(
     ``axis_name``; every device's shard length must be equal (global seq =
     s_local × axis size, q-shard i owning global positions
     [i·s_local, (i+1)·s_local)).
+
+    Grouped K/V (``[b, s_local, g, d]`` with g dividing the query head
+    count) ride the ring at group width: the rotating ppermute messages
+    — the dominant ICI traffic of ring attention — shrink by n/g, and
+    the chunk kernels broadcast groups via their GQA index maps.  dK/dV
+    come back at group width.
     """
     if q.ndim != 4:
         raise ValueError(f"expected [b, s_local, n, d], got {q.shape}")
-    if q.shape != k.shape or k.shape != v.shape:
-        raise ValueError("ring attention requires equal q/k/v shard shapes")
+    if k.shape != v.shape:
+        raise ValueError("ring attention requires equal k/v shard shapes")
+    if q.shape[:2] + q.shape[3:] != k.shape[:2] + k.shape[3:]:
+        raise ValueError(
+            f"q/k shard shapes differ beyond the head axis: {q.shape} "
+            f"vs {k.shape}")
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"query heads ({q.shape[2]}) must be a multiple of the K/V "
+            f"group count ({k.shape[2]})")
     d = q.shape[-1]
     scale = (1.0 / d ** 0.5) if scale is None else float(scale)
     return _ring(q, k, v, axis_name, causal, scale)
