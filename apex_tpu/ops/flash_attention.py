@@ -549,7 +549,7 @@ def _bwd_dkv_kernel(scale, causal, sq_real, sk_real, block_q, block_k,
 
 
 def _bwd_fused_kernel(scale, causal, sq_real, sk_real, block_q, skp,
-                      has_kpm, has_seg, dropout_p, *refs):
+                      has_kpm, has_seg, dropout_p, gqa, *refs):
     """Single-pass backward for short key sequences: K/V stay fully
     VMEM-resident, the probability tile is computed ONCE, and dq/dk/dv
     all fall out of the same pass — where the split dq + dkv kernels
@@ -567,8 +567,21 @@ def _bwd_fused_kernel(scale, causal, sq_real, sk_real, block_q, skp,
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dq_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
     bh, qi = pl.program_id(0), pl.program_id(1)
+    if gqa is None:
+        first = qi == 0
+        last = qi == pl.num_programs(1) - 1
+    else:
+        # grouped K/V: the grid still walks q-head rows (batch-major, so
+        # a group's rep heads are consecutive in bh) while the dk/dv
+        # output block is the group row — init on the group's first
+        # (head, q-block) step, flush on its last
+        n, g = gqa
+        rep = n // g
+        r = (bh % n) % rep
+        first = (r == 0) & (qi == 0)
+        last = (r == rep - 1) & (qi == pl.num_programs(1) - 1)
 
-    @pl.when(qi == 0)
+    @pl.when(first)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -621,7 +634,7 @@ def _bwd_fused_kernel(scale, causal, sq_real, sk_real, block_q, skp,
         ds, q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
-    @pl.when(qi == pl.num_programs(1) - 1)
+    @pl.when(last)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -629,9 +642,12 @@ def _bwd_fused_kernel(scale, causal, sq_real, sk_real, block_q, skp,
 
 def _bwd_pallas_fused(q3, k3, v3, do3, lse, delta, kpm, seg, seed, scale,
                       causal, sq_real, sk_real, block_q, dropout_p,
-                      interpret, out_dtype=None):
+                      interpret, out_dtype=None, gqa=None):
     """Driver for :func:`_bwd_fused_kernel` — grid (bh, q-blocks), K/V
-    full-width (call only when the padded key length fits VMEM)."""
+    full-width per group (call only when the padded key length fits
+    VMEM).  Under ``gqa`` the k/v (and dk/dv) rows are group-width; the
+    group's rep consecutive q-head rows accumulate into one output
+    block, which stays resident across their grid steps."""
     from jax.experimental.pallas import tpu as pltpu
 
     bh, sqp, d = q3.shape
@@ -639,7 +655,11 @@ def _bwd_pallas_fused(q3, k3, v3, do3, lse, delta, kpm, seg, seed, scale,
     lse3 = jnp.broadcast_to(lse[:, :, None], (bh, sqp, _LANES))
     delta3 = jnp.broadcast_to(delta[:, :, None], (bh, sqp, _LANES))
     qmap = lambda b, i: (b, i, 0)
-    kmap = lambda b, i: (b, 0, 0)
+    if gqa is not None:
+        n, g = gqa
+        kmap = lambda b, i, n=n, g=g: (_kv_of(b, n, g), 0, 0)
+    else:
+        kmap = lambda b, i: (b, 0, 0)
     qspec = pl.BlockSpec((1, block_q, d), qmap, memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((1, skp, d), kmap, memory_space=pltpu.VMEM)
     rowspec = pl.BlockSpec((1, block_q, _LANES), qmap,
@@ -667,16 +687,17 @@ def _bwd_pallas_fused(q3, k3, v3, do3, lse, delta, kpm, seg, seed, scale,
             (1, 1, skp), lambda b, i, h=heads: (b // h, 0, 0),
             memory_space=pltpu.VMEM))
         args.append(kpm)
+    nkv = k3.shape[0]
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, scale, causal, sq_real,
                           sk_real, block_q, skp, kpm is not None,
-                          seg is not None, dropout_p),
+                          seg is not None, dropout_p, gqa),
         grid=(bh, sqp // block_q),
         in_specs=in_specs,
         out_specs=[qspec, kspec, kspec],
         out_shape=[out_struct((bh, sqp, d), out_dtype or q3.dtype, q3),
-                   out_struct((bh, skp, d), out_dtype or k3.dtype, k3),
-                   out_struct((bh, skp, d), out_dtype or v3.dtype, k3)],
+                   out_struct((nkv, skp, d), out_dtype or k3.dtype, k3),
+                   out_struct((nkv, skp, d), out_dtype or v3.dtype, k3)],
         scratch_shapes=[pltpu.VMEM((skp, d), jnp.float32),
                         pltpu.VMEM((skp, d), jnp.float32)],
         interpret=interpret,
@@ -922,11 +943,6 @@ def _flash_bwd(causal, scale, dropout_p, res, do):
     # silicon, raise FUSED_MAX back to the measured crossover (512 was
     # the projected value for the short-key / BERT class).
     fused_max = int(os.environ.get("APEX_TPU_FLASH_BWD_FUSED_MAX", "0"))
-    if gqa is not None:
-        # the fused single-pass kernel accumulates dk/dv per q-head row;
-        # grouped K/V takes the split pair (whose dkv grid accumulates a
-        # whole group per row) until a grouped fused variant is measured
-        mode = "split"
     if mode == "fused" or (mode == "auto" and skp <= fused_max):
         # short-key class (BERT s512 etc.): K/V fit VMEM whole — one
         # pass computes p once and emits dq/dk/dv together, vs the
@@ -941,7 +957,7 @@ def _flash_bwd(causal, scale, dropout_p, res, do):
         dq3, dk3, dv3 = _bwd_pallas_fused(
             q3, k3, v3, do3, lse3, delta, kpm3, seg3, seed, scale,
             causal, sq, sk, fused_bq, dropout_p,
-            interpret=not on_tpu())
+            interpret=not on_tpu(), gqa=gqa)
     else:
         dq3, dk3, dv3 = _bwd_pallas(
             q3, k3, v3, do3, lse3, delta, kpm3, seg3, seed, scale,

@@ -509,15 +509,45 @@ class TestGroupedKV:
                 np.asarray(a), np.asarray(b_), atol=2e-4, rtol=2e-4,
                 err_msg=f"grouped+seg d{name}")
 
-    def test_fused_mode_routes_to_split(self, monkeypatch):
-        """The fused single-pass backward accumulates per q-head row:
-        grouped K/V must take the split pair even when fused is forced
-        (until a grouped fused variant is measured)."""
-        monkeypatch.setenv("APEX_TPU_FLASH_BWD", "fused")
+    def test_fused_backward_matches_split(self, monkeypatch):
+        """The fused single-pass backward supports grouping too: its
+        dk/dv output block stays resident across a group's consecutive
+        q-head grid rows.  Must agree with the split pair exactly."""
         q, k, v = self._grouped(seed=25)
-        g1 = jax.grad(lambda *a: jnp.sum(flash_attention(
-            *a, causal=True)), argnums=(0, 1, 2))(q, k, v)
-        assert g1[1].shape == k.shape   # grouped dk, no crash
+
+        def grads():
+            return jax.grad(lambda *a: jnp.sum(flash_attention(
+                *a, causal=True)), argnums=(0, 1, 2))(q, k, v)
+
+        monkeypatch.setenv("APEX_TPU_FLASH_BWD", "fused")
+        g_fused = grads()
+        monkeypatch.setenv("APEX_TPU_FLASH_BWD", "split")
+        g_split = grads()
+        assert g_fused[1].shape == k.shape   # grouped dk
+        for a, b_, name in zip(g_fused, g_split, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=1e-5, rtol=1e-5,
+                err_msg=f"grouped fused d{name}")
+
+    def test_fused_backward_mqa_with_dropout(self, monkeypatch):
+        """MQA extreme through the fused kernel with dropout: the
+        reconstructed per-q-head dropout stream must match split."""
+        q, k, v = self._grouped(g=1, seed=26)
+        rng = jax.random.PRNGKey(11)
+
+        def grads():
+            return jax.grad(lambda *a: jnp.sum(flash_attention(
+                *a, causal=True, dropout_p=0.25, dropout_rng=rng)),
+                argnums=(0, 1, 2))(q, k, v)
+
+        monkeypatch.setenv("APEX_TPU_FLASH_BWD", "fused")
+        g_fused = grads()
+        monkeypatch.setenv("APEX_TPU_FLASH_BWD", "split")
+        g_split = grads()
+        for a, b_, name in zip(g_fused, g_split, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=1e-5, rtol=1e-5,
+                err_msg=f"mqa fused+dropout d{name}")
 
     def test_invalid_group_ratio_rejected(self):
         q, k, v = self._grouped(n=8, g=3)

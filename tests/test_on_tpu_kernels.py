@@ -300,10 +300,11 @@ def test_ln_backward_split_partials_on_chip(monkeypatch):
                 err_msg=f"{mode} {nm}", **tols[nm])
 
 
-def test_grouped_kv_flash_on_chip():
-    """GQA-aware flash under Mosaic: the grouped index maps (fwd + dq)
-    and the 4-D dkv accumulation grid only ever ran in interpret mode
-    until a chip is attached — tiling/layout bugs surface here."""
+def test_grouped_kv_flash_on_chip(monkeypatch):
+    """GQA-aware flash under Mosaic: the grouped index maps (fwd + dq),
+    the 4-D dkv accumulation grid, AND the fused kernel's cross-row
+    group accumulation only ever ran in interpret mode until a chip is
+    attached — tiling/layout bugs surface here."""
     from apex_tpu.ops.flash_attention import flash_attention, mha_reference
 
     rs = np.random.RandomState(9)
@@ -315,15 +316,17 @@ def test_grouped_kv_flash_on_chip():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=5e-3, rtol=5e-3)
 
-    g1 = jax.grad(lambda *a: jnp.sum(flash_attention(*a, causal=True)),
-                  argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(lambda *a: jnp.sum(mha_reference(*a, causal=True)),
                   argnums=(0, 1, 2))(q, k, v)
-    for a, b, name in zip(g1, g2, "qkv"):
-        assert a.shape == b.shape, name
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-3,
-            err_msg=f"grouped d{name} on chip")
+    for mode in ("split", "fused"):
+        monkeypatch.setenv("APEX_TPU_FLASH_BWD", mode)
+        g1 = jax.grad(lambda *a: jnp.sum(flash_attention(
+            *a, causal=True)), argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g1, g2, "qkv"):
+            assert a.shape == b.shape, name
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-3,
+                err_msg=f"grouped {mode} d{name} on chip")
 
 
 def test_ring_attention_on_chip():
