@@ -32,9 +32,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from apex_tpu.amp import scaler as scaler_lib
 from apex_tpu.amp.policy import _effective, policy_for_opt_level
-from apex_tpu.ops.pallas_adam import adam_kernel_flat
 from apex_tpu.utils.collectives import flag_and
-from apex_tpu.utils.registry import on_tpu
+
 
 __all__ = ["ZeroTrainState", "make_distributed_adam_train_step"]
 
@@ -243,23 +242,16 @@ def make_distributed_adam_train_step(
         step_new = (state.step + 1).astype(jnp.float32)
         bc1 = 1.0 - beta1 ** step_new if bias_correction else jnp.float32(1)
         bc2 = 1.0 - beta2 ** step_new if bias_correction else jnp.float32(1)
-        if on_tpu():
-            scalars = jnp.stack([
-                jnp.asarray(lr, jnp.float32), jnp.float32(beta1),
-                jnp.float32(beta2), jnp.float32(eps),
-                jnp.asarray(weight_decay, jnp.float32), bc1, bc2])
-            u, m_new, v_new = adam_kernel_flat(
-                g_local, master, state.m_shard, state.v_shard, scalars,
-                adam_w_mode=adam_w_mode, interpret=False)
-        else:
-            # closed-form XLA path (the Pallas interpreter cannot run
-            # under shard_map vma typing); same math as _adam_body
-            g = g_local if adam_w_mode else g_local + weight_decay * master
-            m_new = beta1 * state.m_shard + (1.0 - beta1) * g
-            v_new = beta2 * state.v_shard + (1.0 - beta2) * g * g
-            u = -lr * (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
-            if adam_w_mode:
-                u = u - lr * weight_decay * master
+        # closed-form XLA flat update on the local shard: the round-5
+        # win-or-delete sweep retired the Pallas flat kernel (1.82x XLA
+        # at its best block size — BASELINE.md kernel ledger), and XLA
+        # fuses this chain into one HBM pass on every backend
+        g = g_local if adam_w_mode else g_local + weight_decay * master
+        m_new = beta1 * state.m_shard + (1.0 - beta1) * g
+        v_new = beta2 * state.v_shard + (1.0 - beta2) * g * g
+        u = -lr * (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+        if adam_w_mode:
+            u = u - lr * weight_decay * master
         master_new = master + u
 
         new_ls, overflow = scaler_lib.update_loss_scale(

@@ -13,7 +13,7 @@ from apex_tpu.ops.layer_norm import (  # noqa: F401
     fused_layer_norm,
     fused_rms_norm,
 )
-from apex_tpu.ops.pallas_adam import flat_adam_update  # noqa: F401
+from apex_tpu.ops.flat_adam import flat_adam_update  # noqa: F401
 from apex_tpu.ops.rope import (  # noqa: F401
     fused_apply_rotary_pos_emb,
     fused_apply_rotary_pos_emb_2d,

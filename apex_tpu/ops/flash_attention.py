@@ -936,24 +936,30 @@ def _flash_bwd(causal, scale, dropout_p, res, do):
     if mode not in ("auto", "fused", "split"):
         raise ValueError(
             f"APEX_TPU_FLASH_BWD={mode!r}: expected auto|fused|split")
-    # auto currently resolves to the split pair everywhere: the fused
-    # single-pass backward has only ever run in interpret mode (the
-    # round-4 chip outage), and the repo's policy is that defaults are
-    # measured winners.  When tools/sweep_r4.py measures a fused win on
-    # silicon, raise FUSED_MAX back to the measured crossover (512 was
-    # the projected value for the short-key / BERT class).
-    fused_max = int(os.environ.get("APEX_TPU_FLASH_BWD_FUSED_MAX", "0"))
+    # auto routes the short-key class (sk<=512) to the fused single-pass
+    # backward: the round-5 on-chip sweep (first silicon after the
+    # round-3/4 outage) measured fused beating the split pair at every
+    # swept q-block for s512 — causal 531.7us vs 708.0us, non-causal
+    # 569.0us vs 821.6us at bq=512 (tools/sweep_r4.py, SWEEP log
+    # 2026-07-31) — and improving monotonically with bq.  Above 512 the
+    # split pair keeps the s1024/s2048 wins from the round-3 retune
+    # until tools/sweep_r5.py measures the fused kernel there.
+    fused_max = int(os.environ.get("APEX_TPU_FLASH_BWD_FUSED_MAX", "512"))
     if mode == "fused" or (mode == "auto" and skp <= fused_max):
         # short-key class (BERT s512 etc.): K/V fit VMEM whole — one
         # pass computes p once and emits dq/dk/dv together, vs the
-        # split kernels' two passes with p recomputed in each
-        fused_bq = min(int(os.environ.get(
-            "APEX_TPU_FLASH_FUSED_BQ", str(min(block_q, sqp)))), sqp)
+        # split kernels' two passes with p recomputed in each.  q-block
+        # default 512: the round-5 sweep improved monotonically with bq
+        # (128: 671us, 256: 581us, 512: 532us at s512 causal)
+        env_bq = os.environ.get("APEX_TPU_FLASH_FUSED_BQ")
+        fused_bq = min(int(env_bq) if env_bq else 512, sqp)
         if sqp % fused_bq:
-            raise ValueError(
-                f"APEX_TPU_FLASH_FUSED_BQ={fused_bq} must divide the "
-                f"padded query length {sqp} (floor-division grids would "
-                "silently drop tail q-rows)")
+            if env_bq:
+                raise ValueError(
+                    f"APEX_TPU_FLASH_FUSED_BQ={fused_bq} must divide the "
+                    f"padded query length {sqp} (floor-division grids "
+                    "would silently drop tail q-rows)")
+            fused_bq = block_q   # always divides sqp (it set the padding)
         dq3, dk3, dv3 = _bwd_pallas_fused(
             q3, k3, v3, do3, lse3, delta, kpm3, seg3, seed, scale,
             causal, sq, sk, fused_bq, dropout_p,

@@ -112,12 +112,16 @@ def _ln_fwd_kernel(rms: bool, affine: bool, has_bias: bool, eps: float,
     rs_ref[:] = rs
 
 
-def _ln_bwd_kernel(rms: bool, affine: bool, has_bias: bool, split: bool,
-                   *refs):
-    """dx plus dγ/dβ: either accumulated into one revisited tile
-    (``split=False``, the round-3 kernel) or written as per-block
-    partials a trailing XLA sum reduces (``split=True`` — removes the
-    serial revisit dependency; VERDICT r3 #4 LN candidate)."""
+def _ln_bwd_kernel(rms: bool, affine: bool, has_bias: bool, *refs):
+    """dx plus dγ/dβ accumulated into one revisited (1, hidden) tile.
+
+    A round-4 "split partials" variant wrote per-block dγ/dβ rows for a
+    trailing XLA sum instead; it was deleted in round 5 — Mosaic rejects
+    its (1, hidden) output block over a (n_blocks, hidden) array (last
+    two block dims must be (8k, 128k) or equal the array's), and the
+    revisit kernel it was meant to replace *wins* on silicon anyway
+    (fwd+bwd 16384x768 bf16: 108.8us vs the XLA chain's 150.1us, round-5
+    sweep)."""
     if affine:
         if has_bias:
             (dy_ref, x_ref, w_ref, mu_ref, rs_ref,
@@ -146,22 +150,17 @@ def _ln_bwd_kernel(rms: bool, affine: bool, has_bias: bool, split: bool,
     dx_ref[:] = dx.astype(dx_ref.dtype)
 
     if affine:
-        if split:
-            dw_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
-            if has_bias:
-                db_ref[:] = jnp.sum(dy, axis=0, keepdims=True)
-        else:
-            first = pl.program_id(0) == 0
+        first = pl.program_id(0) == 0
 
-            @pl.when(first)
-            def _init():
-                dw_ref[:] = jnp.zeros_like(dw_ref)
-                if has_bias:
-                    db_ref[:] = jnp.zeros_like(db_ref)
-
-            dw_ref[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+        @pl.when(first)
+        def _init():
+            dw_ref[:] = jnp.zeros_like(dw_ref)
             if has_bias:
-                db_ref[:] += jnp.sum(dy, axis=0, keepdims=True)
+                db_ref[:] = jnp.zeros_like(db_ref)
+
+        dw_ref[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+        if has_bias:
+            db_ref[:] += jnp.sum(dy, axis=0, keepdims=True)
 
 
 def _pallas_ok(hidden: int, dtype) -> bool:
@@ -227,8 +226,7 @@ def _ln_fwd_pallas(x2, weight, bias, eps, rms):
     return y[:rows], mu[:rows], rs[:rows]
 
 
-def _ln_bwd_pallas(dy2, x2, weight, mu, rs, rms, has_bias,
-                   split_partials=False):
+def _ln_bwd_pallas(dy2, x2, weight, mu, rs, rms, has_bias):
     from jax.experimental.pallas import tpu as pltpu
 
     hidden = x2.shape[1]
@@ -261,15 +259,7 @@ def _ln_bwd_pallas(dy2, x2, weight, mu, rs, rms, has_bias,
     in_specs += [stat_tile, stat_tile]
     args += [mu, rs]
 
-    n_blocks = grid[0]
-    if split_partials:
-        # per-block partial rows, reduced by XLA below (no revisit)
-        part_tile = pl.BlockSpec((1, hidden), lambda i: (i, 0),
-                                 memory_space=pltpu.VMEM)
-        acc_tile, acc_rows = part_tile, n_blocks
-    else:
-        acc_rows = 1
-
+    acc_rows = 1
     out_specs = [row_tile]
     out_shape = [out_struct((prows, hidden), x2.dtype, x2)]
     if affine:
@@ -281,8 +271,7 @@ def _ln_bwd_pallas(dy2, x2, weight, mu, rs, rms, has_bias,
                 out_struct((acc_rows, hidden), jnp.float32, x2))
 
     outs = pl.pallas_call(
-        functools.partial(_ln_bwd_kernel, rms, affine, has_bias,
-                          split_partials),
+        functools.partial(_ln_bwd_kernel, rms, affine, has_bias),
         grid=grid,
         in_specs=in_specs,
         out_specs=tuple(out_specs),
@@ -294,7 +283,7 @@ def _ln_bwd_pallas(dy2, x2, weight, mu, rs, rms, has_bias,
         return dx[:rows], None, None
 
     def red(t):
-        return t.sum(axis=0) if split_partials else t.reshape(-1)
+        return t.reshape(-1)
 
     if has_bias:
         dx, dw, db = outs
@@ -354,19 +343,30 @@ def _norm_fwd(x, weight, bias, eps, rms, memory_efficient):
 
 
 def _ln_bwd_mode(hidden, dtype) -> Optional[str]:
-    """Backward backend gate. Measured on v5e (bench_kernels.py, round 3):
-    the XLA-composed backward beats the round-3 Pallas bwd kernel
-    because XLA fuses dx into neighboring ops while the kernel's
-    revisited dγ/dβ accumulator tile adds a serial pass (LN fwd+bwd
-    16384x768 bf16: pallas 143us vs mixed pallas-fwd/xla-bwd 93us).
-    Forward stays Pallas (35us vs 78us).  APEX_TPU_LN_BWD=pallas opts
-    the revisit kernel back in; =pallas_split selects the round-4
-    per-block-partials variant (sweep_r4 measures all three)."""
+    """Backward backend gate. Measured on v5e, round-5 sweep (first chip
+    contact after the round-3/4 outage): the Pallas revisit kernel WINS
+    the full fwd+bwd chain — 16384x768 bf16: 108.8us vs 150.1us for the
+    pallas-fwd/XLA-bwd mix (ratio 0.725) — reversing the round-3 reading
+    (143us vs 93us) that had demoted it.  The kernel is unchanged since
+    round 3, so the flip is environmental (the tunnel/toolchain behind
+    the chip was rebuilt during the two-round outage); sweep_r4
+    re-measures both sides every campaign, so a flip back would be
+    caught.  Default is therefore
+    the Pallas backward wherever the Pallas forward is eligible;
+    ``APEX_TPU_LN_BWD=xla`` opts back into the XLA composition (and is
+    what sweep_r4 measures against)."""
     import os
 
     mode = os.environ.get("APEX_TPU_LN_BWD")
-    if mode in ("pallas", "pallas_split") and _pallas_ok(hidden, dtype):
-        return mode
+    if mode == "xla":
+        return None
+    if mode not in (None, "", "pallas"):
+        raise ValueError(
+            f"APEX_TPU_LN_BWD={mode!r}: expected pallas|xla (the round-4 "
+            "pallas_split variant was deleted in round 5 — Mosaic rejects "
+            "its partials block spec and the revisit kernel wins on chip)")
+    if _pallas_ok(hidden, dtype):
+        return "pallas"
     return None
 
 
@@ -397,8 +397,7 @@ def _norm_bwd(eps, rms, memory_efficient, res, dy):
     bwd_mode = _ln_bwd_mode(hidden, x2.dtype)
     if bwd_mode is not None:
         dx, dw, db = _ln_bwd_pallas(
-            dy2, x2, weight, mu, rs, rms, bias is not None,
-            split_partials=(bwd_mode == "pallas_split")
+            dy2, x2, weight, mu, rs, rms, bias is not None
         )
     else:
         dy32 = dy2.astype(jnp.float32)

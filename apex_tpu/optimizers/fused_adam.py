@@ -80,7 +80,7 @@ def fused_adam(
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
 
         if use_pallas:
-            from apex_tpu.ops.pallas_adam import flat_adam_update
+            from apex_tpu.ops.flat_adam import flat_adam_update
 
             updates, m, v = flat_adam_update(
                 grads, params, state.exp_avg, state.exp_avg_sq,

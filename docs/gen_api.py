@@ -48,7 +48,7 @@ MODULES = [
     ("apex_tpu.ops.swiglu", "ops", "ops.swiglu — fused bias-SwiGLU"),
     ("apex_tpu.ops.rope", "ops", "ops.rope — rotary embeddings"),
     ("apex_tpu.ops.dense", "ops", "ops.dense — fused dense epilogues"),
-    ("apex_tpu.ops.pallas_adam", "ops", "ops.pallas_adam — flat Adam"),
+    ("apex_tpu.ops.flat_adam", "ops", "ops.flat_adam — flat Adam"),
     # parallel
     ("apex_tpu.parallel.mesh", "parallel", "parallel.mesh — device mesh"),
     ("apex_tpu.parallel.launch", "parallel",

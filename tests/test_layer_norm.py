@@ -143,18 +143,19 @@ def test_pallas_interpret_matches_ref(monkeypatch):
     np.testing.assert_allclose(np.asarray(gb), np.asarray(gb_r), atol=1e-4)
 
 
-@pytest.mark.parametrize("mode", ["pallas", "pallas_split"])
+@pytest.mark.parametrize("mode", ["pallas"])
 def test_pallas_bwd_kernel_opt_in(monkeypatch, mode):
-    """The Pallas backward kernels are opt-in since round 3 (the XLA
-    composition measured faster on v5e — BASELINE.md kernel ledger);
-    keep both opt-in paths (revisit accumulator and round-4 per-block
-    partials) covered so they cannot rot."""
+    """The Pallas revisit backward became the default in round 5 (it wins
+    the on-chip fwd+bwd chain, 0.725x the XLA mix — BASELINE.md kernel
+    ledger); the round-4 pallas_split variant was deleted (Mosaic rejects
+    its partials block spec).  Exercise the kernel against the XLA
+    composition so it cannot rot."""
     monkeypatch.setenv("APEX_TPU_PALLAS_INTERPRET", "1")
     monkeypatch.setenv("APEX_TPU_LN_BWD", mode)
     rng = np.random.RandomState(11)
     # >512 rows -> multiple grid blocks (_rows_block(256, 8) = 512): the
-    # split mode must actually write per-block partials and reduce them,
-    # not degenerate to the single-block case where both modes coincide
+    # revisit accumulator must actually cross block boundaries, not
+    # degenerate to the single-block case
     x = jnp.asarray(rng.randn(1040, 256).astype(np.float32))
     w = jnp.asarray((rng.rand(256) + 0.5).astype(np.float32))
     b = jnp.asarray(rng.randn(256).astype(np.float32))
@@ -166,7 +167,7 @@ def test_pallas_bwd_kernel_opt_in(monkeypatch, mode):
     gx, gw, gb = jax.grad(f, argnums=(0, 1, 2))(x, w, b)
 
     monkeypatch.delenv("APEX_TPU_PALLAS_INTERPRET")
-    monkeypatch.delenv("APEX_TPU_LN_BWD")
+    monkeypatch.setenv("APEX_TPU_LN_BWD", "xla")  # reference side: XLA composition
     gx_r, gw_r, gb_r = jax.grad(f, argnums=(0, 1, 2))(x, w, b)
     np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_r), atol=1e-5)
     np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_r), atol=1e-4)
@@ -181,7 +182,7 @@ def test_pallas_bwd_kernel_opt_in(monkeypatch, mode):
 
     rx, rw = jax.grad(fr, argnums=(0, 1))(x, w)
     monkeypatch.delenv("APEX_TPU_PALLAS_INTERPRET")
-    monkeypatch.delenv("APEX_TPU_LN_BWD")
+    monkeypatch.setenv("APEX_TPU_LN_BWD", "xla")  # reference side: XLA composition
     rx_r, rw_r = jax.grad(fr, argnums=(0, 1))(x, w)
     np.testing.assert_allclose(np.asarray(rx), np.asarray(rx_r), atol=1e-5)
     np.testing.assert_allclose(np.asarray(rw), np.asarray(rw_r), atol=1e-4)

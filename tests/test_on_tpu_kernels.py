@@ -89,24 +89,11 @@ def test_softmax_kernels_on_chip():
     np.testing.assert_allclose(got, ref, atol=2e-5, rtol=2e-5)
 
 
-def test_flat_adam_kernel_on_chip():
-    from apex_tpu.ops.pallas_adam import adam_kernel_flat
-
-    rs = np.random.RandomState(4)
-    n = 4096
-    g = jnp.asarray(rs.randn(n), jnp.float32)
-    p = jnp.asarray(rs.randn(n), jnp.float32)
-    m = jnp.zeros((n,), jnp.float32)
-    v = jnp.zeros((n,), jnp.float32)
-    scalars = jnp.asarray([1e-3, 0.9, 0.999, 1e-8, 0.0, 0.1, 0.001999],
-                          jnp.float32)
-    u, m2, v2 = adam_kernel_flat(g, p, m, v, scalars, adam_w_mode=True,
-                                 interpret=False)
-    m_ref = 0.1 * np.asarray(g)
-    v_ref = 0.001 * np.asarray(g) ** 2
-    u_ref = -1e-3 * (m_ref / 0.1) / (np.sqrt(v_ref / 0.001999) + 1e-8)
-    np.testing.assert_allclose(np.asarray(m2), m_ref, rtol=1e-5)
-    np.testing.assert_allclose(np.asarray(u), u_ref, rtol=1e-4, atol=1e-7)
+# (test_flat_adam_kernel_on_chip was deleted in round 5 along with the
+# Pallas flat Adam kernel it Mosaic-validated: the round-5 win-or-delete
+# sweep measured it 1.82x the XLA fused update at its best block size.
+# The XLA flat update that replaced it has no Mosaic surface; its
+# numerics are covered by tests/test_optimizers.py.)
 
 
 def test_xentropy_kernel_on_chip():
@@ -290,7 +277,7 @@ def test_ln_backward_split_partials_on_chip(monkeypatch):
     tols = {"dx": dict(atol=1e-2, rtol=2e-2),
             "dw": dict(atol=0.5, rtol=2e-2),
             "db": dict(atol=0.5, rtol=2e-2)}
-    for mode in ("pallas", "pallas_split"):
+    for mode in ("pallas",):
         monkeypatch.setenv("APEX_TPU_LN_BWD", mode)
         g = jax.grad(f, argnums=(0, 1, 2))(x, w, b)
         monkeypatch.delenv("APEX_TPU_LN_BWD")

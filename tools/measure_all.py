@@ -8,30 +8,26 @@ the measured winner and which still-losing kernels get deleted.
     PYTHONPATH=.:/root/.axon_site python tools/measure_all.py
 
 Stages (each its own subprocess so one failure cannot strand the rest;
-logs land in measure_logs/):
+logs land in measure_logs/), ordered most-valuable-first so a
+mid-campaign tunnel wedge — which is how the round-5 first contact
+ended — costs the least-valuable stages:
 
-1. ``tools/sweep_r4.py --json SWEEP_r4.json`` — the four round-3 losing
-   kernels (fused flash bwd x bq, flat Adam block rows, LN bwd variants,
-   softmax grad-path confirmation).
-2. ``bench_kernels.py --json KERNEL_BENCH.json`` — refresh the full
-   per-kernel ledger at the round-3 methodology.
-3. ``bench.py`` — the BASELINE.md workload matrix (GPT/RN50/BERT/RNN-T/
+1. ``bench.py`` — the BASELINE.md workload matrix (GPT/RN50/BERT/RNN-T/
    MoE/decode/long-context/cp-compare rows), one JSON line.
-4. ``APEX_TPU_TEST_ON_TPU=1 pytest tests/test_on_tpu_kernels.py -m tpu``
-   — the 15 Mosaic-compile hardware tests (interpret-green != Mosaic-
-   green).
-5. ``tools/step_breakdown.py --model resnet50`` — the ablation/roofline
+2. ``APEX_TPU_TEST_ON_TPU=1 pytest tests/test_on_tpu_kernels.py -m tpu``
+   — the Mosaic-compile hardware tests (interpret-green != Mosaic-
+   green; now covers the round-5 default fused flash bwd + LN bwd).
+3. ``tools/sweep_r5.py`` — the open crossovers (fused-vs-split flash at
+   s1024, the s512 fwd re-measure at larger inner counts).
+4. ``tools/sweep_r4.py`` — re-confirm flash s512 / LN / softmax on the
+   current defaults.
+5. ``bench_kernels.py`` — refresh the full per-kernel ledger.
+6. ``tools/step_breakdown.py --model resnet50`` — the ablation/roofline
    profile that must precede the RN50 MFU attack (VERDICT r4 #3).
 
-Decision rules printed at the end (from BASELINE.md round-4 note):
-- flash bwd: if any fused variant beats split at s512, set
-  ``APEX_TPU_FLASH_BWD_FUSED_MAX`` to the measured crossover; else
-  delete the fused kernel + knob.
-- flat Adam: if no block-rows setting beats XLA, delete the kernel and
-  switch distributed_fused_adam to the XLA flat update.
-- LN bwd: if both pallas variants still lose, delete the bwd kernel +
-  ``APEX_TPU_LN_BWD``.
-- softmax: confirm grad-path ratio ~1.0 (fusion-barrier fix held).
+The flat-Adam / LN / flash-s512 win-or-delete decisions fired on the
+2026-07-31 03:46 first contact (BASELINE.md round-5 note); the one
+still-open decision rule is the flash FUSED_MAX crossover at s1024.
 """
 
 from __future__ import annotations
@@ -46,7 +42,14 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOGS = os.path.join(ROOT, "measure_logs")
 
 
-def _run(name, cmd, env_extra=None, timeout=7200):
+def _run(name, cmd, env_extra=None, timeout=7200, stall=900):
+    """Run a stage, logging to measure_logs/<name>.log.
+
+    Two kill conditions, both observed on real outages: a hard wall
+    (``timeout``) and a STALL watchdog (``stall`` seconds with no new
+    log bytes).  The round-5 first-contact run hung 30+ minutes on a
+    wedged tunnel RPC with zero output — a plain subprocess timeout of
+    2 h would have burned the rest of the chip window."""
     os.makedirs(LOGS, exist_ok=True)
     log = os.path.join(LOGS, f"{name}.log")
     env = dict(os.environ)
@@ -56,62 +59,34 @@ def _run(name, cmd, env_extra=None, timeout=7200):
     t0 = time.time()
     print(f"[measure_all] {name}: {' '.join(cmd)} (log: {log})",
           flush=True)
-    try:
-        with open(log, "w") as f:
-            rc = subprocess.run(cmd, cwd=ROOT, env=env, stdout=f,
-                                stderr=subprocess.STDOUT,
-                                timeout=timeout).returncode
-    except subprocess.TimeoutExpired:
-        # one hung stage (the axon failure mode) must not strand the
-        # rest of the campaign or the decision checklist
-        print(f"[measure_all] {name}: TIMED OUT after {timeout}s",
-              flush=True)
-        return 124
+    with open(log, "w") as f:
+        proc = subprocess.Popen(cmd, cwd=ROOT, env=env, stdout=f,
+                                stderr=subprocess.STDOUT)
+        last_size, last_change = 0, time.time()
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            now = time.time()
+            size = os.path.getsize(log)
+            if size != last_size:
+                last_size, last_change = size, now
+            reason = None
+            if now - t0 > timeout:
+                reason = f"TIMED OUT after {timeout}s"
+            elif now - last_change > stall:
+                reason = (f"STALLED — no log output for {stall}s "
+                          "(wedged tunnel RPC?)")
+            if reason:
+                proc.kill()
+                proc.wait()
+                print(f"[measure_all] {name}: {reason}", flush=True)
+                return 124
+            time.sleep(10)
     dt = time.time() - t0
     status = "ok" if rc == 0 else f"FAILED rc={rc}"
     print(f"[measure_all] {name}: {status} in {dt:.0f}s", flush=True)
     return rc
-
-
-def _flash_decision(sweep):
-    rows = {k: v for k, v in sweep.items() if k.startswith("flash_fwdbwd")}
-    out = []
-    for tag in ("b8xs512_causal", "b8xs512"):
-        split = rows.get(f"flash_fwdbwd_{tag}_split", {})
-        fused = {k: v for k, v in rows.items()
-                 if k.startswith(f"flash_fwdbwd_{tag}_fused")}
-        if not split or not fused:
-            continue
-        best_k, best = min(fused.items(),
-                           key=lambda kv: kv[1]["pallas_over_xla"])
-        verdict = ("FLIP: set APEX_TPU_FLASH_BWD_FUSED_MAX=512 "
-                   f"(winner {best_k})"
-                   if best["pallas_over_xla"] < split["pallas_over_xla"]
-                   else "DELETE the fused kernel + knob (split wins)")
-        out.append(f"  flash {tag}: split={split['pallas_over_xla']:.2f} "
-                   f"best-fused={best['pallas_over_xla']:.2f} -> {verdict}")
-    return out
-
-
-def _simple_decision(sweep, prefix, keep_msg, delete_msg,
-                     value_strip=None):
-    rows = {k: v["pallas_over_xla"] for k, v in sweep.items()
-            if k.startswith(prefix)}
-    if not rows:
-        # an empty sweep is NOT a pass: sweep_r4 continues past
-        # per-variant failures, so silence here would read as covered
-        return [f"  {prefix}: NO measurements in SWEEP_r4.json — every "
-                "variant failed; check measure_logs/sweep_r4.log (per "
-                "BASELINE rules an unmeasurable kernel is a delete)"]
-    best_k = min(rows, key=rows.get)
-    wins = rows[best_k] < 1.0
-    # value_strip maps the sweep key to the literal knob value the
-    # checklist should name (flat_adam_88m_rows2048 -> 2048,
-    # ln_fwdbwd_pallas_split -> pallas_split)
-    best_val = (best_k[len(value_strip):] if value_strip
-                and best_k.startswith(value_strip) else best_k)
-    return [f"  {prefix}: best {best_k}={rows[best_k]:.2f} -> "
-            + (keep_msg.format(best=best_val) if wins else delete_msg)]
 
 
 def main():
@@ -123,49 +98,61 @@ def main():
               "needs the chip — aborting without touching artifacts")
         return 1
     print(f"[measure_all] TPU up: {info[1]} device(s). Campaign start.")
+    # Value-first ordering (learned from the round-5 first contact,
+    # where the tunnel wedged 25 minutes in): the headline workload
+    # matrix and the Mosaic-validation tier run BEFORE the long kernel
+    # ledgers, so a mid-campaign wedge costs the least-valuable stages.
     results = {}
-    results["sweep_r4"] = _run(
-        "sweep_r4", [sys.executable, "tools/sweep_r4.py", "--json",
-                     "SWEEP_r4.json"])
-    results["bench_kernels"] = _run(
-        "bench_kernels", [sys.executable, "bench_kernels.py", "--json",
-                          "KERNEL_BENCH.json"])
-    results["bench"] = _run("bench", [sys.executable, "bench.py"])
+    results["bench"] = _run("bench", [sys.executable, "bench.py"],
+                            timeout=3600)
     results["tpu_tier"] = _run(
         "tpu_tier", [sys.executable, "-m", "pytest",
                      "tests/test_on_tpu_kernels.py", "-m", "tpu", "-q"],
-        env_extra={"APEX_TPU_TEST_ON_TPU": "1"})
+        env_extra={"APEX_TPU_TEST_ON_TPU": "1"}, timeout=3600)
+    results["sweep_r5"] = _run(
+        "sweep_r5", [sys.executable, "tools/sweep_r5.py", "--json",
+                     "SWEEP_r5.json"], timeout=3600)
+    results["sweep_r4"] = _run(
+        "sweep_r4", [sys.executable, "tools/sweep_r4.py", "--json",
+                     "SWEEP_r4.json"], timeout=3600)
+    results["bench_kernels"] = _run(
+        "bench_kernels", [sys.executable, "bench_kernels.py", "--json",
+                          "KERNEL_BENCH.json"])
     results["rn50_breakdown"] = _run(
         "rn50_breakdown", [sys.executable, "tools/step_breakdown.py",
                            "--model", "resnet50"])
 
     print("\n[measure_all] stage results:", json.dumps(results))
-    sweep_path = os.path.join(ROOT, "SWEEP_r4.json")
-    if os.path.exists(sweep_path) and results.get("sweep_r4") == 0:
+    sweep_path = os.path.join(ROOT, "SWEEP_r5.json")
+    if os.path.exists(sweep_path) and results.get("sweep_r5") == 0:
         with open(sweep_path) as f:
             sweep = json.load(f)
         print("[measure_all] DECISION CHECKLIST (BASELINE.md rules):")
-        for line in _flash_decision(sweep):
-            print(line)
-        for line in _simple_decision(
-                sweep, "flat_adam_88m",
-                "flip APEX_TPU_ADAM_BLOCK_ROWS default to {best}",
-                "DELETE adam_kernel_flat + APEX_TPU_ADAM_BLOCK_ROWS "
-                "(XLA wins); switch distributed_fused_adam to XLA flat",
-                value_strip="flat_adam_88m_rows"):
-            print(line)
-        for line in _simple_decision(
-                sweep, "ln_fwdbwd_pallas",
-                "flip APEX_TPU_LN_BWD default to {best}",
-                "DELETE the LN bwd kernels + APEX_TPU_LN_BWD (XLA wins)",
-                value_strip="ln_fwdbwd_"):
-            print(line)
-        sm = sweep.get("softmax_causal_fwdbwd_512")
-        if sm:
-            print(f"  softmax grad-path: {sm['pallas_over_xla']:.2f} "
-                  "(expect ~1.0 after the fusion-barrier fix)")
-        print("[measure_all] then: update BASELINE.md ledger, flip "
-              "defaults, delete losers, re-run bench.py for BENCH_r05.")
+        print("  (adam + LN + flash-s512 decisions fired on the 03:46 "
+              "first contact — see BASELINE.md round-5 note)")
+        rows = {k: v["pallas_over_xla"] for k, v in sweep.items()
+                if "s1024" in k and "fused" in k}
+        split = {k: v["pallas_over_xla"] for k, v in sweep.items()
+                 if "s1024" in k and k.endswith("split")}
+        if rows and split:
+            best_k = min(rows, key=rows.get)
+            worst_split = max(split.values())
+            if rows[best_k] < min(split.values()):
+                print(f"  flash s1024: best fused {best_k}="
+                      f"{rows[best_k]:.2f} beats split "
+                      f"({worst_split:.2f}) -> raise "
+                      "APEX_TPU_FLASH_BWD_FUSED_MAX to 1024")
+            else:
+                print(f"  flash s1024: split holds "
+                      f"({min(split.values()):.2f} vs best fused "
+                      f"{rows[best_k]:.2f}) -> FUSED_MAX stays 512")
+        for k, v in sweep.items():
+            if "remeasure" in k:
+                print(f"  {k}: {v['pallas_over_xla']:.2f} (ledger "
+                      "s512-fwd row refresh)")
+        print("[measure_all] then: update BASELINE.md ledger + "
+              "KERNEL_BENCH rows, re-run bench.py for BENCH_r05 if "
+              "defaults moved.")
     return 1 if any(rc != 0 for rc in results.values()) else 0
 
 

@@ -7,11 +7,12 @@ Each knob is read at trace time, so one process sweeps every variant:
 - flash s512 fwd+bwd: split (round-3 default) vs the new fused
   single-pass backward (``APEX_TPU_FLASH_BWD``) x fused q-block size
   (``APEX_TPU_FLASH_FUSED_BQ`` 128/256/512);
-- flat Adam 88M: ``APEX_TPU_ADAM_BLOCK_ROWS`` 512/1024/2048/4096 vs the
-  XLA fused tree update;
-- LN bwd 16384x768 bf16: the round-3 revisit-accumulator kernel
-  (``APEX_TPU_LN_BWD=pallas``), the round-4 per-block-partials variant
-  (``=pallas_split``), and the XLA default, all vs the XLA chain;
+- flat Adam 88M: decided round 5 (kernel deleted — see the tombstone
+  note at sweep_flat_adam's former site);
+- LN bwd 16384x768 bf16: the revisit-accumulator kernel
+  (``APEX_TPU_LN_BWD=pallas``, the round-5 default — it wins on chip)
+  vs the XLA composition (``=xla``); the round-4 per-block-partials
+  variant was deleted in round 5 (Mosaic rejects its block spec);
 - softmax causal 512^2: confirms the grad path now routes to XLA
   (expected ratio ~1.0) while fwd-only keeps the Pallas win.
 
@@ -86,66 +87,12 @@ def sweep_flash_s512(results):
                     f"fwd+bwd {tag} {label}", got, xla)
 
 
-def _time_adam(update, g, p, m, v):
-    """Chain the full (p, m, v) state through a fori_loop so BOTH sides
-    must materialize every output each iteration (returning only a
-    scalar-dependent value would let XLA dead-code the moment writes and
-    flatter the baseline)."""
-    from bench_kernels import _time
-
-    def make_run(n):
-        @jax.jit
-        def run(g, p, m, v):
-            def body(i, c):
-                p_, m_, v_ = c
-                u, m2, v2 = update(g, p_, m_, v_)
-                return (p_ + u, m2, v2)
-
-            p2, m2, v2 = jax.lax.fori_loop(0, n, body, (p, m, v))
-            return p2[0] + m2[0] + v2[0]
-        return run
-
-    return _time(make_run, (g, p, m, v), inner=(8, 24, 80))
-
-
-def sweep_flat_adam(results):
-    from apex_tpu.ops.pallas_adam import adam_kernel_flat
-
-    print("flat Adam 88M fp32: Pallas block sweep vs XLA", flush=True)
-    rng = np.random.RandomState(0)
-    n = 88_000_000
-    g = jnp.asarray(rng.randn(n).astype(np.float32))
-    p = jnp.zeros((n,), jnp.float32)
-    m = jnp.zeros((n,), jnp.float32)
-    v = jnp.ones((n,), jnp.float32)
-    scalars = jnp.asarray([1e-3, 0.9, 0.999, 1e-8, 0.01, 0.9, 0.999],
-                          jnp.float32)
-
-    def xla_update(g, p, m, v):
-        m2 = 0.9 * m + 0.1 * g
-        v2 = 0.999 * v + 0.001 * g * g
-        u = -1e-3 * (m2 / 0.9) / (jnp.sqrt(v2 / 0.999) + 1e-8) \
-            - 1e-3 * 0.01 * p
-        return u, m2, v2
-
-    xla = _time_adam(xla_update, g, p, m, v)
-    for rows in (512, 1024, 2048, 4096):
-        with _knobs(APEX_TPU_ADAM_BLOCK_ROWS=rows):
-            # the kernel wrapper is itself jitted: drop its trace cache
-            # or the env knob is ignored after the first variant
-            adam_kernel_flat.clear_cache()
-
-            def pallas_update(g, p, m, v):
-                return adam_kernel_flat(g, p, m, v, scalars)
-
-            try:
-                got = _time_adam(pallas_update, g, p, m, v)
-            except Exception as e:
-                print(f"  rows={rows}: {type(e).__name__}: {e}"[:120],
-                      flush=True)
-                continue
-        _report(results, f"flat_adam_88m_rows{rows}",
-                f"flat adam 88M rows={rows}", got, xla)
+# (sweep_flat_adam was removed in round 5: the decision it existed to
+# make fired on first chip contact — rows=512 → 1.82x, rows=1024 →
+# 1.85x the XLA fused update, rows≥2048 failed to compile — so the
+# Pallas flat kernel and APEX_TPU_ADAM_BLOCK_ROWS were deleted and the
+# optimizers keep the XLA flat path.  bench_kernels.py's adam row now
+# tracks the XLA update's absolute time.)
 
 
 def sweep_ln_bwd(results):
@@ -159,10 +106,10 @@ def sweep_ln_bwd(results):
     ln = lambda x, w, b: fused_layer_norm(x, w, b)
     ref = lambda x, w, b: layer_norm_ref(x, w, b)
     xla_chain = chain_grad(ref, (0, 1, 2), x, w, b)
-    for mode in ("pallas", "pallas_split", None):
+    for mode in ("pallas", "xla"):
         with _knobs(APEX_TPU_LN_BWD=mode):
             got = chain_grad(ln, (0, 1, 2), x, w, b)
-        tag = mode or "default_xla_bwd"
+        tag = mode
         _report(results, f"ln_fwdbwd_{tag}", f"LN fwd+bwd {tag}",
                 got, xla_chain)
 
@@ -189,7 +136,7 @@ def main():
     args = ap.parse_args()
     print(f"devices: {jax.devices()}", flush=True)
     results = {}
-    sweeps = {"flash": sweep_flash_s512, "adam": sweep_flat_adam,
+    sweeps = {"flash": sweep_flash_s512,
               "ln": sweep_ln_bwd, "softmax": sweep_softmax}
     only = set(args.only.split(",")) if args.only else set(sweeps)
     for name, fn in sweeps.items():
