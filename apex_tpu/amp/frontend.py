@@ -86,6 +86,7 @@ def make_train_step(
     grad_postprocess: Optional[Callable[[Any], Any]] = None,
     accum_steps: int = 1,
     main_grad_dtype=jnp.float32,
+    norm_telemetry: bool = False,
 ) -> Tuple[Callable, Callable]:
     """Build ``(init_fn, step_fn)`` implementing the full AMP training step.
 
@@ -112,6 +113,13 @@ def make_train_step(
         training's accumulated wgrad at fp32 fidelity instead of summing
         rounded bf16 grads.
       main_grad_dtype: dtype of the accumulation buffer (fp32 default).
+      norm_telemetry: when True the metrics dict additionally carries
+        ``grad_norm``, ``update_norm``, ``param_norm`` and
+        ``update_to_param_ratio`` (``optimizers._common.norm_metrics``
+        over the unscaled fp32 grads / the optimizer's updates / the
+        master params).  OFF by default: each norm is a full-tree
+        reduction.  Record them host-side at the step boundary with
+        ``observability.record_step_metrics(metrics)``.
 
     The returned ``step_fn(state, *batch) -> (state, metrics)`` is pure and
     jittable; metrics carry ``loss``, ``overflow``, ``loss_scale``.
@@ -286,6 +294,11 @@ def make_train_step(
             "overflow": overflow,
             "loss_scale": new_ls_state.loss_scale,
         }
+        if norm_telemetry:
+            from apex_tpu.optimizers._common import norm_metrics
+
+            metrics.update(
+                norm_metrics(grads, updates, state.master_params))
         if aux is not None:
             metrics["aux"] = aux
         return new_state, metrics

@@ -28,6 +28,7 @@ __all__ = [
     "scale_loss",
     "unscale_grads",
     "update_loss_scale",
+    "record_scaler_step",
 ]
 
 
@@ -144,3 +145,48 @@ def update_loss_scale(
         overflow | window_hit, jnp.asarray(0, jnp.int32), unskipped_clean
     )
     return LossScaleState(new_scale, new_unskipped), overflow
+
+
+def record_scaler_step(metrics) -> None:
+    """Host-side AMP telemetry at the step boundary.
+
+    The reference prints "Gradient overflow.  Skipping step, loss scaler
+    0 reducing loss scale to ..." from inside ``update_scale``
+    (scaler.py:206-226); here the scaler is pure device arithmetic, so
+    the observable half runs on the host from the metrics dict a train
+    step already returns (keys ``loss_scale`` and ``overflow`` —
+    amp/frontend.py).  Records:
+
+    - gauge ``amp.loss_scale`` (per-step value),
+    - counters ``amp.overflow_count`` and ``amp.skipped_steps``,
+    - event ``amp.loss_scale_change`` + an INFO log line whenever the
+      scale moved (both overflow halvings and window doublings).
+
+    No-op (one enabled() check) when telemetry is disabled.  Reading
+    the metrics forces a device sync, the same one any per-step logging
+    already pays.
+    """
+    from apex_tpu.observability import metrics as _telemetry
+
+    reg = _telemetry.registry()
+    if reg is None:
+        return
+    import numpy as np
+
+    scale = float(np.asarray(metrics["loss_scale"]).reshape(())[()])
+    overflow = bool(np.asarray(metrics.get("overflow", False)).reshape(())[()])
+    g = reg.gauge("amp.loss_scale")
+    prev = g.value
+    g.set(scale)
+    if overflow:
+        reg.counter("amp.overflow_count").inc()
+        reg.counter("amp.skipped_steps").inc()
+    if prev is not None and prev != scale:
+        reg.event("amp.loss_scale_change", old=prev, new=scale,
+                  overflow=overflow)
+        from apex_tpu.utils.logging import get_logger
+
+        get_logger("amp").info(
+            "loss scale %s -> %s%s", prev, scale,
+            " (gradient overflow: step skipped)" if overflow else
+            " (scale window reached)")

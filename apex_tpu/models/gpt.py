@@ -59,6 +59,7 @@ def make_gpt_train_step(
     context_parallel: Union[bool, str] = False,
     grad_postprocess: Optional[Callable] = None,
     fsdp: bool = False,
+    norm_telemetry: bool = False,
 ):
     """GSPMD data/tensor/sequence-parallel AMP train step.
 
@@ -134,6 +135,7 @@ def make_gpt_train_step(
     init_fn, step_fn = make_train_step(
         loss_fn, optimizer, policy_or_amp,
         grad_postprocess=grad_postprocess,
+        norm_telemetry=norm_telemetry,
     )
 
     def init(rng):

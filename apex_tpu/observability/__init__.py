@@ -1,0 +1,65 @@
+"""apex_tpu.observability — dependency-free metrics + tracing.
+
+The reference apex ships its subsystems dark: loss-scale decisions,
+fused-optimizer behavior, and collective traffic are invisible without
+user prints.  This package is the one measurement path for the repo —
+``bench.py``, ``tools/measure_all.py``, ``tools/step_breakdown.py`` and
+the training loops all report through it — built from three pieces:
+
+- :mod:`apex_tpu.observability.metrics` — a process-local registry of
+  counters, gauges and histogram/quantile summaries, tagged with the
+  same rank sources as ``utils/logging.RankInfoFormatter``, with
+  pluggable sinks (JSONL file, stderr summary) and a module-level
+  **no-op fast path**: when telemetry is not configured every
+  instrumented call site costs one ``is None`` check.
+- :mod:`apex_tpu.observability.spans` — ``with span("fwd")`` (context
+  manager + decorator) and :class:`StepTimer`, the BENCH_r0x step-timing
+  protocol (warmup fenced per-iteration, one trailing fence across the
+  timed iterations) with the scalar-materialization fence that actually
+  blocks on tunneled TPU platforms.
+- :mod:`apex_tpu.observability.sinks` — the JSONL and stderr-summary
+  sinks; the ``jax.profiler`` trace-annotation sink is the
+  ``profiler=True`` feature flag (``APEX_TPU_TELEMETRY_PROFILER=1``),
+  consumed by :mod:`~apex_tpu.observability.spans`.
+
+Everything is host-side at step boundaries: no host callbacks, nothing
+traced into jit bodies — device values enter telemetry only through the
+aux/metrics values a step already returns.  See docs/observability.md.
+"""
+
+from apex_tpu.observability.metrics import (  # noqa: F401
+    SCHEMA_VERSION,
+    MetricsRegistry,
+    configure,
+    configure_from_env,
+    counter,
+    enabled,
+    event,
+    gauge,
+    histogram,
+    record_step_metrics,
+    registry,
+    shutdown,
+)
+from apex_tpu.observability.sinks import JsonlSink, StderrSummarySink  # noqa: F401
+from apex_tpu.observability.spans import StepTimer, fence, span  # noqa: F401
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "MetricsRegistry",
+    "JsonlSink",
+    "StderrSummarySink",
+    "StepTimer",
+    "configure",
+    "configure_from_env",
+    "counter",
+    "enabled",
+    "event",
+    "fence",
+    "gauge",
+    "histogram",
+    "record_step_metrics",
+    "registry",
+    "shutdown",
+    "span",
+]

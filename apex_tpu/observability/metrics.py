@@ -1,0 +1,444 @@
+"""Process-local metrics registry with a zero-overhead disabled path.
+
+Design constraints (ISSUE 1):
+
+- **No-op fast path.** The module-level ``_REGISTRY`` is ``None`` until
+  :func:`configure` runs; every helper (:func:`counter`, :func:`gauge`,
+  :func:`histogram`, :func:`event`, :func:`record_step_metrics`) checks
+  it once and hands back the shared :data:`NOOP_METRIC` singleton or
+  returns.  Instrumented call sites in the hot subsystems therefore cost
+  one attribute load + ``is None`` check when telemetry is off — no
+  allocation, no string formatting, no I/O.
+- **Host-callback-free.** Nothing here runs inside a jit body.  Device
+  values enter through the metrics/aux dicts a train step already
+  returns (:func:`record_step_metrics`,
+  ``amp.scaler.record_scaler_step``) or through static trace-time facts
+  (collective shapes, pipeline schedule geometry).
+- **Rank-tagged.** The registry's tags come from the same sources as
+  ``utils/logging.RankInfoFormatter``: ``jax.process_index`` (guarded —
+  no reachable backend degrades to no tag) and, when initialized,
+  ``transformer.parallel_state.get_rank_info``.
+
+Record stream (see docs/observability.md for the full schema): every
+record is one JSON object with ``schema_version`` (currently
+:data:`SCHEMA_VERSION`), ``t`` (unix seconds), ``type`` (``meta`` |
+``counter`` | ``gauge`` | ``observe`` | ``span`` | ``event``) and
+``name``.  Gauges, histogram observations and spans emit on every
+update; counters accumulate in memory and emit cumulative totals on
+:meth:`MetricsRegistry.flush` (and at close), so hot counters (e.g. a
+collective emitted thousands of times during tracing) cost no I/O per
+increment.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "NOOP_METRIC",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "configure",
+    "configure_from_env",
+    "counter",
+    "enabled",
+    "event",
+    "gauge",
+    "histogram",
+    "record_step_metrics",
+    "registry",
+    "shutdown",
+]
+
+
+class _NoopMetric:
+    """Shared do-nothing metric: handed out by the module-level helpers
+    whenever telemetry is disabled, so ``counter("x").inc()`` is a
+    method call on one long-lived singleton (the no-op fast path the
+    overhead tier-1 test asserts on)."""
+
+    __slots__ = ()
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+    def set(self, value) -> None:
+        pass
+
+    def observe(self, value, **extra) -> None:
+        pass
+
+
+NOOP_METRIC = _NoopMetric()
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is in-memory only; cumulative totals
+    are emitted as records on registry flush/close."""
+
+    __slots__ = ("name", "tags", "value", "_lock")
+
+    def __init__(self, name: str, lock: threading.Lock,
+                 tags: Optional[dict] = None):
+        self.name = name
+        self.tags = tags
+        self.value = 0
+        self._lock = lock
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:   # += is load/add/store; the GIL doesn't cover it
+            self.value += n
+
+
+class Gauge:
+    """Last-value-wins scalar; every ``set`` emits a record (gauges are
+    the per-step time series — loss scale, grad norm — the report tool
+    plots distributions of)."""
+
+    __slots__ = ("name", "tags", "value", "_reg")
+
+    def __init__(self, name: str, reg: "MetricsRegistry",
+                 tags: Optional[dict] = None):
+        self.name = name
+        self.tags = tags
+        self.value: Optional[float] = None
+        self._reg = reg
+
+    def set(self, value) -> None:
+        v = float(value)
+        with self._reg._lock:
+            self.value = v
+        rec = {"type": "gauge", "name": self.name, "value": v}
+        if self.tags:
+            rec["tags"] = self.tags
+        self._reg._emit(rec)   # re-acquires the lock; not held here
+
+
+class Histogram:
+    """Streaming distribution: running count/total plus a bounded window
+    (last 4096 observations) for in-process quantiles.  The JSONL stream
+    carries every observation, so offline summaries (the report tool)
+    are exact; the in-memory window only bounds the live summary."""
+
+    WINDOW = 4096
+
+    __slots__ = ("name", "tags", "record_type", "count", "total", "max",
+                 "_window", "_reg")
+
+    def __init__(self, name: str, reg: "MetricsRegistry",
+                 tags: Optional[dict] = None, record_type: str = "observe"):
+        self.name = name
+        self.tags = tags
+        self.record_type = record_type
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self._window = deque(maxlen=self.WINDOW)
+        self._reg = reg
+
+    def observe(self, value, **extra) -> None:
+        v = float(value)
+        with self._reg._lock:   # stats first, emit after (lock re-entry)
+            self.count += 1
+            self.total += v
+            self.max = max(self.max, v)
+            self._window.append(v)
+        rec = {"type": self.record_type, "name": self.name, "value": v}
+        if self.tags:
+            rec["tags"] = self.tags
+        if extra:
+            rec.update(extra)
+        self._reg._emit(rec)
+
+    def quantile(self, q: float) -> float:
+        with self._reg._lock:   # snapshot: deques hate concurrent append
+            vals = sorted(self._window)
+        if not vals:
+            return 0.0
+        idx = min(len(vals) - 1, max(0, round(q * (len(vals) - 1))))
+        return vals[idx]
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "total": self.total,
+            "mean": self.total / self.count if self.count else 0.0,
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "max": self.max,
+        }
+
+
+class MetricsRegistry:
+    """Process-local registry of named metrics with pluggable sinks.
+
+    Thread-safe for concurrent updates: one lock serializes metric
+    creation, value updates (counter incs, gauge sets, histogram
+    stats) and sink emission — contention only exists when telemetry
+    is on; the disabled fast path never touches it.
+    """
+
+    def __init__(self, sinks=(), tags: Optional[dict] = None,
+                 profiler: bool = False):
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, str], Any] = {}
+        self.sinks = list(sinks)
+        self.tags = dict(tags or {})
+        # Feature flag for the jax.profiler trace-annotation sink:
+        # spans consult it and additionally open a TraceAnnotation.
+        self.profiler = bool(profiler)
+        self._closed = False
+        self._emit({"type": "meta", "tags": self.tags, "pid": os.getpid()})
+
+    # -- emission ----------------------------------------------------------
+
+    def _emit(self, rec: dict) -> None:
+        if not self.sinks:
+            return
+        full = {"schema_version": SCHEMA_VERSION, "t": time.time()}
+        full.update(rec)
+        with self._lock:
+            for sink in self.sinks:
+                sink.emit(full)
+
+    # -- metric accessors (get-or-create) ----------------------------------
+
+    def _get(self, kind: str, name: str, factory):
+        key = (kind, name)
+        m = self._metrics.get(key)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    m = factory()
+                    self._metrics[key] = m
+        return m
+
+    def counter(self, name: str, tags: Optional[dict] = None) -> Counter:
+        return self._get("counter", name,
+                         lambda: Counter(name, self._lock, tags))
+
+    def gauge(self, name: str, tags: Optional[dict] = None) -> Gauge:
+        return self._get("gauge", name, lambda: Gauge(name, self, tags))
+
+    def histogram(self, name: str, tags: Optional[dict] = None,
+                  record_type: str = "observe") -> Histogram:
+        return self._get(
+            f"histogram:{record_type}", name,
+            lambda: Histogram(name, self, tags, record_type=record_type))
+
+    def observe_span(self, name: str, dur_s: float, **extra) -> None:
+        """Record one span duration (seconds) — a ``span``-typed
+        histogram observation; the span API and StepTimer both land
+        here so every timing shares one schema."""
+        self.histogram(name, record_type="span").observe(dur_s, **extra)
+
+    def event(self, name: str, **data) -> None:
+        """One-off structured event (e.g. a loss-scale change)."""
+        self._emit({"type": "event", "name": name, "data": data})
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def summary(self) -> dict:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        for m in metrics:
+            if isinstance(m, Counter):
+                out["counters"][m.name] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][m.name] = m.value
+            elif isinstance(m, Histogram):
+                out["histograms"][m.name] = m.summary()
+        return out
+
+    def flush(self) -> None:
+        """Emit cumulative counter totals, then flush every sink."""
+        with self._lock:
+            counters = [m for m in self._metrics.values()
+                        if isinstance(m, Counter)]
+        for c in counters:
+            rec = {"type": "counter", "name": c.name, "value": c.value}
+            if c.tags:
+                rec["tags"] = c.tags
+            self._emit(rec)
+        with self._lock:
+            for sink in self.sinks:
+                sink.flush()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self.flush()
+        self._closed = True
+        summ = self.summary()
+        with self._lock:
+            for sink in self.sinks:
+                sink.close(summary=summ)
+
+
+# -- module-level fast path ------------------------------------------------
+
+_REGISTRY: Optional[MetricsRegistry] = None
+
+
+def enabled() -> bool:
+    """True when telemetry is configured; the one check every
+    instrumented call site makes."""
+    return _REGISTRY is not None
+
+
+def registry() -> Optional[MetricsRegistry]:
+    return _REGISTRY
+
+
+def counter(name: str, tags: Optional[dict] = None):
+    reg = _REGISTRY
+    return reg.counter(name, tags) if reg is not None else NOOP_METRIC
+
+
+def gauge(name: str, tags: Optional[dict] = None):
+    reg = _REGISTRY
+    return reg.gauge(name, tags) if reg is not None else NOOP_METRIC
+
+
+def histogram(name: str, tags: Optional[dict] = None):
+    reg = _REGISTRY
+    return reg.histogram(name, tags) if reg is not None else NOOP_METRIC
+
+
+def event(name: str, **data) -> None:
+    reg = _REGISTRY
+    if reg is not None:
+        reg.event(name, **data)
+
+
+def _rank_tags() -> dict:
+    """Rank info from the RankInfoFormatter sources, both guarded: a
+    host with no reachable backend (or no parallel_state) gets fewer
+    tags, never an exception."""
+    tags: dict = {}
+    try:
+        import jax
+
+        tags["host"] = int(jax.process_index())
+        tags["num_hosts"] = int(jax.process_count())
+    except Exception:
+        pass
+    try:
+        from apex_tpu.transformer import parallel_state
+
+        if parallel_state.model_parallel_is_initialized():
+            tags["mp_rank"] = str(parallel_state.get_rank_info())
+    except Exception:
+        pass
+    return tags
+
+
+def configure(
+    jsonl_path: Optional[str] = None,
+    stderr_summary: bool = False,
+    profiler: bool = False,
+    tags: Optional[dict] = None,
+    sinks=(),
+) -> MetricsRegistry:
+    """Enable telemetry for this process; returns the live registry.
+
+    - ``jsonl_path``: append records to this JSONL file.
+    - ``stderr_summary``: print a per-metric summary table to stderr at
+      shutdown.
+    - ``profiler``: the ``jax.profiler`` trace-annotation sink flag —
+      spans additionally open a ``TraceAnnotation`` so they show up in
+      xprof traces.
+    - ``sinks``: extra sink objects (``emit``/``flush``/``close``).
+
+    A previously configured registry is shut down (flushed/closed)
+    first, so re-configuration in tests or notebooks is safe.
+    """
+    global _REGISTRY
+    if _REGISTRY is not None:
+        shutdown()
+    from apex_tpu.observability import sinks as sinks_mod
+
+    sink_list = list(sinks)
+    if jsonl_path:
+        sink_list.append(sinks_mod.JsonlSink(jsonl_path))
+    if stderr_summary:
+        sink_list.append(sinks_mod.StderrSummarySink())
+    all_tags = _rank_tags()
+    all_tags.update(tags or {})
+    _REGISTRY = MetricsRegistry(sink_list, tags=all_tags, profiler=profiler)
+    return _REGISTRY
+
+
+def configure_from_env(env=None) -> Optional[MetricsRegistry]:
+    """Configure from the environment, or return None (leaving the
+    no-op fast path in place):
+
+    - ``APEX_TPU_TELEMETRY=<path>``    — JSONL file sink
+    - ``APEX_TPU_TELEMETRY_STDERR=1``  — stderr summary sink
+    - ``APEX_TPU_TELEMETRY_PROFILER=1``— jax.profiler span annotations
+    """
+    env = os.environ if env is None else env
+    path = env.get("APEX_TPU_TELEMETRY")
+    stderr = env.get("APEX_TPU_TELEMETRY_STDERR") == "1"
+    if not path and not stderr:
+        return None
+    return configure(
+        jsonl_path=path or None,
+        stderr_summary=stderr,
+        profiler=env.get("APEX_TPU_TELEMETRY_PROFILER") == "1",
+    )
+
+
+def shutdown() -> None:
+    """Flush + close the registry and restore the no-op fast path."""
+    global _REGISTRY
+    reg, _REGISTRY = _REGISTRY, None
+    if reg is not None:
+        reg.close()
+
+
+atexit.register(shutdown)
+
+
+def record_step_metrics(metrics: dict, prefix: str = "train") -> None:
+    """Record a train step's returned metrics dict at the step boundary.
+
+    This is the host-side half of the host-callback-free contract: the
+    jitted step returns its scalars (loss, loss_scale, grad_norm, ...)
+    and the loop feeds them here.  Scalar floats become gauges
+    ``<prefix>.<key>``; the ``overflow`` flag becomes the counter
+    ``<prefix>.overflow_count``; non-scalars (``aux`` trees) are
+    skipped.  Reading the values forces a device sync — which a loop
+    that logs per step does anyway.  No-op when telemetry is disabled.
+    """
+    reg = _REGISTRY
+    if reg is None:
+        return
+    import numpy as np
+
+    for key, val in metrics.items():
+        if key == "aux":
+            continue
+        try:
+            arr = np.asarray(val)
+        except Exception:
+            continue
+        if arr.size != 1:
+            continue
+        v = arr.reshape(()).item()
+        if key == "overflow" or isinstance(v, bool):
+            reg.counter(f"{prefix}.{key}_count").inc(int(bool(v)))
+        else:
+            reg.gauge(f"{prefix}.{key}").set(float(v))

@@ -1,0 +1,103 @@
+"""Telemetry sinks: where the registry's record stream lands.
+
+Sink protocol (duck-typed): ``emit(record: dict)``, ``flush()``,
+``close(summary: dict | None)``.  Sinks only run when telemetry is
+configured, so their cost is irrelevant to the disabled fast path.
+
+The third sink named by ISSUE 1 — jax.profiler trace annotations — is
+not a record sink: annotations must *wrap* the timed region, so it is
+implemented as the ``profiler=True`` feature flag on the registry,
+consumed by ``observability.spans.span`` (each span opens a
+``jax.profiler.TraceAnnotation`` so xprof traces show the same names as
+the JSONL stream).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Optional
+
+__all__ = ["JsonlSink", "StderrSummarySink"]
+
+
+def _json_default(obj):
+    # numpy scalars / arrays that slipped into event payloads
+    item = getattr(obj, "item", None)
+    if callable(item):
+        try:
+            return item()
+        except Exception:
+            pass
+    return str(obj)
+
+
+class JsonlSink:
+    """Append one JSON object per record to a file.
+
+    Every record is flushed on write: telemetry's main consumer is a
+    post-mortem on a run that may have died mid-step, and the per-line
+    syscall only costs when telemetry is enabled.
+    """
+
+    def __init__(self, path: str):
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self.path = path
+        self._f = open(path, "a")
+
+    def emit(self, record: dict) -> None:
+        self._f.write(
+            json.dumps(record, separators=(",", ":"),
+                       default=_json_default) + "\n")
+        self._f.flush()
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self, summary: Optional[dict] = None) -> None:
+        self._f.flush()
+        self._f.close()
+
+
+class StderrSummarySink:
+    """Print a human-readable per-metric summary table at close.
+
+    Ignores the record stream (the registry aggregates); resolves
+    ``sys.stderr`` at write time so pytest's capture and late stream
+    redirection both see the output.
+    """
+
+    def emit(self, record: dict) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self, summary: Optional[dict] = None) -> None:
+        if not summary:
+            return
+        out = sys.stderr
+        print("== telemetry summary ==", file=out)
+        hists = summary.get("histograms", {})
+        if hists:
+            print(f"{'span/observation':<40} {'count':>7} {'total_s':>10} "
+                  f"{'mean':>10} {'p50':>10} {'p95':>10}", file=out)
+            for name in sorted(hists):
+                s = hists[name]
+                print(f"{name:<40} {s['count']:>7} {s['total']:>10.4g} "
+                      f"{s['mean']:>10.4g} {s['p50']:>10.4g} "
+                      f"{s['p95']:>10.4g}", file=out)
+        counters = summary.get("counters", {})
+        if counters:
+            print(f"{'counter':<40} {'total':>12}", file=out)
+            for name in sorted(counters):
+                print(f"{name:<40} {counters[name]:>12}", file=out)
+        gauges = summary.get("gauges", {})
+        if gauges:
+            print(f"{'gauge':<40} {'last':>12}", file=out)
+            for name in sorted(gauges):
+                v = gauges[name]
+                v = "n/a" if v is None else f"{v:.6g}"
+                print(f"{name:<40} {v:>12}", file=out)

@@ -1,0 +1,178 @@
+"""Spans + StepTimer: the shared timing path for training and benches.
+
+JAX dispatch is asynchronous: ``fn(x)`` returns a future-like array, so
+host wall time between two ``time.perf_counter()`` calls measures
+*dispatch*, not device work.  Two tools here handle that:
+
+- :func:`fence` — block until a value's computation really finished.
+  BENCH_r0x methodology: materialize one scalar through numpy rather
+  than ``jax.block_until_ready`` (which does not actually block on
+  tunneled TPU platforms — see bench.py history).  Every BENCH line
+  ever published by this repo used this fence; :class:`StepTimer`
+  preserves it so numbers stay comparable.
+- :class:`StepTimer` — the steady-state step-timing protocol shared by
+  ``bench.py`` and ``tools/step_breakdown.py``: warmup calls each
+  fenced (absorbing compilation), then ``iters`` back-to-back
+  dispatches with ONE trailing fence, so queue drain amortizes across
+  the timed iterations exactly like prior BENCH_r0x lines.
+
+:func:`span` measures host wall time (enter → exit) and is the right
+tool for host-side phases (data loading, a whole train step including
+its host work, a measurement-campaign stage); pass ``fence_on=`` to
+fence a device value at exit when the span closes over async device
+work.  Never use spans *inside* a jit body — they would measure
+trace-time only; record step-boundary values instead
+(``metrics.record_step_metrics``).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import ContextDecorator
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.observability import metrics as _metrics
+
+__all__ = ["span", "StepTimer", "fence"]
+
+
+def fence(x: Any) -> None:
+    """Block until the computation producing ``x`` has finished.
+
+    Materializes ONE scalar of the first leaf via numpy (the BENCH_r0x
+    fencing semantics — ``jax.block_until_ready`` returns early on
+    tunneled TPU platforms).  Non-scalar leaves are sliced down to one
+    element *on device* first, so fencing a large tensor (a grad tree,
+    a logits array) costs a one-scalar transfer, not a full
+    device-to-host copy inside the timed window — the same recipe as
+    the ad-hoc ``_sync`` helpers this replaced.  Falls back to
+    ``block_until_ready`` for values numpy cannot materialize.
+    """
+    leaves = jax.tree_util.tree_leaves(x)
+    if not leaves:
+        return
+    leaf = leaves[0]
+    try:
+        if getattr(leaf, "ndim", 0) and getattr(leaf, "size", 1):
+            leaf = jnp.ravel(leaf)[0]   # device-side: 1 scalar crosses
+        if getattr(leaf, "size", 1):
+            float(np.asarray(leaf))
+    except (TypeError, ValueError):
+        jax.block_until_ready(leaf)
+
+
+class span(ContextDecorator):
+    """Measure a named region: ``with span("fwd"): ...`` or as a
+    decorator ``@span("fwd")``.
+
+    When telemetry is disabled the context manager is a no-op (no
+    timestamp taken — the fast path).  When enabled it records a
+    ``span`` observation named ``name`` and, if the registry's
+    ``profiler`` feature flag is set, additionally wraps the region in
+    ``jax.profiler.TraceAnnotation`` so xprof shows the same names.
+    """
+
+    def __init__(self, name: str, fence_on: Any = None,
+                 tags: Optional[dict] = None):
+        self.name = name
+        self.tags = tags
+        self._fence_on = fence_on
+        self._t0: Optional[float] = None
+        self._ann = None
+
+    def __enter__(self):
+        reg = _metrics.registry()
+        if reg is None:
+            return self
+        if reg.profiler:
+            try:
+                from jax.profiler import TraceAnnotation
+
+                self._ann = TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._t0 is None:
+            return False
+        if self._fence_on is not None:
+            fence(self._fence_on)
+        dur = time.perf_counter() - self._t0
+        self._t0 = None
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+            self._ann = None
+        reg = _metrics.registry()
+        if reg is not None:
+            extra = {"tags": self.tags} if self.tags else {}
+            reg.observe_span(self.name, dur, **extra)
+        return False
+
+
+class StepTimer:
+    """Steady-state step timing with BENCH_r0x protocol + fencing.
+
+    Two protocols, matching the two call shapes the repo's benches use:
+
+    - :meth:`time` — carry protocol (``bench.py``): ``fn(carry) ->
+      carry`` where ``carry`` is ``None`` on the first call and the
+      returned tuple's LAST element is fenced (by convention the loss).
+      Warmup iterations are fenced individually; the timed iterations
+      dispatch back-to-back with one trailing fence.
+    - :meth:`time_call` — fixed-args protocol
+      (``tools/step_breakdown.py``): ``fn(*args)`` repeatedly; the
+      whole output's first leaf is fenced.
+
+    Both return mean seconds per timed iteration, keep the last output
+    on ``self.last`` (donating steps thread state through the loop),
+    and record a ``step.<name>`` span observation when telemetry is on.
+    """
+
+    def __init__(self, name: str, warmup: int = 2, iters: int = 10,
+                 fence_fn: Callable[[Any], None] = fence):
+        self.name = name
+        self.warmup = warmup
+        self.iters = iters
+        self._fence = fence_fn
+        self.last: Any = None
+
+    def _record(self, avg_s: float) -> None:
+        reg = _metrics.registry()
+        if reg is not None:
+            reg.observe_span(f"step.{self.name}", avg_s,
+                             iters=self.iters, warmup=self.warmup)
+
+    def time(self, fn: Callable[[Any], Any]) -> float:
+        out = None
+        for _ in range(self.warmup):
+            out = fn(out)
+            self._fence(out[-1])
+        t0 = time.perf_counter()
+        for _ in range(self.iters):
+            out = fn(out)
+        self._fence(out[-1])
+        avg = (time.perf_counter() - t0) / self.iters
+        self.last = out
+        self._record(avg)
+        return avg
+
+    def time_call(self, fn: Callable[..., Any], *args) -> float:
+        out = None
+        for _ in range(self.warmup):
+            out = fn(*args)
+            self._fence(out)
+        t0 = time.perf_counter()
+        for _ in range(self.iters):
+            out = fn(*args)
+        self._fence(out)
+        avg = (time.perf_counter() - t0) / self.iters
+        self.last = out
+        self._record(avg)
+        return avg

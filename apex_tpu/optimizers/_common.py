@@ -35,6 +35,11 @@ __all__ = [
     "global_norm",
     "ScheduleOrScalar",
     "resolve_lr",
+    "norm_metrics",
+    "NormTelemetryState",
+    "with_norm_telemetry",
+    "latest_norms",
+    "record_opt_norms",
 ]
 
 
@@ -85,6 +90,87 @@ def global_norm(tree) -> jax.Array:
     return jnp.sqrt(
         sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
     )
+
+
+_NORM_KEYS = ("grad_norm", "update_norm", "param_norm",
+              "update_to_param_ratio")
+
+
+def norm_metrics(grads, updates=None, params=None) -> dict:
+    """Global-norm telemetry scalars for a step's metrics dict.
+
+    Returns fp32 device scalars: ``grad_norm`` always; ``update_norm``
+    / ``param_norm`` when their trees are given; and
+    ``update_to_param_ratio`` (the relative step size, LAMB-trust-ratio
+    flavored) when both are.  OFF by default everywhere it is wired
+    (``amp.frontend.make_train_step(norm_telemetry=...)``,
+    ``fused_adam``/``fused_lamb`` ``norm_telemetry=``): each norm is a
+    full-tree reduction the update would not otherwise pay.
+    """
+    out = {"grad_norm": global_norm(grads)}
+    if updates is not None:
+        out["update_norm"] = global_norm(updates)
+    if params is not None:
+        out["param_norm"] = global_norm(params)
+    if updates is not None and params is not None:
+        out["update_to_param_ratio"] = out["update_norm"] / jnp.maximum(
+            out["param_norm"], 1e-12)
+    return out
+
+
+class NormTelemetryState(NamedTuple):
+    """Optimizer state wrapper carrying the last update's norms as
+    returned aux values — the host-callback-free channel out of jit."""
+
+    inner: Any
+    norms: Any
+
+
+def with_norm_telemetry(tx: GradientTransformation) -> GradientTransformation:
+    """Wrap a transformation so every ``update`` also computes
+    :func:`norm_metrics` and carries them in the state; read them after
+    the step with :func:`latest_norms` / :func:`record_opt_norms`.
+
+    The wrapped ``update`` must receive ``params`` (both fused
+    optimizers require it anyway) so the state keeps a fixed pytree
+    structure across init/update — donation-safe.
+    """
+
+    def init(params):
+        zeros = {k: jnp.zeros((), jnp.float32) for k in _NORM_KEYS}
+        return NormTelemetryState(tx.init(params), zeros)
+
+    def update(grads, state: NormTelemetryState, params=None):
+        updates, inner = tx.update(grads, state.inner, params)
+        norms = norm_metrics(grads, updates, params)
+        for k in _NORM_KEYS:   # fixed structure even if params was None
+            norms.setdefault(k, jnp.zeros((), jnp.float32))
+        return updates, NormTelemetryState(inner, norms)
+
+    return GradientTransformation(init, update)
+
+
+def latest_norms(opt_state):
+    """Host copies of the norms a ``with_norm_telemetry`` state carries
+    (a plain dict of floats), or None for unwrapped states."""
+    if isinstance(opt_state, NormTelemetryState):
+        return {k: float(v) for k, v in
+                jax.device_get(opt_state.norms).items()}
+    return None
+
+
+def record_opt_norms(opt_state, prefix: str = "optim") -> None:
+    """Record :func:`latest_norms` as ``<prefix>.<key>`` gauges.
+    No-op when telemetry is disabled or the state is unwrapped."""
+    from apex_tpu.observability import metrics as _telemetry
+
+    reg = _telemetry.registry()
+    if reg is None:
+        return
+    norms = latest_norms(opt_state)
+    if norms:
+        for k, v in norms.items():
+            reg.gauge(f"{prefix}.{k}").set(v)
 
 
 ScheduleOrScalar = Union[float, jax.Array, Callable[[jax.Array], jax.Array]]

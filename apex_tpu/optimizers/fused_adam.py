@@ -36,6 +36,7 @@ from apex_tpu.optimizers._common import (
     resolve_lr,
     tree_map_float,
     tree_zeros_like_f32,
+    with_norm_telemetry,
 )
 
 __all__ = ["FusedAdam", "fused_adam", "AdamState"]
@@ -56,7 +57,13 @@ def fused_adam(
     bias_correction: bool = True,
     amsgrad: bool = False,
     use_pallas: bool = False,
+    norm_telemetry: bool = False,
 ) -> GradientTransformation:
+    """``norm_telemetry=True`` wraps the transformation with
+    ``_common.with_norm_telemetry``: the state additionally carries the
+    last step's global grad/update/param norms for host-side recording
+    (``record_opt_norms``).  Off by default — it adds full-tree
+    reductions to the update."""
     if amsgrad:
         raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
     beta1, beta2 = betas
@@ -116,7 +123,8 @@ def fused_adam(
         updates = tree_map_float(upd_leaf, m_tree, v_tree, params)
         return updates, AdamState(step, m_tree, v_tree)
 
-    return GradientTransformation(init, update)
+    tx = GradientTransformation(init, update)
+    return with_norm_telemetry(tx) if norm_telemetry else tx
 
 
 # Drop-in-named alias: `FusedAdam(lr=...)` reads like the reference ctor.

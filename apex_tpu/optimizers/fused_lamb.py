@@ -28,6 +28,7 @@ from apex_tpu.optimizers._common import (
     resolve_lr,
     tree_map_float,
     tree_zeros_like_f32,
+    with_norm_telemetry,
 )
 
 __all__ = ["FusedLAMB", "fused_lamb", "LambState"]
@@ -49,7 +50,11 @@ def fused_lamb(
     grad_averaging: bool = True,
     max_grad_norm: float = 1.0,
     use_nvlamb: bool = False,
+    norm_telemetry: bool = False,
 ) -> GradientTransformation:
+    """``norm_telemetry=True``: see ``fused_adam`` — the state carries
+    the last step's global norms for ``record_opt_norms``; off by
+    default (extra full-tree reductions)."""
     beta1, beta2 = betas
 
     def init(params) -> LambState:
@@ -115,7 +120,8 @@ def fused_lamb(
         updates = tree_map_float(upd_leaf, m_tree, v_tree, params)
         return updates, LambState(step, m_tree, v_tree)
 
-    return GradientTransformation(init, update)
+    tx = GradientTransformation(init, update)
+    return with_norm_telemetry(tx) if norm_telemetry else tx
 
 
 FusedLAMB = fused_lamb

@@ -14,6 +14,8 @@ from typing import Dict
 
 import jax
 
+from apex_tpu.observability import metrics as _telemetry
+
 __all__ = ["Timer", "Timers", "get_timers"]
 
 
@@ -37,8 +39,14 @@ class Timer:
             raise RuntimeError(f"timer {self.name_} is not started")
         if barrier_obj is not None:
             jax.block_until_ready(barrier_obj)
-        self.elapsed_ += time.perf_counter() - self._start_time
+        dur = time.perf_counter() - self._start_time
+        self.elapsed_ += dur
         self.started_ = False
+        # converge on the shared registry: each start/stop interval is a
+        # span observation (no-op when telemetry is disabled)
+        reg = _telemetry.registry()
+        if reg is not None:
+            reg.observe_span(f"pipeline.timer.{self.name_}", dur)
 
     def reset(self):
         self.elapsed_ = 0.0

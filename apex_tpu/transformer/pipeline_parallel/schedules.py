@@ -46,6 +46,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.observability import metrics as _telemetry
 from apex_tpu.transformer.parallel_state import PP_AXIS
 from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
     send_forward_recv_forward,
@@ -58,7 +59,36 @@ __all__ = [
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_with_interleaving",
     "pipeline_forward",
+    "record_schedule_telemetry",
 ]
+
+
+def record_schedule_telemetry(schedule: str, *, n_micro: int,
+                              n_stages: int, ticks: int) -> None:
+    """Analytic per-microbatch bubble/stall accounting for a pipeline
+    schedule invocation.
+
+    The scan-based schedules are fully determined by their geometry:
+    stage (or chunk) ``s`` processes microbatch ``m`` at tick
+    ``t = m + s``, so every stage computes for exactly ``n_micro`` of
+    the ``ticks`` scan steps and idles (zero-packet ticks) for the
+    remaining ``ticks - n_micro`` — the fill/drain bubble.  Recorded as
+    gauges under ``pipeline.<schedule>.*`` plus an invocation counter.
+
+    Host-side and trace-time only (the geometry is static); one
+    enabled() check when telemetry is off.
+    """
+    reg = _telemetry.registry()
+    if reg is None:
+        return
+    bubble = ticks - n_micro
+    reg.counter(f"pipeline.{schedule}.invocations").inc()
+    reg.gauge(f"pipeline.{schedule}.n_micro").set(n_micro)
+    reg.gauge(f"pipeline.{schedule}.stages").set(n_stages)
+    reg.gauge(f"pipeline.{schedule}.ticks").set(ticks)
+    reg.gauge(f"pipeline.{schedule}.bubble_ticks_per_stage").set(bubble)
+    reg.gauge(f"pipeline.{schedule}.bubble_fraction").set(
+        bubble / ticks if ticks else 0.0)
 
 
 def _default_loss(out, _mb):
@@ -151,6 +181,8 @@ def pipeline_forward(
     pp = jax.lax.axis_size(axis)
     my = jax.lax.axis_index(axis)
     ticks = n_micro + pp - 1
+    record_schedule_telemetry("1f1b", n_micro=n_micro, n_stages=pp,
+                              ticks=ticks)
 
     x0 = jax.tree_util.tree_map(lambda v: v[0], microbatches)
     zero_like = _zeros_like_output(stage_fn, stage_params, x0)
@@ -248,6 +280,8 @@ def forward_backward_pipelining_with_interleaving(
     vpp = num_model_chunks
     n_chunks = pp * vpp
     ticks = n_micro + n_chunks - 1
+    record_schedule_telemetry("interleaved", n_micro=n_micro,
+                              n_stages=n_chunks, ticks=ticks)
     stage = jax.checkpoint(forward_step_func) if remat else forward_step_func
 
     def total_loss(params_stacked):

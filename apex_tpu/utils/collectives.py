@@ -13,10 +13,13 @@ shard-varying, and a plain division when SPMD-AD has pre-summed.
 
 from __future__ import annotations
 
+import math
 from typing import Any
 
 import jax
 import jax.numpy as jnp
+
+from apex_tpu.observability import metrics as _telemetry
 
 __all__ = [
     "is_varying",
@@ -28,6 +31,28 @@ __all__ = [
     "pvary",
     "vma_of",
 ]
+
+
+def _note_collective(kind: str, x) -> None:
+    """Count a collective about to be emitted: ``collectives.<kind>.calls``
+    and ``collectives.<kind>.bytes`` (abstract shape x itemsize).
+
+    Trace-time accounting — these helpers run while the enclosing
+    jit/shard_map traces, so counts are per collective *emitted into
+    the compiled program* (once per trace), not per executed step;
+    host-callback-free by construction.  One enabled() check when
+    telemetry is off.
+    """
+    reg = _telemetry.registry()
+    if reg is None:
+        return
+    dtype = getattr(x, "dtype", None)
+    nbytes = 0
+    if dtype is not None:
+        nbytes = int(math.prod(getattr(x, "shape", ()) or ())
+                     ) * dtype.itemsize
+    reg.counter(f"collectives.{kind}.calls").inc()
+    reg.counter(f"collectives.{kind}.bytes").inc(nbytes)
 
 
 def pvary(tree, axis_name: str):
@@ -91,6 +116,7 @@ def grad_sum(tree: Any, axis_name: str) -> Any:
         if not hasattr(g, "dtype") or not jnp.issubdtype(g.dtype, jnp.inexact):
             return g
         if is_varying(g, axis_name):
+            _note_collective("psum", g)
             return jax.lax.psum(g, axis_name)
         return g
 
@@ -105,6 +131,7 @@ def grad_mean(tree: Any, axis_name: str) -> Any:
         if not hasattr(g, "dtype") or not jnp.issubdtype(g.dtype, jnp.inexact):
             return g
         if is_varying(g, axis_name):
+            _note_collective("pmean", g)
             return jax.lax.pmean(g, axis_name)
         return g / n
 
@@ -114,11 +141,13 @@ def grad_mean(tree: Any, axis_name: str) -> Any:
 def flag_and(flag, axis_name: str):
     """AND a boolean flag across shards (found-inf combining)."""
     if is_varying(flag, axis_name):
+        _note_collective("pmin", flag)
         return jax.lax.pmin(flag.astype(jnp.int32), axis_name) > 0
     return flag
 
 
 def flag_or(flag, axis_name: str):
     if is_varying(flag, axis_name):
+        _note_collective("pmax", flag)
         return jax.lax.pmax(flag.astype(jnp.int32), axis_name) > 0
     return flag

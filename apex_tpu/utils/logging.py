@@ -69,8 +69,18 @@ def set_logging_level(level) -> None:
 
 
 def print_rank_0(message: str) -> None:
-    """Print only on process 0 (reference pipeline_parallel/utils.py:159)."""
-    import jax
+    """Print only on process 0 (reference pipeline_parallel/utils.py:159).
 
-    if jax.process_index() == 0:
+    Guarded the way ``RankInfoFormatter.format`` already is: with no
+    reachable JAX backend (``jax.process_index`` raising mid-init or on
+    a dead tunnel) this degrades to printing instead of raising from
+    inside a log call.
+    """
+    try:
+        import jax
+
+        rank = jax.process_index()
+    except Exception:
+        rank = 0
+    if rank == 0:
         print(message, flush=True)

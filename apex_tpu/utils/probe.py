@@ -47,7 +47,7 @@ def _cache_ttl() -> float:
         return 0.0
 
 
-def _cache_get(expr: str):
+def _cache_get(expr: str, validate=None):
     ttl = _cache_ttl()
     if ttl <= 0:
         return _MISS
@@ -59,7 +59,14 @@ def _cache_get(expr: str):
                 and isinstance(entry.get("t"), (int, float))
                 and isinstance(entry.get("val"), (str, type(None)))
                 and time.time() - entry["t"] <= ttl):
-            return entry["val"]   # may be None: a cached outage verdict
+            val = entry["val"]   # may be None: a cached outage verdict
+            if (isinstance(val, str) and validate is not None
+                    and not validate(val)):
+                # corrupted/foreign entry: a value the caller cannot
+                # parse must read as a cache MISS (re-probe), not wedge
+                # the gates on garbage for a whole TTL
+                return _MISS
+            return val
     except (OSError, ValueError, KeyError, TypeError):
         pass
     return _MISS
@@ -86,7 +93,8 @@ def _cache_put(expr: str, val: Optional[str]) -> None:
 
 
 def probe_jax(expr: str, timeout_s: int = 45,
-              label: str = "jax backend probe") -> Optional[str]:
+              label: str = "jax backend probe",
+              validate=None) -> Optional[str]:
     """Evaluate ``expr`` (a Python expression over an imported ``jax``)
     in a subprocess; return its str() result, or None on failure.
 
@@ -94,8 +102,13 @@ def probe_jax(expr: str, timeout_s: int = 45,
     ``label`` so a healthy-host misconfiguration does not silently read
     as an outage.  Results (including failures) are shared across
     processes for a short TTL via a temp-file cache — see the module
-    docstring."""
-    cached = _cache_get(expr)
+    docstring.
+
+    ``validate``: optional predicate on the result string.  A *cached*
+    value failing it is treated as a miss (re-probe, don't trust a
+    corrupted cache file); a *fresh* value failing it is treated as a
+    probe failure (printed, cached as None)."""
+    cached = _cache_get(expr, validate)
     if cached is not _MISS:
         print(f"[{label}] using cached probe result "
               f"(APEX_TPU_PROBE_CACHE_TTL={_cache_ttl():g}s): "
@@ -114,6 +127,11 @@ def probe_jax(expr: str, timeout_s: int = 45,
     for line in out.stdout.splitlines():
         if line.startswith("PROBE="):
             val = line.split("=", 1)[1]
+            if validate is not None and not validate(val):
+                print(f"[{label}] unparseable probe result {val!r}; "
+                      "treating as unreachable", flush=True)
+                _cache_put(expr, None)
+                return None
             _cache_put(expr, val)
             return val
     tail = (out.stderr or out.stdout).strip()[-400:]
@@ -122,22 +140,32 @@ def probe_jax(expr: str, timeout_s: int = 45,
     return None
 
 
+def _parse_backend_info(val: str):
+    """Parse ``platform:count`` or return None for anything else —
+    empty counts (``"cpu:"``), non-numeric counts, colon-less strings."""
+    platform, sep, count = val.partition(":")
+    if not sep or not platform or not (count.isascii() and count.isdigit()):
+        return None
+    return platform, int(count)
+
+
 def probe_backend_info(timeout_s: int = 45, label: str = "backend probe"):
     """(platform, device_count) via ONE probed expression, or None.
 
     Both gates (bench.py backend check, dryrun device count) call this
     so a single cached verdict serves the whole driver invocation — two
-    distinct expressions would each pay the outage timeout."""
+    distinct expressions would each pay the outage timeout.  Malformed
+    values (a corrupted cache entry like ``"cpu:"``) are rejected at the
+    cache layer (re-probe) and, on a fresh probe, degrade to None
+    instead of crashing the gates."""
     got = probe_jax("jax.devices()[0].platform + ':' + str(len("
-                    "jax.devices()))", timeout_s, label=label)
+                    "jax.devices()))", timeout_s, label=label,
+                    validate=lambda v: _parse_backend_info(v) is not None)
     if got is None:
         return None
-    try:
-        platform, _, count = got.partition(":")
-        return platform, int(count)
-    except ValueError:
-        # malformed (e.g. corrupted cache entry): the gates built to
-        # degrade through outages must not crash on it
+    parsed = _parse_backend_info(got)
+    if parsed is None:   # unreachable given validate=; belt and braces
         print(f"[{label}] unparseable probe result {got!r}; "
               "treating as unreachable", flush=True)
         return None
+    return parsed

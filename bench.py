@@ -19,13 +19,15 @@ unit and (where meaningful) MFU against the chip's bf16 peak:
 - ``mlp_fused_adam`` — fused-vs-unfused optimizer step ratio (the
                        FusedAdam north-star: examples/simple analog)
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"details"}.
+Prints ONE JSON line: {"schema_version", "metric", "value", "unit",
+"vs_baseline", "details"}.  All rows are timed through the shared
+``observability.StepTimer`` (docs/observability.md documents the
+fencing semantics); set ``APEX_TPU_TELEMETRY=<path>.jsonl`` to stream
+per-row span records too.
 """
 
 import dataclasses
 import json
-import time
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +36,8 @@ import numpy as np
 from apex_tpu.models.config import bert_large, gpt_125m
 from apex_tpu.models.bert import make_bert_train_step
 from apex_tpu.models.gpt import make_gpt_train_step
+from apex_tpu.observability import (
+    SCHEMA_VERSION, StepTimer, configure_from_env)
 from apex_tpu.optimizers import fused_adam, fused_lamb
 
 
@@ -66,22 +70,15 @@ def _param_count(tree) -> int:
         if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating))
 
 
-def _sync(x):
-    # NB: sync via scalar materialization, not jax.block_until_ready — the
-    # latter does not actually block on tunneled TPU platforms.
-    float(np.asarray(x).reshape(-1)[0])
-
-
-def _time_fn(fn, n_warmup=2, iters=10):
-    out = None
-    for _ in range(n_warmup):
-        out = fn(out)
-        _sync(out[-1])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(out)
-    _sync(out[-1])
-    return (time.perf_counter() - t0) / iters
+def _time_fn(fn, n_warmup=2, iters=10, name="bench_row"):
+    # The shared measurement path (ISSUE 1): observability.StepTimer
+    # implements this exact protocol — per-warmup fencing, one trailing
+    # fence across the timed iterations, and the scalar-materialization
+    # fence (jax.block_until_ready does not actually block on tunneled
+    # TPU platforms) — so headline numbers stay comparable to every
+    # prior BENCH_r0x line while also landing in the telemetry stream
+    # as `step.<name>` spans when APEX_TPU_TELEMETRY is set.
+    return StepTimer(name, warmup=n_warmup, iters=iters).time(fn)
 
 
 def bench_gpt(on_tpu, size="125m", query_groups=None, baseline=True):
@@ -134,7 +131,7 @@ def bench_gpt(on_tpu, size="125m", query_groups=None, baseline=True):
         s, m = step(s, tokens, labels)
         return s, m["loss"]
 
-    fused_s = _time_fn(one, iters=iters)
+    fused_s = _time_fn(one, iters=iters, name="gpt2")
     del state
 
     base_s = None
@@ -150,7 +147,8 @@ def bench_gpt(on_tpu, size="125m", query_groups=None, baseline=True):
             s, m = step0(s, tokens, labels)
             return s, m["loss"]
 
-        base_s = _time_fn(one0, iters=max(2, iters // 2))
+        base_s = _time_fn(one0, iters=max(2, iters // 2),
+                          name="gpt2_fp32_unfused")
         del state0
 
     tokens_per_s = batch * seq / fused_s
@@ -195,7 +193,7 @@ def bench_gpt_longctx(on_tpu):
         s, m = step(s, tokens, labels)
         return s, m["loss"]
 
-    sec = _time_fn(one, iters=iters)
+    sec = _time_fn(one, iters=iters, name="gpt2_longctx")
     tokens_per_s = batch * seq / sec
     flops_per_tok = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
     mfu = tokens_per_s * flops_per_tok / _chip_peak_flops()
@@ -254,7 +252,7 @@ def bench_longctx_cp_compare(on_tpu, batch=2, seq=8192, iters=4):
                 s, m = step(s, tokens, labels)
                 return s, m["loss"]
 
-            sec = _time_fn(one, iters=iters)
+            sec = _time_fn(one, iters=iters, name=f"cp_{mode}")
             out[mode] = {
                 "step_ms": round(sec * 1e3, 2),
                 "tokens_per_sec": round(batch * seq / sec, 1),
@@ -298,7 +296,8 @@ def bench_decode(on_tpu, query_groups=None):
         out = generate(params, tokens, cfg, max_new_tokens=new)
         return (out, out)
 
-    sec = _time_fn(run, n_warmup=1, iters=5 if on_tpu else 2)
+    sec = _time_fn(run, n_warmup=1, iters=5 if on_tpu else 2,
+                   name="decode")
     # generate() feeds the prompt through the same per-token cached
     # decode loop (one position per step), so the honest denominator is
     # every decoded step, not just the new tokens
@@ -342,7 +341,7 @@ def bench_resnet50(on_tpu):
         s, st, m = step(s, st, images, labels)
         return s, st, m["loss"]
 
-    sec = _time_fn(one, iters=iters)
+    sec = _time_fn(one, iters=iters, name="resnet50")
     imgs_per_s = batch / sec
     # RN50 train ≈ 3 × fwd (4.1 GFLOP/img at 224²) — standard accounting
     mfu = (imgs_per_s * 3 * 4.1e9 / _chip_peak_flops()) if on_tpu else 0.0
@@ -387,7 +386,7 @@ def bench_bert(on_tpu, seq=512):
         s, m = step(s, tokens, mlm, nsp, tt, mask)
         return s, m["loss"]
 
-    sec = _time_fn(one, iters=iters)
+    sec = _time_fn(one, iters=iters, name="bert_large")
     tokens_per_s = batch * seq / sec
     flops_per_tok = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
     mfu = tokens_per_s * flops_per_tok / _chip_peak_flops()
@@ -430,7 +429,7 @@ def bench_transducer(on_tpu):
         l, ww = train(f, g, ww)
         return l, ww
 
-    sec = _time_fn(one, iters=iters)
+    sec = _time_fn(one, iters=iters, name="transducer")
     return {
         "steps_per_sec": round(1.0 / sec, 2),
         "step_ms": round(sec * 1e3, 2),
@@ -470,7 +469,7 @@ def bench_gpt_moe(on_tpu):
         s, m = step(s, tokens, labels)
         return s, m["loss"]
 
-    sec = _time_fn(one, iters=iters)
+    sec = _time_fn(one, iters=iters, name="gpt_moe")
     return {
         "tokens_per_sec_per_chip": round(batch * seq / sec, 1),
         "step_ms": round(sec * 1e3, 2),
@@ -512,7 +511,8 @@ def bench_mlp_adam(on_tpu):
             s, m = step(s, x)
             return s, m["loss"]
 
-        results[name] = _time_fn(one, iters=20 if on_tpu else 2)
+        results[name] = _time_fn(one, iters=20 if on_tpu else 2,
+                                 name=f"mlp_adam_{name}")
     return {
         "fused_step_ms": round(results["fused"] * 1e3, 3),
         "unfused_step_ms": round(results["unfused"] * 1e3, 3),
@@ -544,6 +544,7 @@ def _probe_backend(timeout_s: int = 45):
     platform = None if info is None else info[0]
     if platform is None:
         print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
             "metric": _HEADLINE,
             "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
             "skipped": "no tpu backend (probe failed or timed out; "
@@ -553,6 +554,9 @@ def _probe_backend(timeout_s: int = 45):
 
 
 def main():
+    # APEX_TPU_TELEMETRY=<path> streams every row's StepTimer span into
+    # the shared JSONL schema alongside the headline JSON line
+    configure_from_env()
     platform = _probe_backend()
     if platform is None:
         return
@@ -581,6 +585,7 @@ def main():
 
     gpt = details.get("gpt2_125m", {})
     print(json.dumps({
+        "schema_version": SCHEMA_VERSION,
         "metric": _HEADLINE,
         "value": gpt.get("tokens_per_sec_per_chip", 0.0),
         "unit": "tokens/s",

@@ -111,6 +111,15 @@ MODULES = [
     ("apex_tpu.contrib.peer_memory", "contrib",
      "contrib.peer_memory — halo exchange"),
     ("apex_tpu.contrib.bottleneck", "contrib", "contrib.bottleneck"),
+    # observability
+    ("apex_tpu.observability", "observability",
+     "apex_tpu.observability — telemetry"),
+    ("apex_tpu.observability.metrics", "observability",
+     "observability.metrics — registry, counters/gauges/histograms"),
+    ("apex_tpu.observability.spans", "observability",
+     "observability.spans — span API + StepTimer"),
+    ("apex_tpu.observability.sinks", "observability",
+     "observability.sinks — JSONL / stderr-summary sinks"),
     # misc
     ("apex_tpu.normalization", "misc", "apex_tpu.normalization"),
     ("apex_tpu.fused_dense", "misc", "apex_tpu.fused_dense"),

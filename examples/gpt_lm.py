@@ -12,7 +12,11 @@ it afterwards —
 - background-thread prefetch of random crops from the memory-mapped
   corpus;
 - checkpoint save/resume (utils/checkpoint.py);
-- KV-cache generation (models/generate.py) prints a sample at the end.
+- KV-cache generation (models/generate.py) prints a sample at the end;
+- optional telemetry (``--telemetry out.jsonl``): per-step spans plus
+  loss-scale / loss / grad-norm gauges in the shared JSONL schema —
+  summarize with ``python tools/telemetry_report.py out.jsonl``
+  (docs/observability.md).
 
 Run:   python examples/gpt_lm.py --data my.txt --steps 200
 """
@@ -24,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu import observability as obs
+from apex_tpu.amp.scaler import record_scaler_step
 from apex_tpu.data import device_prefetch
 from apex_tpu.models.config import TransformerConfig
 from apex_tpu.models.generate import generate
@@ -63,7 +69,14 @@ def main():
     ap.add_argument("--top-p", type=float, default=None,
                     help="nucleus sampling mass (composes with --top-k)")
     ap.add_argument("--temperature", type=float, default=0.8)
+    ap.add_argument("--telemetry", default=None, metavar="PATH",
+                    help="write telemetry JSONL here (also enables "
+                         "per-step grad-norm metrics)")
     args = ap.parse_args()
+
+    telemetry = args.telemetry is not None
+    if telemetry:
+        obs.configure(jsonl_path=args.telemetry, stderr_summary=True)
 
     data = np.frombuffer(open(args.data, "rb").read(), np.uint8)
     if len(data) < args.seq + 2:
@@ -80,7 +93,8 @@ def main():
         fused_head_ce=True, head_ce_chunk=1024,
         compute_dtype=jnp.bfloat16)
     init, step = make_gpt_train_step(cfg, fused_adam(lr=args.lr),
-                                     args.opt_level)
+                                     args.opt_level,
+                                     norm_telemetry=telemetry)
     state = init(jax.random.PRNGKey(0))
 
     start = 0
@@ -96,11 +110,22 @@ def main():
     m = None
     for i in range(start, args.steps):
         tok, lab = next(stream)
-        state, m = step(state, tok, lab)
+        with obs.span("train_step"):
+            state, m = step(state, tok, lab)
+            # dispatch is async: fence inside the span so it measures
+            # the step, not the microseconds of queueing it
+            obs.fence(m["loss"])
+        if telemetry:
+            # host-side at the step boundary: loss-scale gauge +
+            # overflow counters + train.* gauges (incl. grad_norm)
+            record_scaler_step(m)
+            obs.record_step_metrics(m)
         if (i + 1) % 50 == 0:
             print(f"step {i + 1}: loss {float(m['loss']):.4f}")
     loss = float(m["loss"]) if m is not None else float("nan")
     dt = time.perf_counter() - t0
+    if telemetry:
+        obs.shutdown()   # flush counters + print the summary table
     tps = (args.steps - start) * args.batch * args.seq / max(dt, 1e-9)
     print(f"final loss {loss:.4f}  ({tps:,.0f} tokens/s)")
 

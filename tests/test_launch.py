@@ -167,10 +167,13 @@ class TestProbeJax:
         monkeypatch.setattr(sp, "run", boom)
         assert probe.probe_backend_info(timeout_s=120) == (platform, count)
 
-    def test_probe_backend_info_malformed_cache_degrades(self, monkeypatch,
+    def test_probe_backend_info_malformed_cache_reprobes(self, monkeypatch,
                                                          tmp_path, capsys):
-        """A corrupted/foreign cache entry must read as unreachable, not
-        crash the outage-degradation gates."""
+        """ISSUE 1 satellite: a corrupted/foreign cache entry (empty
+        count like ``"cpu:"``, non-numeric count, colon-less garbage) is
+        REJECTED at the cache layer — a fresh probe runs instead of the
+        gates trusting garbage (or reading healthy hosts as unreachable)
+        for a whole TTL."""
         import json as _json
         import time as _time
 
@@ -179,12 +182,17 @@ class TestProbeJax:
         path = tmp_path / "cache.json"
         expr = ("jax.devices()[0].platform + ':' + str(len("
                 "jax.devices()))")
-        path.write_text(_json.dumps(
-            {expr: {"t": _time.time(), "val": "cpu:not_a_number"}}))
+        monkeypatch.delenv("PYTHONPATH", raising=False)
         monkeypatch.setattr(probe, "_CACHE_PATH", str(path))
         monkeypatch.setenv("APEX_TPU_PROBE_CACHE_TTL", "300")
-        assert probe.probe_backend_info(timeout_s=120) is None
-        assert "unparseable" in capsys.readouterr().out
+        for bad in ("cpu:not_a_number", "cpu:", "garbage", ":8"):
+            path.write_text(_json.dumps(
+                {expr: {"t": _time.time(), "val": bad}}))
+            got = probe.probe_backend_info(timeout_s=120)
+            assert got is not None and got[0] == "cpu", bad
+            # the re-probe replaced the malformed entry with a valid one
+            assert probe._parse_backend_info(
+                _json.loads(path.read_text())[expr]["val"]) is not None
         # wrong-type entries are ignored entirely (cache miss, no crash)
         path.write_text(_json.dumps({expr: {"t": "yesterday", "val": 7}}))
         assert probe._cache_get(expr) is probe._MISS
@@ -194,6 +202,34 @@ class TestProbeJax:
         assert probe._cache_get(expr) is probe._MISS
         probe._cache_put(expr, "cpu:1")
         assert probe._cache_get(expr) == "cpu:1"
+
+    def test_probe_backend_info_fresh_malformed_result(self, monkeypatch,
+                                                       capsys):
+        """A FRESH probe answer that does not parse degrades to None
+        (printed + cached as an outage verdict), never a ValueError out
+        of the gates."""
+        import subprocess as sp
+        import types
+
+        import apex_tpu.utils.probe as probe
+
+        monkeypatch.setenv("APEX_TPU_PROBE_CACHE_TTL", "0")
+        for bad in ("cpu:", "cpu:eight", "no_colon_here"):
+            monkeypatch.setattr(
+                sp, "run",
+                lambda *a, bad=bad, **kw: types.SimpleNamespace(
+                    stdout=f"PROBE={bad}\n", stderr="", returncode=0))
+            assert probe.probe_backend_info(timeout_s=5) is None
+            out = capsys.readouterr().out
+            assert "unparseable" in out and repr(bad)[1:-1] in out
+
+    def test_parse_backend_info(self):
+        from apex_tpu.utils.probe import _parse_backend_info
+
+        assert _parse_backend_info("cpu:8") == ("cpu", 8)
+        assert _parse_backend_info("tpu:1") == ("tpu", 1)
+        for bad in ("cpu:", "cpu", ":8", "cpu:x", "", "cpu:１"):
+            assert _parse_backend_info(bad) is None, bad
 
     def test_probe_cache_shares_verdicts(self, monkeypatch, tmp_path,
                                          capsys):

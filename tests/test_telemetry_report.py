@@ -1,0 +1,86 @@
+"""tools/telemetry_report.py over a fixture stream (ISSUE 1 satellite).
+
+The report tool is the downstream consumer the JSONL schema_version
+field exists for, so this tier-1 test pins: exact p50/p95 over a known
+span distribution, cumulative-counter semantics (last flush value per
+file), garbage-line tolerance, and the newer-schema warning.
+"""
+
+import importlib.util
+import io
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "data", "telemetry_fixture.jsonl")
+
+
+@pytest.fixture(scope="module")
+def report():
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(REPO, "tools",
+                                         "telemetry_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_summarize_fixture(report):
+    out = io.StringIO()
+    records = report.load_records([FIXTURE], out=out)
+    assert "unparseable line skipped" in out.getvalue()
+    summ = report.summarize(records)
+    assert summ["spans"]["step.bench"] == [0.1, 0.2, 0.3, 0.4, 0.5]
+    # last cumulative flush wins, not the sum of flush records
+    assert summ["counters"]["collectives.psum.calls"] == 5
+    assert summ["gauges"]["amp.loss_scale"] == [65536.0, 32768.0]
+    assert summ["events"]["amp.loss_scale_change"] == 1
+    assert summ["unknown_schema"] == [99]
+
+
+def test_print_report_table(report):
+    out = io.StringIO()
+    summ = report.summarize(report.load_records([FIXTURE], out=out))
+    report.print_report(summ, out=out)
+    text = out.getvalue()
+    assert "step.bench" in text
+    # p50 of [.1 .2 .3 .4 .5] is .3, p95 is .5 (nearest-rank)
+    line = next(ln for ln in text.splitlines() if "step.bench" in ln)
+    assert "0.3" in line and "0.5" in line
+    assert "amp.loss_scale" in text
+    assert "newer schema_version" in text and "99" in text
+
+
+def test_multi_file_counter_aggregation(report, tmp_path):
+    """Two ranks' files each contribute their own last cumulative
+    total; the report sums across files."""
+    a = tmp_path / "rank0.jsonl"
+    b = tmp_path / "rank1.jsonl"
+    a.write_text('{"schema_version":1,"t":1,"type":"counter",'
+                 '"name":"c","value":3}\n')
+    b.write_text('{"schema_version":1,"t":1,"type":"counter",'
+                 '"name":"c","value":4}\n')
+    summ = report.summarize(report.load_records([str(a), str(b)]))
+    assert summ["counters"]["c"] == 7
+
+
+def test_appended_runs_in_one_file_sum_counters(report, tmp_path):
+    """The JSONL sink appends: two runs into one path each open with a
+    meta record and restart counters at zero — the report must sum the
+    per-run totals, not keep only the last run's."""
+    f = tmp_path / "appended.jsonl"
+    f.write_text(
+        '{"schema_version":1,"t":1,"type":"meta","tags":{},"pid":1}\n'
+        '{"schema_version":1,"t":2,"type":"counter","name":"c","value":3}\n'
+        '{"schema_version":1,"t":3,"type":"meta","tags":{},"pid":2}\n'
+        '{"schema_version":1,"t":4,"type":"counter","name":"c","value":2}\n'
+        '{"schema_version":1,"t":5,"type":"counter","name":"c","value":4}\n')
+    summ = report.summarize(report.load_records([str(f)]))
+    # run 1 total 3 + run 2 last flush 4 (intermediate 2 superseded)
+    assert summ["counters"]["c"] == 7
+
+
+def test_main_exit_code(report, capsys):
+    assert report.main([FIXTURE]) == 0
+    assert "step.bench" in capsys.readouterr().out

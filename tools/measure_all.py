@@ -50,6 +50,8 @@ def _run(name, cmd, env_extra=None, timeout=7200, stall=900):
     log bytes).  The round-5 first-contact run hung 30+ minutes on a
     wedged tunnel RPC with zero output — a plain subprocess timeout of
     2 h would have burned the rest of the chip window."""
+    from apex_tpu.observability import span
+
     os.makedirs(LOGS, exist_ok=True)
     log = os.path.join(LOGS, f"{name}.log")
     env = dict(os.environ)
@@ -59,7 +61,7 @@ def _run(name, cmd, env_extra=None, timeout=7200, stall=900):
     t0 = time.time()
     print(f"[measure_all] {name}: {' '.join(cmd)} (log: {log})",
           flush=True)
-    with open(log, "w") as f:
+    with span(f"stage.{name}"), open(log, "w") as f:
         proc = subprocess.Popen(cmd, cwd=ROOT, env=env, stdout=f,
                                 stderr=subprocess.STDOUT)
         last_size, last_change = 0, time.time()
@@ -98,6 +100,15 @@ def main():
               "needs the chip — aborting without touching artifacts")
         return 1
     print(f"[measure_all] TPU up: {info[1]} device(s). Campaign start.")
+    # Per-stage wall times land in the shared telemetry schema (spans
+    # around each stage) next to the stage logs; summarize afterwards
+    # with tools/telemetry_report.py.
+    from apex_tpu.observability import configure
+
+    os.makedirs(LOGS, exist_ok=True)
+    telemetry_path = os.path.join(LOGS, "telemetry.jsonl")
+    configure(jsonl_path=telemetry_path, stderr_summary=True)
+    print(f"[measure_all] telemetry: {telemetry_path}")
     # Value-first ordering (learned from the round-5 first contact,
     # where the tunnel wedged 25 minutes in): the headline workload
     # matrix and the Mosaic-validation tier run BEFORE the long kernel
@@ -153,6 +164,9 @@ def main():
         print("[measure_all] then: update BASELINE.md ledger + "
               "KERNEL_BENCH rows, re-run bench.py for BENCH_r05 if "
               "defaults moved.")
+    from apex_tpu.observability import shutdown
+
+    shutdown()   # flush stage spans + print the stderr summary table
     return 1 if any(rc != 0 for rc in results.values()) else 0
 
 

@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-import time
 
 import jax
 import jax.numpy as jnp
@@ -35,25 +34,18 @@ from apex_tpu.models.gpt import make_gpt_train_step
 from apex_tpu.models.transformer_lm import (
     gpt_loss, init_gpt_params, lm_head_weight, single_device_ctx,
     transformer_backbone)
+from apex_tpu.observability import StepTimer, configure_from_env
 from apex_tpu.optimizers import fused_adam
 
 _PEAK_FLOPS = 197e12      # v5e bf16 dense
 _PEAK_BYTES = 819e9       # v5e HBM GB/s
 
 
-def _sync(x):
-    leaf = jax.tree_util.tree_leaves(x)[0]
-    float(np.asarray(jnp.ravel(leaf)[0]))
-
-
-def timeit(fn, *args, iters=10):
-    out = fn(*args)
-    _sync(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    _sync(out)
-    return (time.perf_counter() - t0) / iters * 1e3   # ms
+def timeit(fn, *args, iters=10, name="ablation"):
+    # Shared measurement path (ISSUE 1): same StepTimer + fencing
+    # semantics as bench.py, so ablation rows compare against BENCH
+    # lines apples-to-apples; ms to match the printed tables.
+    return StepTimer(name, warmup=1, iters=iters).time_call(fn, *args) * 1e3
 
 
 def roofline(jitted, *args):
@@ -86,13 +78,16 @@ def resnet_main(args):
         init, step = make_resnet_train_step(
             model, fused_adam(lr=1e-3), "O2", image_shape=(224, 224, 3))
         state, stats = init(jax.random.PRNGKey(0))
-        state, stats, m = step(state, stats, images, labels)
-        _sync(m["loss"])
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            state, stats, m = step(state, stats, images, labels)
-        _sync(m["loss"])
-        t_full = (time.perf_counter() - t0) / args.iters * 1e3
+
+        def one(carry, step=step, state=state, stats=stats):
+            s, st = carry[:2] if carry else (state, stats)
+            s, st, m = step(s, st, images, labels)
+            return s, st, m["loss"]
+
+        timer = StepTimer(f"rn50_full_{'s2d' if s2d else '7x7'}",
+                          warmup=1, iters=args.iters)
+        t_full = timer.time(one) * 1e3
+        state, stats = timer.last[:2]
 
         params_bf16 = jax.tree_util.tree_map(
             lambda v: v.astype(jnp.bfloat16)
@@ -110,18 +105,18 @@ def resnet_main(args):
 
         grad_j = jax.jit(jax.grad(loss_f))
         t_fwdbwd = timeit(grad_j, params_bf16, stats, imgs_bf16,
-                          iters=args.iters)
+                          iters=args.iters, name="rn50_fwdbwd")
         fl, by, bound = roofline(grad_j, params_bf16, stats, imgs_bf16)
 
         fwd_j = jax.jit(loss_f)
         t_fwd = timeit(fwd_j, params_bf16, stats, imgs_bf16,
-                       iters=args.iters)
+                       iters=args.iters, name="rn50_fwd")
 
         infer_j = jax.jit(lambda p, st, im: model.apply(
             {"params": p, "batch_stats": st}, im,
             train=False).astype(jnp.float32).mean())
         t_infer = timeit(infer_j, params_bf16, stats, imgs_bf16,
-                         iters=args.iters)
+                         iters=args.iters, name="rn50_infer")
 
         results[s2d] = (t_full, t_fwdbwd, t_fwd, t_infer, fl, by, bound)
 
@@ -151,6 +146,8 @@ def main():
     ap.add_argument("--fused-head-ce", action="store_true")
     ap.add_argument("--iters", type=int, default=10)
     args = ap.parse_args()
+    # APEX_TPU_TELEMETRY=<path> streams every ablation as step.* spans
+    configure_from_env()
     if args.model == "resnet50":
         if args.batch is None:
             args.batch = 256   # the bench-matrix RN50 batch
@@ -168,14 +165,16 @@ def main():
 
     init, step = make_gpt_train_step(cfg, fused_adam(lr=1e-4), "O2")
     state = init(jax.random.PRNGKey(0))
-    # the step donates its state: thread it through the timing loop
-    state, m = step(state, tokens, labels)
-    _sync(m["loss"])
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        state, m = step(state, tokens, labels)
-    _sync(m["loss"])
-    t_full = (time.perf_counter() - t0) / args.iters * 1e3
+
+    # the step donates its state: thread it through the timing carry
+    def one(carry):
+        s = carry[0] if carry else state
+        s, m = step(s, tokens, labels)
+        return s, m["loss"]
+
+    timer = StepTimer("gpt_full_step", warmup=1, iters=args.iters)
+    t_full = timer.time(one) * 1e3
+    state = timer.last[0]
 
     params_bf16 = jax.tree_util.tree_map(
         lambda v: v.astype(jnp.bfloat16)
@@ -183,11 +182,12 @@ def main():
 
     loss_f = lambda p: gpt_loss(p, tokens, labels, cfg)   # noqa: E731
     grad_j = jax.jit(jax.grad(loss_f))
-    t_fwdbwd = timeit(grad_j, params_bf16, iters=args.iters)
+    t_fwdbwd = timeit(grad_j, params_bf16, iters=args.iters,
+                      name="gpt_fwdbwd")
     fl, by, bound = roofline(grad_j, params_bf16)
 
     fwd_j = jax.jit(loss_f)
-    t_fwd = timeit(fwd_j, params_bf16, iters=args.iters)
+    t_fwd = timeit(fwd_j, params_bf16, iters=args.iters, name="gpt_fwd")
 
     ctx = single_device_ctx()
     hidden = jnp.asarray(rng.randn(B, S, cfg.hidden_size), jnp.bfloat16)
@@ -197,7 +197,7 @@ def main():
         return out.astype(jnp.float32).mean()
 
     t_bb = timeit(jax.jit(jax.grad(backbone_loss)), params_bf16, hidden,
-                  iters=args.iters)
+                  iters=args.iters, name="gpt_backbone")
 
     def head_loss(p, h):
         from apex_tpu.ops.lm_head_ce import lm_head_cross_entropy
@@ -215,7 +215,8 @@ def main():
         return losses.mean()
 
     t_head = timeit(jax.jit(jax.grad(head_loss, argnums=(0, 1))),
-                    params_bf16, hidden, iters=args.iters)
+                    params_bf16, hidden, iters=args.iters,
+                    name="gpt_head_ce")
 
     cfg6 = dataclasses.replace(cfg, num_layers=6)
     p6 = jax.tree_util.tree_map(
@@ -227,7 +228,7 @@ def main():
         return out.astype(jnp.float32).mean()
 
     t_bb6 = timeit(jax.jit(jax.grad(backbone6)), p6, hidden,
-                   iters=args.iters)
+                   iters=args.iters, name="gpt_backbone_6layer")
 
     n_params = sum(
         int(np.prod(v.shape))

@@ -1,0 +1,170 @@
+"""Summarize apex_tpu telemetry JSONL files into a per-metric table.
+
+    python tools/telemetry_report.py FILE.jsonl [FILE2.jsonl ...]
+
+Reads one or more telemetry streams (the JSONL sink of
+``apex_tpu.observability`` — schema in docs/observability.md) and
+prints:
+
+- spans/observations: count, total, mean, p50, p95, max (exact — every
+  observation is in the stream, unlike the live in-process summary's
+  bounded window);
+- counters: the cumulative total per name — last flush record per run
+  segment (the JSONL sink appends, so one file can hold several runs,
+  each opening with a ``meta`` record), summed across segments and
+  files, so both multi-host runs and repeated runs into one path
+  aggregate correctly;
+- gauges: count, last, min, max;
+- events: count per name.
+
+Tolerates garbage lines (warns, continues) and newer ``schema_version``
+values (warns once, still summarizes the fields it knows) so one
+corrupt or future-version record never hides a whole campaign's data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Iterable, List, Tuple
+
+SUPPORTED_SCHEMA = 1
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def load_records(paths: Iterable[str], out=None) -> List[dict]:
+    """Parse every line of every file; each record is tagged with its
+    source file index under ``_src`` (counter aggregation needs it)."""
+    out = sys.stdout if out is None else out
+    records: List[dict] = []
+    for src, path in enumerate(paths):
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    print(f"warning: {path}:{lineno}: unparseable line "
+                          "skipped", file=out)
+                    continue
+                if not isinstance(rec, dict):
+                    print(f"warning: {path}:{lineno}: non-object record "
+                          "skipped", file=out)
+                    continue
+                rec["_src"] = src
+                records.append(rec)
+    return records
+
+
+def summarize(records: List[dict]) -> dict:
+    spans: Dict[str, List[float]] = {}
+    counters: Dict[Tuple[int, int, str], float] = {}
+    gauges: Dict[str, List[float]] = {}
+    events: Dict[str, int] = {}
+    unknown_schema = set()
+    epoch: Dict[int, int] = {}   # per-file run segment (meta-delimited)
+    for rec in records:
+        ver = rec.get("schema_version")
+        if isinstance(ver, (int, float)) and ver > SUPPORTED_SCHEMA:
+            unknown_schema.add(ver)
+        rtype, name = rec.get("type"), rec.get("name")
+        if rtype == "meta":
+            # the JSONL sink appends, so one file can hold several runs;
+            # each run starts with a meta record and restarts its
+            # counters from zero — segment so totals sum, not clobber
+            epoch[rec["_src"]] = epoch.get(rec["_src"], -1) + 1
+        if rtype in ("span", "observe") and name is not None:
+            try:
+                spans.setdefault(name, []).append(float(rec["value"]))
+            except (KeyError, TypeError, ValueError):
+                pass
+        elif rtype == "counter" and name is not None:
+            try:
+                # cumulative within a run: keep the last flush value per
+                # (file, run segment)
+                key = (rec["_src"], epoch.get(rec["_src"], 0), name)
+                counters[key] = float(rec["value"])
+            except (KeyError, TypeError, ValueError):
+                pass
+        elif rtype == "gauge" and name is not None:
+            try:
+                gauges.setdefault(name, []).append(float(rec["value"]))
+            except (KeyError, TypeError, ValueError):
+                pass
+        elif rtype == "event" and name is not None:
+            events[name] = events.get(name, 0) + 1
+    counter_totals: Dict[str, float] = {}
+    for (_src, _epoch, name), val in counters.items():
+        counter_totals[name] = counter_totals.get(name, 0.0) + val
+    return {
+        "spans": spans,
+        "counters": counter_totals,
+        "gauges": gauges,
+        "events": events,
+        "unknown_schema": sorted(unknown_schema),
+    }
+
+
+def print_report(summary: dict, out=None) -> None:
+    out = sys.stdout if out is None else out
+    if summary["unknown_schema"]:
+        print("warning: records with newer schema_version "
+              f"{summary['unknown_schema']} (supported <= "
+              f"{SUPPORTED_SCHEMA}); summarizing known fields", file=out)
+    spans = summary["spans"]
+    if spans:
+        print("== spans / observations ==", file=out)
+        print(f"{'name':<44} {'count':>7} {'total':>11} {'mean':>11} "
+              f"{'p50':>11} {'p95':>11} {'max':>11}", file=out)
+        for name in sorted(spans):
+            vals = sorted(spans[name])
+            total = sum(vals)
+            print(f"{name:<44} {len(vals):>7} {total:>11.5g} "
+                  f"{total / len(vals):>11.5g} {_pct(vals, 0.50):>11.5g} "
+                  f"{_pct(vals, 0.95):>11.5g} {vals[-1]:>11.5g}", file=out)
+    counters = summary["counters"]
+    if counters:
+        print("== counters ==", file=out)
+        print(f"{'name':<44} {'total':>13}", file=out)
+        for name in sorted(counters):
+            print(f"{name:<44} {counters[name]:>13g}", file=out)
+    gauges = summary["gauges"]
+    if gauges:
+        print("== gauges ==", file=out)
+        print(f"{'name':<44} {'count':>7} {'last':>11} {'min':>11} "
+              f"{'max':>11}", file=out)
+        for name in sorted(gauges):
+            vals = gauges[name]
+            print(f"{name:<44} {len(vals):>7} {vals[-1]:>11.5g} "
+                  f"{min(vals):>11.5g} {max(vals):>11.5g}", file=out)
+    events = summary["events"]
+    if events:
+        print("== events ==", file=out)
+        print(f"{'name':<44} {'count':>7}", file=out)
+        for name in sorted(events):
+            print(f"{name:<44} {events[name]:>7}", file=out)
+    if not (spans or counters or gauges or events):
+        print("(no telemetry records found)", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize apex_tpu telemetry JSONL files.")
+    ap.add_argument("files", nargs="+", help="telemetry .jsonl file(s)")
+    args = ap.parse_args(argv)
+    records = load_records(args.files)
+    print_report(summarize(records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
