@@ -74,6 +74,9 @@ class TrainState(NamedTuple):
     master_params: Any                # fp32 masters (== params when disabled)
     opt_state: Any
     loss_scale_state: scaler_lib.LossScaleState
+    # per-leaf error-feedback residuals when grad_comm compresses with
+    # error feedback (comm.init_error_state layout); None otherwise
+    comm_state: Any = None
 
 
 def make_train_step(
@@ -87,6 +90,7 @@ def make_train_step(
     accum_steps: int = 1,
     main_grad_dtype=jnp.float32,
     norm_telemetry: bool = False,
+    grad_comm=None,
 ) -> Tuple[Callable, Callable]:
     """Build ``(init_fn, step_fn)`` implementing the full AMP training step.
 
@@ -113,6 +117,22 @@ def make_train_step(
         training's accumulated wgrad at fp32 fidelity instead of summing
         rounded bf16 grads.
       main_grad_dtype: dtype of the accumulation buffer (fp32 default).
+      grad_comm: gradient-communication spec (requires ``axis_name``):
+        ``None`` keeps the plain vma-aware pmean; ``"fp32"`` is the
+        same reduction spelled explicitly; ``"bf16"`` / ``"int8"`` (or
+        a ``comm.GradCommConfig``) route the reduction through
+        ``apex_tpu.comm`` — greedy size-bucketed, block-scaled
+        quantized reduce-scatter + all-gather collectives.  With
+        compression the step differentiates w.r.t. ``pvary``-ed params
+        so gradients arrive per-shard (SPMD-AD's implicit psum would
+        otherwise reduce at fp32 before compression could help), and
+        when the config enables error feedback (int8 default) the
+        train state carries per-leaf fp32 residuals
+        (``TrainState.comm_state``) so quantization error cancels
+        across steps instead of accumulating.  The residuals are
+        rank-local: a shard_map wrapper must spec them
+        ``P(axis_name)`` (``make_ddp_train_step`` does this; see
+        ``comm.error_state_spec`` for custom wrappers).
       norm_telemetry: when True the metrics dict additionally carries
         ``grad_norm``, ``update_norm``, ``param_norm`` and
         ``update_to_param_ratio`` (``optimizers._common.norm_metrics``
@@ -129,6 +149,18 @@ def make_train_step(
     else:
         amp_state = initialize(policy_or_amp)
     policy, ls_cfg = amp_state.policy, amp_state.loss_scale_config
+
+    comm_cfg = None
+    if grad_comm is not None:
+        from apex_tpu import comm as comm_lib
+
+        comm_cfg = comm_lib.resolve(grad_comm)
+        if axis_name is None:
+            raise ValueError(
+                "grad_comm is a cross-shard gradient reduction spec and "
+                "needs axis_name= to name the mesh axis to reduce over")
+    compressing = comm_cfg is not None and comm_cfg.compresses
+    use_ef = compressing and comm_cfg.use_error_feedback
 
     def init_fn(params) -> TrainState:
         # Copy even when the cast is an identity: astype-to-same-dtype
@@ -149,6 +181,13 @@ def make_train_step(
             else model_params
         )
         opt_state = optimizer.init(master)
+        comm_state = None
+        if use_ef:
+            from apex_tpu import comm as comm_lib
+
+            # leading rank axis of 1: a shard_map wrapper expands it to
+            # the axis size and shards it P(axis) (rank-local residuals)
+            comm_state = comm_lib.init_error_state(master)
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=model_params,
@@ -158,10 +197,22 @@ def make_train_step(
             # this factory, and a donated step would otherwise delete the
             # shared scale buffers out from under later init() calls
             loss_scale_state=own(amp_state.loss_scale_state),
+            comm_state=comm_state,
         )
 
     def step_fn(state: TrainState, *batch):
         ls_state = state.loss_scale_state
+        diff_params = state.master_params
+        if compressing:
+            from apex_tpu.utils.collectives import pvary
+
+            # Differentiate w.r.t. shard-VARYING params: under jax≥0.9
+            # shard_map, grads w.r.t. replicated params arrive already
+            # psummed (fp32, uncompressed).  Typing the params varying
+            # stops that implicit collective at the grad boundary, so
+            # the per-shard gradients reach the compressed reduction
+            # below — which is then the step's ONLY grad communication.
+            diff_params = pvary(state.master_params, axis_name)
 
         def scaled_loss_fn(master_params, *mb):
             # Forward runs on compute-dtype params derived from the masters
@@ -226,7 +277,7 @@ def make_train_step(
             def one_micro(main_grad, mb):
                 g, (l, aux_mb) = jax.grad(
                     scaled_loss_fn, has_aux=True)(
-                        state.master_params, *mb)
+                        diff_params, *mb)
                 main_grad = jax.tree_util.tree_map(
                     lambda a, gg: a + gg.astype(a.dtype), main_grad, g)
                 return main_grad, (l, aux_mb)
@@ -246,16 +297,30 @@ def make_train_step(
                 and jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
         else:
             grads, (loss, aux) = jax.grad(scaled_loss_fn, has_aux=True)(
-                state.master_params, *batch
+                diff_params, *batch
             )
         grads, finite = scaler_lib.unscale_grads(grads, ls_state)
 
+        new_comm_state = state.comm_state
         if axis_name is not None:
             from apex_tpu.utils.collectives import flag_and, grad_mean
 
-            # vma-aware: under shard_map SPMD-AD the grads arrive pre-summed
-            # (see utils/collectives.py) — grad_mean only divides then.
-            grads = grad_mean(grads, axis_name)
+            if compressing:
+                from apex_tpu import comm as comm_lib
+
+                # bucketed block-scaled quantized all-reduce; residuals
+                # (when error feedback is on) ride the train state in
+                # unscaled-fp32 units, so loss-scale changes between
+                # steps don't corrupt the carried error
+                grads, new_comm_state = comm_lib.reduce_gradients(
+                    grads, axis_name, comm_cfg,
+                    residuals=state.comm_state if use_ef else None,
+                )
+            else:
+                # vma-aware: under shard_map SPMD-AD the grads arrive
+                # pre-summed (see utils/collectives.py) — grad_mean only
+                # divides then.
+                grads = grad_mean(grads, axis_name)
             finite = flag_and(finite, axis_name)
 
         if grad_postprocess is not None:
@@ -280,6 +345,10 @@ def make_train_step(
 
         new_master = select(new_master, state.master_params)
         new_opt_state = select(new_opt_state, state.opt_state)
+        if use_ef:
+            # an overflowed step's grads (and thus residuals) are
+            # garbage — keep the carried error exactly like the params
+            new_comm_state = select(new_comm_state, state.comm_state)
         new_params = policy.cast_params(new_master)
 
         new_state = TrainState(
@@ -288,6 +357,7 @@ def make_train_step(
             master_params=new_master if policy.master_weights else new_params,
             opt_state=new_opt_state,
             loss_scale_state=new_ls_state,
+            comm_state=new_comm_state,
         )
         metrics = {
             "loss": loss,

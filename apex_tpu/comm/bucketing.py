@@ -1,0 +1,163 @@
+"""Greedy size-bucketed flattening of gradient pytrees.
+
+Reference: apex DDP's ``Reducer`` builds *dtype-segregated greedy
+buckets* on the first backward (apex/parallel/distributed.py:369-390) —
+small tensors are flattened together so each NCCL call moves a
+worthwhile payload, and the bucket boundaries let allreduces launch
+while the tail of backward is still producing grads.  Under XLA the
+motivation inverts but survives: ONE whole-model collective serializes
+against the last grad's producer, while several bucket-sized
+collectives give the latency-hiding scheduler independent operands to
+overlap with remaining backward compute.  Giant leaves (embeddings) are
+*split* across buckets for the same reason.
+
+This module is pure trace-time planning + gather/scatter math — no
+collectives, no jax transforms — so the plan is recomputed from static
+shapes at every trace (cheap python) and the data movement is plain
+``concatenate``/``dynamic_slice``-free reshaping XLA fuses away.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["BucketSlice", "Bucket", "plan_buckets", "gather_bucket",
+           "scatter_buckets"]
+
+
+class BucketSlice(NamedTuple):
+    """One contiguous span of a flattened leaf assigned to a bucket."""
+
+    leaf_index: int
+    start: int     # element offset into the flattened leaf
+    stop: int
+
+
+def _aligned(n: int, align: int) -> int:
+    return -(-n // align) * align
+
+
+class Bucket(NamedTuple):
+    slices: Tuple[BucketSlice, ...]
+    size: int       # flat elements including per-slice alignment padding
+    align: int = 1  # per-slice padding granularity (the scale block)
+
+    @property
+    def nbytes(self) -> int:
+        # planning accounting is in raw fp32 gradient bytes
+        return self.size * 4
+
+
+def plan_buckets(
+    leaves: Sequence[Any],
+    bucket_bytes: int,
+    align: int = 1,
+) -> List[Bucket]:
+    """Partition ``leaves`` (abstract or concrete arrays) into greedy
+    buckets of at most ``bucket_bytes`` raw fp32 bytes.
+
+    Dtype-segregated like the reference Reducer: leaves of different
+    dtypes never share a bucket (tp_bucket keying, distributed.py:378).
+    Leaves larger than a bucket are split into bucket-sized chunks —
+    each chunk becomes its own collective so XLA can overlap them.
+    Every element of every leaf is covered exactly once; empty leaves
+    are skipped.
+
+    ``align > 1`` zero-pads every slice's span in the flat bucket to a
+    multiple of ``align``.  With ``align`` = the quantization block
+    size, no scale block ever mixes elements from two leaves — a
+    small-magnitude bias sharing a block with a large weight would
+    otherwise inherit the weight's int8 step and lose all its bits
+    (zero padding quantizes exactly, so the pad costs bytes but no
+    precision).
+    """
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+    if align <= 0:
+        raise ValueError(f"align must be positive, got {align}")
+    cap = max(align, (bucket_bytes // 4) // align * align)
+    buckets: List[Bucket] = []
+    # dtype segregation: one open bucket per dtype key
+    open_slices: dict = {}
+    open_size: dict = {}
+
+    def close(key):
+        if open_slices.get(key):
+            buckets.append(
+                Bucket(tuple(open_slices[key]), open_size[key], align))
+            open_slices[key] = []
+            open_size[key] = 0
+
+    for i, leaf in enumerate(leaves):
+        shape = getattr(leaf, "shape", ())
+        n = 1
+        for d in shape:
+            n *= int(d)
+        if n == 0:
+            continue
+        key = str(getattr(leaf, "dtype", "f32"))
+        open_slices.setdefault(key, [])
+        open_size.setdefault(key, 0)
+        off = 0
+        while off < n:
+            room = cap - open_size[key]
+            take = min(n - off, room)
+            if take == 0:
+                close(key)
+                continue
+            open_slices[key].append(BucketSlice(i, off, off + take))
+            open_size[key] += _aligned(take, align)
+            off += take
+            if open_size[key] >= cap:
+                close(key)
+    for key in list(open_slices):
+        close(key)
+    return buckets
+
+
+def gather_bucket(leaves: Sequence[jax.Array], bucket: Bucket) -> jax.Array:
+    """Concatenate the bucket's slices into one flat fp32 vector
+    (zero-padding each slice to the bucket's alignment)."""
+    parts = []
+    for s in bucket.slices:
+        piece = (leaves[s.leaf_index].reshape(-1)[s.start:s.stop]
+                 .astype(jnp.float32))
+        pad = _aligned(s.stop - s.start, bucket.align) - (s.stop - s.start)
+        if pad:
+            piece = jnp.pad(piece, (0, pad))
+        parts.append(piece)
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def scatter_buckets(
+    leaves: Sequence[jax.Array],
+    buckets: Sequence[Bucket],
+    flats: Sequence[jax.Array],
+) -> List[jax.Array]:
+    """Rebuild full leaves from per-bucket flat vectors (inverse of
+    :func:`gather_bucket` — alignment padding is dropped).
+
+    Returns a list the same length as ``leaves``: leaves covered by the
+    plan are reassembled (in each leaf's original dtype and shape) from
+    their slices; uncovered leaves (not floating, empty) pass through
+    unchanged.
+    """
+    pieces: dict = {i: [] for i in range(len(leaves))}
+    for bucket, flat in zip(buckets, flats):
+        off = 0
+        for s in bucket.slices:
+            take = s.stop - s.start
+            pieces[s.leaf_index].append((s.start, flat[off:off + take]))
+            off += _aligned(take, bucket.align)
+    out: List[jax.Array] = []
+    for i, leaf in enumerate(leaves):
+        if not pieces[i]:
+            out.append(leaf)
+            continue
+        parts = [p for _, p in sorted(pieces[i], key=lambda t: t[0])]
+        flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        out.append(flat.reshape(leaf.shape).astype(leaf.dtype))
+    return out

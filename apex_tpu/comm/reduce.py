@@ -1,0 +1,341 @@
+"""Compressed gradient collectives under ``shard_map``.
+
+The bandwidth-optimal decomposition of a gradient all-reduce is
+reduce-scatter + all-gather (the cross-replica weight-update-sharding
+recipe, arXiv:2004.13336); EQuARX (arXiv:2506.17615) adds block-scaled
+quantization to both phases inside XLA.  This module implements that
+shape with explicit shard_map collectives:
+
+1. **scatter phase** — each rank splits its (error-compensated) local
+   gradient into ``n`` equal shards, quantizes, and ``all_to_all``s the
+   wire bytes: rank *j* receives every rank's quantized copy of shard
+   *j*, dequantizes in fp32, and sums over ranks in fixed rank order
+   (deterministic, bucket-independent).
+2. **gather phase** — the owner re-quantizes its reduced shard and
+   ``all_gather``s the wire bytes; everyone dequantizes back to fp32.
+
+:func:`compressed_allreduce` runs both phases (DDP semantics);
+:func:`compressed_reduce_scatter` stops after (1) for consumers that
+only need their own shard (the ZeRO optimizer — its param all-gather
+already travels at compute precision).
+
+Error feedback keeps a per-leaf fp32 residual of the *local*
+quantization error (``contribution - dequant(wire)``), added back into
+the next step's contribution — the EF-SGD/1-bit-Adam trick that stops
+deterministic rounding error from accumulating in the params.  The
+residual is rank-local state: carried in the train state with a leading
+rank axis and sharded ``P(axis)`` by the shard_map wrapper (see
+``amp.frontend.make_train_step`` / ``parallel.make_ddp_train_step``).
+
+Like ``utils.collectives``, the tree-level entry is **vma-aware**:
+leaves SPMD-AD already summed (axis-invariant under jax≥0.9 shard_map)
+cannot be compressed after the fact — they take the plain division,
+and only shard-varying leaves pay a collective.  Callers that want
+compression therefore differentiate w.r.t. ``pvary``-ed params so the
+gradients arrive per-shard (see the ``grad_comm`` wiring in
+``amp.frontend``).
+
+Telemetry (trace-time, like ``_note_collective``): counters
+``collectives.compressed.calls``, ``collectives.compressed.bytes``
+(wire payload + scale bytes actually moved, both phases) and
+``collectives.compressed.raw_bytes`` (what the uncompressed fp32 form
+would move: 2 passes for an all-reduce, 1 for a reduce-scatter).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.comm.bucketing import (
+    gather_bucket,
+    plan_buckets,
+    scatter_buckets,
+)
+from apex_tpu.comm.config import GradCommConfig
+from apex_tpu.comm.quantize import (
+    dequantize_blocks,
+    quantize_blocks,
+    scale_bytes_per_element,
+    wire_itemsize,
+)
+from apex_tpu.observability import metrics as _telemetry
+
+__all__ = [
+    "compressed_allreduce",
+    "compressed_reduce_scatter",
+    "reduce_gradients",
+    "init_error_state",
+    "expand_error_state",
+    "error_state_spec",
+]
+
+
+def _note_compressed(cfg: GradCommConfig, n_elements: int,
+                     passes_raw: int, passes_wire: int) -> None:
+    """Trace-time byte accounting: one record per collective emitted
+    into the compiled program (host-callback-free, like
+    ``utils.collectives._note_collective``)."""
+    reg = _telemetry.registry()
+    if reg is None:
+        return
+    per_el = wire_itemsize(cfg.wire_dtype) + scale_bytes_per_element(
+        cfg.wire_dtype, cfg.block)
+    reg.counter("collectives.compressed.calls").inc()
+    reg.counter("collectives.compressed.bytes").inc(
+        int(passes_wire * per_el * n_elements))
+    reg.counter("collectives.compressed.raw_bytes").inc(
+        int(passes_raw * 4 * n_elements))
+
+
+def _axis_size(axis_name: str) -> int:
+    """Static mapped-axis size. ``jax.lax.axis_size`` where available
+    (jax≥0.9); on older jax ``psum(1, axis)`` folds to a python int at
+    trace time — shard shapes below need a static value."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def _all_to_all(x: jax.Array, axis_name: str) -> jax.Array:
+    # [n, shard] → [n, shard]: row j goes to rank j; row i of the
+    # result is rank i's copy of MY shard
+    return jax.lax.all_to_all(x, axis_name, 0, 0, tiled=True)
+
+
+def _scatter_phase(
+    flat: jax.Array,
+    axis_name: str,
+    cfg: GradCommConfig,
+    *,
+    shard_size: Optional[int] = None,
+    residual: Optional[jax.Array] = None,
+    predivide: Optional[float] = None,
+) -> Tuple[jax.Array, Optional[jax.Array], int, int]:
+    """Quantize → all_to_all → local dequant-sum.
+
+    Returns ``(local_sum [shard], err [L] | None, shard, padded)`` where
+    ``local_sum`` is this rank's shard of the cross-rank SUM.
+    """
+    n = _axis_size(axis_name)
+    length = flat.shape[0]
+    x = flat.astype(jnp.float32)
+    if predivide:
+        x = x / predivide
+    c = x + residual if residual is not None else x
+    if shard_size is not None:
+        shard = shard_size
+    else:
+        shard = -(-length // n)
+        if cfg.wire_dtype == "int8":
+            # block-align the shard rows: each row of the [n, shard]
+            # wire matrix starts its own scale-block grid, so a
+            # non-multiple shard would let a block straddle two leaves'
+            # block-aligned spans (see bucketing.plan_buckets align)
+            shard = -(-shard // cfg.block) * cfg.block
+    padded = shard * n
+    if length > padded:
+        raise ValueError(
+            f"flat length {length} exceeds shard_size*n = {padded}")
+    cp = jnp.pad(c, (0, padded - length)).reshape(n, shard)
+    wire, scales = quantize_blocks(cp, cfg.wire_dtype, cfg.block)
+    recv_w = _all_to_all(wire, axis_name)
+    recv_s = _all_to_all(scales, axis_name) if scales is not None else None
+    contrib = dequantize_blocks(recv_w, recv_s, cfg.block, shard)
+    # fixed rank-order reduction: elementwise over the rank axis, so the
+    # result is independent of bucket geometry (bf16 bitwise stability)
+    local_sum = jnp.sum(contrib, axis=0)
+    err = None
+    if residual is not None:
+        own = dequantize_blocks(wire, scales, cfg.block, shard)
+        err = c - own.reshape(padded)[:length]
+    return local_sum, err, shard, padded
+
+
+def compressed_allreduce(
+    flat: jax.Array,
+    axis_name: str,
+    cfg: GradCommConfig,
+    *,
+    residual: Optional[jax.Array] = None,
+    average: bool = True,
+    predivide: Optional[float] = None,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Block-scaled quantized all-reduce of a flat fp32 vector.
+
+    Must run inside ``shard_map``/``pmap`` with ``axis_name`` bound and
+    ``flat`` shard-varying.  Returns ``(reduced [L], new_residual [L] |
+    None)`` — the mean over ranks when ``average`` (the
+    ``gradient_predivide_factor`` arithmetic mirrors
+    ``parallel.allreduce_gradients``), identical on every rank.
+    """
+    n = _axis_size(axis_name)
+    length = flat.shape[0]
+    local_sum, err, shard, padded = _scatter_phase(
+        flat, axis_name, cfg, residual=residual, predivide=predivide)
+    if average:
+        local_sum = local_sum / (n / predivide if predivide else n)
+    # gather phase: requantize the reduced shard, move wire bytes only
+    wire2, scales2 = quantize_blocks(local_sum, cfg.wire_dtype, cfg.block)
+    full_w = jax.lax.all_gather(wire2, axis_name)
+    full_s = (jax.lax.all_gather(scales2, axis_name)
+              if scales2 is not None else None)
+    rows = dequantize_blocks(full_w, full_s, cfg.block, shard)
+    out = rows.reshape(padded)[:length]
+    _note_compressed(cfg, padded, passes_raw=2, passes_wire=2)
+    return out, err
+
+
+def compressed_reduce_scatter(
+    flat: jax.Array,
+    axis_name: str,
+    cfg: GradCommConfig,
+    *,
+    shard_size: int,
+    residual: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Scatter phase only: this rank's ``shard_size`` shard of the
+    cross-rank SUM (not mean), plus the new error-feedback residual.
+
+    ``flat`` is zero-padded to ``shard_size * n``; the caller owns the
+    shard layout (rank *i* holds elements ``[i*shard, (i+1)*shard)`` —
+    the same contiguous split ``dynamic_slice`` on a psum-ed vector
+    would give, so it drops into the ZeRO optimizer unchanged).
+    """
+    local_sum, err, _, padded = _scatter_phase(
+        flat, axis_name, cfg, shard_size=shard_size, residual=residual)
+    _note_compressed(cfg, padded, passes_raw=1, passes_wire=1)
+    return local_sum, err
+
+
+# ---- tree-level entry + error-feedback state ---------------------------------
+
+
+def _is_float(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
+
+
+def init_error_state(tree: Any) -> Tuple[jax.Array, ...]:
+    """Zero residuals for every floating leaf of ``tree`` (flatten
+    order), each with a leading rank axis of size 1.
+
+    The leading axis is the sharding handle: a shard_map wrapper stores
+    the global residual as ``[n_ranks, *leaf.shape]`` (see
+    :func:`expand_error_state`) and specs it ``P(axis)`` so each rank
+    carries its own rank-local error (:func:`error_state_spec`).
+    """
+    return tuple(
+        jnp.zeros((1,) + tuple(leaf.shape), jnp.float32)
+        for leaf in jax.tree_util.tree_leaves(tree) if _is_float(leaf))
+
+
+def expand_error_state(
+    state: Sequence[jax.Array], n_ranks: int
+) -> Tuple[jax.Array, ...]:
+    """Grow the leading rank axis to ``n_ranks`` (zeros — fresh
+    residuals are zero on every rank)."""
+    return tuple(
+        jnp.zeros((n_ranks,) + tuple(r.shape[1:]), r.dtype) for r in state)
+
+
+def error_state_spec(state: Sequence[Any], axis_name: str) -> Tuple:
+    """Per-leaf ``PartitionSpec`` splitting the leading rank axis."""
+    from jax.sharding import PartitionSpec as P
+
+    return tuple(P(axis_name) for _ in state)
+
+
+def reduce_gradients(
+    tree: Any,
+    axis_name: str,
+    cfg: GradCommConfig,
+    residuals: Optional[Sequence[jax.Array]] = None,
+    *,
+    average: bool = True,
+    predivide: Optional[float] = None,
+) -> Tuple[Any, Optional[Tuple[jax.Array, ...]]]:
+    """Bucketed compressed reduction of a gradient pytree.
+
+    Floating, shard-varying leaves are packed into dtype-segregated
+    greedy buckets (giant leaves split — ``cfg.bucket_bytes``) and each
+    bucket takes one :func:`compressed_allreduce`; SPMD-AD pre-summed
+    (axis-invariant) leaves take the plain division, and non-float
+    leaves pass through.  ``residuals`` is the per-leaf error-feedback
+    tuple from :func:`init_error_state` (aligned with the tree's
+    floating leaves); returns ``(reduced_tree, new_residuals)`` with
+    residuals in the same per-leaf layout.
+    """
+    if not cfg.compresses:
+        raise ValueError(
+            "reduce_gradients is the compressed path; use "
+            "utils.collectives.grad_mean / parallel.allreduce_gradients "
+            "for fp32 wire")
+    from apex_tpu.utils.collectives import is_varying
+
+    n = _axis_size(axis_name)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    float_idx = [i for i, leaf in enumerate(leaves) if _is_float(leaf)]
+    if residuals is not None and len(residuals) != len(float_idx):
+        raise ValueError(
+            f"residual count {len(residuals)} != floating leaf count "
+            f"{len(float_idx)} (build it with comm.init_error_state)")
+
+    comp_idx = [i for i in float_idx if is_varying(leaves[i], axis_name)]
+    comp_leaves = [leaves[i] for i in comp_idx]
+    # int8: align slices to the scale-block grid so no block mixes two
+    # leaves (a bias block inheriting a weight's dynamic range would
+    # quantize to pure noise); bf16 has no blocks to protect
+    plan = plan_buckets(
+        comp_leaves, cfg.bucket_bytes,
+        align=cfg.block if cfg.wire_dtype == "int8" else 1)
+
+    res_for = {}
+    if residuals is not None:
+        res_for = dict(zip(float_idx, residuals))
+    # residuals carry a leading rank axis (1 inside shard_map) — view
+    # them leaf-shaped for bucketing
+    res_comp = [res_for[i].reshape(leaves[i].shape) for i in comp_idx] \
+        if residuals is not None else None
+
+    outs: List[jax.Array] = []
+    errs: List[jax.Array] = []
+    for bucket in plan:
+        flat = gather_bucket(comp_leaves, bucket)
+        rflat = (gather_bucket(res_comp, bucket)
+                 if res_comp is not None else None)
+        out, err = compressed_allreduce(
+            flat, axis_name, cfg, residual=rflat,
+            average=average, predivide=predivide)
+        outs.append(out)
+        if err is not None:
+            errs.append(err)
+
+    new_comp = scatter_buckets(comp_leaves, plan, outs)
+    new_res_comp = (scatter_buckets(res_comp, plan, errs)
+                    if res_comp is not None else None)
+
+    out_leaves = list(leaves)
+    for k, i in enumerate(comp_idx):
+        out_leaves[i] = new_comp[k]
+    comp_set = set(comp_idx)
+    for i in float_idx:
+        if i in comp_set:
+            continue
+        # SPMD-AD already summed this leaf over the axis: apply the
+        # same net scaling the varying path would (predivide by f, sum,
+        # then /(n/f) when averaging — net /n averaged, /f otherwise)
+        if average:
+            out_leaves[i] = leaves[i] / n
+        elif predivide:
+            out_leaves[i] = leaves[i] / predivide
+
+    new_residuals = None
+    if residuals is not None:
+        by_idx = dict(res_for)
+        for k, i in enumerate(comp_idx):
+            by_idx[i] = new_res_comp[k].reshape(res_for[i].shape)
+        new_residuals = tuple(by_idx[i] for i in float_idx)
+    return jax.tree_util.tree_unflatten(treedef, out_leaves), new_residuals

@@ -47,6 +47,9 @@ class ZeroTrainState(NamedTuple):
     m_shard: jax.Array              # f32 [n] sharded over dp
     v_shard: jax.Array              # f32 [n] sharded over dp
     loss_scale_state: Any
+    # rank-local error-feedback residual for compressed grad_comm
+    # ([ndev, padded_total] f32 sharded over dp); None when off
+    comm_residual: Any = None
 
 
 def _is_float(x) -> bool:
@@ -130,6 +133,7 @@ def make_distributed_adam_train_step(
     loss_scale=None,
     store_param_remainders: bool = False,
     grad_clip_norm: Optional[float] = None,
+    grad_comm=None,
 ):
     """Build ``(init_fn, step_fn)`` with ZeRO-2 sharded optimizer state.
 
@@ -138,8 +142,30 @@ def make_distributed_adam_train_step(
     params replicated, flat shards split along ``axis_name``).
     ``step_fn(state, *batch) -> (state, metrics)`` — batch sharded on its
     leading dim.
+
+    ``grad_comm`` (``"bf16"`` | ``"int8"`` | ``comm.GradCommConfig``)
+    compresses the ZeRO grad sync: gradients are taken w.r.t.
+    ``pvary``-ed params (stopping SPMD-AD's fp32 psum) and reduced with
+    ``comm.compressed_reduce_scatter`` — quantize → all_to_all →
+    local dequant-sum, the scatter half of the EQuARX recipe; the wire
+    moves ~1/4 (int8) or 1/2 (bf16) of the fp32 bytes and each rank
+    lands exactly its optimizer shard.  No gather phase: the updated
+    params already all-gather at compute precision (GSPMD's ZeRO param
+    sync).  When the resolved config enables error feedback (int8
+    default) the state carries a **full-gradient-sized** fp32 residual
+    per rank (``comm_residual`` — 4 bytes/param/rank, deliberately NOT
+    ZeRO-sharded because the quantization error is rank-local); pass
+    ``GradCommConfig(wire_dtype="int8", error_feedback=False)`` to
+    trade that memory for slow compression-error drift.
     """
     policy = policy_for_opt_level(amp)
+    comm_cfg = None
+    if grad_comm is not None:
+        from apex_tpu import comm as comm_lib
+
+        comm_cfg = comm_lib.resolve(grad_comm)
+    compressing = comm_cfg is not None and comm_cfg.compresses
+    use_ef = compressing and comm_cfg.use_error_feedback
     # uniform compute dtype for the whole flat buffer (the fp32 master
     # shard covers every param, so there is no keep-norm-fp32 split here);
     # _effective realizes fp16 opt levels as bf16 on TPU
@@ -187,6 +213,8 @@ def make_distributed_adam_train_step(
             m_shard=zeros,
             v_shard=zeros,
             loss_scale_state=ls_state0,
+            comm_residual=(jnp.zeros((ndev, padded), jnp.float32)
+                           if use_ef else None),
         )
         rep = NamedSharding(mesh, P())
         shard = NamedSharding(mesh, P(axis_name))
@@ -196,6 +224,7 @@ def make_distributed_adam_train_step(
             master_shard=shard, m_shard=shard, v_shard=shard,
             loss_scale_state=jax.tree_util.tree_map(
                 lambda _: rep, state.loss_scale_state),
+            comm_residual=shard if use_ef else None,
         ))
 
     def shard_step(state: ZeroTrainState, *batch):
@@ -211,18 +240,47 @@ def make_distributed_adam_train_step(
 
         # allow_int: non-float leaves (int tables etc.) ride in the tree;
         # their float0 "grads" are skipped by _ravel_floats
+        diff_params = state.params
+        if compressing:
+            from apex_tpu.utils.collectives import pvary
+
+            # shard-varying params stop SPMD-AD's implicit fp32 psum at
+            # the grad boundary: the per-shard grads below reach the
+            # compressed reduce-scatter uncombined (see amp.frontend)
+            diff_params = pvary(state.params, axis_name)
         grads, loss = jax.grad(scaled_loss, has_aux=True,
-                               allow_int=True)(state.params)
+                               allow_int=True)(diff_params)
         loss = jax.lax.pmean(loss, axis_name)
 
         g_flat, _ = _ravel_floats(grads)
         total = shard_n * ndev
         g_flat = jnp.pad(g_flat, (0, total - g_flat.shape[0]))
-        # ZeRO-2: this rank only keeps its shard of the summed grads
-        g_local = jax.lax.dynamic_slice(g_flat, (my * shard_n,), (shard_n,))
-        g_local = g_local / (ndev * ls_state.loss_scale)
+        if compressing:
+            from apex_tpu import comm as comm_lib
 
-        finite = flag_and(jnp.all(jnp.isfinite(g_local)), axis_name)
+            # quantized reduce-scatter IS the ZeRO grad sync: each rank
+            # receives every peer's wire bytes for its own shard and
+            # dequant-sums locally.  Unscale BEFORE compressing so the
+            # error-feedback residual lives in loss-scale-free units.
+            g_unscaled = g_flat / ls_state.loss_scale
+            # finite check on the PRE-quantization grads: int8 clipping
+            # could otherwise round non-finite inputs into finite wire
+            # values and hide the overflow from the loss scaler
+            finite_local = jnp.all(jnp.isfinite(g_unscaled))
+            res = (state.comm_residual.reshape(total) if use_ef else None)
+            g_local, new_res = comm_lib.compressed_reduce_scatter(
+                g_unscaled, axis_name, comm_cfg,
+                shard_size=shard_n, residual=res)
+            g_local = g_local / ndev
+        else:
+            new_res = None
+            # ZeRO-2: this rank only keeps its shard of the summed grads
+            g_local = jax.lax.dynamic_slice(
+                g_flat, (my * shard_n,), (shard_n,))
+            g_local = g_local / (ndev * ls_state.loss_scale)
+            finite_local = jnp.all(jnp.isfinite(g_local))
+
+        finite = flag_and(finite_local, axis_name)
 
         if grad_clip_norm is not None:
             sq = jax.lax.psum(jnp.sum(g_local * g_local), axis_name)
@@ -263,6 +321,9 @@ def make_distributed_adam_train_step(
         master_new = pick(master_new, master)
         m_new = pick(m_new, state.m_shard)
         v_new = pick(v_new, state.v_shard)
+        if use_ef:
+            # overflowed grads poison the residual — keep the old one
+            new_res = pick(new_res, state.comm_residual.reshape(total))
 
         if store_param_remainders:
             bf_new_local, master_store = _split_bits(master_new)
@@ -278,6 +339,8 @@ def make_distributed_adam_train_step(
             m_shard=m_new,
             v_shard=v_new,
             loss_scale_state=new_ls,
+            comm_residual=(new_res.reshape(state.comm_residual.shape)
+                           if use_ef else None),
         )
         metrics = {"loss": loss, "overflow": overflow,
                    "loss_scale": new_ls.loss_scale}
@@ -291,7 +354,8 @@ def make_distributed_adam_train_step(
         in_state_spec = ZeroTrainState(
             step=P(), params=pspec, master_shard=P(axis_name),
             m_shard=P(axis_name), v_shard=P(axis_name),
-            loss_scale_state=ls_spec)
+            loss_scale_state=ls_spec,
+            comm_residual=P(axis_name) if use_ef else None)
         out_state_spec = in_state_spec._replace(params=None)
         fn = jax.shard_map(
             shard_step, mesh=mesh,

@@ -142,7 +142,10 @@ class Histogram:
         self.record_type = record_type
         self.count = 0
         self.total = 0.0
-        self.max = 0.0
+        # -inf, not 0.0: a histogram of all-negative observations must
+        # report the max it actually saw (summary() maps "never
+        # observed" back to 0.0 for display)
+        self.max = float("-inf")
         self._window = deque(maxlen=self.WINDOW)
         self._reg = reg
 
@@ -175,7 +178,7 @@ class Histogram:
             "mean": self.total / self.count if self.count else 0.0,
             "p50": self.quantile(0.50),
             "p95": self.quantile(0.95),
-            "max": self.max,
+            "max": self.max if self.count else 0.0,
         }
 
 

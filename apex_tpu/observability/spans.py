@@ -27,6 +27,7 @@ trace-time only; record step-boundary values instead
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import ContextDecorator
 from typing import Any, Callable, Optional
@@ -81,34 +82,47 @@ class span(ContextDecorator):
         self.name = name
         self.tags = tags
         self._fence_on = fence_on
-        self._t0: Optional[float] = None
-        self._ann = None
+        # per-thread stack of (t0, annotation): ContextDecorator reuses
+        # ONE instance for every call of a decorated function, so
+        # nested / recursive / multi-threaded entries must not clobber
+        # each other's start time (a single _t0 slot dropped the outer
+        # span record and leaked the outer TraceAnnotation)
+        self._local = threading.local()
+
+    def _thread_stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
 
     def __enter__(self):
         reg = _metrics.registry()
         if reg is None:
+            self._thread_stack().append(None)   # mark: telemetry off
             return self
+        ann = None
         if reg.profiler:
             try:
                 from jax.profiler import TraceAnnotation
 
-                self._ann = TraceAnnotation(self.name)
-                self._ann.__enter__()
+                ann = TraceAnnotation(self.name)
+                ann.__enter__()
             except Exception:
-                self._ann = None
-        self._t0 = time.perf_counter()
+                ann = None
+        self._thread_stack().append((time.perf_counter(), ann))
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        if self._t0 is None:
+        stack = self._thread_stack()
+        entry = stack.pop() if stack else None
+        if entry is None:
             return False
+        t0, ann = entry
         if self._fence_on is not None:
             fence(self._fence_on)
-        dur = time.perf_counter() - self._t0
-        self._t0 = None
-        if self._ann is not None:
-            self._ann.__exit__(exc_type, exc, tb)
-            self._ann = None
+        dur = time.perf_counter() - t0
+        if ann is not None:
+            ann.__exit__(exc_type, exc, tb)
         reg = _metrics.registry()
         if reg is not None:
             extra = {"tags": self.tags} if self.tags else {}

@@ -1,8 +1,11 @@
 """apex_tpu.optimizers — fused optimizers (reference: apex/optimizers/).
 
 All are optax-compatible ``GradientTransformation``s whose whole update fuses
-into the surrounding jitted train step; ``FusedAdam`` additionally offers a
-single-pass Pallas flat-buffer kernel (``use_pallas=True``).
+into the surrounding jitted train step; ``FusedAdam`` additionally offers the
+flattened-buffer update (``use_flat_buffer=True`` — pure XLA over one flat
+vector, the layout the ZeRO-sharded ``distributed_fused_adam`` stores
+natively; the Pallas kernel that once backed it was deleted after losing the
+round-5 on-chip win-or-delete sweep).
 """
 
 from apex_tpu.optimizers._common import (  # noqa: F401

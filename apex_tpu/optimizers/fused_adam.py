@@ -14,18 +14,22 @@ semantics:
 
 The update is elementwise over every param; under jit XLA fuses it across
 the whole tree (the moral equivalent of one ``multi_tensor_apply<4>`` launch
-covering 320 params — csrc/multi_tensor_apply.cuh:44). ``use_pallas=True``
-routes through the flat-buffer Pallas kernel instead; measured on v5e this
-is ~30x *slower* for tree-stored state (ravel/unravel adds 7 HBM copies a
-step that XLA's fusion avoids), so leave it off here — the kernel's purpose
-is the ZeRO-sharded optimizer whose state is stored flat
-(``apex_tpu.contrib.optimizers.distributed_fused_adam``), where no per-step
-concat exists.
+covering 320 params — csrc/multi_tensor_apply.cuh:44).
+``use_flat_buffer=True`` routes through the flattened-buffer update
+(``ops.flat_adam`` — pure XLA since the round-5 win-or-delete sweep
+retired the Pallas kernel); measured on v5e that is ~30x *slower* for
+tree-stored state (ravel/unravel adds 7 HBM copies a step that XLA's
+fusion avoids), so leave it off here — the flat path's purpose is the
+ZeRO-sharded optimizer whose state is stored flat
+(``apex_tpu.contrib.optimizers.distributed_fused_adam``), where no
+per-step concat exists.  ``use_pallas`` survives as a deprecated alias
+of the flag.
 """
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Tuple
+import warnings
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -56,16 +60,30 @@ def fused_adam(
     adam_w_mode: bool = True,
     bias_correction: bool = True,
     amsgrad: bool = False,
-    use_pallas: bool = False,
+    use_flat_buffer: bool = False,
     norm_telemetry: bool = False,
+    use_pallas: Optional[bool] = None,
 ) -> GradientTransformation:
-    """``norm_telemetry=True`` wraps the transformation with
+    """``use_flat_buffer=True`` runs the update over one flattened
+    buffer (``ops.flat_adam`` — pure XLA; the Pallas kernel that once
+    lived there lost its round-5 win-or-delete gate).  Slower for
+    tree-stored state; see the module docstring.  ``use_pallas`` is the
+    deprecated pre-round-5 name for the same flag.
+
+    ``norm_telemetry=True`` wraps the transformation with
     ``_common.with_norm_telemetry``: the state additionally carries the
     last step's global grad/update/param norms for host-side recording
     (``record_opt_norms``).  Off by default — it adds full-tree
     reductions to the update."""
     if amsgrad:
         raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+    if use_pallas is not None:
+        warnings.warn(
+            "fused_adam(use_pallas=...) is deprecated: the flat-buffer "
+            "path has been pure XLA since the Pallas kernel was deleted "
+            "in round 5 — use use_flat_buffer=", DeprecationWarning,
+            stacklevel=2)
+        use_flat_buffer = use_pallas
     beta1, beta2 = betas
 
     def init(params) -> AdamState:
@@ -86,7 +104,7 @@ def fused_adam(
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
 
-        if use_pallas:
+        if use_flat_buffer:
             from apex_tpu.ops.flat_adam import flat_adam_update
 
             updates, m, v = flat_adam_update(

@@ -25,7 +25,6 @@ hooks are the compiler's job. What survives of the reference API:
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Optional
 
 import jax
@@ -49,6 +48,7 @@ def allreduce_gradients(
     allreduce_always_fp32: bool = False,
     gradient_average: bool = True,
     gradient_predivide_factor: Optional[float] = None,
+    grad_comm=None,
 ) -> Any:
     """Average (or sum) grads over a mesh axis — apex DDP's
     ``allreduce_bucket`` semantics (distributed.py:426-470) as one function.
@@ -62,8 +62,31 @@ def allreduce_gradients(
     per-shard grads and SPMD-AD pre-summed grads. When grads were pre-summed
     the reduction already happened in the grad dtype, so
     ``allreduce_always_fp32`` only affects the post-scaling arithmetic.
+
+    ``grad_comm`` (``"bf16"`` | ``"int8"`` | ``comm.GradCommConfig``)
+    routes shard-varying leaves through ``apex_tpu.comm``'s bucketed
+    block-scaled quantized collectives instead of the fp32 psum — the
+    reference's ``allreduce_always_fp16`` generalized.  This stateless
+    entry carries no error feedback (there is nowhere to put the
+    residual between calls); for int8 training use
+    ``amp.make_train_step(..., grad_comm=...)`` or
+    :func:`make_ddp_train_step`, which thread the per-leaf residuals
+    through the train state.  ``allreduce_always_fp32`` is moot under
+    compression: the dequantized reduction is always fp32.
     """
     from apex_tpu.utils.collectives import is_varying
+
+    if grad_comm is not None:
+        from apex_tpu import comm as comm_lib
+
+        cfg = comm_lib.resolve(grad_comm)
+        if cfg is not None and cfg.compresses:
+            reduced, _ = comm_lib.reduce_gradients(
+                grads, axis_name, cfg,
+                average=gradient_average,
+                predivide=gradient_predivide_factor,
+            )
+            return reduced
 
     n = jax.lax.axis_size(axis_name)
 
@@ -99,6 +122,13 @@ class DistributedDataParallel:
 
     The wrapper attaches the reduction to the *backward* only (forward is
     untouched), exactly like the reference's grad hooks.
+
+    ``grad_comm=`` compresses the reduction (see
+    :func:`allreduce_gradients`).  Compression only has bytes to save
+    when the wrapped gradients are still shard-varying — under jax≥0.9
+    shard_map pass ``pvary``-ed params (``utils.collectives.pvary``) so
+    SPMD-AD does not pre-reduce them at fp32; grads w.r.t. replicated
+    params fall back to the plain division either way.
     """
 
     def __init__(
@@ -108,6 +138,7 @@ class DistributedDataParallel:
         allreduce_always_fp32: bool = False,
         gradient_average: bool = True,
         gradient_predivide_factor: Optional[float] = None,
+        grad_comm=None,
     ):
         self.fn = fn
         self.axis_name = axis_name
@@ -115,6 +146,7 @@ class DistributedDataParallel:
             allreduce_always_fp32=allreduce_always_fp32,
             gradient_average=gradient_average,
             gradient_predivide_factor=gradient_predivide_factor,
+            grad_comm=grad_comm,
         )
 
         @jax.custom_vjp
@@ -139,7 +171,9 @@ class DistributedDataParallel:
 
 class Reducer:
     """Manual-reduction variant (reference ``Reducer``, distributed.py:89):
-    call ``reduce(grads)`` yourself when accumulation is done."""
+    call ``reduce(grads)`` yourself when accumulation is done.  All
+    :func:`allreduce_gradients` options pass through, including
+    ``grad_comm=`` for compressed wire dtypes."""
 
     def __init__(self, axis_name: str = "dp", **opts):
         self.axis_name = axis_name
@@ -156,6 +190,7 @@ def make_ddp_train_step(
     mesh: Optional[Mesh] = None,
     *,
     batch_axes: int = 1,
+    grad_comm=None,
     **ddp_opts,
 ):
     """Whole-step DDP: amp train step shard_mapped over the dp axis.
@@ -164,21 +199,42 @@ def make_ddp_train_step(
     batch array's leading dim divisible by the dp size. Params/state are
     replicated, the batch is split, grads pmean over 'dp', the found-inf
     flag combines across shards (transformer/amp/grad_scaler.py analog).
+
+    ``grad_comm="bf16"`` / ``"int8"`` (or a ``comm.GradCommConfig``)
+    compresses the gradient reduction (``amp.make_train_step``'s
+    ``grad_comm``).  When the config carries error feedback (int8
+    default), this wrapper owns the residual plumbing: the train
+    state's ``comm_state`` is expanded to one rank-local fp32 residual
+    per 'dp' shard and sharded ``P('dp')`` through the shard_map, so
+    each rank's quantization error cancels across its own steps.
     """
     from apex_tpu import amp as amp_lib
 
     if mesh is None:
         mesh = create_mesh()
     init_fn, step = amp_lib.make_train_step(
-        loss_fn, optimizer, policy_or_amp, axis_name="dp"
+        loss_fn, optimizer, policy_or_amp, axis_name="dp",
+        grad_comm=grad_comm,
     )
+    ndev = dict(zip(mesh.axis_names, mesh.devices.shape))["dp"]
 
-    @functools.partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(P(), *([P("dp")] * batch_axes)),
-        out_specs=(P(), P()),
-    )
+    def init(params):
+        state = init_fn(params)
+        if getattr(state, "comm_state", None):
+            from jax.sharding import NamedSharding
+
+            from apex_tpu import comm as comm_lib
+
+            # create the [ndev, ...] residuals directly P('dp')-sharded:
+            # an unsharded expand would commit the full grad-sized zeros
+            # tree to one device before the first step reshards it
+            shard = NamedSharding(mesh, P("dp"))
+            state = state._replace(comm_state=tuple(
+                jax.device_put(r, shard)
+                for r in comm_lib.expand_error_state(
+                    state.comm_state, ndev)))
+        return state
+
     def sharded_step(state, *batch):
         new_state, metrics = step(state, *batch)
         metrics = {
@@ -189,4 +245,23 @@ def make_ddp_train_step(
         }
         return new_state, metrics
 
-    return init_fn, jax.jit(sharded_step)
+    def outer_step(state, *batch):
+        # per-leaf state specs: everything replicated except the
+        # rank-local error-feedback residuals, which split their
+        # leading rank axis over 'dp'
+        state_spec = jax.tree_util.tree_map(lambda _: P(), state)
+        comm_state = getattr(state, "comm_state", None)
+        if comm_state:
+            from apex_tpu import comm as comm_lib
+
+            state_spec = state_spec._replace(
+                comm_state=comm_lib.error_state_spec(comm_state, "dp"))
+        fn = jax.shard_map(
+            sharded_step,
+            mesh=mesh,
+            in_specs=(state_spec, *([P("dp")] * batch_axes)),
+            out_specs=(state_spec, P()),
+        )
+        return fn(state, *batch)
+
+    return init, jax.jit(outer_step)

@@ -521,6 +521,76 @@ def bench_mlp_adam(on_tpu):
     }
 
 
+def bench_grad_comm(on_tpu, wire_dtypes=("fp32", "bf16", "int8")):
+    """Wire-dtype ablation for the compressed gradient collectives
+    (``--grad-comm``): the GPT tiny/125M geometry trained through
+    ``make_ddp_train_step`` over a dp mesh of every visible device, one
+    row per wire dtype, with the trace-time compressed-byte counters
+    alongside tokens/s.  On a 1-chip window dp=1 makes the collective a
+    no-op — the row exists so the next multi-chip window can run
+    ``python bench.py --grad-comm fp32,bf16,int8`` and read the
+    crossover directly."""
+    from apex_tpu.models.transformer_lm import gpt_loss
+    from apex_tpu.observability import metrics as _telemetry
+    from apex_tpu.parallel.distributed import make_ddp_train_step
+    from apex_tpu.parallel.mesh import create_mesh
+
+    ndev = len(jax.devices())
+    if on_tpu:
+        batch, seq, iters = 8 * ndev, 1024, 10
+        cfg = gpt_125m(max_position_embeddings=seq, remat=False,
+                       scan_layers=False, fused_head_ce=True)
+    else:
+        batch, seq, iters = 2 * ndev, 128, 2
+        cfg = gpt_125m(num_layers=2, hidden_size=256,
+                       num_attention_heads=4, vocab_size=8192,
+                       max_position_embeddings=seq)
+    mesh = create_mesh(dp=ndev)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    from apex_tpu.models.gpt import init_gpt_params
+
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+
+    def loss_fn(p, t, l):
+        return gpt_loss(p, t, l, cfg, None)
+
+    rows = {}
+    for wire in wire_dtypes:
+        init, step = make_ddp_train_step(
+            loss_fn, fused_adam(lr=1e-4), "O2", mesh,
+            batch_axes=2, grad_comm=wire)
+        state = init(params)
+        reg = _telemetry.registry()
+        base = (reg.counter("collectives.compressed.bytes").value,
+                reg.counter("collectives.compressed.raw_bytes").value
+                ) if reg is not None else (0, 0)
+
+        def one(carry, step=step, state=state):
+            s = carry[0] if carry else state
+            s, m = step(s, tokens, labels)
+            return s, m["loss"]
+
+        sec = _time_fn(one, iters=iters, name=f"gpt_ddp_comm_{wire}")
+        row = {
+            "tokens_per_sec": round(batch * seq / sec, 1),
+            "step_ms": round(sec * 1e3, 2),
+            "dp": ndev,
+        }
+        if reg is not None:
+            row["wire_bytes_per_trace"] = int(
+                reg.counter("collectives.compressed.bytes").value - base[0])
+            row["raw_bytes_per_trace"] = int(
+                reg.counter("collectives.compressed.raw_bytes").value
+                - base[1])
+        rows[wire] = row
+        del state
+    return rows
+
+
 def _probe_backend(timeout_s: int = 45):
     """Initialize the JAX backend with a hard timeout.
 
@@ -554,6 +624,15 @@ def _probe_backend(timeout_s: int = 45):
 
 
 def main():
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--grad-comm", default=None, metavar="DTYPES",
+        help="comma list of gradient wire dtypes (fp32,bf16,int8): run "
+             "ONLY the compressed-collective ablation rows "
+             "(bench_grad_comm) instead of the full matrix")
+    args = parser.parse_args()
     # APEX_TPU_TELEMETRY=<path> streams every row's StepTimer span into
     # the shared JSONL schema alongside the headline JSON line
     configure_from_env()
@@ -561,6 +640,21 @@ def main():
     if platform is None:
         return
     on_tpu = platform == "tpu"
+    if args.grad_comm:
+        wires = tuple(
+            w.strip() for w in args.grad_comm.split(",") if w.strip())
+        if not wires:
+            parser.error("--grad-comm needs at least one wire dtype "
+                         "(fp32, bf16, int8)")
+        rows = bench_grad_comm(on_tpu, wires)
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "metric": "gpt_ddp_grad_comm_ablation",
+            "value": rows.get(wires[0], {}).get("tokens_per_sec", 0.0),
+            "unit": "tokens/s",
+            "details": rows,
+        }))
+        return
     details = {}
     for name, fn in (
         ("gpt2_125m", bench_gpt),

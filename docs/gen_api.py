@@ -49,6 +49,17 @@ MODULES = [
     ("apex_tpu.ops.rope", "ops", "ops.rope — rotary embeddings"),
     ("apex_tpu.ops.dense", "ops", "ops.dense — fused dense epilogues"),
     ("apex_tpu.ops.flat_adam", "ops", "ops.flat_adam — flat Adam"),
+    # comm
+    ("apex_tpu.comm", "comm",
+     "apex_tpu.comm — compressed gradient collectives"),
+    ("apex_tpu.comm.config", "comm",
+     "comm.config — grad_comm spec (wire dtype / error feedback / buckets)"),
+    ("apex_tpu.comm.quantize", "comm",
+     "comm.quantize — block-scaled int8 / bf16 wire formats"),
+    ("apex_tpu.comm.bucketing", "comm",
+     "comm.bucketing — greedy dtype-segregated buckets"),
+    ("apex_tpu.comm.reduce", "comm",
+     "comm.reduce — compressed all-reduce / reduce-scatter + telemetry"),
     # parallel
     ("apex_tpu.parallel.mesh", "parallel", "parallel.mesh — device mesh"),
     ("apex_tpu.parallel.launch", "parallel",

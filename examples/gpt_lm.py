@@ -112,9 +112,13 @@ def main():
         tok, lab = next(stream)
         with obs.span("train_step"):
             state, m = step(state, tok, lab)
-            # dispatch is async: fence inside the span so it measures
-            # the step, not the microseconds of queueing it
-            obs.fence(m["loss"])
+            if telemetry:
+                # dispatch is async: fence inside the span so it
+                # measures the step, not the microseconds of queueing
+                # it.  Only when telemetry is on — the span is a no-op
+                # otherwise, and an unconditional fence would serialize
+                # host dispatch against the device every step.
+                obs.fence(m["loss"])
         if telemetry:
             # host-side at the step boundary: loss-scale gauge +
             # overflow counters + train.* gauges (incl. grad_norm)

@@ -559,11 +559,12 @@ class TestGroupedKV:
 
 
 class TestBackwardModeRouting:
-    """auto currently resolves to the split dq/dkv pair everywhere
-    (the fused single-pass backward is unmeasured on silicon until the
-    sweep_r4 run flips APEX_TPU_FLASH_BWD_FUSED_MAX to the measured
-    crossover), so the fused kernel needs explicit opt-in coverage here
-    and the split kernels are exercised by every other grad test."""
+    """auto routes short keys (sk <= APEX_TPU_FLASH_BWD_FUSED_MAX,
+    default 512 — the round-5 measured crossover) to the fused
+    single-pass backward and longer keys to the split dq/dkv pair, so
+    both kernels get implicit coverage from the other grad tests; the
+    explicit env-forced cases here pin each kernel regardless of where
+    the crossover sits."""
 
     @pytest.mark.parametrize("causal", [False, True])
     def test_split_backward_matches_reference(self, monkeypatch, causal):

@@ -97,18 +97,29 @@ class TestFusedAdam:
         ours = _run_jax(opt.fused_adam(lr=sched), params, grads)
         assert np.isfinite(ours["p0"]).all()
 
-    def test_pallas_path_matches_xla_path(self):
+    def test_flat_buffer_path_matches_tree_path(self):
         params, grads = _rand_params_grads(4)
         base = _run_jax(
             opt.fused_adam(lr=1e-2, weight_decay=0.05), params, grads
         )
-        pallas = _run_jax(
-            opt.fused_adam(lr=1e-2, weight_decay=0.05, use_pallas=True),
+        flat = _run_jax(
+            opt.fused_adam(lr=1e-2, weight_decay=0.05,
+                           use_flat_buffer=True),
             params, grads,
         )
         for k in params:
-            np.testing.assert_allclose(pallas[k], base[k], atol=1e-6,
+            np.testing.assert_allclose(flat[k], base[k], atol=1e-6,
                                        rtol=1e-6)
+
+    def test_use_pallas_alias_deprecated_but_working(self):
+        params, grads = _rand_params_grads(4)
+        with pytest.warns(DeprecationWarning, match="use_flat_buffer"):
+            tx = opt.fused_adam(lr=1e-2, use_pallas=True)
+        aliased = _run_jax(tx, params, grads)
+        flat = _run_jax(
+            opt.fused_adam(lr=1e-2, use_flat_buffer=True), params, grads)
+        for k in params:
+            np.testing.assert_allclose(aliased[k], flat[k], rtol=1e-7)
 
 
 class TestFusedSGD:

@@ -56,6 +56,11 @@ def _run(name, cmd, env_extra=None, timeout=7200, stall=900):
     log = os.path.join(LOGS, f"{name}.log")
     env = dict(os.environ)
     env.setdefault("PYTHONPATH", ".:/root/.axon_site")
+    # Unbuffered children: the stall watchdog below keys on log-file
+    # growth, and a block-buffered healthy stage (python buffers stdout
+    # when it's not a tty) can sit on >900s of progress lines and get
+    # killed as "stalled" (ADVICE round 5).
+    env.setdefault("PYTHONUNBUFFERED", "1")
     if env_extra:
         env.update(env_extra)
     t0 = time.time()
@@ -147,15 +152,18 @@ def main():
                  if "s1024" in k and k.endswith("split")}
         if rows and split:
             best_k = min(rows, key=rows.get)
-            worst_split = max(split.values())
-            if rows[best_k] < min(split.values()):
+            best_split = min(split.values())
+            # log the SAME number the comparison uses (min, i.e. the
+            # best split time) in both branches, so the printed
+            # evidence matches the decision
+            if rows[best_k] < best_split:
                 print(f"  flash s1024: best fused {best_k}="
-                      f"{rows[best_k]:.2f} beats split "
-                      f"({worst_split:.2f}) -> raise "
+                      f"{rows[best_k]:.2f} beats best split "
+                      f"({best_split:.2f}) -> raise "
                       "APEX_TPU_FLASH_BWD_FUSED_MAX to 1024")
             else:
                 print(f"  flash s1024: split holds "
-                      f"({min(split.values()):.2f} vs best fused "
+                      f"({best_split:.2f} vs best fused "
                       f"{rows[best_k]:.2f}) -> FUSED_MAX stays 512")
         for k, v in sweep.items():
             if "remeasure" in k:
