@@ -31,6 +31,8 @@ from apex_tpu.models.generate import (  # noqa: F401
     decode_step,
     generate,
     init_kv_cache,
+    prefill,
+    sample_logits,
 )
 from apex_tpu.models.gpt import (  # noqa: F401
     gpt_pipeline_loss_and_grads,

@@ -1,24 +1,37 @@
-"""Autoregressive GPT decoding with a KV cache.
+"""Autoregressive GPT inference: batched flash prefill + ragged decode.
 
 Beyond the reference: apex is a training-acceleration library with no
 generation runtime (its GPT exists for scaling tests,
 standalone_gpt.py), but a complete framework needs the inference half of
-the model family.  TPU-native design:
+the model family.  TPU-native design (ISSUE 3):
 
-- the whole decode loop is ONE ``lax.scan`` under jit (no per-token
-  dispatch); static shapes throughout — the cache is pre-allocated at
-  ``max_len`` and masked by position;
-- the per-step attention is dense over the cache (sq=1 never benefits
+- **prefill/decode split** — :func:`prefill` runs the full-sequence
+  training forward (the same ``ops/flash_attention.py`` causal kernel
+  the train step uses) and writes the whole KV cache in ONE batched
+  pass, so a 512-token prompt costs one forward instead of 512
+  sequential decode steps; :func:`decode_step` then extends one token
+  per call with dense attention over the cache (sq=1 never benefits
   from the flash kernel's tiling) with fp32 accumulation on the MXU;
+- **ragged batching** — the cache position is a ``[b]`` int32 vector,
+  so prompts of different lengths batch together left-aligned without
+  padding every sequence to the longest: per-sequence attention masks,
+  per-sequence rotary offsets (``ops.rope.fused_apply_rotary_pos_emb_
+  ragged``) and per-sequence EOS done-flags; the outer decode is a
+  ``lax.while_loop`` that exits when every sequence has finished
+  instead of always scanning ``max_new_tokens``;
+- static shapes throughout — the cache is pre-allocated at ``max_len``
+  and masked by position, the one compiled decode body serves every
+  step;
 - parameters are the exact training pytree (init_gpt_params /
   tools/import_hf.py), so a trained or imported model generates without
   conversion; numerics follow transformer_lm.py layer-for-layer
   (pre-LN or the post-LN-residual flag, gelu/gelu_tanh/swiglu FFNs,
-  learned or rope positions).
+  learned or rope positions, MHA or grouped-query K/V).
 
 Teacher-forcing parity with ``gpt_forward`` is tested to float
-tolerance (tests/test_generate.py), which pins the cached attention
-against the training forward.
+tolerance and prefill-vs-stepwise cache equivalence is pinned exactly
+(tests/test_generate.py).  The slot-based continuous-batching engine in
+``apex_tpu/serving`` builds on these three primitives.
 """
 
 from __future__ import annotations
@@ -32,28 +45,80 @@ import jax.numpy as jnp
 from apex_tpu.models.config import TransformerConfig
 from apex_tpu.models.transformer_lm import (
     apply_norm, lm_head_weight, rope_cos_sin)
+from apex_tpu.observability import metrics as _telemetry
 
-__all__ = ["init_kv_cache", "decode_step", "generate"]
+__all__ = ["init_kv_cache", "decode_step", "prefill", "generate",
+           "sample_logits"]
 
 
-def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int):
-    """[L, b, max_len, kv_groups, dh] k/v buffers + position counter.
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
+                  cache_dtype=None):
+    """[L, b, max_len, kv_groups, dh] k/v buffers + ``[b]`` positions.
 
     Under GQA the cache holds only the group heads — the persistent
     per-token memory shrinks by num_attention_heads/num_query_groups
-    (the principal GQA/MQA serving win, arXiv:2305.13245)."""
+    (the principal GQA/MQA serving win, arXiv:2305.13245).
+
+    ``cache_dtype`` overrides the buffer dtype (default
+    ``cfg.compute_dtype``) so a serving deployment can hold bf16 caches
+    under an fp32 compute config — decode casts at the attention einsum
+    as it already does for the compute dtype.
+
+    ``pos`` is per-sequence: sequence ``i``'s next token lands at
+    ``pos[i]`` and its attention sees ``t <= pos[i]``, which is what
+    lets ragged prompts share one batch.
+    """
+    dt = cfg.compute_dtype if cache_dtype is None else cache_dtype
     nh = cfg.kv_groups
     dh = cfg.kv_channels
     shape = (cfg.num_layers, batch, max_len, nh, dh)
     return {
-        "k": jnp.zeros(shape, cfg.compute_dtype),
-        "v": jnp.zeros(shape, cfg.compute_dtype),
-        "pos": jnp.zeros((), jnp.int32),
+        "k": jnp.zeros(shape, dt),
+        "v": jnp.zeros(shape, dt),
+        "pos": jnp.zeros((batch,), jnp.int32),
     }
 
 
+def _check_sampling_args(temperature: float,
+                         top_k: Optional[int]) -> None:
+    """Shared static-argument guard for sample_logits / generate."""
+    if temperature < 0:
+        raise ValueError(
+            f"temperature={temperature}: negative temperatures would "
+            "silently invert the distribution (prefer the *least* "
+            "likely tokens); pass 0 for greedy or a positive value")
+    if top_k is not None and top_k < 1:
+        raise ValueError(
+            f"top_k={top_k}: pass None (not 0) to disable the cutoff — "
+            "a zero-width cutoff would silently break the nucleus mask")
+
+
+def _check_decode_cfg(cfg: TransformerConfig) -> None:
+    """Shared config guard for every cached-inference entry point."""
+    if cfg.num_experts:
+        raise ValueError(
+            "KV-cache decoding does not support MoE configs yet")
+    if cfg.attn_mask_type != "causal":
+        raise ValueError(
+            "KV-cache decoding is causal by construction; "
+            f"attn_mask_type={cfg.attn_mask_type!r} would silently "
+            "decode with the wrong mask")
+
+
+def _vector_pos(cache: dict, batch: int) -> jax.Array:
+    """Normalize the cache position to the ``[b]`` vector form (legacy
+    scalar-counter caches broadcast — every sequence at the same
+    offset)."""
+    pos = cache["pos"]
+    if pos.ndim == 0:
+        return jnp.full((batch,), pos, jnp.int32)
+    return pos.astype(jnp.int32)
+
+
 def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
-    """One layer, one token: x [b, 1, h] + cache slice [b, T, nh, dh]."""
+    """One layer, one token: x [b, 1, h] + cache slice [b, T, nh, dh];
+    ``pos`` [b] int32 — each sequence writes and attends at its own
+    offset."""
     b = x.shape[0]
     nh = cfg.num_attention_heads
     dh = cfg.kv_channels
@@ -69,17 +134,19 @@ def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
         q, k, v = jnp.split(qkv, 3, axis=-1)
     if rope is not None:
         cos, sin = rope          # [max_len, d]
-        cos_t = jax.lax.dynamic_slice_in_dim(cos, pos, 1)[None, :, None]
-        sin_t = jax.lax.dynamic_slice_in_dim(sin, pos, 1)[None, :, None]
-        from apex_tpu.ops.rope import fused_apply_rotary_pos_emb_cached
+        from apex_tpu.ops.rope import fused_apply_rotary_pos_emb_ragged
 
-        q = fused_apply_rotary_pos_emb_cached(q, cos_t, sin_t)
-        k = fused_apply_rotary_pos_emb_cached(k, cos_t, sin_t)
+        q = fused_apply_rotary_pos_emb_ragged(q, cos, sin, pos)
+        k = fused_apply_rotary_pos_emb_ragged(k, cos, sin, pos)
 
-    cache_k = jax.lax.dynamic_update_slice_in_dim(
-        cache_k, k.astype(cache_k.dtype), pos, axis=1)
-    cache_v = jax.lax.dynamic_update_slice_in_dim(
-        cache_v, v.astype(cache_v.dtype), pos, axis=1)
+    # per-sequence scatter: row (i, pos[i]) only — O(b·nh·dh) written
+    # per step, not a full-buffer select; out-of-bounds positions
+    # (finished rows parked past the cache) drop, matching the masked
+    # semantics below
+    b_idx = jnp.arange(b)
+    cache_k = cache_k.at[b_idx, pos].set(k[:, 0].astype(cache_k.dtype))
+    cache_v = cache_v.at[b_idx, pos].set(v[:, 0].astype(cache_v.dtype))
+    t_idx = jnp.arange(cache_k.shape[1])
 
     # dense attention over the (masked) cache; under GQA the query
     # heads fold as [groups, rep] against the group-width cache — no
@@ -90,8 +157,8 @@ def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
     qg = q.reshape(b, 1, g, rep, dh)
     s = jnp.einsum("bqgrd,btgd->bgrqt", qg, cache_k,
                    preferred_element_type=jnp.float32) * scale
-    t_idx = jnp.arange(cache_k.shape[1])
-    s = jnp.where((t_idx <= pos)[None, None, None, None, :], s, -1e30)
+    live = (t_idx[None] <= pos[:, None])[:, None, None, None, :]
+    s = jnp.where(live, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     ctxv = jnp.einsum("bgrqt,btgd->bqgrd", p.astype(cache_v.dtype),
                       cache_v,
@@ -111,24 +178,18 @@ def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
 
 def decode_step(params: dict, token: jax.Array, cache: dict,
                 cfg: TransformerConfig):
-    """One decoding step: token [b] int32 at position ``cache['pos']`` →
+    """One decoding step: token [b] int32 at per-sequence position
+    ``cache['pos']`` ([b] int32; a legacy scalar broadcasts) →
     (logits [b, v], updated cache)."""
-    if cfg.num_experts:
-        raise ValueError(
-            "KV-cache decoding does not support MoE configs yet")
-    if cfg.attn_mask_type != "causal":
-        raise ValueError(
-            "KV-cache decoding is causal by construction; "
-            f"attn_mask_type={cfg.attn_mask_type!r} would silently "
-            "decode with the wrong mask")
+    _check_decode_cfg(cfg)
     cd = cfg.compute_dtype
-    pos = cache["pos"]
+    b = token.shape[0]
+    pos = _vector_pos(cache, b)
     x = jnp.take(params["embedding"]["word"].astype(cd), token,
                  axis=0)[:, None]
     if cfg.position_embedding_type == "learned":
-        pe = jax.lax.dynamic_slice_in_dim(
-            params["embedding"]["position"], pos, 1)
-        x = x + pe.astype(cd)[None]
+        pe = jnp.take(params["embedding"]["position"], pos, axis=0)
+        x = x + pe.astype(cd)[:, None]
     rope = None
     if cfg.position_embedding_type == "rope":
         rope = rope_cos_sin(cache["k"].shape[2], cfg.kv_channels)
@@ -152,8 +213,229 @@ def decode_step(params: dict, token: jax.Array, cache: dict,
     return logits, cache
 
 
+def _layer_prefill(cfg, lp, x, kpm, rope):
+    """One layer over the whole prompt [b, s, h]: the training
+    forward's attention block (``transformer_lm._attention`` with
+    ``return_kv`` — ONE implementation of the projection/split/rope/
+    flash-attention math, so prefill cannot drift from training) plus
+    the residual/MLP wiring of ``_layer`` without dropout."""
+    from apex_tpu.models.transformer_lm import (
+        _attention, _mlp, single_device_ctx)
+
+    ctx = single_device_ctx()
+    h = apply_norm(cfg, x, lp["ln1_scale"], lp["ln1_bias"])
+    a, k, v = _attention(cfg, lp, h, ctx, kpm, rope, None,
+                         return_kv=True)
+    res = h if cfg.apply_residual_connection_post_layernorm else x
+    x = res + a
+    h = apply_norm(cfg, x, lp["ln2_scale"], lp["ln2_bias"])
+    m = _mlp(cfg, lp, h, ctx)
+    res = h if cfg.apply_residual_connection_post_layernorm else x
+    return res + m, k, v
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_len",
+                                             "cache_dtype"))
+def prefill(
+    params: dict,
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    prompt_lens: Optional[jax.Array] = None,
+    cache: Optional[dict] = None,
+    max_len: Optional[int] = None,
+    cache_dtype=None,
+):
+    """Consume a whole prompt [b, s] in ONE batched forward →
+    (last-token logits [b, v], filled KV cache).
+
+    This is the fast half of the prefill/decode split: the prompt runs
+    through the full-sequence training forward (flash attention for the
+    causal pattern — O(s·d) memory, MXU-tiled) and every layer's
+    post-rope K/V lands in the cache in a single dynamic-update, so a
+    512-token prompt costs one forward instead of 512 sequential
+    :func:`decode_step` calls.
+
+    Ragged batches: ``prompt_lens`` [b] int32 marks each row's real
+    length (rows are LEFT-aligned, padding on the right).  Padding keys
+    are masked in-kernel via the flash key-padding path; the garbage
+    K/V written at a row's padding slots is invisible (decode masks
+    ``t <= pos[i]``) and is overwritten slot-by-slot as that sequence
+    decodes.  The returned ``cache['pos']`` equals ``prompt_lens``.
+
+    ``cache``: fill an existing cache (e.g. a serving slot buffer of
+    ``max_len`` > s); otherwise one is allocated at ``max_len``
+    (default ``s``) with ``cache_dtype``.
+    """
+    _check_decode_cfg(cfg)
+    b, s = prompt.shape
+    if cache is None:
+        cache = init_kv_cache(cfg, b, max_len if max_len else s,
+                              cache_dtype=cache_dtype)
+    if s > cache["k"].shape[2]:
+        raise ValueError(
+            f"prompt length {s} exceeds the cache max_len "
+            f"{cache['k'].shape[2]}")
+    cd = cfg.compute_dtype
+    lens = (jnp.full((b,), s, jnp.int32) if prompt_lens is None
+            else prompt_lens.astype(jnp.int32))
+    # key-padding mask (True = masked) only when the batch is ragged —
+    # the uniform path keeps the exact training-forward flash variant
+    kpm = None
+    if prompt_lens is not None:
+        kpm = jnp.arange(s)[None] >= lens[:, None]
+
+    x = jnp.take(params["embedding"]["word"].astype(cd), prompt, axis=0)
+    if cfg.position_embedding_type == "learned":
+        x = x + params["embedding"]["position"][:s].astype(cd)[None]
+    rope = None
+    if cfg.position_embedding_type == "rope":
+        rope = rope_cos_sin(s, cfg.kv_channels)
+
+    def body(x, lp):
+        x, k, v = _layer_prefill(cfg, lp, x, kpm, rope)
+        return x, (k.astype(cache["k"].dtype), v.astype(cache["v"].dtype))
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+
+    x = apply_norm(cfg, x, params["final_ln"]["scale"],
+                   params["final_ln"]["bias"])
+    # logits for each row's LAST REAL token only ([b, h] @ head — the
+    # [b, s, v] prompt logits are never materialized)
+    x_last = jnp.take_along_axis(
+        x, jnp.maximum(lens - 1, 0)[:, None, None], axis=1)[:, 0]
+    logits = jnp.einsum(
+        "bh,vh->bv", x_last, lm_head_weight(params, cfg).astype(cd),
+        preferred_element_type=jnp.float32)
+    cache = {
+        "k": jax.lax.dynamic_update_slice_in_dim(
+            cache["k"], ks, 0, axis=2),
+        "v": jax.lax.dynamic_update_slice_in_dim(
+            cache["v"], vs, 0, axis=2),
+        "pos": lens,
+    }
+    return logits, cache
+
+
+def sample_logits(logits, key, *, temperature: float = 0.0,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None,
+                  vocab_limit: Optional[int] = None):
+    """Pick next tokens [b] from logits [b, v] (greedy at
+    ``temperature=0``; otherwise softmax sampling with optional
+    ``top_k`` and/or nucleus ``top_p`` cutoffs — both given =
+    intersection, top_k first).
+
+    ``vocab_limit`` masks logits at and beyond that id — REQUIRED
+    knowledge for padded vocab tables (tools/import_hf.py pads GPT-2's
+    50257 to 50304; the zero-logit pad ids would otherwise be sampleable
+    and can even win argmax when all real logits are negative).
+
+    Without ``top_p`` the top-k cutoff uses ``jax.lax.top_k``
+    (O(v·log k)) instead of a full descending sort (O(v·log v)) —
+    sample_logits runs once per decoded token, and at GPT-2's 50k vocab
+    the full sort is real money.  The single-sort path survives only
+    where the nucleus mass genuinely needs the sorted cumulative sum.
+    """
+    _check_sampling_args(temperature, top_k)
+    if vocab_limit is not None:
+        over = jnp.arange(logits.shape[-1]) >= vocab_limit
+        logits = jnp.where(over[None], -1e30, logits)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_p is None:
+        if top_k is not None:
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        return jax.random.categorical(key, logits).astype(jnp.int32)
+    # one descending sort serves both cutoffs (the nucleus mass below
+    # needs the sorted cumulative sum anyway)
+    sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+    if top_k is not None:
+        kth = sorted_l[:, top_k - 1][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+        # reflect the cutoff in sorted space so the nucleus mass
+        # below is computed over the top_k-filtered distribution
+        rank = jnp.arange(sorted_l.shape[-1])[None]
+        sorted_l = jnp.where(rank >= top_k, -1e30, sorted_l)
+    # nucleus: drop tokens outside the smallest prob-sorted prefix
+    # reaching mass top_p; n_keep clamps to 1 so the head token always
+    # stays (top_p<=0 means near-greedy, not a silent no-op)
+    probs = jax.nn.softmax(sorted_l, axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (csum - probs) < top_p
+    n_keep = jnp.maximum(jnp.sum(keep_sorted, axis=-1), 1)
+    cutoff = jnp.take_along_axis(
+        sorted_l, (n_keep - 1)[:, None], axis=-1)
+    logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=(
-    "cfg", "max_new_tokens", "temperature", "top_k", "vocab_limit"))
+    "cfg", "max_new_tokens", "temperature", "top_k", "top_p",
+    "vocab_limit", "eos_token_id", "cache_dtype"))
+def _generate_impl(params, prompt, prompt_lens, rng, *, cfg,
+                   max_new_tokens, temperature, top_k, top_p,
+                   vocab_limit, eos_token_id, cache_dtype):
+    """Prefill + while-loop decode; returns (tokens, realized steps)."""
+    b, s = prompt.shape
+    total = s + max_new_tokens
+    cache = init_kv_cache(cfg, b, total, cache_dtype=cache_dtype)
+    lens = (jnp.full((b,), s, jnp.int32) if prompt_lens is None
+            else prompt_lens.astype(jnp.int32))
+    logits, cache = prefill(params, prompt, cfg,
+                            prompt_lens=prompt_lens, cache=cache)
+    tokens = jnp.concatenate(
+        [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1)
+    col = jnp.arange(total)
+
+    def pick(lg, key):
+        return sample_logits(lg, key, temperature=temperature,
+                             top_k=top_k, top_p=top_p,
+                             vocab_limit=vocab_limit)
+
+    def cond(carry):
+        i, done = carry[0], carry[1]
+        # the loop only needs max_new_tokens - 1 decode forwards: the
+        # first token comes from the prefill logits and the LAST one
+        # needs no decode_step (nothing ever consumes its K/V)
+        return (i < max_new_tokens - 1) & ~jnp.all(done)
+
+    def body(carry):
+        i, done, logits, tokens, cache, key = carry
+        key, sub = jax.random.split(key)
+        nxt = pick(logits, sub)
+        # each live sequence appends at its own end (lens[i] + step) —
+        # the emitted EOS itself is written, later steps are not
+        wmask = (col[None] == (lens + i)[:, None]) & (~done)[:, None]
+        tokens = jnp.where(wmask, nxt[:, None].astype(tokens.dtype),
+                           tokens)
+        if eos_token_id is not None:
+            done = done | (nxt == eos_token_id)
+        # the decode batch stays rectangular: finished sequences still
+        # step (their logits are ignored) but their cache position is
+        # frozen so they stop consuming slots
+        prev = cache["pos"]
+        logits, cache = decode_step(params, nxt.astype(prompt.dtype),
+                                    cache, cfg)
+        cache = dict(cache, pos=jnp.where(done, prev, cache["pos"]))
+        return (i + 1, done, logits, tokens, cache, key)
+
+    carry = (jnp.int32(0), jnp.zeros((b,), bool), logits, tokens, cache,
+             rng)
+    i, done, logits, tokens, _, key = jax.lax.while_loop(cond, body,
+                                                         carry)
+    # the final token: sampled from the last logits, no decode behind it
+    if max_new_tokens > 0:
+        _, sub = jax.random.split(key)
+        nxt = pick(logits, sub)
+        wmask = (col[None] == (lens + i)[:, None]) & (~done)[:, None]
+        tokens = jnp.where(wmask, nxt[:, None].astype(tokens.dtype),
+                           tokens)
+    return tokens, i
+
+
 def generate(
     params: dict,
     prompt: jax.Array,
@@ -165,20 +447,40 @@ def generate(
     top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
     vocab_limit: Optional[int] = None,
+    prompt_lens: Optional[jax.Array] = None,
+    eos_token_id: Optional[int] = None,
+    cache_dtype=None,
 ) -> jax.Array:
-    """Decode ``max_new_tokens`` past ``prompt`` [b, s] → [b, s+new].
+    """Decode up to ``max_new_tokens`` past ``prompt`` [b, s] →
+    [b, s+max_new_tokens].
 
-    ``temperature=0`` is greedy; otherwise softmax sampling with an
-    optional ``top_k`` cutoff and/or nucleus ``top_p`` cutoff (keep the
-    smallest prefix of probability-sorted tokens whose mass reaches
-    ``top_p``; both given = intersection, top_k first).  The prompt is
-    consumed through the same cached step (prefill == decode path, so
-    the parity test covers both).
+    The prompt is consumed by ONE batched :func:`prefill` forward
+    (flash attention, whole KV cache written in one pass); decoding is
+    a ``lax.while_loop`` over :func:`decode_step` that exits as soon as
+    every sequence has emitted ``eos_token_id`` (when given) instead of
+    always scanning ``max_new_tokens``.
 
-    ``vocab_limit`` masks logits at and beyond that id — REQUIRED
-    knowledge for padded vocab tables (tools/import_hf.py pads GPT-2's
-    50257 to 50304; the zero-logit pad ids would otherwise be sampleable
-    and can even win argmax when all real logits are negative).
+    ``temperature=0`` is greedy; otherwise softmax sampling with the
+    optional ``top_k`` / nucleus ``top_p`` cutoffs of
+    :func:`sample_logits`.  ``vocab_limit`` masks padded vocab ids
+    (tools/import_hf.py).
+
+    Ragged batches: pass right-padded prompts plus ``prompt_lens`` [b]
+    int32.  Each sequence decodes from its own length — generated
+    tokens overwrite the row's padding left-to-right, so row ``i``
+    holds its prompt in ``[:lens[i]]``, its generation in
+    ``[lens[i]:lens[i]+n_i]``, and untouched padding after.  Greedy
+    output is token-identical to running each sequence through its own
+    unbatched ``generate`` call (tests/test_generate.py pins this).
+
+    When telemetry is configured the call records
+    ``generate.prefill_calls`` and ``generate.decode_steps`` counters —
+    the decode-step count equals the realized while-loop trip count
+    (``== max_new_tokens - 1`` when no sequence stops early: the first
+    token comes from the prefill logits and the last needs no decode
+    behind it), which is how the prefill-not-per-token property is
+    asserted in tests — the count scales with the NEW tokens, never
+    with the prompt length.
     """
     b, s = prompt.shape
     total = s + max_new_tokens
@@ -188,68 +490,20 @@ def generate(
             f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"max_position_embeddings ({cfg.max_position_embeddings}); "
             "the learned position lookup would silently clamp")
-    if top_k is not None and top_k < 1:
-        raise ValueError(
-            f"top_k={top_k}: pass None (not 0) to disable the cutoff — "
-            "a zero-width cutoff would silently break the nucleus mask")
-    cache = init_kv_cache(cfg, b, total)
+    _check_sampling_args(temperature, top_k)
+    _check_decode_cfg(cfg)
     if rng is None:
         rng = jax.random.PRNGKey(0)
-
-    def pick(logits, key):
-        if vocab_limit is not None:
-            over = jnp.arange(logits.shape[-1]) >= vocab_limit
-            logits = jnp.where(over[None], -1e30, logits)
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / temperature
-        if top_k is not None or top_p is not None:
-            # one descending sort serves both cutoffs (pick() runs every
-            # scan step; a second O(v log v) sort per token is real money
-            # at GPT-2's 50k vocab)
-            sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
-        if top_k is not None:
-            kth = sorted_l[:, top_k - 1][:, None]
-            logits = jnp.where(logits < kth, -1e30, logits)
-            # reflect the cutoff in sorted space so the nucleus mass
-            # below is computed over the top_k-filtered distribution
-            pos = jnp.arange(sorted_l.shape[-1])[None]
-            sorted_l = jnp.where(pos >= top_k, -1e30, sorted_l)
-        if top_p is not None:
-            # nucleus: drop tokens outside the smallest prob-sorted
-            # prefix reaching mass top_p; n_keep clamps to 1 so the
-            # head token always stays (top_p<=0 means near-greedy, not
-            # a silent no-op)
-            probs = jax.nn.softmax(sorted_l, axis=-1)
-            csum = jnp.cumsum(probs, axis=-1)
-            keep_sorted = (csum - probs) < top_p
-            n_keep = jnp.maximum(jnp.sum(keep_sorted, axis=-1), 1)
-            cutoff = jnp.take_along_axis(
-                sorted_l, (n_keep - 1)[:, None], axis=-1)
-            logits = jnp.where(logits < cutoff, -1e30, logits)
-        return jax.random.categorical(key, logits).astype(jnp.int32)
-
-    def body(carry, i):
-        cache, tokens, key = carry
-        token = jax.lax.dynamic_index_in_dim(
-            tokens, i, axis=1, keepdims=False)
-        logits, cache = decode_step(params, token, cache, cfg)
-        key, sub = jax.random.split(key)
-        nxt = pick(logits, sub)
-        # only write past the prompt (positions < s-1 feed the prefill)
-        write_at = i + 1
-        keep = write_at >= s
-        cur = jax.lax.dynamic_index_in_dim(
-            tokens, jnp.minimum(write_at, total - 1), axis=1,
-            keepdims=False)
-        out = jnp.where(keep, nxt, cur)
-        tokens = jax.lax.dynamic_update_slice_in_dim(
-            tokens, out[:, None], jnp.minimum(write_at, total - 1),
-            axis=1)
-        return (cache, tokens, key), None
-
-    tokens = jnp.concatenate(
-        [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1)
-    (cache, tokens, _), _ = jax.lax.scan(
-        body, (cache, tokens, rng), jnp.arange(total - 1))
+    if prompt_lens is not None:
+        prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+    tokens, n_steps = _generate_impl(
+        params, prompt, prompt_lens, rng, cfg=cfg,
+        max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, top_p=top_p, vocab_limit=vocab_limit,
+        eos_token_id=eos_token_id, cache_dtype=cache_dtype)
+    if _telemetry.enabled():
+        # host-side counters (the jitted loop cannot emit); reading the
+        # realized trip count syncs — acceptable when telemetry is on
+        _telemetry.counter("generate.prefill_calls").inc()
+        _telemetry.counter("generate.decode_steps").inc(int(n_steps))
     return tokens

@@ -569,9 +569,14 @@ def split_qkv_gqa(cfg: TransformerConfig, qkv, b, s, nh):
 
 
 def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
-               attention_mask, rope, dropout_rng):
+               attention_mask, rope, dropout_rng, return_kv: bool = False):
     """ParallelAttention (reference :358): column-parallel fused QKV,
-    core attention, row-parallel output projection."""
+    core attention, row-parallel output projection.
+
+    ``return_kv=True`` additionally returns the post-rope group-width
+    K/V — the KV-cache prefill (models/generate.py) consumes them, so
+    the inference prefill and the training forward share ONE
+    implementation of the projection/split/rope/core-attention math."""
     nh = cfg.num_attention_heads // ctx.tp
     b, s, _ = x.shape
 
@@ -619,7 +624,8 @@ def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
     ctxv = ctxv.reshape(b, s, -1)
     out = ctxv @ lp["proj_kernel"].astype(x.dtype)
     out = ctx.reduce_out(out)
-    return out + lp["proj_bias"].astype(x.dtype)
+    out = out + lp["proj_bias"].astype(x.dtype)
+    return (out, k, v) if return_kv else out
 
 
 def _moe_mlp(cfg: TransformerConfig, lp: dict, x):

@@ -18,6 +18,7 @@ from apex_tpu.ops.rope import (  # noqa: F401
     fused_apply_rotary_pos_emb,
     fused_apply_rotary_pos_emb_2d,
     fused_apply_rotary_pos_emb_cached,
+    fused_apply_rotary_pos_emb_ragged,
     fused_apply_rotary_pos_emb_thd,
 )
 from apex_tpu.ops.softmax import (  # noqa: F401

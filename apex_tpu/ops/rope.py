@@ -31,6 +31,7 @@ __all__ = [
     "fused_apply_rotary_pos_emb_cached",
     "fused_apply_rotary_pos_emb_thd",
     "fused_apply_rotary_pos_emb_2d",
+    "fused_apply_rotary_pos_emb_ragged",
 ]
 
 
@@ -127,6 +128,33 @@ def fused_apply_rotary_pos_emb_thd(
     cos = jnp.take(jnp.cos(f32), pos, axis=0)[:, None, :]         # [T,1,d2]
     sin = jnp.take(jnp.sin(f32), pos, axis=0)[:, None, :]
     return _rope(t, cos, sin)
+
+
+def fused_apply_rotary_pos_emb_ragged(
+    t: jax.Array,
+    cos_: jax.Array,
+    sin_: jax.Array,
+    positions: jax.Array,
+) -> jax.Array:
+    """`bshd` layout with per-sequence base positions: t [b, s, h, d],
+    cos_/sin_ tables [max_len, d2], positions [b] int32 — token (i, j)
+    rotates by angle table row ``positions[i] + j``.
+
+    The ragged-batch inference case (models/generate.py): sequences at
+    different absolute offsets decode together, so the rotary row is a
+    per-batch gather rather than the uniform slice of the cached
+    variant.  ``positions`` of shape ``()`` broadcasts (uniform batch —
+    the legacy scalar-pos decode).  Rows are clamped to the table, so a
+    finished sequence whose position counter ran past ``max_len`` reads
+    a valid (ignored) angle instead of out-of-bounds memory.
+    """
+    b, s = t.shape[0], t.shape[1]
+    pos = jnp.broadcast_to(jnp.asarray(positions, jnp.int32), (b,))
+    rows = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+    rows = jnp.clip(rows, 0, cos_.shape[0] - 1)
+    cos_g = jnp.take(cos_.astype(jnp.float32), rows, axis=0)[:, :, None, :]
+    sin_g = jnp.take(sin_.astype(jnp.float32), rows, axis=0)[:, :, None, :]
+    return _rope(t, cos_g, sin_g)
 
 
 def fused_apply_rotary_pos_emb_2d(

@@ -1,0 +1,47 @@
+"""apex_tpu.serving — slot-based continuous-batching inference engine.
+
+The training half of the repo scales by sharding one step over many
+chips; the serving half scales by keeping ONE chip's decode batch full.
+This package turns the three ``models/generate.py`` primitives
+(:func:`~apex_tpu.models.generate.prefill`,
+:func:`~apex_tpu.models.generate.decode_step`,
+:func:`~apex_tpu.models.generate.sample_logits`) into a request-level
+engine:
+
+- :class:`~apex_tpu.serving.engine.ServingEngine` — a fixed pool of KV
+  cache *slots*; new requests are admitted into freed slots mid-flight
+  (continuous batching, the vLLM/Orca scheduling idea specialized to
+  static TPU shapes), each prompt prefilled in one flash forward and
+  all live slots advanced by one token per batched decode step;
+- :mod:`~apex_tpu.serving.batching` — the bucketed prompt-length
+  compile cache (prefill recompiles per *bucket*, O(log max_len)
+  shapes, never per request) and slot bookkeeping;
+- observability — ``serving.{prefill_ms, decode_tokens_per_sec,
+  slot_occupancy, queue_depth}`` through the existing metrics registry
+  (docs/observability.md), plus ``serving.prefill`` spans.
+
+See docs/inference.md for the engine lifecycle and bench.py
+``--decode`` for the measured prefill-heavy / decode-heavy mixes.
+"""
+
+from apex_tpu.serving.batching import (  # noqa: F401
+    SlotPool,
+    default_buckets,
+    pad_prompt,
+    pick_bucket,
+)
+from apex_tpu.serving.engine import (  # noqa: F401
+    Request,
+    Response,
+    ServingEngine,
+)
+
+__all__ = [
+    "Request",
+    "Response",
+    "ServingEngine",
+    "SlotPool",
+    "default_buckets",
+    "pad_prompt",
+    "pick_bucket",
+]

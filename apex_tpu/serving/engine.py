@@ -1,0 +1,383 @@
+"""The slot-based continuous-batching serving engine.
+
+Lifecycle (docs/inference.md has the full walkthrough)::
+
+    engine = ServingEngine(params, cfg, max_slots=8, max_len=1024)
+    rid = engine.submit([1, 2, 3], max_new_tokens=32, eos_token_id=50256)
+    while True:
+        for resp in engine.step():       # 0+ completed Responses
+            ...
+        if engine.idle:
+            break
+    # or simply: responses = engine.run(requests)
+
+Each :meth:`ServingEngine.step`:
+
+1. **admit** — while a cache slot is free and the queue is non-empty,
+   pop a request, pad its prompt to the smallest compile bucket, run
+   ONE batched flash :func:`~apex_tpu.models.generate.prefill` into a
+   bucket-sized cache, scatter that into the slot's row of the big
+   cache, and sample the first token from the prefill logits.  A
+   request can therefore enter the batch *mid-flight*, the moment an
+   earlier one frees its slot — the continuous-batching property that
+   keeps decode utilization flat under mixed-length traffic.
+2. **decode** — one batched :func:`~apex_tpu.models.generate.decode_step`
+   over ALL slots (the batch stays rectangular; inactive slots ride
+   along masked, their cache positions frozen), then a vectorized
+   sample with per-slot temperatures.  One host sync per step reads the
+   new tokens for EOS / length bookkeeping.
+3. **complete** — slots whose token hit ``eos_token_id`` or whose
+   budget ran out are converted to :class:`Response` and released.
+
+Static-shape discipline: exactly one decode compile for the engine's
+lifetime (shape ``[max_slots]``), one prefill compile per prompt
+bucket, one scatter compile per bucket — the bucketed compile cache
+that bounds recompiles under production traffic.
+
+Telemetry (no-op unless ``observability.configure`` ran):
+``serving.prefill_ms`` (histogram, per admission),
+``serving.decode_tokens_per_sec`` (gauge, per step),
+``serving.slot_occupancy`` / ``serving.queue_depth`` (gauges), and the
+``serving.{requests,prefill_calls,decode_steps,tokens_generated}``
+counters the trace-count tests pin against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from collections import deque
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.generate import (
+    _check_decode_cfg, decode_step, init_kv_cache, prefill, sample_logits)
+from apex_tpu.observability import metrics as _telemetry
+from apex_tpu.observability import span
+from apex_tpu.serving.batching import (
+    SlotPool, default_buckets, pad_prompt, pick_bucket)
+
+__all__ = ["Request", "Response", "ServingEngine"]
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``prompt`` is a 1-D int token array."""
+
+    prompt: np.ndarray
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    eos_token_id: Optional[int] = None
+    request_id: Optional[int] = None
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens={self.max_new_tokens} must be >= 1")
+        if self.temperature < 0:
+            raise ValueError(
+                f"temperature={self.temperature}: negative temperatures "
+                "would silently invert the distribution; pass 0 for "
+                "greedy or a positive value")
+
+
+@dataclasses.dataclass
+class Response:
+    """A completed request: generated tokens (prompt excluded)."""
+
+    request_id: int
+    prompt: np.ndarray
+    tokens: np.ndarray
+    finish_reason: str            # 'eos' | 'length'
+    prefill_ms: float
+    decode_steps: int
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Host bookkeeping for one live cache slot."""
+
+    request: Request
+    tokens: List[int]
+    prefill_ms: float
+
+
+class ServingEngine:
+    """Continuous-batching engine over a fixed pool of KV cache slots.
+
+    ``max_len`` bounds prompt + generation per request (the per-slot
+    cache length).  ``cache_dtype`` (e.g. ``jnp.bfloat16``) shrinks the
+    resident cache under an fp32 compute config.  ``top_k`` / ``top_p``
+    / ``vocab_limit`` are engine-wide static sampling knobs (a jit
+    recompile each — per-request values would retrace); temperature is
+    per-request (a traced ``[max_slots]`` vector).
+    """
+
+    def __init__(self, params: dict, cfg: TransformerConfig, *,
+                 max_slots: int = 8, max_len: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 cache_dtype=None, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 vocab_limit: Optional[int] = None,
+                 rng: Optional[jax.Array] = None):
+        _check_decode_cfg(cfg)
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len or cfg.max_position_embeddings)
+        if (cfg.position_embedding_type == "learned"
+                and self.max_len > cfg.max_position_embeddings):
+            raise ValueError(
+                f"max_len={self.max_len} exceeds the learned position "
+                f"table ({cfg.max_position_embeddings})")
+        self.buckets = tuple(sorted(prompt_buckets
+                                    or default_buckets(self.max_len)))
+        if self.buckets[-1] > self.max_len:
+            raise ValueError(
+                f"largest prompt bucket {self.buckets[-1]} exceeds "
+                f"max_len {self.max_len}")
+        self.cache = init_kv_cache(cfg, self.max_slots, self.max_len,
+                                   cache_dtype=cache_dtype)
+        self._cache_dtype = self.cache["k"].dtype
+        self._pool = SlotPool(self.max_slots)
+        self._slots: List[Optional[_Slot]] = [None] * self.max_slots
+        self._queue: deque = deque()
+        self._key = rng if rng is not None else jax.random.PRNGKey(0)
+        # decode lane state, host-side mirrors of the device batch
+        self._pending = np.zeros((self.max_slots,), np.int32)
+        self._temps = np.zeros((self.max_slots,), np.float32)
+        self._next_id = 0
+        self._sampling = dict(top_k=top_k, top_p=top_p,
+                              vocab_limit=vocab_limit)
+        self._decode_fn = _make_decode_fn(cfg, top_k, top_p, vocab_limit)
+        self._sample_fn = _make_sample_fn(top_k, top_p, vocab_limit)
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, prompt, *, max_new_tokens: int = 32,
+               temperature: float = 0.0,
+               eos_token_id: Optional[int] = None) -> int:
+        """Queue one request; returns its request id."""
+        req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
+                      temperature=temperature, eos_token_id=eos_token_id,
+                      request_id=self._next_id)
+        if req.prompt.size + req.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({req.prompt.size}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds the engine max_len "
+                f"({self.max_len}); raise max_len or shorten the request")
+        pick_bucket(req.prompt.size, self.buckets)   # validate early
+        self._next_id += 1
+        self._queue.append(req)
+        _telemetry.counter("serving.requests").inc()
+        self._set_gauges()
+        return req.request_id
+
+    @property
+    def idle(self) -> bool:
+        """True when no request is queued or in flight."""
+        return not self._queue and self._pool.n_active == 0
+
+    def step(self) -> List[Response]:
+        """Admit what fits, decode one token for every live slot;
+        returns the requests completed by this step."""
+        completed = self._admit()
+        if self._pool.n_active:
+            completed.extend(self._decode_once())
+        self._set_gauges()
+        return completed
+
+    def run(self, requests: Sequence[dict] = (),
+            max_steps: Optional[int] = None) -> List[Response]:
+        """Submit ``requests`` (dicts of :meth:`submit` kwargs), drive
+        :meth:`step` until drained, return responses sorted by request
+        id."""
+        for kw in requests:
+            self.submit(**kw)
+        out: List[Response] = []
+        steps = 0
+        while not self.idle:
+            out.extend(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return sorted(out, key=lambda r: r.request_id)
+
+    def stats(self) -> dict:
+        return {
+            "queued": len(self._queue),
+            "active": self._pool.n_active,
+            "free_slots": self._pool.n_free,
+            "max_slots": self.max_slots,
+            "max_len": self.max_len,
+            "buckets": self.buckets,
+            "sampling": dict(self._sampling),
+        }
+
+    # -- internals ---------------------------------------------------------
+
+    def _set_gauges(self) -> None:
+        _telemetry.gauge("serving.slot_occupancy").set(
+            self._pool.n_active / self.max_slots)
+        _telemetry.gauge("serving.queue_depth").set(len(self._queue))
+
+    def _admit(self) -> List[Response]:
+        """Prefill queued requests into free slots (continuous
+        batching's entry edge).  Returns requests that completed at
+        admission (first token hit EOS, or a one-token budget)."""
+        completed = []
+        while self._queue and self._pool.n_free:
+            req = self._queue.popleft()
+            slot = self._pool.claim()
+            n = req.prompt.size
+            bucket = pick_bucket(n, self.buckets)
+            t0 = time.perf_counter()
+            with span("serving.prefill"):
+                padded = jnp.asarray(pad_prompt(req.prompt, bucket)[None])
+                lens = jnp.asarray([n], jnp.int32)
+                logits, small = prefill(
+                    self.params, padded, self.cfg, prompt_lens=lens,
+                    max_len=bucket, cache_dtype=self._cache_dtype)
+                self.cache = _insert_slot(
+                    self.cache, small["k"], small["v"],
+                    jnp.int32(slot), jnp.int32(n))
+                self._key, sub = jax.random.split(self._key)
+                first = self._sample_fn(
+                    logits, jnp.asarray([req.temperature], jnp.float32),
+                    sub)
+                tok = int(np.asarray(first)[0])      # host sync
+            ms = (time.perf_counter() - t0) * 1e3
+            _telemetry.counter("serving.prefill_calls").inc()
+            _telemetry.histogram("serving.prefill_ms").observe(ms)
+            _telemetry.counter("serving.tokens_generated").inc()
+            st = _Slot(request=req, tokens=[tok], prefill_ms=ms)
+            self._slots[slot] = st
+            self._pending[slot] = tok
+            self._temps[slot] = req.temperature
+            done = self._finish_reason(st, tok)
+            if done:
+                completed.append(self._complete(slot, done))
+        return completed
+
+    def _decode_once(self) -> List[Response]:
+        """One batched decode step over every slot (live ones advance,
+        free ones ride along masked)."""
+        active = np.zeros((self.max_slots,), bool)
+        for i, st in enumerate(self._slots):
+            active[i] = st is not None
+        t0 = time.perf_counter()
+        self._key, sub = jax.random.split(self._key)
+        nxt, self.cache = self._decode_fn(
+            self.params, self.cache, jnp.asarray(self._pending),
+            jnp.asarray(self._temps), jnp.asarray(active), sub)
+        nxt_host = np.asarray(nxt)                   # host sync
+        dt = time.perf_counter() - t0
+        _telemetry.counter("serving.decode_steps").inc()
+        completed = []
+        emitted = 0
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            tok = int(nxt_host[slot])
+            st.tokens.append(tok)
+            self._pending[slot] = tok
+            emitted += 1
+            done = self._finish_reason(st, tok)
+            if done:
+                completed.append(self._complete(slot, done))
+        _telemetry.counter("serving.tokens_generated").inc(emitted)
+        if dt > 0:
+            _telemetry.gauge("serving.decode_tokens_per_sec").set(
+                emitted / dt)
+        return completed
+
+    def _finish_reason(self, st: _Slot, tok: int) -> Optional[str]:
+        eos = st.request.eos_token_id
+        if eos is not None and tok == eos:
+            return "eos"
+        if len(st.tokens) >= st.request.max_new_tokens:
+            return "length"
+        return None
+
+    def _complete(self, slot: int, reason: str) -> Response:
+        st = self._slots[slot]
+        self._slots[slot] = None
+        self._temps[slot] = 0.0
+        self._pool.release(slot)
+        return Response(
+            request_id=st.request.request_id,
+            prompt=st.request.prompt,
+            tokens=np.asarray(st.tokens, np.int32),
+            finish_reason=reason,
+            prefill_ms=st.prefill_ms,
+            decode_steps=len(st.tokens) - 1,
+        )
+
+
+# -- jitted pieces ----------------------------------------------------------
+
+
+def _mixed_sample(logits, temps, key, *, top_k, top_p, vocab_limit):
+    """Per-row temperature sampling: greedy rows (temp == 0) take the
+    argmax, the rest sample at temperature 1 over pre-scaled logits —
+    one traced [b] vector, no recompile per request mix."""
+    greedy = sample_logits(logits, key, temperature=0.0,
+                           vocab_limit=vocab_limit)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = sample_logits(scaled, key, temperature=1.0, top_k=top_k,
+                            top_p=top_p, vocab_limit=vocab_limit)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_sample_fn(top_k, top_p, vocab_limit):
+    return jax.jit(functools.partial(
+        _mixed_sample, top_k=top_k, top_p=top_p, vocab_limit=vocab_limit))
+
+
+@functools.lru_cache(maxsize=None)
+def _make_decode_fn(cfg, top_k, top_p, vocab_limit):
+    """One compiled decode+sample step for the engine's lifetime —
+    memoized on the static knobs so engines sharing a config (tests,
+    multi-engine processes) share the XLA compile too.
+
+    The cache is donated: the slot buffers are updated in place on
+    device rather than copied per token (on CPU test platforms the
+    donation degrades to a copy with a one-time warning)."""
+
+    @functools.partial(jax.jit, donate_argnames=("cache",))
+    def step_fn(params, cache, tokens, temps, active, key):
+        prev_pos = cache["pos"]
+        logits, cache = decode_step(params, tokens, cache, cfg)
+        # free slots ride along; freezing their position keeps their
+        # lane from walking off the cache during long droughts
+        cache = dict(cache, pos=jnp.where(active, cache["pos"], prev_pos))
+        nxt = _mixed_sample(logits, temps, key, top_k=top_k, top_p=top_p,
+                            vocab_limit=vocab_limit)
+        return nxt, cache
+
+    return step_fn
+
+
+@functools.partial(jax.jit, donate_argnames=("cache",))
+def _insert_slot(cache, ks, vs, slot, length):
+    """Scatter a bucket-sized prefill cache [L, 1, S, g, dh] into row
+    ``slot`` of the big cache and set its position counter.  The big
+    cache is donated — admission updates the slot row in place instead
+    of copying the whole multi-slot buffer per request."""
+    k = jax.lax.dynamic_update_slice(
+        cache["k"], ks.astype(cache["k"].dtype),
+        (jnp.int32(0), slot, jnp.int32(0), jnp.int32(0), jnp.int32(0)))
+    v = jax.lax.dynamic_update_slice(
+        cache["v"], vs.astype(cache["v"].dtype),
+        (jnp.int32(0), slot, jnp.int32(0), jnp.int32(0), jnp.int32(0)))
+    pos = cache["pos"].at[slot].set(length)
+    return {"k": k, "v": v, "pos": pos}

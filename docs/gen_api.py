@@ -102,9 +102,16 @@ MODULES = [
      "models.transformer_lm — decoder backbone"),
     ("apex_tpu.models.gpt", "models", "models.gpt — GPT wiring"),
     ("apex_tpu.models.generate", "models",
-     "models.generate — KV-cache decoding"),
+     "models.generate — flash prefill + ragged KV-cache decoding"),
     ("apex_tpu.models.bert", "models", "models.bert"),
     ("apex_tpu.models.resnet", "models", "models.resnet"),
+    # serving
+    ("apex_tpu.serving", "serving",
+     "apex_tpu.serving — continuous-batching inference engine"),
+    ("apex_tpu.serving.engine", "serving",
+     "serving.engine — ServingEngine + Request/Response"),
+    ("apex_tpu.serving.batching", "serving",
+     "serving.batching — prompt buckets + slot pool"),
     # data
     ("apex_tpu.data.image_folder", "data",
      "data.image_folder — file-backed input pipeline"),

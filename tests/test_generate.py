@@ -1,4 +1,5 @@
-"""KV-cache decoding: teacher-forcing parity with the training forward."""
+"""KV-cache decoding: teacher-forcing parity with the training forward,
+prefill-vs-stepwise cache equivalence, and ragged-batch decode parity."""
 
 
 import jax
@@ -7,7 +8,8 @@ import numpy as np
 import pytest
 
 from apex_tpu.models.config import TransformerConfig
-from apex_tpu.models.generate import decode_step, generate, init_kv_cache
+from apex_tpu.models.generate import (
+    decode_step, generate, init_kv_cache, prefill, sample_logits)
 from apex_tpu.models.transformer_lm import gpt_forward, init_gpt_params
 
 
@@ -146,6 +148,244 @@ class TestGenerate:
         with pytest.raises(ValueError, match="causal"):
             decode_step(params2, jnp.asarray([1], jnp.int32),
                         init_kv_cache(cfg2, 1, 4), cfg2)
+
+
+def _ragged_batch(rng, vocab, lens):
+    """Left-aligned right-padded [b, max(lens)] batch + per-row prompts."""
+    prompts = [rng.randint(0, vocab, (n,)).astype(np.int32) for n in lens]
+    batch = np.zeros((len(lens), max(lens)), np.int32)
+    for i, p in enumerate(prompts):
+        batch[i, : len(p)] = p
+    return jnp.asarray(batch), prompts
+
+
+class TestPrefill:
+    """The batched flash prefill must fill EXACTLY the cache the
+    sequential decode would have built — the cache-equivalence pin that
+    keeps the prefill/decode split honest."""
+
+    # the GQA x rope variant is the riskiest; the activation/norm
+    # variants ride the slow tier (prefill reuses the same layer math)
+    @pytest.mark.parametrize("variant", [
+        {},
+        {"position_embedding_type": "rope", "num_query_groups": 2},
+        pytest.param({"activation": "swiglu", "normalization": "rmsnorm"},
+                     marks=pytest.mark.slow),
+    ])
+    def test_prefill_cache_matches_stepwise_decode(self, variant):
+        cfg = _cfg(**variant)
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.RandomState(0)
+        b, s = 2, 10
+        tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, s)),
+                             jnp.int32)
+
+        cache = init_kv_cache(cfg, b, s)
+        for i in range(s):
+            _, cache = decode_step(params, tokens[:, i], cache, cfg)
+
+        logits, pcache = prefill(params, tokens, cfg)
+        np.testing.assert_allclose(
+            np.asarray(pcache["k"]), np.asarray(cache["k"]),
+            atol=2e-4, rtol=2e-4, err_msg=f"{variant} k")
+        np.testing.assert_allclose(
+            np.asarray(pcache["v"]), np.asarray(cache["v"]),
+            atol=2e-4, rtol=2e-4, err_msg=f"{variant} v")
+        np.testing.assert_array_equal(np.asarray(pcache["pos"]),
+                                      np.full((b,), s))
+        # prefill's last-token logits == the training forward's
+        want = np.asarray(gpt_forward(params, tokens, cfg))[:, -1]
+        np.testing.assert_allclose(np.asarray(logits), want,
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_prefill_into_longer_cache_then_decode(self):
+        """Teacher-forcing split point: prefill the first half, decode
+        the second half stepwise — logits must match the full forward
+        at every decoded position (extends TestDecodeParity across the
+        prefill/decode seam)."""
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(1), cfg)
+        rng = np.random.RandomState(1)
+        b, s, tail = 2, 12, 5
+        tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, s)),
+                             jnp.int32)
+        want = np.asarray(gpt_forward(params, tokens, cfg))
+
+        head = s - tail
+        logits, cache = prefill(params, tokens[:, :head], cfg, max_len=s)
+        np.testing.assert_allclose(np.asarray(logits), want[:, head - 1],
+                                   atol=2e-4, rtol=2e-4)
+        for i in range(head, s):
+            logits, cache = decode_step(params, tokens[:, i], cache, cfg)
+            np.testing.assert_allclose(
+                np.asarray(logits), want[:, i], atol=2e-4, rtol=2e-4,
+                err_msg=f"position {i}")
+
+    def test_ragged_prefill_matches_per_sequence(self):
+        cfg = _cfg(position_embedding_type="rope")
+        params = init_gpt_params(jax.random.PRNGKey(2), cfg)
+        rng = np.random.RandomState(2)
+        lens = [3, 7]
+        batch, prompts = _ragged_batch(rng, cfg.vocab_size, lens)
+        logits, cache = prefill(params, batch, cfg,
+                                prompt_lens=jnp.asarray(lens))
+        np.testing.assert_array_equal(np.asarray(cache["pos"]), lens)
+        for i, p in enumerate(prompts):
+            solo_logits, solo = prefill(params, jnp.asarray(p[None]), cfg)
+            n = len(p)
+            np.testing.assert_allclose(
+                np.asarray(cache["k"])[:, i, :n],
+                np.asarray(solo["k"])[:, 0],
+                atol=2e-4, rtol=2e-4, err_msg=f"row {i} k")
+            np.testing.assert_allclose(
+                np.asarray(logits)[i], np.asarray(solo_logits)[0],
+                atol=2e-4, rtol=2e-4, err_msg=f"row {i} logits")
+
+
+class TestRaggedGenerate:
+    def test_ragged_greedy_matches_unbatched(self):
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(3), cfg)
+        rng = np.random.RandomState(3)
+        lens = [3, 8]          # each solo length is its own compile
+        batch, prompts = _ragged_batch(rng, cfg.vocab_size, lens)
+        new = 6
+        out = generate(params, batch, cfg, max_new_tokens=new,
+                       prompt_lens=jnp.asarray(lens))
+        assert out.shape == (len(lens), max(lens) + new)
+        for i, p in enumerate(prompts):
+            solo = generate(params, jnp.asarray(p[None]), cfg,
+                            max_new_tokens=new)
+            np.testing.assert_array_equal(
+                np.asarray(out)[i, lens[i]: lens[i] + new],
+                np.asarray(solo)[0, lens[i]:],
+                err_msg=f"row {i}")
+
+    def test_ragged_gqa_rope_matches_unbatched(self):
+        """GQA + rope through the [b] position vector — the riskiest
+        combination (grouped cache heads x per-sequence rotary
+        offsets)."""
+        cfg = _cfg(position_embedding_type="rope", num_query_groups=2)
+        params = init_gpt_params(jax.random.PRNGKey(4), cfg)
+        rng = np.random.RandomState(4)
+        lens = [2, 6]
+        batch, prompts = _ragged_batch(rng, cfg.vocab_size, lens)
+        new = 5
+        out = generate(params, batch, cfg, max_new_tokens=new,
+                       prompt_lens=jnp.asarray(lens))
+        for i, p in enumerate(prompts):
+            solo = generate(params, jnp.asarray(p[None]), cfg,
+                            max_new_tokens=new)
+            np.testing.assert_array_equal(
+                np.asarray(out)[i, lens[i]: lens[i] + new],
+                np.asarray(solo)[0, lens[i]:],
+                err_msg=f"row {i}")
+
+    def test_eos_stops_early_and_freezes_rows(self):
+        from apex_tpu.observability import metrics as telemetry
+
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(5), cfg)
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        ref = np.asarray(generate(params, prompt, cfg, max_new_tokens=8))
+        eos = int(ref[0, 3])   # the FIRST generated token: stops at once
+        reg = telemetry.configure()
+        try:
+            out = generate(params, prompt, cfg, max_new_tokens=8,
+                           eos_token_id=eos)
+            # identical up to and including the emitted EOS, padding after
+            np.testing.assert_array_equal(np.asarray(out)[0, :4],
+                                          ref[0, :4])
+            np.testing.assert_array_equal(np.asarray(out)[0, 4:], 0)
+            # the while_loop exited early: fewer decode steps than budget
+            steps = reg.counter("generate.decode_steps").value
+            assert steps < 8, steps
+        finally:
+            telemetry.shutdown()
+
+
+class TestTraceCounts:
+    """The acceptance pin of the prefill/decode split: the prompt does
+    NOT pass through the per-token decode loop."""
+
+    def _counts(self, b, s, new):
+        from apex_tpu.observability import metrics as telemetry
+
+        cfg = _cfg(max_position_embeddings=max(24, s + new))
+        params = init_gpt_params(jax.random.PRNGKey(6), cfg)
+        rng = np.random.RandomState(6)
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, s)),
+                             jnp.int32)
+        reg = telemetry.configure()
+        try:
+            generate(params, prompt, cfg, max_new_tokens=new)
+            return (reg.counter("generate.prefill_calls").value,
+                    reg.counter("generate.decode_steps").value)
+        finally:
+            telemetry.shutdown()
+
+    # new - 1 decode forwards: the first token comes from the prefill
+    # logits, the last needs no decode behind it — the count scales
+    # with the NEW tokens, never with the prompt length
+
+    def test_prefill_once_decode_counts_new_tokens_only(self):
+        prefills, steps = self._counts(b=2, s=16, new=5)
+        assert prefills == 1
+        assert steps == 5 - 1      # not s + new
+
+    @pytest.mark.slow   # the [b=4, s=512] acceptance geometry; CI slow job
+    def test_prefill_512_one_forward(self):
+        prefills, steps = self._counts(b=4, s=512, new=8)
+        assert prefills == 1
+        assert steps == 8 - 1      # not 512 + 8
+
+
+class TestSamplingSatellites:
+    def test_negative_temperature_raises(self):
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(7), cfg)
+        prompt = jnp.asarray([[1, 2]], jnp.int32)
+        with pytest.raises(ValueError, match="temperature"):
+            generate(params, prompt, cfg, max_new_tokens=2,
+                     temperature=-0.5)
+        with pytest.raises(ValueError, match="temperature"):
+            sample_logits(jnp.zeros((1, 8)), jax.random.PRNGKey(0),
+                          temperature=-1.0)
+
+    def test_topk_without_topp_restricts_support(self):
+        """The lax.top_k fast path (no full vocab sort) must still
+        confine sampling to the k best logits."""
+        rng = np.random.RandomState(0)
+        logits = jnp.asarray(rng.randn(2, 64), jnp.float32)
+        top3 = np.argsort(np.asarray(logits), axis=-1)[:, -3:]
+        for seed in range(20):
+            toks = np.asarray(sample_logits(
+                logits, jax.random.PRNGKey(seed), temperature=1.0,
+                top_k=3))
+            for row in range(2):
+                assert toks[row] in top3[row], (seed, row, toks)
+        # top_k=1 at full temperature degenerates to greedy
+        np.testing.assert_array_equal(
+            np.asarray(sample_logits(logits, jax.random.PRNGKey(0),
+                                     temperature=1.0, top_k=1)),
+            np.asarray(sample_logits(logits, jax.random.PRNGKey(0))))
+
+    def test_cache_dtype_override(self):
+        cfg = _cfg()   # fp32 compute
+        cache = init_kv_cache(cfg, 2, 8)
+        assert cache["k"].dtype == cfg.compute_dtype
+        assert cache["pos"].shape == (2,)
+        bf16 = init_kv_cache(cfg, 2, 8, cache_dtype=jnp.bfloat16)
+        assert bf16["k"].dtype == jnp.bfloat16
+        # decode runs with the downcast cache (casts at the einsum)
+        params = init_gpt_params(jax.random.PRNGKey(8), cfg)
+        logits, bf16 = decode_step(
+            params, jnp.asarray([1, 2], jnp.int32), bf16, cfg)
+        assert bf16["k"].dtype == jnp.bfloat16
+        assert logits.shape == (2, cfg.vocab_size)
+        out = generate(params, jnp.asarray([[1, 2, 3]], jnp.int32), cfg,
+                       max_new_tokens=4, cache_dtype=jnp.bfloat16)
+        assert out.shape == (1, 7)
 
 
 class TestTopP:

@@ -1,0 +1,228 @@
+"""Continuous-batching serving engine: lifecycle, parity, metrics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.generate import generate
+from apex_tpu.models.transformer_lm import init_gpt_params
+from apex_tpu.serving import (
+    Request, ServingEngine, SlotPool, default_buckets, pad_prompt,
+    pick_bucket)
+
+
+def _cfg(**kw):
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("remat", False)
+    return TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+class TestBatchingHelpers:
+    def test_default_buckets_ladder(self):
+        assert default_buckets(256) == (32, 64, 128, 256)
+        assert default_buckets(100) == (32, 64, 100)
+        assert default_buckets(16) == (16,)
+
+    def test_pick_bucket(self):
+        assert pick_bucket(1, (8, 16)) == 8
+        assert pick_bucket(8, (8, 16)) == 8
+        assert pick_bucket(9, (8, 16)) == 16
+        with pytest.raises(ValueError, match="exceeds"):
+            pick_bucket(17, (8, 16))
+
+    def test_pad_prompt(self):
+        out = pad_prompt(np.asarray([1, 2, 3]), 8)
+        np.testing.assert_array_equal(out, [1, 2, 3, 0, 0, 0, 0, 0])
+        with pytest.raises(ValueError, match="exceeds"):
+            pad_prompt(np.arange(9), 8)
+
+    def test_slot_pool(self):
+        pool = SlotPool(2)
+        a, b = pool.claim(), pool.claim()
+        assert {a, b} == {0, 1}
+        assert pool.claim() is None
+        pool.release(a)
+        assert pool.n_free == 1 and pool.n_active == 1
+        assert pool.claim() == a
+        with pytest.raises(ValueError, match="not active"):
+            pool.release(7)
+
+    def test_request_validation(self):
+        with pytest.raises(ValueError, match="empty"):
+            Request(prompt=np.asarray([], np.int32))
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            Request(prompt=np.asarray([1]), max_new_tokens=0)
+        with pytest.raises(ValueError, match="temperature"):
+            Request(prompt=np.asarray([1]), temperature=-1.0)
+
+
+class TestEngineLifecycle:
+    def test_mixed_lengths_match_generate(self, model):
+        """More requests than slots, ragged lengths, greedy: every
+        response must be token-identical to generate() — continuous
+        batching must not change the math.  The oracle is ONE ragged
+        generate call; its own parity against per-sequence decoding is
+        pinned in tests/test_generate.py."""
+        cfg, params = model
+        rng = np.random.RandomState(0)
+        lens = [3, 7, 5]
+        new = 6
+        prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in lens]
+        batch = np.zeros((len(lens), max(lens)), np.int32)
+        for i, p in enumerate(prompts):
+            batch[i, : len(p)] = p
+        want = np.asarray(generate(
+            params, jnp.asarray(batch), cfg, max_new_tokens=new,
+            prompt_lens=jnp.asarray(lens)))
+
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               prompt_buckets=(8,))
+        resps = engine.run([dict(prompt=p, max_new_tokens=new)
+                            for p in prompts])
+        assert [r.request_id for r in resps] == [0, 1, 2]
+        for r, n in zip(resps, lens):
+            np.testing.assert_array_equal(
+                r.tokens, want[r.request_id, n: n + new],
+                err_msg=f"request {r.request_id}")
+            assert r.finish_reason == "length"
+            assert r.decode_steps == new - 1
+        assert engine.idle
+
+    def test_continuous_admission_overlaps_decodes(self, model):
+        """A freed slot admits the next request while others are still
+        decoding: the total decode-step count must be far below the
+        batch-serial sum."""
+        from apex_tpu.observability import metrics as telemetry
+
+        cfg, params = model
+        rng = np.random.RandomState(1)
+        budgets = [2, 10, 4]
+        prompts = [rng.randint(0, cfg.vocab_size, (4,)).astype(np.int32)
+                   for _ in budgets]
+        reg = telemetry.configure()
+        try:
+            engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                                   prompt_buckets=(8,))
+            resps = engine.run([
+                dict(prompt=p, max_new_tokens=m)
+                for p, m in zip(prompts, budgets)])
+            assert len(resps) == 3
+            steps = reg.counter("serving.decode_steps").value
+            # serial lower bound would be sum(m - 1) = 13; overlapped
+            # lanes need at most max-budget + the admission step
+            assert steps <= 10, steps
+            assert reg.counter("serving.prefill_calls").value == 3
+        finally:
+            telemetry.shutdown()
+
+    def test_eos_completion_frees_slot(self, model):
+        cfg, params = model
+        p = np.asarray([5, 9, 13], np.int32)
+        ref = np.asarray(generate(params, jnp.asarray(p[None]), cfg,
+                                  max_new_tokens=6))[0, 3:]
+        eos = int(ref[1])   # stop after the 2nd generated token
+        engine = ServingEngine(params, cfg, max_slots=1, max_len=32,
+                               prompt_buckets=(8,))
+        resps = engine.run([dict(prompt=p, max_new_tokens=6,
+                                 eos_token_id=eos)])
+        (r,) = resps
+        assert r.finish_reason == "eos"
+        assert r.tokens[-1] == eos
+        assert r.tokens.size <= 6
+        np.testing.assert_array_equal(r.tokens, ref[: r.tokens.size])
+        assert engine.idle and engine.stats()["free_slots"] == 1
+
+    def test_submit_validation(self, model):
+        cfg, params = model
+        engine = ServingEngine(params, cfg, max_slots=1, max_len=16,
+                               prompt_buckets=(8,))
+        with pytest.raises(ValueError, match="max_len"):
+            engine.submit(np.arange(8), max_new_tokens=9)
+        with pytest.raises(ValueError, match="exceeds the largest"):
+            engine.submit(np.arange(9), max_new_tokens=1)
+
+    def test_metrics_stream(self, model):
+        from apex_tpu.observability import metrics as telemetry
+
+        cfg, params = model
+        rng = np.random.RandomState(2)
+        reg = telemetry.configure()
+        try:
+            engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                                   prompt_buckets=(8,))
+            engine.run([
+                dict(prompt=rng.randint(0, cfg.vocab_size, (4,)),
+                     max_new_tokens=3) for _ in range(3)])
+            summ = reg.summary()
+            assert summ["counters"]["serving.requests"] == 3
+            assert summ["counters"]["serving.prefill_calls"] == 3
+            assert summ["counters"]["serving.tokens_generated"] == 9
+            assert summ["histograms"]["serving.prefill_ms"]["count"] == 3
+            # drained engine: occupancy and queue gauges end at zero
+            assert summ["gauges"]["serving.slot_occupancy"] == 0.0
+            assert summ["gauges"]["serving.queue_depth"] == 0.0
+        finally:
+            telemetry.shutdown()
+
+    def test_bf16_cache_and_temperature_mix(self, model):
+        """bf16 slot caches under the fp32 compute config (the serving
+        memory win) + a per-request temperature mix in one batch."""
+        cfg, params = model
+        rng = np.random.RandomState(3)
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               prompt_buckets=(8,),
+                               cache_dtype=jnp.bfloat16)
+        assert engine.cache["k"].dtype == jnp.bfloat16
+        resps = engine.run([
+            dict(prompt=rng.randint(0, cfg.vocab_size, (5,)),
+                 max_new_tokens=4, temperature=0.0),
+            dict(prompt=rng.randint(0, cfg.vocab_size, (5,)),
+                 max_new_tokens=4, temperature=0.9),
+        ])
+        assert len(resps) == 2
+        for r in resps:
+            assert r.tokens.size == 4
+            assert ((r.tokens >= 0) & (r.tokens < cfg.vocab_size)).all()
+
+
+@pytest.mark.slow   # serving soak: many mixed requests; CI slow job
+class TestServingSoak:
+    def test_soak_mixed_traffic(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(4)
+        engine = ServingEngine(params, cfg, max_slots=3, max_len=64)
+        reqs = []
+        for i in range(16):
+            n = int(rng.randint(2, 24))
+            reqs.append(dict(
+                prompt=rng.randint(0, cfg.vocab_size, (n,)),
+                max_new_tokens=int(rng.randint(1, 12)),
+                temperature=float(rng.choice([0.0, 0.8])),
+                eos_token_id=int(rng.randint(0, cfg.vocab_size))
+                if i % 3 == 0 else None,
+            ))
+        resps = engine.run(reqs)
+        assert len(resps) == 16
+        assert engine.idle
+        for r, kw in zip(resps, reqs):
+            assert 1 <= r.tokens.size <= kw["max_new_tokens"]
+            if r.finish_reason == "eos":
+                assert r.tokens[-1] == kw["eos_token_id"]
+            elif kw["eos_token_id"] is None:
+                assert r.finish_reason == "length"
+                assert r.tokens.size == kw["max_new_tokens"]

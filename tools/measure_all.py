@@ -13,7 +13,9 @@ mid-campaign tunnel wedge — which is how the round-5 first contact
 ended — costs the least-valuable stages:
 
 1. ``bench.py`` — the BASELINE.md workload matrix (GPT/RN50/BERT/RNN-T/
-   MoE/decode/long-context/cp-compare rows), one JSON line.
+   MoE/decode/long-context/cp-compare rows), one JSON line; then
+   ``bench.py --decode`` — the inference fast path rows (prefill/decode
+   split + continuous-batching serving mixes) as their own JSON line.
 2. ``APEX_TPU_TEST_ON_TPU=1 pytest tests/test_on_tpu_kernels.py -m tpu``
    — the Mosaic-compile hardware tests (interpret-green != Mosaic-
    green; now covers the round-5 default fused flash bwd + LN bwd).
@@ -121,6 +123,12 @@ def main():
     results = {}
     results["bench"] = _run("bench", [sys.executable, "bench.py"],
                             timeout=3600)
+    # the inference fast path (prefill/decode split + serving engine):
+    # its own stage so the decode rows land in a dedicated JSON line
+    # (BENCH-comparable) even if the full matrix above partially failed
+    results["bench_decode"] = _run(
+        "bench_decode", [sys.executable, "bench.py", "--decode"],
+        timeout=1800)
     results["tpu_tier"] = _run(
         "tpu_tier", [sys.executable, "-m", "pytest",
                      "tests/test_on_tpu_kernels.py", "-m", "tpu", "-q"],
