@@ -142,7 +142,15 @@ def make_train_step(
         ``observability.record_step_metrics(metrics)``.
 
     The returned ``step_fn(state, *batch) -> (state, metrics)`` is pure and
-    jittable; metrics carry ``loss``, ``overflow``, ``loss_scale``.
+    jittable; metrics carry ``loss``, ``overflow``, ``loss_scale`` and
+    ``step`` (this step's index).  Feeding that dict to
+    ``observability.record_step_metrics`` at the step boundary is the
+    whole diagnostics hookup: it records the gauges, stamps records
+    with the step index, fills the flight recorder's ring, and runs
+    the anomaly detectors (loss-spike / grad-norm / NaN first-seen —
+    with ``norm_telemetry=True`` the grad/update norms give the
+    detectors their earliest signal); ``amp.scaler.record_scaler_step``
+    additionally feeds the scaler-thrash detector.
     """
     if isinstance(policy_or_amp, AmpState):
         amp_state = policy_or_amp
@@ -363,6 +371,12 @@ def make_train_step(
             "loss": loss,
             "overflow": overflow,
             "loss_scale": new_ls_state.loss_scale,
+            # the index of THIS step (pre-increment): the flight
+            # recorder and anomaly detectors key their post-mortems on
+            # it (observability.record_step_metrics stamps every record
+            # with it), so "first anomalous step" names a real index
+            # even in loops that never count steps themselves
+            "step": state.step,
         }
         if norm_telemetry:
             from apex_tpu.optimizers._common import norm_metrics

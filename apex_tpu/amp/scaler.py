@@ -160,7 +160,12 @@ def record_scaler_step(metrics) -> None:
     - gauge ``amp.loss_scale`` (per-step value),
     - counters ``amp.overflow_count`` and ``amp.skipped_steps``,
     - event ``amp.loss_scale_change`` + an INFO log line whenever the
-      scale moved (both overflow halvings and window doublings).
+      scale moved (both overflow halvings and window doublings),
+    - the scaler-thrash anomaly detector's overflow window (ISSUE 4):
+      a scaler that overflows on a large fraction of recent steps is
+      cycling halve/skip/double instead of settling — that fires
+      ``anomaly.scaler_thrash`` and (when configured) a flight-recorder
+      post-mortem.
 
     No-op (one enabled() check) when telemetry is disabled.  Reading
     the metrics forces a device sync, the same one any per-step logging
@@ -173,11 +178,22 @@ def record_scaler_step(metrics) -> None:
         return
     import numpy as np
 
+    # adopt this step's index up front: the canonical loop calls
+    # record_scaler_step BEFORE record_step_metrics, and the amp.*
+    # records / thrash feed must carry THIS step, not the previous one
+    if "step" in metrics:
+        try:
+            reg.set_step(int(np.asarray(metrics["step"]).reshape(())[()]))
+        except (TypeError, ValueError):
+            pass
     scale = float(np.asarray(metrics["loss_scale"]).reshape(())[()])
     overflow = bool(np.asarray(metrics.get("overflow", False)).reshape(())[()])
     g = reg.gauge("amp.loss_scale")
     prev = g.value
     g.set(scale)
+    bank = reg.detectors
+    if bank is not None:
+        bank.feed_scaler(reg.step, overflow)
     if overflow:
         reg.counter("amp.overflow_count").inc()
         reg.counter("amp.skipped_steps").inc()

@@ -1,0 +1,232 @@
+"""Runtime accounting: recompilation tracking + device-memory gauges.
+
+Two failure modes fail dark without this module:
+
+- **Silent retraces.**  A shape or static-arg change recompiles inside
+  the steady-state loop; the step "gets slow" with no signal.  JAX
+  emits ``jax.monitoring`` duration events on every backend compile
+  (``/jax/core/compile/backend_compile_duration``), so
+  :class:`RecompileTracker` listens there and accounts every compile as
+  ``compile.{count,ms}`` — overall and per *function label* (the
+  :func:`compile_label` context names whatever region triggered it:
+  ``StepTimer`` labels its warmup, the serving engine its
+  prefill/decode compiles, ``make_ddp_train_step`` its step).
+- **HBM creep.**  Fragmentation or a cache that grows per request eats
+  headroom until an OOM with no history.
+  :func:`sample_device_memory` reads
+  ``jax.local_devices()[i].memory_stats()`` into ``hbm.{bytes_in_use,
+  peak_bytes}`` gauges (summed over local devices, per-device under
+  ``hbm.dev<i>.*`` when more than one) — sampled by ``StepTimer`` and
+  the serving engine, so the JSONL stream and the trace timeline carry
+  a memory time series next to the step times.
+
+The tracker is intentionally usable WITHOUT a configured registry:
+``bench.py`` installs it standalone and attaches
+:func:`runtime_summary` to the BENCH JSON line, so recompile counts and
+HBM peaks ride every published measurement.  The ``jax.monitoring``
+listener is registered once per process and costs nothing between
+compile events; when neither a tracker nor a registry exists it returns
+immediately.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = [
+    "RecompileTracker",
+    "compile_label",
+    "current_compile_label",
+    "install_recompile_tracker",
+    "recompile_tracker",
+    "runtime_summary",
+    "sample_device_memory",
+]
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_TRACKER: Optional["RecompileTracker"] = None
+_LISTENER_REGISTERED = False
+_LABELS = threading.local()
+
+
+class compile_label:
+    """Name the region whose compiles should be attributed to ``label``.
+
+    ``with compile_label("gpt2"): step(...)`` — any backend compile
+    triggered inside the block (a jit cache miss, i.e. a first compile
+    or a retrace) is accounted to ``compile.gpt2.*``.  Labels nest;
+    the innermost wins.  Pure host-side thread-local bookkeeping: two
+    list ops per block, safe on the disabled fast path."""
+
+    __slots__ = ("label",)
+
+    def __init__(self, label: str):
+        self.label = label
+
+    def __enter__(self):
+        stack = getattr(_LABELS, "stack", None)
+        if stack is None:
+            stack = _LABELS.stack = []
+        stack.append(self.label)
+        return self
+
+    def __exit__(self, *exc):
+        _LABELS.stack.pop()
+        return False
+
+
+def current_compile_label() -> Optional[str]:
+    stack = getattr(_LABELS, "stack", None)
+    return stack[-1] if stack else None
+
+
+class RecompileTracker:
+    """Per-process compile accounting fed by ``jax.monitoring``.
+
+    Keeps its own ``{label: {count, ms}}`` ledger (so ``bench.py`` can
+    read it with telemetry off) and mirrors into the live registry's
+    ``compile.count`` / ``compile.ms`` counters (+ per-label
+    ``compile.<label>.{count,ms}``) when one is configured.  ``ms``
+    counters are integer milliseconds (counters are ints)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.by_label: Dict[str, Dict[str, float]] = {}
+
+    def on_compile(self, dur_s: float, label: Optional[str]) -> None:
+        label = label or "unlabeled"
+        with self._lock:
+            row = self.by_label.setdefault(label, {"count": 0, "ms": 0.0})
+            row["count"] += 1
+            row["ms"] += dur_s * 1e3
+        from apex_tpu.observability import metrics as _metrics
+
+        reg = _metrics.registry()
+        if reg is not None:
+            ms = int(round(dur_s * 1e3))
+            reg.counter("compile.count").inc()
+            reg.counter("compile.ms").inc(ms)
+            reg.counter(f"compile.{label}.count").inc()
+            reg.counter(f"compile.{label}.ms").inc(ms)
+            reg.event("compile", label=label, ms=round(dur_s * 1e3, 3))
+
+    def total_count(self) -> int:
+        """Locked total compile count — the jax.monitoring listener
+        mutates ``by_label`` from compile threads, so readers on the
+        telemetry path must not iterate it bare."""
+        with self._lock:
+            return sum(v["count"] for v in self.by_label.values())
+
+    def summary(self) -> dict:
+        with self._lock:
+            by_label = {k: {"count": v["count"],
+                            "ms": round(v["ms"], 3)}
+                        for k, v in self.by_label.items()}
+        return {
+            "count": sum(v["count"] for v in by_label.values()),
+            "ms": round(sum(v["ms"] for v in by_label.values()), 3),
+            "by_label": by_label,
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.by_label.clear()
+
+
+def _on_monitoring_event(name: str, dur_s: float, **kw) -> None:
+    # called for EVERY jax duration event; keep the miss path tiny
+    if name != _COMPILE_EVENT:
+        return
+    tracker = _TRACKER
+    if tracker is None:
+        return
+    tracker.on_compile(dur_s, current_compile_label())
+
+
+def install_recompile_tracker() -> Optional[RecompileTracker]:
+    """Install (or return the existing) process-wide tracker.
+
+    Registers the ``jax.monitoring`` listener on first call; there is
+    no unregister API, so the listener stays and fast-paths out when
+    the tracker is later discarded.  Returns None when jax.monitoring
+    is unavailable (the tracker degrades to absent, never raises)."""
+    global _TRACKER, _LISTENER_REGISTERED
+    if _TRACKER is not None:
+        return _TRACKER
+    if not _LISTENER_REGISTERED:
+        try:
+            from jax import monitoring
+        except Exception:   # pragma: no cover - jax without monitoring
+            return None
+        monitoring.register_event_duration_secs_listener(
+            _on_monitoring_event)
+        _LISTENER_REGISTERED = True
+    _TRACKER = RecompileTracker()
+    return _TRACKER
+
+
+def recompile_tracker() -> Optional[RecompileTracker]:
+    return _TRACKER
+
+
+def sample_device_memory(emit: bool = True) -> Optional[dict]:
+    """Read ``memory_stats()`` across local devices into gauges.
+
+    Returns ``{"bytes_in_use", "peak_bytes", "devices"}`` (sums over
+    local devices) or None when the platform reports nothing (CPU
+    returns no stats).  With ``emit`` and a configured registry, sets
+    ``hbm.bytes_in_use`` / ``hbm.peak_bytes`` (+ per-device
+    ``hbm.dev<i>.*`` when more than one device is attached).  Reading
+    memory_stats is a cheap local runtime query — no device sync."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:   # pragma: no cover - no backend at all
+        return None
+    total_in_use = total_peak = 0
+    per_dev = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        in_use = int(stats.get("bytes_in_use", 0))
+        peak = int(stats.get("peak_bytes_in_use", in_use))
+        per_dev.append((in_use, peak))
+        total_in_use += in_use
+        total_peak += peak
+    if not per_dev:
+        return None
+    out = {"bytes_in_use": total_in_use, "peak_bytes": total_peak,
+           "devices": len(per_dev)}
+    if emit:
+        from apex_tpu.observability import metrics as _metrics
+
+        reg = _metrics.registry()
+        if reg is not None:
+            reg.gauge("hbm.bytes_in_use").set(total_in_use)
+            reg.gauge("hbm.peak_bytes").set(total_peak)
+            if len(per_dev) > 1:
+                for i, (in_use, peak) in enumerate(per_dev):
+                    reg.gauge(f"hbm.dev{i}.bytes_in_use").set(in_use)
+                    reg.gauge(f"hbm.dev{i}.peak_bytes").set(peak)
+    return out
+
+
+def runtime_summary() -> dict:
+    """The accounting block ``bench.py`` attaches to the BENCH JSON
+    line: compile counts/ms (per label) + HBM usage when the platform
+    reports it.  Works with or without a configured registry."""
+    out: dict = {}
+    tracker = _TRACKER
+    if tracker is not None:
+        out["compile"] = tracker.summary()
+    mem = sample_device_memory(emit=False)
+    if mem is not None:
+        out["hbm"] = mem
+    return out

@@ -23,11 +23,22 @@ Record stream (see docs/observability.md for the full schema): every
 record is one JSON object with ``schema_version`` (currently
 :data:`SCHEMA_VERSION`), ``t`` (unix seconds), ``type`` (``meta`` |
 ``counter`` | ``gauge`` | ``observe`` | ``span`` | ``event``) and
-``name``.  Gauges, histogram observations and spans emit on every
-update; counters accumulate in memory and emit cumulative totals on
-:meth:`MetricsRegistry.flush` (and at close), so hot counters (e.g. a
-collective emitted thousands of times during tracing) cost no I/O per
-increment.
+``name``; records emitted after :func:`set_step` additionally carry
+``step`` (the train-step index — ``tools/telemetry_report.py
+--since-step`` filters on it).  Gauges, histogram observations and
+spans emit on every update; counters accumulate in memory and emit
+cumulative totals on :meth:`MetricsRegistry.flush` (and at close), so
+hot counters (e.g. a collective emitted thousands of times during
+tracing) cost no I/O per increment.
+
+Beyond the record stream the registry optionally hosts the ISSUE 4
+diagnostics, constructed by :func:`configure` and reachable as
+attributes: ``registry().detectors`` (a
+:class:`~apex_tpu.observability.detectors.DetectorBank`, on by
+default) and ``registry().recorder`` (a
+:class:`~apex_tpu.observability.recorder.FlightRecorder`, on when a
+dump path is configured).  :func:`record_step_metrics` feeds both at
+the step boundary.
 """
 
 from __future__ import annotations
@@ -39,7 +50,9 @@ import time
 from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
-SCHEMA_VERSION = 1
+# v2: records may carry the optional "step" field (set_step); the trace
+# and flight-recorder artifacts are versioned separately.
+SCHEMA_VERSION = 2
 
 __all__ = [
     "SCHEMA_VERSION",
@@ -57,6 +70,7 @@ __all__ = [
     "histogram",
     "record_step_metrics",
     "registry",
+    "set_step",
     "shutdown",
 ]
 
@@ -201,6 +215,19 @@ class MetricsRegistry:
         # spans consult it and additionally open a TraceAnnotation.
         self.profiler = bool(profiler)
         self._closed = False
+        # ISSUE 4 diagnostics, attached by configure(): a DetectorBank
+        # and (when a dump path is set) a FlightRecorder.  None means
+        # absent — feeding call sites bind + None-check.
+        self.detectors: Optional[Any] = None
+        self.recorder: Optional[Any] = None
+        # current train-step index; stamped onto every record once known
+        self.step: Optional[int] = None
+        self._auto_step = 0
+        # True once anyone declared a step explicitly (set_step or a
+        # metrics dict carrying "step"): the auto-increment fallback
+        # then stays out of the way (a loop resumed at step 50k must
+        # not be re-stamped 1, 2, 3...)
+        self._external_step = False
         self._emit({"type": "meta", "tags": self.tags, "pid": os.getpid()})
 
     # -- emission ----------------------------------------------------------
@@ -209,10 +236,22 @@ class MetricsRegistry:
         if not self.sinks:
             return
         full = {"schema_version": SCHEMA_VERSION, "t": time.time()}
+        if self.step is not None:
+            full["step"] = self.step
         full.update(rec)
         with self._lock:
             for sink in self.sinks:
                 sink.emit(full)
+
+    def set_step(self, step: int) -> None:
+        """Declare the current train-step index; subsequent records
+        carry ``step`` until the next call.  ``record_step_metrics``
+        calls this from the metrics dict's ``step`` entry; loops whose
+        step fn reports no index may call it directly (and doing so
+        disables the auto-increment fallback — an externally declared
+        step is never clobbered)."""
+        self.step = int(step)
+        self._external_step = True
 
     # -- metric accessors (get-or-create) ----------------------------------
 
@@ -243,11 +282,18 @@ class MetricsRegistry:
     def observe_span(self, name: str, dur_s: float, **extra) -> None:
         """Record one span duration (seconds) — a ``span``-typed
         histogram observation; the span API and StepTimer both land
-        here so every timing shares one schema."""
+        here so every timing shares one schema.  Each observation also
+        feeds the throughput-regression detector (per-name baselines),
+        so a step that silently got slower fires an anomaly."""
         self.histogram(name, record_type="span").observe(dur_s, **extra)
+        bank = self.detectors
+        if bank is not None:
+            bank.feed_step_time(name, dur_s, self.step)
 
-    def event(self, name: str, **data) -> None:
-        """One-off structured event (e.g. a loss-scale change)."""
+    def event(self, name: str, /, **data) -> None:
+        """One-off structured event (e.g. a loss-scale change).
+        ``name`` is positional-only so payloads may carry a ``name``
+        key of their own."""
         self._emit({"type": "event", "name": name, "data": data})
 
     # -- lifecycle ---------------------------------------------------------
@@ -284,6 +330,10 @@ class MetricsRegistry:
             return
         self.flush()
         self._closed = True
+        if self.recorder is not None:
+            # before sinks close: the shutdown dump (fires only when
+            # anomalies were recorded) snapshots the live summary
+            self.recorder.on_shutdown()
         summ = self.summary()
         with self._lock:
             for sink in self.sinks:
@@ -320,10 +370,18 @@ def histogram(name: str, tags: Optional[dict] = None):
     return reg.histogram(name, tags) if reg is not None else NOOP_METRIC
 
 
-def event(name: str, **data) -> None:
+def event(name: str, /, **data) -> None:
     reg = _REGISTRY
     if reg is not None:
         reg.event(name, **data)
+
+
+def set_step(step: int) -> None:
+    """Stamp subsequent records with this train-step index (no-op on
+    the disabled fast path)."""
+    reg = _REGISTRY
+    if reg is not None:
+        reg.set_step(step)
 
 
 def _rank_tags() -> dict:
@@ -354,6 +412,12 @@ def configure(
     profiler: bool = False,
     tags: Optional[dict] = None,
     sinks=(),
+    trace_path: Optional[str] = None,
+    flight_recorder: Optional[str] = None,
+    flight_steps: int = 256,
+    dump_on_anomaly: bool = True,
+    detectors: bool = True,
+    detector_config: Optional[dict] = None,
 ) -> MetricsRegistry:
     """Enable telemetry for this process; returns the live registry.
 
@@ -364,6 +428,22 @@ def configure(
       spans additionally open a ``TraceAnnotation`` so they show up in
       xprof traces.
     - ``sinks``: extra sink objects (``emit``/``flush``/``close``).
+    - ``trace_path``: mirror the record stream into a Chrome
+      trace_events JSON file (open in Perfetto / chrome://tracing —
+      :mod:`~apex_tpu.observability.trace`).
+    - ``flight_recorder``: dump path for the crash/anomaly post-mortem
+      ring buffer (:mod:`~apex_tpu.observability.recorder`);
+      ``flight_steps`` bounds the ring, ``dump_on_anomaly`` dumps on
+      the first detector firing.
+    - ``detectors``: run the step-boundary anomaly detectors
+      (loss-spike / grad-norm / NaN-first-seen / scaler-thrash /
+      throughput-regression / serving-queue —
+      :mod:`~apex_tpu.observability.detectors`).  ``detector_config``
+      overrides thresholds (see ``DetectorBank``).
+
+    Configuring also installs the process-wide recompilation tracker
+    (:func:`~apex_tpu.observability.device.install_recompile_tracker`)
+    so ``compile.{count,ms}`` counters accumulate from here on.
 
     A previously configured registry is shut down (flushed/closed)
     first, so re-configuration in tests or notebooks is safe.
@@ -378,30 +458,117 @@ def configure(
         sink_list.append(sinks_mod.JsonlSink(jsonl_path))
     if stderr_summary:
         sink_list.append(sinks_mod.StderrSummarySink())
+    if trace_path:
+        from apex_tpu.observability.trace import TraceSink
+
+        sink_list.append(TraceSink(trace_path))
     all_tags = _rank_tags()
     all_tags.update(tags or {})
-    _REGISTRY = MetricsRegistry(sink_list, tags=all_tags, profiler=profiler)
+    reg = MetricsRegistry(sink_list, tags=all_tags, profiler=profiler)
+    if detectors:
+        from apex_tpu.observability.detectors import DetectorBank
+
+        reg.detectors = DetectorBank(reg, detector_config)
+    if flight_recorder:
+        from apex_tpu.observability.recorder import FlightRecorder
+
+        rec = FlightRecorder(flight_recorder, max_steps=flight_steps,
+                             dump_on_anomaly=dump_on_anomaly)
+        rec._registry = reg
+        rec.install_excepthook()
+        reg.recorder = rec
+    from apex_tpu.observability import device as device_mod
+
+    device_mod.install_recompile_tracker()
+    _REGISTRY = reg
     return _REGISTRY
 
 
-def configure_from_env(env=None) -> Optional[MetricsRegistry]:
-    """Configure from the environment, or return None (leaving the
-    no-op fast path in place):
+# The one authoritative table of APEX_TPU_TELEMETRY_* variables:
+# name (sans prefix) -> (kind, configure kwarg, help).  Document new
+# variables HERE — configure_from_env validates against this table and
+# warns (with the variable name) on anything unknown or malformed
+# instead of silently disabling telemetry.
+ENV_PREFIX = "APEX_TPU_TELEMETRY"
+ENV_VARS = {
+    "": ("path", "jsonl_path", "JSONL record-stream file"),
+    "_STDERR": ("bool", "stderr_summary",
+                "per-metric summary table at shutdown"),
+    "_PROFILER": ("bool", "profiler",
+                  "jax.profiler span annotations (xprof)"),
+    "_TRACE": ("path", "trace_path",
+               "Chrome trace_events JSON timeline (Perfetto)"),
+    "_FLIGHT": ("path", "flight_recorder",
+                "flight-recorder post-mortem dump path"),
+    "_FLIGHT_STEPS": ("int", "flight_steps",
+                      "flight-recorder ring size (steps)"),
+    "_DETECTORS": ("bool", "detectors",
+                   "step-boundary anomaly detectors (default on)"),
+}
 
-    - ``APEX_TPU_TELEMETRY=<path>``    — JSONL file sink
-    - ``APEX_TPU_TELEMETRY_STDERR=1``  — stderr summary sink
-    - ``APEX_TPU_TELEMETRY_PROFILER=1``— jax.profiler span annotations
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off", "")
+
+
+def _env_warn(msg: str) -> None:
+    from apex_tpu.utils.logging import get_logger
+
+    get_logger("observability").warning(msg)
+
+
+def configure_from_env(env=None) -> Optional[MetricsRegistry]:
+    """Configure from ``APEX_TPU_TELEMETRY*`` variables, or return None
+    (leaving the no-op fast path in place) when none is set.
+
+    The full variable table is :data:`ENV_VARS` (docs/observability.md
+    mirrors it).  Validation policy: an unknown ``APEX_TPU_TELEMETRY_*``
+    variable or a malformed value warns *naming the variable* and falls
+    back to that option's default — one typo never silently disables
+    the rest of the telemetry config.
     """
     env = os.environ if env is None else env
-    path = env.get("APEX_TPU_TELEMETRY")
-    stderr = env.get("APEX_TPU_TELEMETRY_STDERR") == "1"
-    if not path and not stderr:
+    kwargs: dict = {}
+    for suffix, (kind, kwarg, _help) in ENV_VARS.items():
+        name = ENV_PREFIX + suffix
+        if name not in env:
+            continue
+        raw = env[name]
+        if kind == "path":
+            if raw:
+                kwargs[kwarg] = raw
+            continue
+        if kind == "bool":
+            low = raw.strip().lower()
+            if low in _TRUE:
+                kwargs[kwarg] = True
+            elif low in _FALSE:
+                kwargs[kwarg] = False
+            else:
+                _env_warn(f"{name}={raw!r} is not a recognized boolean "
+                          f"(use one of {_TRUE + _FALSE[:-1]}); "
+                          "ignoring it")
+            continue
+        if kind == "int":
+            try:
+                kwargs[kwarg] = int(raw)
+            except ValueError:
+                _env_warn(f"{name}={raw!r} is not an integer; using "
+                          "the default")
+            continue
+    for name in env:
+        if (name.startswith(ENV_PREFIX)
+                and name[len(ENV_PREFIX):] not in ENV_VARS):
+            known = ", ".join(ENV_PREFIX + s for s in ENV_VARS)
+            _env_warn(f"unknown telemetry variable {name} (known: "
+                      f"{known}); it has no effect")
+    # telemetry turns ON only when an output is requested (a sink path
+    # or the stderr summary); _PROFILER/_DETECTORS/_FLIGHT_STEPS alone
+    # only modify a configuration that something else enabled
+    if not any(kwargs.get(k) for k in ("jsonl_path", "trace_path",
+                                       "flight_recorder",
+                                       "stderr_summary")):
         return None
-    return configure(
-        jsonl_path=path or None,
-        stderr_summary=stderr,
-        profiler=env.get("APEX_TPU_TELEMETRY_PROFILER") == "1",
-    )
+    return configure(**kwargs)
 
 
 def shutdown() -> None:
@@ -425,12 +592,21 @@ def record_step_metrics(metrics: dict, prefix: str = "train") -> None:
     ``<prefix>.overflow_count``; non-scalars (``aux`` trees) are
     skipped.  Reading the values forces a device sync — which a loop
     that logs per step does anyway.  No-op when telemetry is disabled.
+
+    ISSUE 4 additions (still one ``is None`` check when disabled): the
+    step index (``metrics["step"]`` when the step reports one —
+    ``amp.frontend.make_train_step`` does — else an internal counter)
+    stamps subsequent records; the scalars feed the flight recorder's
+    ring buffer and the anomaly detectors (loss-spike / grad-norm /
+    NaN-first-seen), so a diverging run fires ``anomaly.*`` events and
+    a post-mortem dump with no extra code in the loop.
     """
     reg = _REGISTRY
     if reg is None:
         return
     import numpy as np
 
+    scalars: Dict[str, Any] = {}
     for key, val in metrics.items():
         if key == "aux":
             continue
@@ -441,7 +617,43 @@ def record_step_metrics(metrics: dict, prefix: str = "train") -> None:
         if arr.size != 1:
             continue
         v = arr.reshape(()).item()
+        scalars[key] = v
+    step = scalars.pop("step", None)
+    if step is not None:
+        reg.set_step(int(step))
+    elif not reg._external_step:
+        # fallback for loops that neither return nor declare a step:
+        # count record_step_metrics calls (direct write — this is not
+        # an external declaration and must stay overridable)
+        reg._auto_step += 1
+        reg.step = reg._auto_step
+    for key, v in scalars.items():
         if key == "overflow" or isinstance(v, bool):
             reg.counter(f"{prefix}.{key}_count").inc(int(bool(v)))
         else:
             reg.gauge(f"{prefix}.{key}").set(float(v))
+    # a DDP step pmeans its metrics, so "overflow" may arrive as a
+    # float — normalize it out of the detector value set either way
+    overflow = bool(scalars.get("overflow", False))
+    float_scalars = {k: float(v) for k, v in scalars.items()
+                     if not isinstance(v, bool) and k != "overflow"}
+    recorder = reg.recorder
+    if recorder is not None:
+        row = dict(float_scalars)
+        if "overflow" in scalars:
+            row["overflow"] = overflow
+        # cumulative comm wire bytes, when the comm layer is active —
+        # cheap in-memory counter reads, no device traffic
+        for cname in ("collectives.compressed.bytes",
+                      "collectives.compressed.raw_bytes"):
+            c = reg._metrics.get(("counter", cname))
+            if c is not None:
+                row[cname.rsplit(".", 1)[-1] + "_comm"] = c.value
+        recorder.record_step(reg.step, row)
+    # NOTE: the scaler-thrash detector is fed by
+    # amp.scaler.record_scaler_step (the AMP entry point owns the
+    # overflow stream) — feeding it here too would double-count loops
+    # that call both.
+    bank = reg.detectors
+    if bank is not None:
+        bank.feed_step(reg.step, float_scalars, overflow=overflow)

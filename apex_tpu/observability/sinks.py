@@ -15,6 +15,7 @@ the JSONL stream).
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 from typing import Optional
@@ -31,6 +32,21 @@ def _json_default(obj):
         except Exception:
             pass
     return str(obj)
+
+
+def sanitize_json(obj):
+    """Strict-JSON (RFC-8259) form: Python's json emits bare
+    ``NaN``/``Infinity`` tokens that Perfetto, jq, and JSON.parse all
+    reject — and a NaN loss is exactly the value the trace and the
+    flight-recorder post-mortem must survive.  Non-finite floats
+    become their repr strings."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else repr(obj)
+    if isinstance(obj, dict):
+        return {k: sanitize_json(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [sanitize_json(v) for v in obj]
+    return obj
 
 
 class JsonlSink:

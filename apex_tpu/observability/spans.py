@@ -147,6 +147,13 @@ class StepTimer:
     Both return mean seconds per timed iteration, keep the last output
     on ``self.last`` (donating steps thread state through the loop),
     and record a ``step.<name>`` span observation when telemetry is on.
+
+    ISSUE 4 wiring (all no-ops when telemetry is off): warmup runs
+    under ``compile_label(name)`` and the timed window under
+    ``compile_label(f"{name}.retrace")`` so the recompile tracker
+    attributes expected compiles vs silent retraces; each recording
+    samples the HBM gauges and feeds the throughput-regression
+    detector (via ``observe_span``).
     """
 
     def __init__(self, name: str, warmup: int = 2, iters: int = 10,
@@ -162,30 +169,47 @@ class StepTimer:
         if reg is not None:
             reg.observe_span(f"step.{self.name}", avg_s,
                              iters=self.iters, warmup=self.warmup)
+            # HBM time series rides the step cadence (no device sync —
+            # memory_stats is a local runtime query; None on CPU)
+            from apex_tpu.observability import device as _device
+
+            _device.sample_device_memory()
 
     def time(self, fn: Callable[[Any], Any]) -> float:
+        from apex_tpu.observability.device import compile_label
+
         out = None
-        for _ in range(self.warmup):
-            out = fn(out)
-            self._fence(out[-1])
+        # warmup absorbs compilation — label it so the recompile
+        # tracker attributes compile.{count,ms} to this timer's name
+        with compile_label(self.name):
+            for _ in range(self.warmup):
+                out = fn(out)
+                self._fence(out[-1])
         t0 = time.perf_counter()
-        for _ in range(self.iters):
-            out = fn(out)
-        self._fence(out[-1])
+        with compile_label(f"{self.name}.retrace"):
+            # a compile in the TIMED window is a silent retrace — the
+            # label makes it visible as compile.<name>.retrace.*
+            for _ in range(self.iters):
+                out = fn(out)
+            self._fence(out[-1])
         avg = (time.perf_counter() - t0) / self.iters
         self.last = out
         self._record(avg)
         return avg
 
     def time_call(self, fn: Callable[..., Any], *args) -> float:
+        from apex_tpu.observability.device import compile_label
+
         out = None
-        for _ in range(self.warmup):
-            out = fn(*args)
-            self._fence(out)
+        with compile_label(self.name):
+            for _ in range(self.warmup):
+                out = fn(*args)
+                self._fence(out)
         t0 = time.perf_counter()
-        for _ in range(self.iters):
-            out = fn(*args)
-        self._fence(out)
+        with compile_label(f"{self.name}.retrace"):
+            for _ in range(self.iters):
+                out = fn(*args)
+            self._fence(out)
         avg = (time.perf_counter() - t0) / self.iters
         self.last = out
         self._record(avg)

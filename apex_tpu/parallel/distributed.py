@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from apex_tpu.observability.device import compile_label
 from apex_tpu.parallel.mesh import create_mesh
 
 __all__ = [
@@ -264,4 +265,15 @@ def make_ddp_train_step(
         )
         return fn(state, *batch)
 
-    return init, jax.jit(outer_step)
+    jitted = jax.jit(outer_step)
+
+    def labeled_step(state, *batch):
+        # attribute (re)compiles of the whole sharded step to one name
+        # in the recompile tracker: steady-state DDP training should
+        # land exactly one compile on `compile.ddp_step.*` — a second
+        # is a silent retrace (a shape/dtype wobble in the batch or a
+        # state spec change), the regression the tracker exists to name
+        with compile_label("ddp_step"):
+            return jitted(state, *batch)
+
+    return init, labeled_step

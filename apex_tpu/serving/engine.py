@@ -40,6 +40,17 @@ Telemetry (no-op unless ``observability.configure`` ran):
 ``serving.slot_occupancy`` / ``serving.queue_depth`` (gauges), and the
 ``serving.{requests,prefill_calls,decode_steps,tokens_generated}``
 counters the trace-count tests pin against.
+
+Diagnostics (ISSUE 4, same no-op contract): each request emits paired
+``serving.request.begin`` / ``serving.request.end`` events (submit →
+completion, queue time included) that the Perfetto trace sink renders
+as per-request async rows, plus a ``serving.request_ms`` latency
+histogram tagged with the finish reason; the queue/occupancy gauges
+feed the admission-stall/backlog anomaly detector; prefill and decode
+compiles are labeled for the recompile tracker
+(``compile.serving.{prefill,decode}.*`` — a bucketed engine should
+stop compiling once traffic has touched every bucket); HBM gauges are
+sampled at admission and every 64 decode steps.
 """
 
 from __future__ import annotations
@@ -59,6 +70,8 @@ from apex_tpu.models.generate import (
     _check_decode_cfg, decode_step, init_kv_cache, prefill, sample_logits)
 from apex_tpu.observability import metrics as _telemetry
 from apex_tpu.observability import span
+from apex_tpu.observability.device import (
+    compile_label, sample_device_memory)
 from apex_tpu.serving.batching import (
     SlotPool, default_buckets, pad_prompt, pick_bucket)
 
@@ -74,6 +87,9 @@ class Request:
     temperature: float = 0.0
     eos_token_id: Optional[int] = None
     request_id: Optional[int] = None
+    # stamped by ServingEngine.submit; end-to-end latency (queue time
+    # included) is measured from here
+    submitted_t: float = 0.0
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -155,6 +171,7 @@ class ServingEngine:
         self._pending = np.zeros((self.max_slots,), np.int32)
         self._temps = np.zeros((self.max_slots,), np.float32)
         self._next_id = 0
+        self._decode_count = 0
         self._sampling = dict(top_k=top_k, top_p=top_p,
                               vocab_limit=vocab_limit)
         self._decode_fn = _make_decode_fn(cfg, top_k, top_p, vocab_limit)
@@ -176,8 +193,14 @@ class ServingEngine:
                 f"({self.max_len}); raise max_len or shorten the request")
         pick_bucket(req.prompt.size, self.buckets)   # validate early
         self._next_id += 1
+        req.submitted_t = time.perf_counter()
         self._queue.append(req)
         _telemetry.counter("serving.requests").inc()
+        # paired with serving.request.end at completion: the trace sink
+        # renders the pair as one async per-request latency row
+        _telemetry.event("serving.request.begin", id=req.request_id,
+                         prompt_tokens=int(req.prompt.size),
+                         max_new_tokens=req.max_new_tokens)
         self._set_gauges()
         return req.request_id
 
@@ -190,6 +213,13 @@ class ServingEngine:
         """Admit what fits, decode one token for every live slot;
         returns the requests completed by this step."""
         completed = self._admit()
+        # feed the stall detector HERE — after admission, before
+        # decode.  This is the only point in the cycle where "queued
+        # work alongside free slots" is abnormal: after _decode_once,
+        # completions legitimately free slots while the backlog waits
+        # for the NEXT step's admission (healthy continuous batching),
+        # and before the first step a submit burst is just a queue.
+        self._feed_queue_detector()
         if self._pool.n_active:
             completed.extend(self._decode_once())
         self._set_gauges()
@@ -229,6 +259,14 @@ class ServingEngine:
             self._pool.n_active / self.max_slots)
         _telemetry.gauge("serving.queue_depth").set(len(self._queue))
 
+    def _feed_queue_detector(self) -> None:
+        """Anomaly feed for the queue detector (see step() for why the
+        post-admission instant is the only valid sampling point)."""
+        reg = _telemetry.registry()
+        if reg is not None and reg.detectors is not None:
+            reg.detectors.feed_serving(
+                len(self._queue), self._pool.n_active / self.max_slots)
+
     def _admit(self) -> List[Response]:
         """Prefill queued requests into free slots (continuous
         batching's entry edge).  Returns requests that completed at
@@ -237,34 +275,61 @@ class ServingEngine:
         while self._queue and self._pool.n_free:
             req = self._queue.popleft()
             slot = self._pool.claim()
-            n = req.prompt.size
-            bucket = pick_bucket(n, self.buckets)
-            t0 = time.perf_counter()
-            with span("serving.prefill"):
-                padded = jnp.asarray(pad_prompt(req.prompt, bucket)[None])
-                lens = jnp.asarray([n], jnp.int32)
-                logits, small = prefill(
-                    self.params, padded, self.cfg, prompt_lens=lens,
-                    max_len=bucket, cache_dtype=self._cache_dtype)
-                self.cache = _insert_slot(
-                    self.cache, small["k"], small["v"],
-                    jnp.int32(slot), jnp.int32(n))
-                self._key, sub = jax.random.split(self._key)
-                first = self._sample_fn(
-                    logits, jnp.asarray([req.temperature], jnp.float32),
-                    sub)
-                tok = int(np.asarray(first)[0])      # host sync
-            ms = (time.perf_counter() - t0) * 1e3
-            _telemetry.counter("serving.prefill_calls").inc()
-            _telemetry.histogram("serving.prefill_ms").observe(ms)
-            _telemetry.counter("serving.tokens_generated").inc()
-            st = _Slot(request=req, tokens=[tok], prefill_ms=ms)
-            self._slots[slot] = st
-            self._pending[slot] = tok
-            self._temps[slot] = req.temperature
-            done = self._finish_reason(st, tok)
-            if done:
-                completed.append(self._complete(slot, done))
+            try:
+                completed.extend(self._admit_one(req, slot))
+            except Exception:
+                # a transient prefill failure (device OOM, XLA error)
+                # must not leak the slot or drop the request: restore
+                # both so the engine stays drainable and a retry can
+                # succeed, then surface the error.  Unwind ONLY the
+                # pre-handoff state — if the failure struck after the
+                # slot was handed over (or after _complete already
+                # served and released it), releasing again would
+                # double-free and requeueing would serve the request
+                # twice.
+                if (self._slots[slot] is None
+                        and slot in self._pool.active):
+                    self._pool.release(slot)
+                    self._queue.appendleft(req)
+                    self._set_gauges()
+                raise
+        return completed
+
+    def _admit_one(self, req: Request, slot: int) -> List[Response]:
+        """Prefill one claimed request into its slot (split out so
+        :meth:`_admit` can unwind slot + queue state on failure)."""
+        completed: List[Response] = []
+        n = req.prompt.size
+        bucket = pick_bucket(n, self.buckets)
+        t0 = time.perf_counter()
+        with span("serving.prefill"), \
+                compile_label("serving.prefill"):
+            padded = jnp.asarray(pad_prompt(req.prompt, bucket)[None])
+            lens = jnp.asarray([n], jnp.int32)
+            logits, small = prefill(
+                self.params, padded, self.cfg, prompt_lens=lens,
+                max_len=bucket, cache_dtype=self._cache_dtype)
+            self.cache = _insert_slot(
+                self.cache, small["k"], small["v"],
+                jnp.int32(slot), jnp.int32(n))
+            self._key, sub = jax.random.split(self._key)
+            first = self._sample_fn(
+                logits, jnp.asarray([req.temperature], jnp.float32),
+                sub)
+            tok = int(np.asarray(first)[0])      # host sync
+        ms = (time.perf_counter() - t0) * 1e3
+        _telemetry.counter("serving.prefill_calls").inc()
+        _telemetry.histogram("serving.prefill_ms").observe(ms)
+        _telemetry.counter("serving.tokens_generated").inc()
+        if _telemetry.enabled():
+            sample_device_memory()   # admission = cache growth edge
+        st = _Slot(request=req, tokens=[tok], prefill_ms=ms)
+        self._slots[slot] = st
+        self._pending[slot] = tok
+        self._temps[slot] = req.temperature
+        done = self._finish_reason(st, tok)
+        if done:
+            completed.append(self._complete(slot, done))
         return completed
 
     def _decode_once(self) -> List[Response]:
@@ -275,12 +340,18 @@ class ServingEngine:
             active[i] = st is not None
         t0 = time.perf_counter()
         self._key, sub = jax.random.split(self._key)
-        nxt, self.cache = self._decode_fn(
-            self.params, self.cache, jnp.asarray(self._pending),
-            jnp.asarray(self._temps), jnp.asarray(active), sub)
-        nxt_host = np.asarray(nxt)                   # host sync
+        with compile_label("serving.decode"):
+            # exactly ONE compile should ever land on this label; a
+            # second is the static-shape discipline breaking
+            nxt, self.cache = self._decode_fn(
+                self.params, self.cache, jnp.asarray(self._pending),
+                jnp.asarray(self._temps), jnp.asarray(active), sub)
+            nxt_host = np.asarray(nxt)               # host sync
         dt = time.perf_counter() - t0
         _telemetry.counter("serving.decode_steps").inc()
+        self._decode_count += 1
+        if self._decode_count % 64 == 0 and _telemetry.enabled():
+            sample_device_memory()   # HBM creep shows on the decode cadence
         completed = []
         emitted = 0
         for slot, st in enumerate(self._slots):
@@ -312,6 +383,15 @@ class ServingEngine:
         self._slots[slot] = None
         self._temps[slot] = 0.0
         self._pool.release(slot)
+        latency_ms = (time.perf_counter()
+                      - st.request.submitted_t) * 1e3
+        _telemetry.histogram("serving.request_ms").observe(
+            latency_ms, rid=st.request.request_id, finish_reason=reason,
+            tokens=len(st.tokens))
+        _telemetry.event("serving.request.end",
+                         id=st.request.request_id, finish_reason=reason,
+                         tokens=len(st.tokens),
+                         latency_ms=round(latency_ms, 3))
         return Response(
             request_id=st.request.request_id,
             prompt=st.request.prompt,

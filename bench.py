@@ -24,10 +24,15 @@ unit and (where meaningful) MFU against the chip's bf16 peak:
                        serving mixes (``serving_continuous_batching``)
 
 Prints ONE JSON line: {"schema_version", "metric", "value", "unit",
-"vs_baseline", "details"}.  All rows are timed through the shared
-``observability.StepTimer`` (docs/observability.md documents the
+"vs_baseline", "details", "runtime"}.  All rows are timed through the
+shared ``observability.StepTimer`` (docs/observability.md documents the
 fencing semantics); set ``APEX_TPU_TELEMETRY=<path>.jsonl`` to stream
-per-row span records too.
+per-row span records too, ``APEX_TPU_TELEMETRY_TRACE=<path>.json`` for
+a Perfetto timeline of the whole run.  The ``runtime`` block is the
+ISSUE 4 accounting (always on): backend-compile count/ms per row label
+(an unexpected ``<row>.retrace`` entry means a compile landed inside
+the timed window) and HBM bytes-in-use/peak where the platform reports
+memory_stats.
 """
 
 import dataclasses
@@ -41,7 +46,8 @@ from apex_tpu.models.config import bert_large, gpt_125m
 from apex_tpu.models.bert import make_bert_train_step
 from apex_tpu.models.gpt import make_gpt_train_step
 from apex_tpu.observability import (
-    SCHEMA_VERSION, StepTimer, configure_from_env)
+    SCHEMA_VERSION, StepTimer, configure_from_env,
+    install_recompile_tracker, runtime_summary)
 from apex_tpu.optimizers import fused_adam, fused_lamb
 
 
@@ -727,7 +733,15 @@ def main():
     args = parser.parse_args()
     # APEX_TPU_TELEMETRY=<path> streams every row's StepTimer span into
     # the shared JSONL schema alongside the headline JSON line
+    # (APEX_TPU_TELEMETRY_TRACE=<path> adds the Perfetto timeline).
     configure_from_env()
+    # recompile + HBM accounting rides EVERY bench run (standalone —
+    # no telemetry required): the tracker counts backend compiles per
+    # StepTimer label, and the "runtime" block below lands in the
+    # BENCH JSON so published rows carry their compile counts and HBM
+    # peaks.  An unexpected `<row>.retrace` entry = a compile in the
+    # timed window = the row's number is compile-polluted.
+    install_recompile_tracker()
     platform = _probe_backend()
     if platform is None:
         return
@@ -745,6 +759,7 @@ def main():
             "value": rows.get(wires[0], {}).get("tokens_per_sec", 0.0),
             "unit": "tokens/s",
             "details": rows,
+            "runtime": runtime_summary(),
         }))
         return
     if args.decode:
@@ -764,6 +779,7 @@ def main():
                 "decode_tokens_per_sec", 0.0),
             "unit": "tokens/s",
             "details": details,
+            "runtime": runtime_summary(),
         }))
         return
     details = {}
@@ -797,6 +813,10 @@ def main():
         "unit": "tokens/s",
         "vs_baseline": gpt.get("speedup_vs_fp32_unfused", 0.0),
         "details": details,
+        # compile.{count,ms} per row label + HBM peak: a row whose
+        # label shows a `.retrace` compile was polluted; a peak near
+        # device capacity explains an MFU cliff (docs/observability.md)
+        "runtime": runtime_summary(),
     }))
 
 

@@ -138,6 +138,14 @@ MODULES = [
      "observability.spans — span API + StepTimer"),
     ("apex_tpu.observability.sinks", "observability",
      "observability.sinks — JSONL / stderr-summary sinks"),
+    ("apex_tpu.observability.trace", "observability",
+     "observability.trace — Chrome trace_events / Perfetto export"),
+    ("apex_tpu.observability.recorder", "observability",
+     "observability.recorder — flight recorder / crash post-mortem"),
+    ("apex_tpu.observability.detectors", "observability",
+     "observability.detectors — step-boundary anomaly detectors"),
+    ("apex_tpu.observability.device", "observability",
+     "observability.device — recompile tracking + HBM gauges"),
     # misc
     ("apex_tpu.normalization", "misc", "apex_tpu.normalization"),
     ("apex_tpu.fused_dense", "misc", "apex_tpu.fused_dense"),

@@ -94,6 +94,45 @@ class TestDisabledFastPath:
         record_schedule_telemetry("1f1b", n_micro=4, n_stages=2, ticks=5)
         assert not obs.enabled()
 
+    def test_tight_loop_unconfigured_shares_one_noop(self):
+        """ISSUE 4 satellite: instrument a tight loop with telemetry
+        unconfigured and assert every public helper — including the
+        detector-feeding entry points — hands back the SHARED no-op
+        (one singleton across all iterations, i.e. no per-call
+        allocation of metric objects) and materializes no registry,
+        detector bank, or recorder as a side effect."""
+        from apex_tpu.amp.scaler import record_scaler_step
+
+        assert not obs.enabled()
+        hot_span = obs.span("hot")           # constructed once, reused
+        returned = set()
+        for i in range(1000):
+            returned.add(id(obs.counter("c")))
+            returned.add(id(obs.gauge("g")))
+            returned.add(id(obs.histogram("h")))
+            # inert singleton methods + void helpers
+            obs.counter("c").inc()
+            obs.gauge("g").set(i)
+            obs.histogram("h").observe(i)
+            assert obs.event("e", step=i) is None
+            assert obs.set_step(i) is None
+            with hot_span:
+                pass
+            # the detector/recorder feeds fast-path out before any work
+            assert obs.record_step_metrics(
+                {"loss": 1.0, "step": i}) is None
+            assert record_scaler_step(
+                {"loss_scale": 1.0, "overflow": False}) is None
+        assert returned == {id(NOOP_METRIC)}
+        assert obs.registry() is None        # nothing materialized
+        assert hot_span._thread_stack() == []
+
+    def test_sample_device_memory_disabled_emits_nothing(self):
+        # emit path requires a registry; unconfigured it must neither
+        # create one nor raise
+        obs.sample_device_memory()
+        assert not obs.enabled()
+
 
 # ---------------------------------------------------------------------------
 # registry + sinks
@@ -239,6 +278,46 @@ class TestSpans:
 # ---------------------------------------------------------------------------
 # subsystem instrumentation
 # ---------------------------------------------------------------------------
+
+
+class TestStepStamping:
+    def test_external_set_step_is_never_clobbered(self):
+        """A loop resumed at step 50k that drives obs.set_step itself
+        must not be re-stamped 1, 2, 3... by the auto-increment
+        fallback when its step fn returns no 'step' key."""
+        reg = obs.configure()
+        for i in range(3):
+            obs.set_step(50000 + i)
+            obs.record_step_metrics({"loss": 1.0})   # no 'step' key
+            assert reg.step == 50000 + i
+        obs.shutdown()
+
+    def test_auto_increment_without_any_declaration(self):
+        reg = obs.configure()
+        for expect in (1, 2, 3):
+            obs.record_step_metrics({"loss": 1.0})
+            assert reg.step == expect
+        obs.shutdown()
+
+    def test_scaler_records_carry_current_step(self, tmp_path):
+        """record_scaler_step runs BEFORE record_step_metrics in the
+        canonical loop; its amp.* records and thrash feed must carry
+        THIS step's index (adopted from the metrics dict), not the
+        previous one."""
+        import json
+
+        from apex_tpu.amp.scaler import record_scaler_step
+
+        path = tmp_path / "t.jsonl"
+        reg = obs.configure(jsonl_path=str(path))
+        record_scaler_step({"loss_scale": 1024.0, "overflow": False,
+                            "step": 7})
+        assert reg.step == 7
+        obs.record_step_metrics({"loss": 1.0, "step": 7})
+        obs.shutdown()
+        recs = [json.loads(line) for line in open(path)]
+        amp_recs = [r for r in recs if r.get("name") == "amp.loss_scale"]
+        assert amp_recs and all(r["step"] == 7 for r in amp_recs)
 
 
 class TestAmpScalerTelemetry:

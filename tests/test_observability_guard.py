@@ -1,0 +1,152 @@
+"""Grep-based guard: instrumentation must ride the no-op fast path.
+
+The zero-overhead-when-disabled invariant (ISSUE 1, re-asserted by
+ISSUE 4) is structural: every instrumented call site in ``apex_tpu/``
+must reach telemetry through one of
+
+- the module-level helpers (``_telemetry.counter(...)`` /
+  ``gauge`` / ``histogram`` / ``event`` / ``set_step`` /
+  ``record_step_metrics``), which embed the ``is None`` check; or
+- an explicit bind-and-check: ``reg = _telemetry.registry()`` then
+  ``if reg is None: return`` / ``if reg is not None:``.
+
+What breaks it — and what this test greps for — is the *unconditional
+chained* form ``registry().counter(...)`` (an AttributeError when
+disabled, an allocation-per-call when enabled-by-accident), direct
+``MetricsRegistry(...)`` construction outside the observability
+package (a second registry dodges configure/shutdown and the fast
+path), reaching into the private ``_REGISTRY`` global, and hot-path
+device sampling (``sample_device_memory``) without an ``enabled()``
+gate.  Source-text enforcement keeps the invariant reviewable: a new
+subsystem cannot silently regress it without editing this test.
+"""
+
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "apex_tpu")
+OBS_DIR = os.path.join(PKG, "observability")
+
+# chained registry().<metric>(...) — bypasses the bind-and-check idiom
+_CHAINED = re.compile(
+    r"registry\(\)\s*\.\s*"
+    r"(counter|gauge|histogram|event|observe_span|set_step|summary)\b")
+# a second MetricsRegistry outside the observability package
+_DIRECT_REGISTRY = re.compile(r"\bMetricsRegistry\s*\(")
+# the private module global
+_PRIVATE_GLOBAL = re.compile(r"\b_REGISTRY\b")
+# device-memory sampling: a real (if cheap) runtime query per call —
+# hot paths must gate it
+_MEM_SAMPLE = re.compile(r"\bsample_device_memory\s*\(")
+_MEM_GATE = re.compile(r"enabled\(\)|is not None|is None|emit=False")
+
+
+def _py_files():
+    for root, _dirs, files in os.walk(PKG):
+        if "__pycache__" in root:
+            continue
+        for fn in files:
+            if fn.endswith(".py"):
+                yield os.path.join(root, fn)
+
+
+def _in_obs(path: str) -> bool:
+    return os.path.abspath(path).startswith(os.path.abspath(OBS_DIR))
+
+
+def test_no_unconditional_chained_registry_calls():
+    offenders = []
+    for path in _py_files():
+        if _in_obs(path):
+            continue   # the package itself owns the registry internals
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                if _CHAINED.search(line):
+                    offenders.append(f"{path}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "instrumented call sites must bind-and-check "
+        "(reg = registry(); if reg is None: ...) or use the "
+        "module-level helpers — unconditional registry().<metric>() "
+        "bypasses the no-op fast path:\n" + "\n".join(offenders))
+
+
+def test_no_direct_metricsregistry_construction():
+    offenders = []
+    for path in _py_files():
+        if _in_obs(path):
+            continue
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                if _DIRECT_REGISTRY.search(line) and "import" not in line:
+                    offenders.append(f"{path}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "construct registries via observability.configure() only — a "
+        "direct MetricsRegistry() dodges configure/shutdown and the "
+        "module-level fast path:\n" + "\n".join(offenders))
+
+
+def test_no_private_registry_global_access():
+    offenders = []
+    for path in _py_files():
+        if os.path.basename(path) == "metrics.py" and _in_obs(path):
+            continue   # the owner
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                if _PRIVATE_GLOBAL.search(line):
+                    offenders.append(f"{path}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "_REGISTRY is private to observability.metrics; go through "
+        "registry()/enabled():\n" + "\n".join(offenders))
+
+
+def test_device_memory_sampling_is_gated():
+    """``sample_device_memory()`` outside the observability package
+    must sit within two lines of an ``enabled()`` / bind-and-check
+    gate (or pass ``emit=False``, the caller-owns-it form)."""
+    offenders = []
+    for path in _py_files():
+        if _in_obs(path):
+            continue
+        with open(path) as f:
+            lines = f.readlines()
+        for i, line in enumerate(lines):
+            if not _MEM_SAMPLE.search(line):
+                continue
+            if "import" in line:
+                continue
+            context = "".join(lines[max(0, i - 2): i + 1])
+            if not _MEM_GATE.search(context):
+                offenders.append(f"{path}:{i + 1}: {line.strip()}")
+    assert not offenders, (
+        "gate device-memory sampling on enabled() in hot paths:\n"
+        + "\n".join(offenders))
+
+
+def test_guard_patterns_actually_match():
+    """The guard is only as good as its regexes: each must match its
+    own anti-pattern (a regression here silently disables the guard)."""
+    assert _CHAINED.search("reg = registry().counter('x')")
+    assert _CHAINED.search("metrics.registry().gauge('x').set(1)")
+    assert not _CHAINED.search("reg = _telemetry.registry()")
+    assert _DIRECT_REGISTRY.search("r = MetricsRegistry(sinks)")
+    assert _PRIVATE_GLOBAL.search("from x import _REGISTRY")
+    assert _MEM_SAMPLE.search("sample_device_memory()")
+
+
+@pytest.mark.parametrize("helper", [
+    "counter", "gauge", "histogram", "event", "set_step",
+    "record_step_metrics",
+])
+def test_module_helpers_embed_the_check(helper):
+    """Every helper the guard steers call sites toward must itself
+    fast-path on the disabled registry (source-level: the function
+    body reads _REGISTRY and checks None before doing work)."""
+    import inspect
+
+    from apex_tpu.observability import metrics
+
+    src = inspect.getsource(getattr(metrics, helper))
+    assert "_REGISTRY" in src and "is None" in src or "is not None" in src

@@ -200,6 +200,129 @@ class TestEngineLifecycle:
             assert ((r.tokens >= 0) & (r.tokens < cfg.vocab_size)).all()
 
 
+class TestServingTelemetry:
+    """ISSUE 4 satellite: serving.* emission with the registry
+    unconfigured (no-op, no crash) and configured mid-flight."""
+
+    def test_unconfigured_engine_is_noop_and_does_not_crash(self, model):
+        from apex_tpu.observability import metrics as telemetry
+        from apex_tpu.observability.metrics import NOOP_METRIC
+
+        cfg, params = model
+        assert not telemetry.enabled()
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               prompt_buckets=(8,))
+        resps = engine.run([
+            dict(prompt=np.asarray([1, 2, 3]), max_new_tokens=3),
+            dict(prompt=np.asarray([4, 5]), max_new_tokens=2),
+        ])
+        assert len(resps) == 2
+        # the whole run left telemetry on the no-op fast path
+        assert not telemetry.enabled()
+        assert telemetry.counter("serving.requests") is NOOP_METRIC
+
+    def test_healthy_backlog_fires_no_admission_stall(self, model):
+        """Neither a submit burst before the first step nor sustained
+        short-request traffic (completions free slots every step while
+        the backlog waits for the NEXT admission) is a stall: the
+        detector samples post-admission, the one instant where free
+        slots + queued work is abnormal.  24 two-token requests on 2
+        slots drive well past the detector's patience window."""
+        from apex_tpu.observability import metrics as telemetry
+
+        cfg, params = model
+        rng = np.random.RandomState(11)
+        reg = telemetry.configure()
+        try:
+            engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                                   prompt_buckets=(8,))
+            for _ in range(24):     # >> detector patience, all queued
+                engine.submit(rng.randint(0, cfg.vocab_size, (4,)),
+                              max_new_tokens=2)
+            assert not reg.detectors.anomalies
+            steps = 0
+            while not engine.idle:
+                engine.step()
+                steps += 1
+            assert steps > 8        # really exceeded patience
+            # queue-detector specifically: wall-clock-noise kinds
+            # (throughput) are out of scope for this test
+            stalls = [a.kind for a in reg.detectors.anomalies
+                      if a.kind.startswith("serving_")]
+            assert stalls == []
+        finally:
+            telemetry.shutdown()
+
+    def test_prefill_failure_leaks_no_slot_and_keeps_request(
+            self, model, monkeypatch):
+        """A transient prefill failure (device OOM, XLA error) must
+        not leak the claimed slot or drop the popped request: the
+        engine stays drainable and a retry succeeds."""
+        import apex_tpu.serving.engine as engine_mod
+
+        cfg, params = model
+        engine = ServingEngine(params, cfg, max_slots=1, max_len=32,
+                               prompt_buckets=(8,))
+        real_prefill = engine_mod.prefill
+        boom = {"armed": True}
+
+        def flaky_prefill(*a, **kw):
+            if boom["armed"]:
+                boom["armed"] = False
+                raise RuntimeError("transient prefill failure")
+            return real_prefill(*a, **kw)
+
+        monkeypatch.setattr(engine_mod, "prefill", flaky_prefill)
+        rid = engine.submit(np.asarray([3, 1, 4]), max_new_tokens=3)
+        with pytest.raises(RuntimeError, match="transient"):
+            engine.step()
+        assert engine.stats()["free_slots"] == 1     # slot released
+        assert engine.stats()["queued"] == 1         # request kept
+        assert not engine.idle
+        resps = engine.run([])                       # retry drains it
+        assert [r.request_id for r in resps] == [rid]
+        assert resps[0].tokens.size == 3
+        assert engine.idle and engine.stats()["free_slots"] == 1
+
+    def test_configured_mid_flight_picks_up_serving_metrics(
+            self, model, tmp_path):
+        import json
+
+        from apex_tpu.observability import metrics as telemetry
+
+        cfg, params = model
+        rng = np.random.RandomState(7)
+        engine = ServingEngine(params, cfg, max_slots=1, max_len=32,
+                               prompt_buckets=(8,))
+        # phase 1: dark — a request runs with telemetry off
+        engine.run([dict(prompt=rng.randint(0, cfg.vocab_size, (4,)),
+                         max_new_tokens=2)])
+        # phase 2: configure mid-flight; later requests are counted
+        path = tmp_path / "serving.jsonl"
+        reg = telemetry.configure(jsonl_path=str(path))
+        try:
+            engine.run([
+                dict(prompt=rng.randint(0, cfg.vocab_size, (4,)),
+                     max_new_tokens=3) for _ in range(2)])
+            summ = reg.summary()
+            assert summ["counters"]["serving.requests"] == 2
+            assert summ["counters"]["serving.prefill_calls"] == 2
+            assert summ["counters"]["serving.tokens_generated"] == 6
+            assert summ["histograms"]["serving.request_ms"]["count"] == 2
+        finally:
+            telemetry.shutdown()
+        recs = [json.loads(line) for line in open(path)]
+        begins = [r for r in recs if r.get("type") == "event"
+                  and r.get("name") == "serving.request.begin"]
+        ends = [r for r in recs if r.get("type") == "event"
+                and r.get("name") == "serving.request.end"]
+        # request ids continue from the dark phase (id 0 ran dark)
+        assert [b["data"]["id"] for b in begins] == [1, 2]
+        assert sorted(e["data"]["id"] for e in ends) == [1, 2]
+        assert all(e["data"]["finish_reason"] == "length" for e in ends)
+        assert all(e["data"]["latency_ms"] > 0 for e in ends)
+
+
 @pytest.mark.slow   # serving soak: many mixed requests; CI slow job
 class TestServingSoak:
     def test_soak_mixed_traffic(self, model):

@@ -84,3 +84,59 @@ def test_appended_runs_in_one_file_sum_counters(report, tmp_path):
 def test_main_exit_code(report, capsys):
     assert report.main([FIXTURE]) == 0
     assert "step.bench" in capsys.readouterr().out
+
+
+def test_missing_schema_version_warns_once_best_effort(report, tmp_path):
+    """ISSUE 4 satellite: a record with NO schema_version (hand-edited
+    stream, pre-ISSUE-1 writer) is still summarized; one warning names
+    the condition instead of silently dropping or crashing."""
+    f = tmp_path / "old.jsonl"
+    f.write_text(
+        '{"t":1,"type":"gauge","name":"legacy.gauge","value":2.0}\n'
+        '{"t":2,"type":"gauge","name":"legacy.gauge","value":4.0}\n'
+        '{"schema_version":2,"t":3,"type":"gauge","name":"new.gauge",'
+        '"value":1.0}\n')
+    summ = report.summarize(report.load_records([str(f)]))
+    assert summ["gauges"]["legacy.gauge"] == [2.0, 4.0]   # best-effort
+    assert summ["missing_schema"] == 2
+    out = io.StringIO()
+    report.print_report(summ, out=out)
+    text = out.getvalue()
+    assert text.count("missing schema_version") == 1       # warn once
+    assert "legacy.gauge" in text
+
+
+def test_since_step_filters_stamped_records(report, tmp_path):
+    """--since-step keeps step >= N records; unstamped records (meta,
+    trace-time counters) pass through so run identity survives."""
+    f = tmp_path / "steps.jsonl"
+    f.write_text(
+        '{"schema_version":2,"t":1,"type":"meta","tags":{},"pid":1}\n'
+        '{"schema_version":2,"t":2,"step":5,"type":"gauge",'
+        '"name":"train.loss","value":1.0}\n'
+        '{"schema_version":2,"t":3,"step":9,"type":"gauge",'
+        '"name":"train.loss","value":2.0}\n'
+        '{"schema_version":2,"t":4,"step":10,"type":"gauge",'
+        '"name":"train.loss","value":3.0}\n'
+        '{"schema_version":2,"t":5,"type":"counter",'
+        '"name":"collectives.psum.calls","value":7}\n')
+    records = report.load_records([str(f)])
+    kept = report.filter_since_step(records, 10)
+    summ = report.summarize(kept)
+    assert summ["gauges"]["train.loss"] == [3.0]
+    assert summ["counters"]["collectives.psum.calls"] == 7  # unstamped
+    # no filter = identity
+    assert report.filter_since_step(records, None) is records
+
+
+def test_since_step_cli_flag(report, tmp_path, capsys):
+    f = tmp_path / "steps.jsonl"
+    f.write_text(
+        '{"schema_version":2,"t":2,"step":1,"type":"gauge",'
+        '"name":"train.loss","value":1.0}\n'
+        '{"schema_version":2,"t":3,"step":8,"type":"gauge",'
+        '"name":"train.loss","value":99.0}\n')
+    assert report.main(["--since-step", "5", str(f)]) == 0
+    out = capsys.readouterr().out
+    line = next(ln for ln in out.splitlines() if "train.loss" in ln)
+    assert "99" in line and line.split()[1] == "1"   # count == 1

@@ -1,0 +1,280 @@
+"""Render a flight-recorder dump or Perfetto trace into an incident
+summary.
+
+    python tools/health_report.py flight_recorder.json
+    python tools/health_report.py trace.json
+    python tools/health_report.py --last 20 flight_recorder.json
+
+The flight recorder (``apex_tpu.observability.recorder``) dumps a JSON
+post-mortem on crash / first anomaly / shutdown-with-anomalies; the
+trace sink (``apex_tpu.observability.trace``) streams a Chrome
+trace_events timeline.  Both are machine artifacts — this tool is the
+human end: what went wrong, at which step, what the run looked like
+around it, and what to check first.
+
+File type is auto-detected (a dump is a JSON object with
+``dump_schema_version``; a trace is a JSON array / ``traceEvents``
+object, truncated tails tolerated).  Dependency-free on purpose: a
+post-mortem is read on whatever box has the artifact, not necessarily
+one with jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List
+
+# what to check first, per anomaly kind (the incident summary's
+# "next actions" block)
+_HINTS = {
+    "nan_inf": "check the keys named above: a non-finite grad_norm "
+               "before the loss implicates the backward (lower the lr "
+               "or loss-scale ceiling); a non-finite loss first "
+               "implicates the data/labels or the forward",
+    "loss_spike": "inspect the data pipeline around that step (a bad "
+                  "shard/batch), then lr schedule warmup/restarts",
+    "grad_norm_explosion": "enable/verify grad clipping "
+                           "(grad_postprocess=) and inspect the lr at "
+                           "that step",
+    "scaler_thrash": "the loss scale is cycling: lower "
+                     "init_scale/max_loss_scale, or raise "
+                     "scale_window; sustained thrash usually precedes "
+                     "divergence",
+    "throughput_regression": "check compile.count for a silent "
+                             "retrace (shape/dtype wobble) and "
+                             "hbm.peak_bytes for memory creep/spill",
+    "serving_admission_stall": "requests queued while slots sit free: "
+                               "admission is wedged (a prefill "
+                               "exception or a bucket mismatch)",
+    "serving_backlog": "sustained overload: add slots/replicas or "
+                       "shed load",
+}
+
+
+def _fmt_t(t) -> str:
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(float(t)))
+    except (TypeError, ValueError):
+        return "?"
+
+
+def _fmt_bytes(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.2f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.2f} TiB"
+
+
+def load_artifact(path: str):
+    """Return ("dump", dict) or ("trace", [events]); trace loading
+    tolerates the crash-truncated array form the sink writes."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        events = []
+        for line in text.splitlines():
+            line = line.strip().rstrip(",")
+            if line in ("[", "]", ""):
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue
+        return "trace", events
+    if isinstance(doc, list):
+        return "trace", doc
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        return "trace", list(doc["traceEvents"])
+    if isinstance(doc, dict) and ("dump_schema_version" in doc
+                                  or "steps" in doc):
+        return "dump", doc
+    raise ValueError(
+        f"{path}: neither a flight-recorder dump nor a trace_events "
+        "file")
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder dumps
+# ---------------------------------------------------------------------------
+
+
+def render_dump(doc: dict, out=None, last: int = 12) -> None:
+    out = sys.stdout if out is None else out
+    p = lambda *a: print(*a, file=out)   # noqa: E731
+    p("== incident summary (flight recorder) ==")
+    p(f"reason: {doc.get('reason', '?')}   dumped: "
+      f"{_fmt_t(doc.get('t'))}   pid: {doc.get('pid', '?')}")
+    if doc.get("argv"):
+        p(f"argv: {' '.join(map(str, doc['argv']))}")
+    if doc.get("tags"):
+        p(f"tags: {doc['tags']}")
+    if doc.get("error"):
+        p(f"error: {doc['error']}")
+    first = doc.get("first_anomaly")
+    if first:
+        p(f"\nINCIDENT: [{first.get('kind')}] first anomalous step = "
+          f"{doc.get('first_anomalous_step')}")
+        p(f"  {first.get('message', '')}")
+    else:
+        p("\n(no anomalies recorded)")
+    anomalies = doc.get("anomalies") or []
+    if anomalies:
+        p(f"\n== anomalies ({len(anomalies)}) ==")
+        p(f"{'kind':<26} {'step':>8}  message")
+        for a in anomalies[:50]:
+            step = a.get("step")
+            p(f"{str(a.get('kind')):<26} "
+          f"{'-' if step is None else step:>8}  {a.get('message', '')}")
+        if len(anomalies) > 50:
+            p(f"... and {len(anomalies) - 50} more")
+    steps = doc.get("steps") or []
+    if steps:
+        tail = steps[-last:]
+        keys: List[str] = []
+        for s in tail:
+            for k in s:
+                if k not in ("t", "step") and k not in keys:
+                    keys.append(k)
+        keys = keys[:6]   # the table must fit a terminal
+        first_step = doc.get("first_anomalous_step")
+        p(f"\n== last {len(tail)} recorded steps ==")
+        p(f"{'step':>8} " + " ".join(f"{k:>14}" for k in keys))
+        for s in tail:
+            mark = "*" if (first_step is not None
+                           and s.get("step") == first_step) else " "
+            row = []
+            for k in keys:
+                v = s.get(k)
+                if isinstance(v, float):
+                    row.append(f"{v:>14.6g}")
+                elif v is None:
+                    row.append(f"{'-':>14}")
+                else:
+                    row.append(f"{str(v):>14}")
+            p(f"{str(s.get('step', '?')):>7}{mark} " + " ".join(row))
+        if first_step is not None:
+            p("(* = first anomalous step)")
+    runtime = doc.get("runtime") or {}
+    if runtime.get("compile"):
+        c = runtime["compile"]
+        p(f"\n== recompilation ==")
+        p(f"total: {c.get('count', 0)} compiles, "
+          f"{c.get('ms', 0.0):.1f} ms")
+        for label, row in sorted((c.get("by_label") or {}).items()):
+            p(f"  {label:<32} {row['count']:>5}x {row['ms']:>10.1f} ms")
+    if runtime.get("hbm"):
+        h = runtime["hbm"]
+        p(f"\n== device memory ==")
+        p(f"in use: {_fmt_bytes(h.get('bytes_in_use'))}   peak: "
+          f"{_fmt_bytes(h.get('peak_bytes'))}   devices: "
+          f"{h.get('devices', '?')}")
+    kinds = {a.get("kind") for a in anomalies}
+    hints = [(k, _HINTS[k]) for k in sorted(k for k in kinds if k in _HINTS)]
+    if hints:
+        p("\n== next actions ==")
+        for kind, hint in hints:
+            p(f"- [{kind}] {hint}")
+
+
+# ---------------------------------------------------------------------------
+# trace files
+# ---------------------------------------------------------------------------
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def render_trace(events: List[dict], out=None) -> None:
+    out = sys.stdout if out is None else out
+    p = lambda *a: print(*a, file=out)   # noqa: E731
+    p(f"== trace summary ({len(events)} events) ==")
+    slices: dict = {}
+    counters: dict = {}
+    begins: dict = {}
+    asyncs: dict = {}
+    instants: dict = {}
+    for ev in events:
+        ph = ev.get("ph")
+        name = ev.get("name", "?")
+        if ph == "X":
+            slices.setdefault(name, []).append(
+                float(ev.get("dur", 0.0)) / 1e6)
+        elif ph == "C":
+            counters[name] = ev.get("args", {}).get("value")
+        elif ph == "b":
+            begins[(name, ev.get("id"))] = float(ev.get("ts", 0.0))
+        elif ph == "e":
+            t0 = begins.pop((name, ev.get("id")), None)
+            if t0 is not None:
+                asyncs.setdefault(name, []).append(
+                    (float(ev.get("ts", 0.0)) - t0) / 1e6)
+        elif ph == "i":
+            instants[name] = instants.get(name, 0) + 1
+    if slices:
+        p("\n== span slices ==")
+        p(f"{'name':<40} {'count':>7} {'total_s':>10} {'mean_s':>10} "
+          f"{'max_s':>10}")
+        for name in sorted(slices, key=lambda n: -sum(slices[n])):
+            vals = slices[name]
+            p(f"{name:<40} {len(vals):>7} {sum(vals):>10.4g} "
+              f"{sum(vals) / len(vals):>10.4g} {max(vals):>10.4g}")
+    if asyncs:
+        p("\n== request rows (async begin/end pairs) ==")
+        p(f"{'name':<40} {'count':>7} {'mean_s':>10} {'p95_s':>10} "
+          f"{'max_s':>10}")
+        for name in sorted(asyncs):
+            vals = sorted(asyncs[name])
+            p(f"{name:<40} {len(vals):>7} "
+              f"{sum(vals) / len(vals):>10.4g} "
+              f"{_pct(vals, 0.95):>10.4g} {vals[-1]:>10.4g}")
+    if begins:
+        p(f"\n{len(begins)} request(s) still in flight at end of trace "
+          "(begin without end — in-progress or lost to a crash):")
+        for (name, rid) in sorted(begins)[:20]:
+            p(f"  {name} id={rid}")
+    if counters:
+        p("\n== counter tracks (final values) ==")
+        for name in sorted(counters):
+            p(f"  {name:<44} {counters[name]}")
+    if instants:
+        p("\n== instant events ==")
+        for name in sorted(instants):
+            p(f"  {name:<44} {instants[name]}")
+    if not (slices or asyncs or counters or instants):
+        p("(no recognizable events — is this really a trace file?)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render a flight-recorder dump or Perfetto trace "
+                    "into an incident summary.")
+    ap.add_argument("file", help="flight_recorder .json dump or "
+                                 "trace_events .json file")
+    ap.add_argument("--last", type=int, default=12, metavar="N",
+                    help="show the last N recorded steps of a dump "
+                         "(default 12)")
+    args = ap.parse_args(argv)
+    kind, doc = load_artifact(args.file)
+    if kind == "dump":
+        render_dump(doc, last=args.last)
+    else:
+        render_trace(doc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

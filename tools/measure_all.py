@@ -114,8 +114,16 @@ def main():
 
     os.makedirs(LOGS, exist_ok=True)
     telemetry_path = os.path.join(LOGS, "telemetry.jsonl")
-    configure(jsonl_path=telemetry_path, stderr_summary=True)
+    trace_path = os.path.join(LOGS, "trace.json")
+    flight_path = os.path.join(LOGS, "flight_recorder.json")
+    # the campaign driver records its own timeline + post-mortem: the
+    # stage spans land in the Perfetto trace (open trace.json at
+    # https://ui.perfetto.dev), and a crash mid-campaign dumps the
+    # flight recorder (render with tools/health_report.py)
+    configure(jsonl_path=telemetry_path, stderr_summary=True,
+              trace_path=trace_path, flight_recorder=flight_path)
     print(f"[measure_all] telemetry: {telemetry_path}")
+    print(f"[measure_all] perfetto trace: {trace_path}")
     # Value-first ordering (learned from the round-5 first contact,
     # where the tunnel wedged 25 minutes in): the headline workload
     # matrix and the Mosaic-validation tier run BEFORE the long kernel
@@ -180,9 +188,14 @@ def main():
         print("[measure_all] then: update BASELINE.md ledger + "
               "KERNEL_BENCH rows, re-run bench.py for BENCH_r05 if "
               "defaults moved.")
-    from apex_tpu.observability import shutdown
+    from apex_tpu.observability import runtime_summary, shutdown
 
+    # driver-process compile/HBM accounting (the stages are
+    # subprocesses and carry their own in their BENCH JSON lines)
+    print("[measure_all] runtime:", json.dumps(runtime_summary()))
     shutdown()   # flush stage spans + print the stderr summary table
+    print("[measure_all] post-mortem/trace rendering: "
+          f"python tools/health_report.py {trace_path}")
     return 1 if any(rc != 0 for rc in results.values()) else 0
 
 

@@ -1,6 +1,7 @@
 """Summarize apex_tpu telemetry JSONL files into a per-metric table.
 
     python tools/telemetry_report.py FILE.jsonl [FILE2.jsonl ...]
+    python tools/telemetry_report.py --since-step 1000 FILE.jsonl
 
 Reads one or more telemetry streams (the JSONL sink of
 ``apex_tpu.observability`` — schema in docs/observability.md) and
@@ -17,9 +18,18 @@ prints:
 - gauges: count, last, min, max;
 - events: count per name.
 
-Tolerates garbage lines (warns, continues) and newer ``schema_version``
-values (warns once, still summarizes the fields it knows) so one
-corrupt or future-version record never hides a whole campaign's data.
+``--since-step N`` keeps only records stamped with ``step >= N``
+(schema v2 stamps every record emitted after the loop declared a step
+index); records that carry no ``step`` at all — the ``meta`` record,
+pre-loop configuration, trace-time counters — are kept, so the filter
+narrows the time series without hiding run identity.
+
+Tolerance policy (a post-mortem tool must read wounded data): garbage
+lines warn and are skipped; records with a *newer* ``schema_version``
+warn once and are best-effort parsed; records *missing* the field
+entirely (a hand-edited stream, a pre-ISSUE-1 writer) warn once and
+are parsed the same way — one corrupt or future-version record never
+hides a whole campaign's data.
 """
 
 from __future__ import annotations
@@ -27,9 +37,9 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import Dict, Iterable, List, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
-SUPPORTED_SCHEMA = 1
+SUPPORTED_SCHEMA = 2
 
 
 def _pct(sorted_vals: List[float], q: float) -> float:
@@ -65,17 +75,31 @@ def load_records(paths: Iterable[str], out=None) -> List[dict]:
     return records
 
 
+def filter_since_step(records: List[dict],
+                      since_step: Optional[int]) -> List[dict]:
+    """Keep records stamped ``step >= since_step``; records with no
+    ``step`` field (meta, pre-loop, trace-time) pass through."""
+    if since_step is None:
+        return records
+    return [r for r in records
+            if not isinstance(r.get("step"), (int, float))
+            or r["step"] >= since_step]
+
+
 def summarize(records: List[dict]) -> dict:
     spans: Dict[str, List[float]] = {}
     counters: Dict[Tuple[int, int, str], float] = {}
     gauges: Dict[str, List[float]] = {}
     events: Dict[str, int] = {}
     unknown_schema = set()
+    missing_schema = 0
     epoch: Dict[int, int] = {}   # per-file run segment (meta-delimited)
     for rec in records:
         ver = rec.get("schema_version")
         if isinstance(ver, (int, float)) and ver > SUPPORTED_SCHEMA:
             unknown_schema.add(ver)
+        elif ver is None:
+            missing_schema += 1
         rtype, name = rec.get("type"), rec.get("name")
         if rtype == "meta":
             # the JSONL sink appends, so one file can hold several runs;
@@ -111,6 +135,7 @@ def summarize(records: List[dict]) -> dict:
         "gauges": gauges,
         "events": events,
         "unknown_schema": sorted(unknown_schema),
+        "missing_schema": missing_schema,
     }
 
 
@@ -120,6 +145,9 @@ def print_report(summary: dict, out=None) -> None:
         print("warning: records with newer schema_version "
               f"{summary['unknown_schema']} (supported <= "
               f"{SUPPORTED_SCHEMA}); summarizing known fields", file=out)
+    if summary.get("missing_schema"):
+        print(f"warning: {summary['missing_schema']} record(s) missing "
+              "schema_version; best-effort parse", file=out)
     spans = summary["spans"]
     if spans:
         print("== spans / observations ==", file=out)
@@ -160,8 +188,12 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Summarize apex_tpu telemetry JSONL files.")
     ap.add_argument("files", nargs="+", help="telemetry .jsonl file(s)")
+    ap.add_argument(
+        "--since-step", type=int, default=None, metavar="N",
+        help="only summarize records stamped with step >= N (records "
+             "without a step stamp are kept)")
     args = ap.parse_args(argv)
-    records = load_records(args.files)
+    records = filter_since_step(load_records(args.files), args.since_step)
     print_report(summarize(records))
     return 0
 
