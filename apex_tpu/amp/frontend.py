@@ -91,6 +91,7 @@ def make_train_step(
     main_grad_dtype=jnp.float32,
     norm_telemetry: bool = False,
     grad_comm=None,
+    overlap_comm: Optional[bool] = None,
 ) -> Tuple[Callable, Callable]:
     """Build ``(init_fn, step_fn)`` implementing the full AMP training step.
 
@@ -133,6 +134,15 @@ def make_train_step(
         rank-local: a shard_map wrapper must spec them
         ``P(axis_name)`` (``make_ddp_train_step`` does this; see
         ``comm.error_state_spec`` for custom wrappers).
+      overlap_comm: tensor-parallel comm-overlap tri-state.  When set
+        (``True``/``False``), ``loss_fn`` is traced inside
+        ``ops.collective_matmul.overlap_scope(overlap_comm)``: TP
+        contexts built with ``overlap_comm=None`` (the ``gspmd_ctx`` /
+        ``manual_ctx`` default) then route their row-parallel exits
+        through the overlapped ring collective-matmul (or keep the
+        monolithic collectives, on ``False``) without the model wiring
+        ever seeing this train-step flag.  ``None`` (default) leaves
+        whatever scope the caller established.
       norm_telemetry: when True the metrics dict additionally carries
         ``grad_norm``, ``update_norm``, ``param_norm`` and
         ``update_to_param_ratio`` (``optimizers._common.norm_metrics``
@@ -157,6 +167,15 @@ def make_train_step(
     else:
         amp_state = initialize(policy_or_amp)
     policy, ls_cfg = amp_state.policy, amp_state.loss_scale_config
+
+    if overlap_comm is not None:
+        from apex_tpu.ops.collective_matmul import overlap_scope
+
+        _user_loss_fn = loss_fn
+
+        def loss_fn(params, *batch):   # noqa: F811
+            with overlap_scope(overlap_comm):
+                return _user_loss_fn(params, *batch)
 
     comm_cfg = None
     if grad_comm is not None:

@@ -102,8 +102,12 @@ def _axis_size(axis_name: str) -> int:
 
 def _all_to_all(x: jax.Array, axis_name: str) -> jax.Array:
     # [n, shard] → [n, shard]: row j goes to rank j; row i of the
-    # result is rank i's copy of MY shard
-    return jax.lax.all_to_all(x, axis_name, 0, 0, tiled=True)
+    # result is rank i's copy of MY shard.  Counted wrapper: the wire
+    # traffic also lands in collectives.all_to_all.* next to the
+    # compressed-byte accounting.
+    from apex_tpu.utils.collectives import all_to_all
+
+    return all_to_all(x, axis_name, 0, 0, tiled=True)
 
 
 def _scatter_phase(
@@ -180,8 +184,10 @@ def compressed_allreduce(
         local_sum = local_sum / (n / predivide if predivide else n)
     # gather phase: requantize the reduced shard, move wire bytes only
     wire2, scales2 = quantize_blocks(local_sum, cfg.wire_dtype, cfg.block)
-    full_w = jax.lax.all_gather(wire2, axis_name)
-    full_s = (jax.lax.all_gather(scales2, axis_name)
+    from apex_tpu.utils.collectives import all_gather as _counted_ag
+
+    full_w = _counted_ag(wire2, axis_name)
+    full_s = (_counted_ag(scales2, axis_name)
               if scales2 is not None else None)
     rows = dequantize_blocks(full_w, full_s, cfg.block, shard)
     out = rows.reshape(padded)[:length]

@@ -60,6 +60,7 @@ def make_gpt_train_step(
     grad_postprocess: Optional[Callable] = None,
     fsdp: bool = False,
     norm_telemetry: bool = False,
+    overlap_comm: Optional[bool] = None,
 ):
     """GSPMD data/tensor/sequence-parallel AMP train step.
 
@@ -90,6 +91,14 @@ def make_gpt_train_step(
     rejected up front (they would silently fall back to the gathered
     path and OOM at exactly the lengths the flag exists for);
     ``hidden_dropout`` is fine.
+
+    ``overlap_comm=True`` routes the tensor-parallel row-parallel exits
+    (attention proj, MLP fc2) through the ring collective-matmul
+    (``ops/collective_matmul``): the tp reduction is decomposed into
+    ppermute hops overlapped with per-shard matmul chunks instead of one
+    serialized all-reduce after the matmul.  Default ``None`` keeps the
+    monolithic collectives unless an enclosing
+    ``collective_matmul.overlap_scope`` turns the ring on.
     """
     if context_parallel:
         if cfg.attn_mask_type == "padding":
@@ -119,7 +128,8 @@ def make_gpt_train_step(
                     f"({sp_size}); use context_parallel='ring' for "
                     "head counts that don't factor.")
     ctx = (gspmd_ctx(seq_axis=seq_axis,
-                     context_parallel=context_parallel)
+                     context_parallel=context_parallel,
+                     overlap_comm=overlap_comm)
            if mesh is not None else None)
     has_dropout = (cfg.hidden_dropout > 0 or cfg.attention_dropout > 0
                    or cfg.drop_path_rate > 0)
@@ -136,6 +146,7 @@ def make_gpt_train_step(
         loss_fn, optimizer, policy_or_amp,
         grad_postprocess=grad_postprocess,
         norm_telemetry=norm_telemetry,
+        overlap_comm=overlap_comm,
     )
 
     def init(rng):

@@ -107,6 +107,15 @@ class TPContext(NamedTuple):
     cp_axis: Optional[str] = None
     cp_qkv_spec: Optional[P] = None
     cp_mode: str = "ring"
+    # overlapped TP collectives (ops/collective_matmul): when set, the
+    # row-parallel exits (attention proj, MLP fc2) call
+    # ``row_parallel_matmul(x, w)`` instead of ``reduce_out(x @ w)`` —
+    # the hook fuses the matmul with its reduction as a ppermute ring so
+    # transfer hops overlap partial-product chunks.  The hook returns
+    # ``None`` whenever the ring path does not apply (overlap disabled,
+    # no mesh, tp absent/1, indivisible shapes) and the caller falls
+    # back to the exact monolithic expression.
+    row_parallel_matmul: Optional[Callable] = None
 
 
 def _constrain(x, spec: P):
@@ -127,7 +136,8 @@ def _constrain(x, spec: P):
 
 def gspmd_ctx(batch_axis: str = "dp", tp_axis: str = "tp",
               seq_axis: Optional[str] = None,
-              context_parallel: Union[bool, str] = False) -> TPContext:
+              context_parallel: Union[bool, str] = False,
+              overlap_comm: Optional[bool] = None) -> TPContext:
     """Constraint-based context: annotate, let XLA partition.
 
     ``seq_axis`` shards activations along sequence (Megatron SP under
@@ -137,7 +147,14 @@ def gspmd_ctx(batch_axis: str = "dp", tp_axis: str = "tp",
     cap the sequence length.  ``True`` or ``"ring"`` selects ring
     attention (O(s_local) memory); ``"ulysses"`` selects all-to-all
     head re-sharding (one full-sequence flash call per head group —
-    needs num_heads divisible by the axis size)."""
+    needs num_heads divisible by the axis size).
+
+    ``overlap_comm`` routes the row-parallel matmul+reduce exits
+    through the ring collective-matmul (``ops/collective_matmul``):
+    ``True``/``False`` is explicit, ``None`` (default) inherits
+    ``collective_matmul.overlap_scope`` at trace time — which is how
+    ``amp.frontend.make_train_step(overlap_comm=...)`` reaches contexts
+    it never sees."""
     if context_parallel and seq_axis is None:
         raise ValueError(
             "context_parallel requires seq_axis (the mesh axis the "
@@ -155,6 +172,17 @@ def gspmd_ctx(batch_axis: str = "dp", tp_axis: str = "tp",
         return _constrain(
             x, P(batch_axis, *([None] * (x.ndim - 2)), tp_axis))
 
+    def row_mm(x, w):
+        # ring matmul-reduce-scatter island over tp; the hidden
+        # constraint re-gathers the sequence-scattered result lazily
+        # (XLA overlaps that all-gather with downstream compute)
+        from apex_tpu.ops.collective_matmul import gspmd_row_parallel_matmul
+
+        y = gspmd_row_parallel_matmul(
+            x, w, tp_axis=tp_axis, batch_axis=batch_axis,
+            seq_axis=seq_axis, enable=overlap_comm)
+        return None if y is None else hidden(y)
+
     return TPContext(
         tp=1,
         tp_axis=tp_axis,
@@ -167,11 +195,32 @@ def gspmd_ctx(batch_axis: str = "dp", tp_axis: str = "tp",
         cp_qkv_spec=(P(batch_axis, seq_axis, tp_axis, None)
                      if context_parallel else None),
         cp_mode=cp_mode,
+        row_parallel_matmul=row_mm if overlap_comm is not False else None,
     )
 
 
-def manual_ctx(tp: int, axis: str = "tp") -> TPContext:
-    """shard_map context: explicit mapping collectives, local shards."""
+def manual_ctx(tp: int, axis: str = "tp",
+               overlap_comm: Optional[bool] = None) -> TPContext:
+    """shard_map context: explicit mapping collectives, local shards.
+
+    ``overlap_comm`` (tri-state like :func:`gspmd_ctx`) swaps the
+    row-parallel exits' matmul → psum for the ring
+    ``matmul_all_reduce`` (reduce-scatter hops overlapped with the
+    partial-product chunks, then an all-gather; backward stays
+    communication-free exactly like ``reduce_from``'s identity)."""
+
+    def row_mm(x, w):
+        from apex_tpu.ops import collective_matmul as _cm
+
+        if tp <= 1 or not _cm.overlap_enabled(overlap_comm):
+            return None
+        # scatter the largest leading dim the axis divides (prefer the
+        # sequence dim of [b, s, k] inputs); no fit → monolithic psum
+        for d in (1, 0) if x.ndim >= 3 else (0,):
+            if x.shape[d] % tp == 0:
+                return _cm.matmul_all_reduce(x, w, axis, scatter_dim=d)
+        return None
+
     return TPContext(
         tp=tp,
         tp_axis=axis,
@@ -181,6 +230,7 @@ def manual_ctx(tp: int, axis: str = "tp") -> TPContext:
         constrain_hidden=lambda x: x,
         constrain_col=lambda x: x,
         vocab_parallel=tp > 1,
+        row_parallel_matmul=row_mm if overlap_comm is not False else None,
     )
 
 
@@ -622,10 +672,19 @@ def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
         ctxv = _core_attention(cfg, q, k, v, attention_mask, dropout_rng,
                                ctx)
     ctxv = ctxv.reshape(b, s, -1)
-    out = ctxv @ lp["proj_kernel"].astype(x.dtype)
-    out = ctx.reduce_out(out)
+    out = _row_parallel_out(ctx, ctxv, lp["proj_kernel"].astype(x.dtype))
     out = out + lp["proj_bias"].astype(x.dtype)
     return (out, k, v) if return_kv else out
+
+
+def _row_parallel_out(ctx: TPContext, x, w):
+    """The row-parallel exit: overlapped ring matmul+reduce when the
+    context's hook applies, else the monolithic matmul → reduce_out."""
+    if ctx.row_parallel_matmul is not None:
+        y = ctx.row_parallel_matmul(x, w)
+        if y is not None:
+            return y
+    return ctx.reduce_out(x @ w)
 
 
 def _moe_mlp(cfg: TransformerConfig, lp: dict, x):
@@ -671,8 +730,7 @@ def _mlp(cfg: TransformerConfig, lp: dict, x, ctx: TPContext):
         y = jax.nn.gelu(
             y.astype(jnp.float32),
             approximate=cfg.activation == "gelu_tanh").astype(x.dtype)
-    out = y @ lp["fc2_kernel"].astype(x.dtype)
-    out = ctx.reduce_out(out)
+    out = _row_parallel_out(ctx, y, lp["fc2_kernel"].astype(x.dtype))
     return out + lp["fc2_bias"].astype(x.dtype)
 
 

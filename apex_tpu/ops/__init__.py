@@ -14,6 +14,13 @@ from apex_tpu.ops.layer_norm import (  # noqa: F401
     fused_rms_norm,
 )
 from apex_tpu.ops.flat_adam import flat_adam_update  # noqa: F401
+from apex_tpu.ops.collective_matmul import (  # noqa: F401
+    all_gather_matmul,
+    matmul_all_reduce,
+    matmul_reduce_scatter,
+    ring_all_gather,
+    ring_reduce_scatter,
+)
 from apex_tpu.ops.rope import (  # noqa: F401
     fused_apply_rotary_pos_emb,
     fused_apply_rotary_pos_emb_2d,

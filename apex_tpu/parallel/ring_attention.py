@@ -47,7 +47,11 @@ from apex_tpu.ops.flash_attention import (
     _pad_to,
     _to_bh,
 )
-from apex_tpu.utils.collectives import match_vma, vma_of
+from apex_tpu.utils.collectives import (
+    match_vma,
+    ppermute as _ppermute,
+    vma_of,
+)
 from apex_tpu.utils.registry import on_tpu
 
 __all__ = ["ring_attention"]
@@ -254,8 +258,8 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
         o_c, lse_c = _chunk_fwd(q3, k_cur, v_cur, scale, mode, s_local,
                                 block_q, block_k, gqa=gqa)
         o_acc, lse_acc = _merge(o_acc, lse_acc, o_c, lse_c)
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        k_nxt = _ppermute(k_cur, axis_name, perm)
+        v_nxt = _ppermute(v_cur, axis_name, perm)
         return k_nxt, v_nxt, o_acc, lse_acc
 
     o0, lse0 = match_vma(
@@ -302,10 +306,10 @@ def _ring_vjp_bwd(axis_name, causal, scale, res, do):
         dk_cur = dk_cur + dk_c
         dv_cur = dv_cur + dv_c
         # rotate kv and its traveling gradient accumulators together
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
-        dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
+        k_nxt = _ppermute(k_cur, axis_name, perm)
+        v_nxt = _ppermute(v_cur, axis_name, perm)
+        dk_nxt = _ppermute(dk_cur, axis_name, perm)
+        dv_nxt = _ppermute(dv_cur, axis_name, perm)
         return k_nxt, v_nxt, dk_nxt, dv_nxt, dq_acc
 
     z3, zq = match_vma((jnp.zeros(k3.shape, jnp.float32),

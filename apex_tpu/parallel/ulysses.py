@@ -64,11 +64,11 @@ def ulysses_attention(
 
     # one stacked collective for q/k/v: [3, b, s_local, n, d] ->
     # [3, b, s_global, n/sp, d] (fewer collective launches than three)
+    from apex_tpu.utils.collectives import all_to_all as _counted_a2a
+
     qkv = jnp.stack([q, k, v])
-    qkv = jax.lax.all_to_all(
-        qkv, axis_name, split_axis=3, concat_axis=2, tiled=True)
+    qkv = _counted_a2a(qkv, axis_name, 3, 2, tiled=True)
     out = flash_attention(qkv[0], qkv[1], qkv[2], causal=causal,
                           scale=scale)
     # [b, s_global, n/sp, d] -> [b, s_local, n, d]
-    return jax.lax.all_to_all(
-        out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    return _counted_a2a(out, axis_name, 1, 2, tiled=True)

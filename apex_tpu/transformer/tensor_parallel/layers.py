@@ -86,7 +86,16 @@ class VocabParallelEmbedding(nn.Module):
 class ColumnParallelLinear(nn.Module):
     """Y = X·A + b with A column-sharded: A = [A_1 … A_p]
     (reference layers.py:429). Returns ``(out, bias)`` with bias separate
-    when ``skip_bias_add`` (for downstream bias+act fusions)."""
+    when ``skip_bias_add`` (for downstream bias+act fusions).
+
+    ``overlap_comm`` (with ``sequence_parallel_enabled``) replaces the
+    monolithic sequence all-gather → matmul with the ring
+    ``ops.collective_matmul.all_gather_matmul``: each hop's incoming
+    sequence shard is matmul'd while the next shard is in flight, and the
+    backward rides the dual ring (matmul-reduce-scatter).  Falls back to
+    the monolithic path when no 'tp' mesh axis is active or shapes don't
+    divide.  Without sequence parallelism the column matmul has no tp
+    collective, so the flag is a no-op there."""
 
     input_size: int
     output_size: int
@@ -94,6 +103,7 @@ class ColumnParallelLinear(nn.Module):
     gather_output: bool = True
     skip_bias_add: bool = False
     sequence_parallel_enabled: bool = False
+    overlap_comm: bool = False
     init_method: Callable = nn.initializers.lecun_normal()
     params_dtype: jnp.dtype = jnp.float32
     use_partitioning: bool = True
@@ -119,18 +129,29 @@ class ColumnParallelLinear(nn.Module):
             )
             b = jnp.asarray(b)
 
-        if self.sequence_parallel_enabled:
-            # input arrives sequence-sharded [s/tp, b, h]; the matmul needs
-            # the full sequence — constrain to replicated so XLA emits the
-            # all-gather (reference gather_from_sequence_parallel_region,
-            # layers.py:577-612).
-            x = constrain(x, P(None, None, None))
+        y = None
+        if self.sequence_parallel_enabled and self.overlap_comm:
+            from apex_tpu.ops.collective_matmul import (
+                sequence_parallel_matmul,
+            )
 
-        y = jax.lax.dot_general(
-            x, kernel.astype(x.dtype),
-            dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+            y = sequence_parallel_matmul(
+                x, kernel.astype(x.dtype), mode="gather", enable=True)
+            if y is not None:
+                y = y.astype(x.dtype)
+        if y is None:
+            if self.sequence_parallel_enabled:
+                # input arrives sequence-sharded [s/tp, b, h]; the matmul
+                # needs the full sequence — constrain to replicated so XLA
+                # emits the all-gather (reference
+                # gather_from_sequence_parallel_region, layers.py:577-612).
+                x = constrain(x, P(*([None] * x.ndim)))
+
+            y = jax.lax.dot_general(
+                x, kernel.astype(x.dtype),
+                dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(x.dtype)
         if not self.gather_output:
             y = constrain(y, P(*([None] * (y.ndim - 1) + ["tp"])))
         out_bias = None
@@ -144,7 +165,17 @@ class ColumnParallelLinear(nn.Module):
 
 class RowParallelLinear(nn.Module):
     """Y = X·A + b with A row-sharded; the partial products sum over 'tp'
-    (reference layers.py:613)."""
+    (reference layers.py:613).
+
+    ``overlap_comm`` replaces the serialized matmul → reduce-scatter
+    (``sequence_parallel_enabled``) / all-reduce with the ring
+    ``ops.collective_matmul.matmul_reduce_scatter``: the rotating
+    accumulator overlaps each hop's transfer with the next partial-
+    product chunk.  Without sequence parallelism the ring output stays
+    sequence-scattered inside the island and the replicated-output
+    constraint re-gathers it — same wire bytes as the all-reduce, with
+    the reduce-scatter half overlapped.  Falls back monolithic when no
+    'tp' mesh axis is active or shapes don't divide."""
 
     input_size: int
     output_size: int
@@ -152,6 +183,7 @@ class RowParallelLinear(nn.Module):
     input_is_parallel: bool = False
     skip_bias_add: bool = False
     sequence_parallel_enabled: bool = False
+    overlap_comm: bool = False
     init_method: Callable = nn.initializers.lecun_normal()
     params_dtype: jnp.dtype = jnp.float32
     use_partitioning: bool = True
@@ -168,13 +200,25 @@ class RowParallelLinear(nn.Module):
         kernel = jnp.asarray(kernel)
         if self.input_is_parallel:
             x = constrain(x, P(*([None] * (x.ndim - 1) + ["tp"])))
-        y = jax.lax.dot_general(
-            x, kernel.astype(x.dtype),
-            dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+        y = None
+        if self.overlap_comm:
+            from apex_tpu.ops.collective_matmul import (
+                sequence_parallel_matmul,
+            )
+
+            y = sequence_parallel_matmul(
+                x, kernel.astype(x.dtype), mode="scatter", enable=True)
+            if y is not None:
+                y = y.astype(x.dtype)
+        if y is None:
+            y = jax.lax.dot_general(
+                x, kernel.astype(x.dtype),
+                dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(x.dtype)
         if self.sequence_parallel_enabled:
-            # reduce-scatter to sequence shards (reference layers.py:744-780)
+            # reduce-scatter to sequence shards (reference layers.py:744-780;
+            # already scattered on the overlap path — idempotent)
             y = constrain(y, P("tp", *([None] * (y.ndim - 1))))
         else:
             y = constrain(y, P(*([None] * y.ndim)))

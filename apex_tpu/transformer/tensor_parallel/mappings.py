@@ -20,6 +20,15 @@ inside ``shard_map`` on the 'tp' axis. jax≥0.9 varying-axes typing is kept
 consistent: identities that move a value into per-shard compute insert
 ``pvary``; reductions produce axis-invariant values.
 
+The two sequence-parallel mappings with a collective on *both* sides of
+the table take an ``overlap_comm`` tri-state (explicit bool, or ``None``
+to inherit ``ops.collective_matmul.overlap_scope``): when enabled, the
+monolithic all-gather / reduce-scatter is decomposed into n−1
+``ppermute`` ring hops (``ring_all_gather`` / ``ring_reduce_scatter``)
+in the forward AND the backward, so the XLA scheduler can overlap each
+hop with neighboring compute — and a mapping whose forward rides the
+ring never falls back to a monolithic collective under grad.
+
 (The GSPMD layer path — apex_tpu.transformer.tensor_parallel.layers — does
 not call these; XLA inserts the same collectives from sharding annotations.
 These exist for manual shard_map programming and 1:1 reference parity.)
@@ -148,45 +157,69 @@ def _sp_scatter_bwd(axis, _, g):
 scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _seq_all_gather(x, axis, overlap_comm):
+    """Dim-0 all-gather: monolithic (counted) or the n−1-hop ring form
+    under ``overlap_comm`` (ops.collective_matmul.ring_all_gather)."""
+    from apex_tpu.ops import collective_matmul as _cm
+
+    if _cm.overlap_enabled(overlap_comm):
+        return _cm.ring_all_gather(x, axis, dim=0)
+    from apex_tpu.utils.collectives import all_gather
+
+    return all_gather(x, axis, axis=0, tiled=True)
+
+
+def _seq_reduce_scatter(x, axis, overlap_comm):
+    """Dim-0 sum-scatter: monolithic (counted) or the rotating-
+    accumulator ring form under ``overlap_comm``."""
+    from apex_tpu.ops import collective_matmul as _cm
+
+    if _cm.overlap_enabled(overlap_comm):
+        return _cm.ring_reduce_scatter(x, axis, dim=0)
+    from apex_tpu.utils.collectives import psum_scatter
+
+    return psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
 def gather_from_sequence_parallel_region(
-    x, to_model_parallel: bool = True, axis=TP_AXIS
+    x, to_model_parallel: bool = True, axis=TP_AXIS, overlap_comm=None
 ):
     """fwd: all-gather along dim 0. bwd: reduce-scatter when the gathered
     value feeds tensor-parallel compute (reference
     _GatherFromSequenceParallelRegion :223, to_model_parallel flag), else a
-    plain split."""
-    return jax.lax.all_gather(x, axis, axis=0, tiled=True)
+    plain split.  ``overlap_comm`` (tri-state; ``None`` inherits
+    ``overlap_scope``) rides both directions on the ppermute ring."""
+    return _seq_all_gather(x, axis, overlap_comm)
 
 
-def _sp_gather_fwd(x, to_model_parallel, axis):
-    return jax.lax.all_gather(x, axis, axis=0, tiled=True), None
+def _sp_gather_fwd(x, to_model_parallel, axis, overlap_comm):
+    return _seq_all_gather(x, axis, overlap_comm), None
 
 
-def _sp_gather_bwd(to_model_parallel, axis, _, g):
+def _sp_gather_bwd(to_model_parallel, axis, overlap_comm, _, g):
     if to_model_parallel:
-        return (jax.lax.psum_scatter(g, axis, scatter_dimension=0,
-                                     tiled=True),)
+        return (_seq_reduce_scatter(g, axis, overlap_comm),)
     return (_split_along(g, 0, axis),)
 
 
 gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def reduce_scatter_to_sequence_parallel_region(x, axis=TP_AXIS):
-    return jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def reduce_scatter_to_sequence_parallel_region(x, axis=TP_AXIS,
+                                               overlap_comm=None):
+    """fwd: sum-scatter along dim 0; bwd: all-gather.  ``overlap_comm``
+    (tri-state) decomposes both into ppermute ring hops."""
+    return _seq_reduce_scatter(x, axis, overlap_comm)
 
 
-def _sp_rs_fwd(x, axis):
-    return (
-        jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True),
-        None,
-    )
+def _sp_rs_fwd(x, axis, overlap_comm):
+    return _seq_reduce_scatter(x, axis, overlap_comm), None
 
 
-def _sp_rs_bwd(axis, _, g):
-    return (_pvary(jax.lax.all_gather(g, axis, axis=0, tiled=True), axis),)
+def _sp_rs_bwd(axis, overlap_comm, _, g):
+    return (_pvary(_seq_all_gather(g, axis, overlap_comm), axis),)
 
 
 reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)

@@ -30,6 +30,10 @@ __all__ = [
     "match_vma",
     "pvary",
     "vma_of",
+    "all_gather",
+    "all_to_all",
+    "ppermute",
+    "psum_scatter",
 ]
 
 
@@ -151,3 +155,40 @@ def flag_or(flag, axis_name: str):
         _note_collective("pmax", flag)
         return jax.lax.pmax(flag.astype(jnp.int32), axis_name) > 0
     return flag
+
+
+# ---- counted pass-throughs for the non-psum collective family -------------
+# The psum/pmean/pmin/pmax helpers above count themselves; everything the
+# comm/ and ring paths emit (all_gather, all_to_all, ppermute,
+# psum_scatter) was invisible to collectives.* until these wrappers.
+# ``bytes`` counts what THIS rank puts on the wire per emitted collective:
+# the full local operand (trace-time accounting, like _note_collective).
+
+
+def all_gather(x, axis_name: str, *, axis: int = 0, tiled: bool = False):
+    """Counted ``jax.lax.all_gather`` → ``collectives.all_gather.*``."""
+    _note_collective("all_gather", x)
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int, *,
+               tiled: bool = False):
+    """Counted ``jax.lax.all_to_all`` → ``collectives.all_to_all.*``."""
+    _note_collective("all_to_all", x)
+    return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis,
+                              tiled=tiled)
+
+
+def ppermute(x, axis_name: str, perm):
+    """Counted ``jax.lax.ppermute`` → ``collectives.ppermute.*``."""
+    _note_collective("ppermute", x)
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def psum_scatter(x, axis_name: str, *, scatter_dimension: int = 0,
+                 tiled: bool = False):
+    """Counted ``jax.lax.psum_scatter`` → ``collectives.psum_scatter.*``."""
+    _note_collective("psum_scatter", x)
+    return jax.lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_dimension,
+                                tiled=tiled)

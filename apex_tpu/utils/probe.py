@@ -28,7 +28,7 @@ import tempfile
 import time
 from typing import Optional
 
-__all__ = ["probe_jax", "probe_backend_info"]
+__all__ = ["probe_jax", "probe_backend_info", "resolve_timeout"]
 
 # uid-suffixed: /tmp is world-shared, and a fixed name would (a) break
 # the cache for the second user on a host (0600 file, silent open
@@ -38,6 +38,26 @@ _CACHE_PATH = os.path.join(
     f"apex_tpu_probe_cache_{os.getuid() if hasattr(os, 'getuid') else 0}"
     ".json")
 _MISS = object()
+
+
+def resolve_timeout(timeout_s: Optional[int], default: int = 45) -> int:
+    """The probe timeout actually used: ``APEX_TPU_PROBE_TIMEOUT`` (an
+    operator knob — BENCH_r05 lost every row to a hard-coded 45s on a
+    slow-to-answer tunnel) overrides any caller value; else the caller's
+    explicit ``timeout_s``; else ``default``.  Malformed env values warn
+    by name and are ignored."""
+    raw = os.environ.get("APEX_TPU_PROBE_TIMEOUT")
+    if raw:
+        try:
+            val = int(float(raw))
+            if val > 0:
+                return val
+            raise ValueError
+        except ValueError:
+            print(f"[probe] ignoring malformed APEX_TPU_PROBE_TIMEOUT="
+                  f"{raw!r} (want a positive number of seconds)",
+                  flush=True)
+    return default if timeout_s is None else int(timeout_s)
 
 
 def _cache_ttl() -> float:
@@ -92,11 +112,16 @@ def _cache_put(expr: str, val: Optional[str]) -> None:
         pass   # cache is best-effort; the probe result is already known
 
 
-def probe_jax(expr: str, timeout_s: int = 45,
+def probe_jax(expr: str, timeout_s: Optional[int] = None,
               label: str = "jax backend probe",
               validate=None) -> Optional[str]:
     """Evaluate ``expr`` (a Python expression over an imported ``jax``)
     in a subprocess; return its str() result, or None on failure.
+
+    ``timeout_s=None`` resolves to 45s; ``APEX_TPU_PROBE_TIMEOUT``
+    overrides either (see :func:`resolve_timeout`), and the chosen value
+    is printed in the probe log line so a skipped-row post-mortem can
+    see which timeout actually applied.
 
     Failures (timeout, crash) print the child's tail of stderr with the
     ``label`` so a healthy-host misconfiguration does not silently read
@@ -108,12 +133,17 @@ def probe_jax(expr: str, timeout_s: int = 45,
     value failing it is treated as a miss (re-probe, don't trust a
     corrupted cache file); a *fresh* value failing it is treated as a
     probe failure (printed, cached as None)."""
+    timeout_s = resolve_timeout(timeout_s)
     cached = _cache_get(expr, validate)
     if cached is not _MISS:
         print(f"[{label}] using cached probe result "
               f"(APEX_TPU_PROBE_CACHE_TTL={_cache_ttl():g}s): "
               f"{cached!r}", flush=True)
         return cached
+    env_src = (" (from APEX_TPU_PROBE_TIMEOUT)"
+               if os.environ.get("APEX_TPU_PROBE_TIMEOUT") else "")
+    print(f"[{label}] probing backend, timeout {timeout_s}s{env_src}",
+          flush=True)
     code = f"import jax; print('PROBE=' + str({expr}))"
     try:
         out = subprocess.run(
@@ -149,8 +179,11 @@ def _parse_backend_info(val: str):
     return platform, int(count)
 
 
-def probe_backend_info(timeout_s: int = 45, label: str = "backend probe"):
+def probe_backend_info(timeout_s: Optional[int] = None,
+                       label: str = "backend probe"):
     """(platform, device_count) via ONE probed expression, or None.
+    ``timeout_s`` resolves through :func:`resolve_timeout`
+    (``APEX_TPU_PROBE_TIMEOUT`` wins, then the caller value, then 45s).
 
     Both gates (bench.py backend check, dryrun device count) call this
     so a single cached verdict serves the whole driver invocation — two

@@ -676,6 +676,78 @@ def bench_grad_comm(on_tpu, wire_dtypes=("fp32", "bf16", "int8")):
     return rows
 
 
+def bench_tp_overlap(on_tpu):
+    """Off/on ablation for the ring collective-matmul TP overlap
+    (``--tp-overlap``): the GPT geometry trained through
+    ``make_gpt_train_step`` over a (dp, tp) mesh of every visible
+    device, one row per ``overlap_comm`` setting, with the trace-time
+    ``collectives.ring.*`` counters alongside tokens/s.  On a 1-chip
+    window tp=1 makes the ring inapplicable (calls stay 0) — the rows
+    exist so the next multi-chip window can run
+    ``python bench.py --tp-overlap`` and read the crossover directly."""
+    import math
+
+    from apex_tpu.observability import metrics as _telemetry
+    from apex_tpu.parallel.mesh import create_mesh
+
+    ndev = len(jax.devices())
+    if on_tpu:
+        batch, seq, iters = 8, 1024, 10
+        cfg = gpt_125m(max_position_embeddings=seq, remat=False,
+                       scan_layers=False, fused_head_ce=True)
+    else:
+        batch, seq, iters = 2, 128, 2
+        cfg = gpt_125m(num_layers=2, hidden_size=256,
+                       num_attention_heads=4, vocab_size=8192,
+                       max_position_embeddings=seq)
+    # tp must divide the head count; the rest of the devices go to dp
+    tp = math.gcd(ndev, cfg.num_attention_heads)
+    dp = ndev // tp
+    mesh = create_mesh(dp=dp, tp=tp)
+    batch = batch * dp
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+
+    rows = {}
+    for name, overlap in (("off", False), ("on", True)):
+        init, step = make_gpt_train_step(
+            cfg, fused_adam(lr=1e-4), "O2", mesh, overlap_comm=overlap)
+        state = init(jax.random.PRNGKey(0))
+        reg = _telemetry.registry()
+        base = ((reg.counter("collectives.ring.calls").value,
+                 reg.counter("collectives.ring.hops").value,
+                 reg.counter("collectives.ring.bytes").value)
+                if reg is not None else (0, 0, 0))
+
+        def one(carry, step=step, state=state):
+            s = carry[0] if carry else state
+            s, m = step(s, tokens, labels)
+            return s, m["loss"]
+
+        sec = _time_fn(one, iters=iters, name=f"gpt_tp_overlap_{name}")
+        row = {
+            "tokens_per_sec": round(batch * seq / sec, 1),
+            "step_ms": round(sec * 1e3, 2),
+            "tp": tp, "dp": dp,
+        }
+        if reg is not None:
+            row["ring_calls_per_trace"] = int(
+                reg.counter("collectives.ring.calls").value - base[0])
+            row["ring_hops_per_trace"] = int(
+                reg.counter("collectives.ring.hops").value - base[1])
+            row["ring_bytes_per_trace"] = int(
+                reg.counter("collectives.ring.bytes").value - base[2])
+        rows[name] = row
+        del state
+    if "off" in rows and "on" in rows and rows["off"]["step_ms"]:
+        rows["on_over_off"] = round(
+            rows["on"]["step_ms"] / rows["off"]["step_ms"], 3)
+    return rows
+
+
 # the inference rows, shared by the full matrix and --decode so the two
 # run modes can never report differently-configured rows under one name
 _DECODE_ROWS = (
@@ -684,8 +756,9 @@ _DECODE_ROWS = (
 )
 
 
-def _probe_backend(timeout_s: int = 45):
-    """Initialize the JAX backend with a hard timeout.
+def _probe_backend(timeout_s=None):
+    """Initialize the JAX backend with a hard timeout (45s default;
+    ``APEX_TPU_PROBE_TIMEOUT`` overrides — see utils/probe.py).
 
     A tunnel outage must not read as a broken repo (VERDICT r3 #2): if the
     backend raises *or hangs*, return None so main() can emit a parseable
@@ -726,6 +799,11 @@ def main():
              "ONLY the compressed-collective ablation rows "
              "(bench_grad_comm) instead of the full matrix")
     parser.add_argument(
+        "--tp-overlap", action="store_true",
+        help="run ONLY the ring collective-matmul TP-overlap ablation "
+             "rows (bench_tp_overlap, overlap_comm off vs on) instead "
+             "of the full matrix")
+    parser.add_argument(
         "--decode", action="store_true",
         help="run ONLY the inference rows (prefill/decode split + GQA "
              "variant + the continuous-batching serving mixes) instead "
@@ -757,6 +835,17 @@ def main():
             "schema_version": SCHEMA_VERSION,
             "metric": "gpt_ddp_grad_comm_ablation",
             "value": rows.get(wires[0], {}).get("tokens_per_sec", 0.0),
+            "unit": "tokens/s",
+            "details": rows,
+            "runtime": runtime_summary(),
+        }))
+        return
+    if args.tp_overlap:
+        rows = bench_tp_overlap(on_tpu)
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "metric": "gpt_tp_overlap_ablation",
+            "value": rows.get("off", {}).get("tokens_per_sec", 0.0),
             "unit": "tokens/s",
             "details": rows,
             "runtime": runtime_summary(),

@@ -49,6 +49,8 @@ MODULES = [
     ("apex_tpu.ops.rope", "ops", "ops.rope — rotary embeddings"),
     ("apex_tpu.ops.dense", "ops", "ops.dense — fused dense epilogues"),
     ("apex_tpu.ops.flat_adam", "ops", "ops.flat_adam — flat Adam"),
+    ("apex_tpu.ops.collective_matmul", "ops",
+     "ops.collective_matmul — overlapped ring TP collectives"),
     # comm
     ("apex_tpu.comm", "comm",
      "apex_tpu.comm — compressed gradient collectives"),

@@ -203,6 +203,44 @@ class TestProbeJax:
         probe._cache_put(expr, "cpu:1")
         assert probe._cache_get(expr) == "cpu:1"
 
+    def test_resolve_timeout_env_override(self, monkeypatch, capsys):
+        """ISSUE 5 satellite: APEX_TPU_PROBE_TIMEOUT is the operator
+        knob for slow-to-answer tunnels (BENCH_r05 lost every row to
+        the hard-coded 45s) — it beats caller values, malformed values
+        warn by name and fall through."""
+        from apex_tpu.utils.probe import resolve_timeout
+
+        monkeypatch.delenv("APEX_TPU_PROBE_TIMEOUT", raising=False)
+        assert resolve_timeout(None) == 45            # default
+        assert resolve_timeout(None, default=60) == 60
+        assert resolve_timeout(90) == 90              # caller value
+        monkeypatch.setenv("APEX_TPU_PROBE_TIMEOUT", "120")
+        assert resolve_timeout(None) == 120
+        assert resolve_timeout(30) == 120             # env beats caller
+        monkeypatch.setenv("APEX_TPU_PROBE_TIMEOUT", "12.9")
+        assert resolve_timeout(None) == 12            # float accepted
+        for bad in ("abc", "-5", "0", ""):
+            monkeypatch.setenv("APEX_TPU_PROBE_TIMEOUT", bad)
+            capsys.readouterr()
+            assert resolve_timeout(33) == 33, bad
+            out = capsys.readouterr().out
+            if bad:   # empty string is falsy — silently ignored
+                assert "APEX_TPU_PROBE_TIMEOUT" in out, bad
+
+    def test_probe_log_line_names_timeout(self, monkeypatch, capsys):
+        """The chosen timeout (and its env provenance) lands in the
+        probe log line so a skipped-row post-mortem can see which
+        timeout actually applied."""
+        import apex_tpu.utils.probe as probe
+
+        monkeypatch.delenv("PYTHONPATH", raising=False)
+        monkeypatch.setenv("APEX_TPU_PROBE_CACHE_TTL", "0")
+        monkeypatch.setenv("APEX_TPU_PROBE_TIMEOUT", "77")
+        assert probe.probe_jax("1 + 1", label="timeout probe") == "2"
+        out = capsys.readouterr().out
+        assert "timeout 77s" in out
+        assert "(from APEX_TPU_PROBE_TIMEOUT)" in out
+
     def test_probe_backend_info_fresh_malformed_result(self, monkeypatch,
                                                        capsys):
         """A FRESH probe answer that does not parse degrades to None

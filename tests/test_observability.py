@@ -414,6 +414,57 @@ class TestCollectivesTelemetry:
         assert bool(np.asarray(out).all())
         assert reg.counter("collectives.pmax.calls").value >= 1
 
+    def test_counted_nonpsum_family(self):
+        """all_gather / ppermute / all_to_all / psum_scatter were
+        invisible to collectives.* until the counted wrappers — the
+        comm/ and ring paths route through these."""
+        from apex_tpu.utils import collectives as coll
+
+        reg = obs.configure()
+        n = jax.local_device_count()
+        x = jnp.arange(float(n * 4)).reshape(n, 4)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def f(v):
+            g = coll.all_gather(v, "dp", axis=0, tiled=True)
+            p = coll.ppermute(v, "dp", perm)
+            s = coll.psum_scatter(g, "dp", scatter_dimension=0,
+                                  tiled=True)
+            a = coll.all_to_all(g.reshape(n, -1), "dp", 0, 0, tiled=True)
+            return g.sum() + p.sum() + s.sum() + a.sum()
+
+        jax.pmap(f, axis_name="dp")(x)
+        for kind, nbytes in (("all_gather", 4 * 4),
+                             ("ppermute", 4 * 4),
+                             ("psum_scatter", n * 4 * 4),
+                             ("all_to_all", n * 4 * 4)):
+            assert reg.counter(f"collectives.{kind}.calls").value >= 1, kind
+            assert reg.counter(f"collectives.{kind}.bytes").value >= nbytes, \
+                kind
+
+    def test_ring_counters_and_hop_invariant(self):
+        """collectives.ring.*: each ring loop books one call and exactly
+        n−1 hops (the dryrun tp_overlap acceptance invariant)."""
+        import functools
+
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from apex_tpu.ops import collective_matmul as cm
+
+        reg = obs.configure()
+        n = jax.local_device_count()
+        mesh = Mesh(np.asarray(jax.devices()), ("tp",))
+        c0 = reg.counter("collectives.ring.calls").value
+        h0 = reg.counter("collectives.ring.hops").value
+        jax.shard_map(
+            functools.partial(cm.ring_all_gather, axis_name="tp"),
+            mesh=mesh, in_specs=P("tp"), out_specs=P())(
+                jnp.arange(float(n * 2)).reshape(n * 2, 1))
+        calls = reg.counter("collectives.ring.calls").value - c0
+        hops = reg.counter("collectives.ring.hops").value - h0
+        assert calls == 1 and hops == n - 1
+        assert reg.counter("collectives.ring.bytes").value > 0
+
 
 class TestPipelineTelemetry:
     def test_schedule_bubble_accounting(self):

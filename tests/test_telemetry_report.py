@@ -129,6 +129,35 @@ def test_since_step_filters_stamped_records(report, tmp_path):
     assert report.filter_since_step(records, None) is records
 
 
+def test_ring_summary_derives_tp(report, tmp_path):
+    """ISSUE 5 satellite: collectives.ring.* get a derived view — the
+    per-call hop count implies the ring (tp) size, since every ring
+    loop books exactly tp−1 hops."""
+    f = tmp_path / "ring.jsonl"
+    f.write_text(
+        '{"schema_version":2,"t":1,"type":"counter",'
+        '"name":"collectives.ring.calls","value":6}\n'
+        '{"schema_version":2,"t":2,"type":"counter",'
+        '"name":"collectives.ring.hops","value":42}\n'
+        '{"schema_version":2,"t":3,"type":"counter",'
+        '"name":"collectives.ring.bytes","value":4096}\n')
+    summ = report.summarize(report.load_records([str(f)]))
+    ring = report.ring_summary(summ["counters"])
+    assert ring["calls"] == 6 and ring["hops"] == 42
+    assert ring["hops_per_call"] == 7 and ring["tp"] == 8
+    out = io.StringIO()
+    report.print_report(summ, out=out)
+    text = out.getvalue()
+    assert "ring collectives" in text
+    assert "ring size (tp) 8" in text
+    # no ring calls -> no derived section, not a crash
+    assert report.ring_summary({"collectives.psum.calls": 3}) is None
+    # mixed-tp streams: non-integral hops/call is flagged, not rounded
+    mixed = report.ring_summary({"collectives.ring.calls": 2.0,
+                                 "collectives.ring.hops": 3.0})
+    assert mixed["tp"] is None
+
+
 def test_since_step_cli_flag(report, tmp_path, capsys):
     f = tmp_path / "steps.jsonl"
     f.write_text(

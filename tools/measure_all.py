@@ -15,7 +15,10 @@ ended — costs the least-valuable stages:
 1. ``bench.py`` — the BASELINE.md workload matrix (GPT/RN50/BERT/RNN-T/
    MoE/decode/long-context/cp-compare rows), one JSON line; then
    ``bench.py --decode`` — the inference fast path rows (prefill/decode
-   split + continuous-batching serving mixes) as their own JSON line.
+   split + continuous-batching serving mixes) as their own JSON line;
+   then ``bench.py --tp-overlap`` — the ring collective-matmul off/on
+   ablation rows — and the ``tp_overlap`` dryrun parity phase
+   (overlapped == monolithic fwd+bwd on the 8-virtual-device mesh).
 2. ``APEX_TPU_TEST_ON_TPU=1 pytest tests/test_on_tpu_kernels.py -m tpu``
    — the Mosaic-compile hardware tests (interpret-green != Mosaic-
    green; now covers the round-5 default fused flash bwd + LN bwd).
@@ -137,6 +140,18 @@ def main():
     results["bench_decode"] = _run(
         "bench_decode", [sys.executable, "bench.py", "--decode"],
         timeout=1800)
+    # TP comm overlap (ISSUE 5): the ring collective-matmul off/on
+    # ablation rows, then the tp_overlap dryrun parity phase alone on
+    # the 8-virtual-device mesh (overlapped == monolithic fwd+bwd and
+    # the hops == (tp-1) x calls telemetry invariant)
+    results["bench_tp_overlap"] = _run(
+        "bench_tp_overlap",
+        [sys.executable, "bench.py", "--tp-overlap"], timeout=1800)
+    results["dryrun_tp_overlap"] = _run(
+        "dryrun_tp_overlap",
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        env_extra={"APEX_TPU_DRYRUN_PHASE": "tp_overlap"}, timeout=1800)
     results["tpu_tier"] = _run(
         "tpu_tier", [sys.executable, "-m", "pytest",
                      "tests/test_on_tpu_kernels.py", "-m", "tpu", "-q"],

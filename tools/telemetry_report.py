@@ -139,6 +139,27 @@ def summarize(records: List[dict]) -> dict:
     }
 
 
+def ring_summary(counters: Dict[str, float]) -> Optional[dict]:
+    """Derived view of the ``collectives.ring.*`` counters (the
+    overlapped TP collective-matmul paths): per-call hop count and the
+    implied ring size, since each ring loop books exactly tp−1 hops —
+    ``hops == (tp−1) × calls`` on a fixed-tp program.  None when the
+    stream carries no ring calls."""
+    calls = counters.get("collectives.ring.calls", 0.0)
+    if not calls:
+        return None
+    hops = counters.get("collectives.ring.hops", 0.0)
+    per_call = hops / calls
+    integral = abs(per_call - round(per_call)) < 1e-9
+    return {
+        "calls": calls,
+        "hops": hops,
+        "bytes": counters.get("collectives.ring.bytes", 0.0),
+        "hops_per_call": per_call,
+        "tp": int(round(per_call)) + 1 if integral else None,
+    }
+
+
 def print_report(summary: dict, out=None) -> None:
     out = sys.stdout if out is None else out
     if summary["unknown_schema"]:
@@ -165,6 +186,19 @@ def print_report(summary: dict, out=None) -> None:
         print(f"{'name':<44} {'total':>13}", file=out)
         for name in sorted(counters):
             print(f"{name:<44} {counters[name]:>13g}", file=out)
+    ring = ring_summary(counters) if counters else None
+    if ring:
+        print("== ring collectives (collectives.ring.*) ==", file=out)
+        print(f"  calls {ring['calls']:g}  hops {ring['hops']:g}  "
+              f"bytes {ring['bytes']:g}", file=out)
+        if ring["tp"] is not None:
+            print(f"  hops/call {ring['hops_per_call']:g} -> ring size "
+                  f"(tp) {ring['tp']}", file=out)
+        else:
+            print(f"  hops/call {ring['hops_per_call']:.3g} — NOT an "
+                  "integer: the stream mixes ring sizes (several tp "
+                  "geometries in one run), per-call invariant still "
+                  "hops == (tp-1) x calls within each", file=out)
     gauges = summary["gauges"]
     if gauges:
         print("== gauges ==", file=out)
