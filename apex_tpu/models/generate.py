@@ -22,6 +22,15 @@ the model family.  TPU-native design (ISSUE 3):
 - static shapes throughout — the cache is pre-allocated at ``max_len``
   and masked by position, the one compiled decode body serves every
   step;
+- **two cache layouts** (ISSUE 6) — ``cache_layout="contiguous"`` is
+  the original per-sequence ``[b, max_len]`` stripe;
+  ``cache_layout="paged"`` stores K/V in a global pool of fixed-size
+  blocks addressed through per-sequence block tables
+  (``serving/paged_cache.py``), with decode attention running the
+  fused ragged-paged kernel (``ops/paged_attention.py``).  Both
+  layouts decode token-identically (tests/test_generate_paged.py);
+  the paged one is what lets the serving engine commit HBM per
+  allocated block instead of per ``max_slots × max_len``;
 - parameters are the exact training pytree (init_gpt_params /
   tools/import_hf.py), so a trained or imported model generates without
   conversion; numerics follow transformer_lm.py layer-for-layer
@@ -51,9 +60,27 @@ __all__ = ["init_kv_cache", "decode_step", "prefill", "generate",
            "sample_logits"]
 
 
+DEFAULT_BLOCK_SIZE = 16
+
+
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
-                  cache_dtype=None):
-    """[L, b, max_len, kv_groups, dh] k/v buffers + ``[b]`` positions.
+                  cache_dtype=None, *, cache_layout: str = "contiguous",
+                  block_size: int = DEFAULT_BLOCK_SIZE):
+    """KV cache for ``batch`` sequences of up to ``max_len`` tokens.
+
+    ``cache_layout="contiguous"`` (default): ``[L, b, max_len,
+    kv_groups, dh]`` k/v buffers + ``[b]`` positions — every sequence
+    owns a max-length stripe.
+
+    ``cache_layout="paged"``: a global block pool ``[L, num_blocks,
+    block_size, kv_groups, dh]`` plus per-sequence ``block_tables``
+    ``[b, ceil(max_len/block_size)]``.  Here the tables are filled
+    linearly (sequence ``i`` owns blocks ``[i·mb, (i+1)·mb)``) — the
+    static one-shot form :func:`generate` uses; the serving engine
+    allocates tables dynamically through
+    :class:`~apex_tpu.serving.paged_cache.BlockManager` instead, which
+    is where the pool layout actually pays (HBM per allocated block,
+    prefix sharing, preemption).
 
     Under GQA the cache holds only the group heads — the persistent
     per-token memory shrinks by num_attention_heads/num_query_groups
@@ -71,12 +98,27 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
     dt = cfg.compute_dtype if cache_dtype is None else cache_dtype
     nh = cfg.kv_groups
     dh = cfg.kv_channels
-    shape = (cfg.num_layers, batch, max_len, nh, dh)
-    return {
-        "k": jnp.zeros(shape, dt),
-        "v": jnp.zeros(shape, dt),
-        "pos": jnp.zeros((batch,), jnp.int32),
-    }
+    if cache_layout == "contiguous":
+        shape = (cfg.num_layers, batch, max_len, nh, dh)
+        return {
+            "k": jnp.zeros(shape, dt),
+            "v": jnp.zeros(shape, dt),
+            "pos": jnp.zeros((batch,), jnp.int32),
+        }
+    if cache_layout != "paged":
+        raise ValueError(
+            f"cache_layout={cache_layout!r}: expected 'contiguous' or "
+            "'paged'")
+    from apex_tpu.serving.paged_cache import blocks_for, init_paged_pool
+
+    mb = blocks_for(max_len, block_size)
+    pool = init_paged_pool(cfg, batch * mb, block_size,
+                           cache_dtype=cache_dtype)
+    tables = (jnp.arange(batch, dtype=jnp.int32)[:, None] * mb
+              + jnp.arange(mb, dtype=jnp.int32)[None])
+    pool["pos"] = jnp.zeros((batch,), jnp.int32)
+    pool["block_tables"] = tables
+    return pool
 
 
 def _check_sampling_args(temperature: float,
@@ -105,24 +147,28 @@ def _check_decode_cfg(cfg: TransformerConfig) -> None:
             "decode with the wrong mask")
 
 
-def _vector_pos(cache: dict, batch: int) -> jax.Array:
-    """Normalize the cache position to the ``[b]`` vector form (legacy
-    scalar-counter caches broadcast — every sequence at the same
-    offset)."""
+def _vector_pos(cache: dict) -> jax.Array:
+    """The ``[b]`` int32 cache position.  The pre-PR-3 scalar-counter
+    broadcast form is gone — everything in-tree has written vector
+    positions since the ragged-decode rework, so a scalar here is a
+    stale caller bug, not a layout to silently paper over."""
     pos = cache["pos"]
-    if pos.ndim == 0:
-        return jnp.full((batch,), pos, jnp.int32)
+    if pos.ndim != 1:
+        raise ValueError(
+            f"cache['pos'] must be a [b] int32 vector, got shape "
+            f"{pos.shape}; the legacy scalar-counter broadcast path "
+            "was removed (PR 6) — build caches with init_kv_cache")
     return pos.astype(jnp.int32)
 
 
-def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
-    """One layer, one token: x [b, 1, h] + cache slice [b, T, nh, dh];
-    ``pos`` [b] int32 — each sequence writes and attends at its own
-    offset."""
+def _decode_qkv(cfg, lp, x, pos, rope):
+    """Shared one-token pre-attention math (norm → qkv projection →
+    GQA split → per-sequence rotary): the contiguous and paged layer
+    bodies differ only in where K/V land and how the cache is read, so
+    this is ONE implementation of everything before that fork."""
     b = x.shape[0]
     nh = cfg.num_attention_heads
     dh = cfg.kv_channels
-
     h = apply_norm(cfg, x, lp["ln1_scale"], lp["ln1_bias"])
     qkv = h @ lp["qkv_kernel"].astype(x.dtype) + lp["qkv_bias"].astype(
         x.dtype)
@@ -138,6 +184,32 @@ def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
 
         q = fused_apply_rotary_pos_emb_ragged(q, cos, sin, pos)
         k = fused_apply_rotary_pos_emb_ragged(k, cos, sin, pos)
+    return h, q, k, v
+
+
+def _decode_out(cfg, lp, x, h, ctx_flat):
+    """Shared one-token post-attention math (output projection →
+    residual → MLP); ``ctx_flat`` [b, 1, nh*dh]."""
+    a = ctx_flat @ lp["proj_kernel"].astype(x.dtype)
+    a = a + lp["proj_bias"].astype(x.dtype)
+    res = h if cfg.apply_residual_connection_post_layernorm else x
+    x = res + a
+    h = apply_norm(cfg, x, lp["ln2_scale"], lp["ln2_bias"])
+    from apex_tpu.models.transformer_lm import _mlp, single_device_ctx
+
+    m = _mlp(cfg, lp, h, single_device_ctx())
+    res = h if cfg.apply_residual_connection_post_layernorm else x
+    return res + m
+
+
+def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
+    """One layer, one token, contiguous layout: x [b, 1, h] + cache
+    slice [b, T, nh, dh]; ``pos`` [b] int32 — each sequence writes and
+    attends at its own offset."""
+    b = x.shape[0]
+    nh = cfg.num_attention_heads
+    dh = cfg.kv_channels
+    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope)
 
     # per-sequence scatter: row (i, pos[i]) only — O(b·nh·dh) written
     # per step, not a full-buffer select; out-of-bounds positions
@@ -163,28 +235,59 @@ def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
     ctxv = jnp.einsum("bgrqt,btgd->bqgrd", p.astype(cache_v.dtype),
                       cache_v,
                       preferred_element_type=jnp.float32).astype(x.dtype)
-    a = ctxv.reshape(b, 1, nh * dh) @ lp["proj_kernel"].astype(x.dtype)
-    a = a + lp["proj_bias"].astype(x.dtype)
+    x = _decode_out(cfg, lp, x, h, ctxv.reshape(b, 1, nh * dh))
+    return x, cache_k, cache_v
 
-    res = h if cfg.apply_residual_connection_post_layernorm else x
-    x = res + a
-    h = apply_norm(cfg, x, lp["ln2_scale"], lp["ln2_bias"])
-    from apex_tpu.models.transformer_lm import _mlp, single_device_ctx
 
-    m = _mlp(cfg, lp, h, single_device_ctx())
-    res = h if cfg.apply_residual_connection_post_layernorm else x
-    return res + m, cache_k, cache_v
+def _layer_decode_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope):
+    """One layer, one token, paged layout: x [b, 1, h] + this layer's
+    block pool [num_blocks, block_size, g, dh] + ``tables``
+    [b, max_blocks].  The new K/V append to each sequence's tail block
+    (one-cell scatter through the table); attention runs the fused
+    ragged-paged kernel over the block list — the gathered cache never
+    materializes."""
+    from apex_tpu.ops.paged_attention import ragged_paged_attention
+
+    b = x.shape[0]
+    nh = cfg.num_attention_heads
+    dh = cfg.kv_channels
+    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope)
+
+    nb, bs = cache_k.shape[0], cache_k.shape[1]
+    mb = tables.shape[1]
+    # tail-block append: cell (tables[i, pos//bs], pos % bs).  Unmapped
+    # table entries (>= nb: released serving lanes, short tables) and
+    # positions past the table's reach drop — a lane can never write
+    # into a block it does not own.
+    blk = jnp.take_along_axis(
+        tables, jnp.minimum(pos // bs, mb - 1)[:, None], axis=1)[:, 0]
+    blk = jnp.where(pos < mb * bs, blk, nb)
+    off = pos % bs
+    cache_k = cache_k.at[blk, off].set(
+        k[:, 0].astype(cache_k.dtype), mode="drop")
+    cache_v = cache_v.at[blk, off].set(
+        v[:, 0].astype(cache_v.dtype), mode="drop")
+
+    ctx = ragged_paged_attention(q[:, 0], cache_k, cache_v, tables,
+                                 pos + 1)
+    x = _decode_out(cfg, lp, x, h,
+                    ctx.astype(x.dtype).reshape(b, 1, nh * dh))
+    return x, cache_k, cache_v
 
 
 def decode_step(params: dict, token: jax.Array, cache: dict,
                 cfg: TransformerConfig):
     """One decoding step: token [b] int32 at per-sequence position
-    ``cache['pos']`` ([b] int32; a legacy scalar broadcasts) →
-    (logits [b, v], updated cache)."""
+    ``cache['pos']`` ([b] int32) → (logits [b, v], updated cache).
+
+    The cache dict selects the layout: a ``block_tables`` entry means
+    paged (pool ``[L, num_blocks, block_size, g, dh]``, tail-block
+    append + the fused ragged-paged attention kernel); otherwise the
+    contiguous ``[L, b, max_len, g, dh]`` stripe layout."""
     _check_decode_cfg(cfg)
     cd = cfg.compute_dtype
-    b = token.shape[0]
-    pos = _vector_pos(cache, b)
+    paged = "block_tables" in cache
+    pos = _vector_pos(cache)
     x = jnp.take(params["embedding"]["word"].astype(cd), token,
                  axis=0)[:, None]
     if cfg.position_embedding_type == "learned":
@@ -192,14 +295,27 @@ def decode_step(params: dict, token: jax.Array, cache: dict,
         x = x + pe.astype(cd)[:, None]
     rope = None
     if cfg.position_embedding_type == "rope":
-        rope = rope_cos_sin(cache["k"].shape[2], cfg.kv_channels)
+        if paged:
+            max_pos = cache["block_tables"].shape[1] * cache["k"].shape[2]
+        else:
+            max_pos = cache["k"].shape[2]
+        rope = rope_cos_sin(max_pos, cfg.kv_channels)
 
     # one compiled layer body scanned over the stacked layer params
     # (transformer_backbone's shape — compile time constant in depth)
-    def body(x, layer_in):
-        lp, ck, cv = layer_in
-        x, ck, cv = _layer_decode(cfg, lp, x, ck, cv, pos, rope)
-        return x, (ck, cv)
+    if paged:
+        tables = cache["block_tables"].astype(jnp.int32)
+
+        def body(x, layer_in):
+            lp, ck, cv = layer_in
+            x, ck, cv = _layer_decode_paged(cfg, lp, x, ck, cv, tables,
+                                            pos, rope)
+            return x, (ck, cv)
+    else:
+        def body(x, layer_in):
+            lp, ck, cv = layer_in
+            x, ck, cv = _layer_decode(cfg, lp, x, ck, cv, pos, rope)
+            return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"]))
@@ -210,6 +326,8 @@ def decode_step(params: dict, token: jax.Array, cache: dict,
         "bsh,vh->bsv", x, lm_head_weight(params, cfg).astype(cd),
         preferred_element_type=jnp.float32)[:, 0]
     cache = {"k": new_k, "v": new_v, "pos": pos + 1}
+    if paged:
+        cache["block_tables"] = tables
     return logits, cache
 
 
@@ -265,17 +383,24 @@ def prefill(
 
     ``cache``: fill an existing cache (e.g. a serving slot buffer of
     ``max_len`` > s); otherwise one is allocated at ``max_len``
-    (default ``s``) with ``cache_dtype``.
+    (default ``s``) with ``cache_dtype``.  A PAGED cache (built by
+    ``init_kv_cache(..., cache_layout="paged")`` or the serving
+    engine's block manager) is recognized by its ``block_tables``
+    entry: prefill then writes whole pages — every position scatters
+    through the table in one update, padding and unmapped pages
+    dropping — and returns the same paged dict.
     """
     _check_decode_cfg(cfg)
     b, s = prompt.shape
     if cache is None:
         cache = init_kv_cache(cfg, b, max_len if max_len else s,
                               cache_dtype=cache_dtype)
-    if s > cache["k"].shape[2]:
+    paged = "block_tables" in cache
+    cache_len = (cache["block_tables"].shape[1] * cache["k"].shape[2]
+                 if paged else cache["k"].shape[2])
+    if s > cache_len:
         raise ValueError(
-            f"prompt length {s} exceeds the cache max_len "
-            f"{cache['k'].shape[2]}")
+            f"prompt length {s} exceeds the cache max_len {cache_len}")
     cd = cfg.compute_dtype
     lens = (jnp.full((b,), s, jnp.int32) if prompt_lens is None
             else prompt_lens.astype(jnp.int32))
@@ -307,6 +432,27 @@ def prefill(
     logits = jnp.einsum(
         "bh,vh->bv", x_last, lm_head_weight(params, cfg).astype(cd),
         preferred_element_type=jnp.float32)
+    if paged:
+        # whole-page scatter through the block tables: position t of
+        # row i lands in cell (tables[i, t//bs], t % bs).  Row padding
+        # (t >= lens[i]) and unmapped table entries drop, so a ragged
+        # prefill can never write into blocks the row does not own.
+        tables = cache["block_tables"].astype(jnp.int32)
+        nb, bs = cache["k"].shape[1], cache["k"].shape[2]
+        mb = tables.shape[1]
+        t = jnp.arange(s)
+        blk = jnp.take_along_axis(
+            tables, jnp.broadcast_to(
+                jnp.minimum(t // bs, mb - 1)[None], (b, s)), axis=1)
+        blk = jnp.where(t[None] < lens[:, None], blk, nb)
+        off = jnp.broadcast_to(t % bs, (b, s))
+        cache = {
+            "k": cache["k"].at[:, blk, off].set(ks, mode="drop"),
+            "v": cache["v"].at[:, blk, off].set(vs, mode="drop"),
+            "pos": lens,
+            "block_tables": tables,
+        }
+        return logits, cache
     cache = {
         "k": jax.lax.dynamic_update_slice_in_dim(
             cache["k"], ks, 0, axis=2),
@@ -374,14 +520,18 @@ def sample_logits(logits, key, *, temperature: float = 0.0,
 
 @functools.partial(jax.jit, static_argnames=(
     "cfg", "max_new_tokens", "temperature", "top_k", "top_p",
-    "vocab_limit", "eos_token_id", "cache_dtype"))
+    "vocab_limit", "eos_token_id", "cache_dtype", "cache_layout",
+    "block_size"))
 def _generate_impl(params, prompt, prompt_lens, rng, *, cfg,
                    max_new_tokens, temperature, top_k, top_p,
-                   vocab_limit, eos_token_id, cache_dtype):
+                   vocab_limit, eos_token_id, cache_dtype,
+                   cache_layout, block_size):
     """Prefill + while-loop decode; returns (tokens, realized steps)."""
     b, s = prompt.shape
     total = s + max_new_tokens
-    cache = init_kv_cache(cfg, b, total, cache_dtype=cache_dtype)
+    cache = init_kv_cache(cfg, b, total, cache_dtype=cache_dtype,
+                          cache_layout=cache_layout,
+                          block_size=block_size)
     lens = (jnp.full((b,), s, jnp.int32) if prompt_lens is None
             else prompt_lens.astype(jnp.int32))
     logits, cache = prefill(params, prompt, cfg,
@@ -450,9 +600,18 @@ def generate(
     prompt_lens: Optional[jax.Array] = None,
     eos_token_id: Optional[int] = None,
     cache_dtype=None,
+    cache_layout: str = "contiguous",
+    block_size: int = DEFAULT_BLOCK_SIZE,
 ) -> jax.Array:
     """Decode up to ``max_new_tokens`` past ``prompt`` [b, s] →
     [b, s+max_new_tokens].
+
+    ``cache_layout="paged"`` runs the same prefill + while-loop decode
+    over the block-pool cache (``block_size`` tokens per block, tables
+    filled linearly) and the fused ragged-paged attention kernel —
+    greedy output is token-identical to the contiguous layout
+    (tests/test_generate_paged.py pins it); the layout exists for the
+    serving engine, where blocks are allocated dynamically.
 
     The prompt is consumed by ONE batched :func:`prefill` forward
     (flash attention, whole KV cache written in one pass); decoding is
@@ -496,11 +655,16 @@ def generate(
         rng = jax.random.PRNGKey(0)
     if prompt_lens is not None:
         prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+    if cache_layout not in ("contiguous", "paged"):
+        raise ValueError(
+            f"cache_layout={cache_layout!r}: expected 'contiguous' or "
+            "'paged'")
     tokens, n_steps = _generate_impl(
         params, prompt, prompt_lens, rng, cfg=cfg,
         max_new_tokens=max_new_tokens, temperature=temperature,
         top_k=top_k, top_p=top_p, vocab_limit=vocab_limit,
-        eos_token_id=eos_token_id, cache_dtype=cache_dtype)
+        eos_token_id=eos_token_id, cache_dtype=cache_dtype,
+        cache_layout=cache_layout, block_size=block_size)
     if _telemetry.enabled():
         # host-side counters (the jitted loop cannot emit); reading the
         # realized trip count syncs — acceptable when telemetry is on

@@ -28,6 +28,10 @@ from apex_tpu.ops.rope import (  # noqa: F401
     fused_apply_rotary_pos_emb_ragged,
     fused_apply_rotary_pos_emb_thd,
 )
+from apex_tpu.ops.paged_attention import (  # noqa: F401
+    paged_attention_reference,
+    ragged_paged_attention,
+)
 from apex_tpu.ops.softmax import (  # noqa: F401
     generic_scaled_masked_softmax,
     scaled_masked_softmax,

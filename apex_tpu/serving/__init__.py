@@ -1,4 +1,4 @@
-"""apex_tpu.serving — slot-based continuous-batching inference engine.
+"""apex_tpu.serving — continuous-batching inference engine.
 
 The training half of the repo scales by sharding one step over many
 chips; the serving half scales by keeping ONE chip's decode batch full.
@@ -8,20 +8,31 @@ This package turns the three ``models/generate.py`` primitives
 :func:`~apex_tpu.models.generate.sample_logits`) into a request-level
 engine:
 
-- :class:`~apex_tpu.serving.engine.ServingEngine` — a fixed pool of KV
-  cache *slots*; new requests are admitted into freed slots mid-flight
-  (continuous batching, the vLLM/Orca scheduling idea specialized to
-  static TPU shapes), each prompt prefilled in one flash forward and
-  all live slots advanced by one token per batched decode step;
+- :class:`~apex_tpu.serving.engine.ServingEngine` — a fixed pool of
+  decode *lanes*; new requests are admitted mid-flight (continuous
+  batching, the vLLM/Orca scheduling idea specialized to static TPU
+  shapes), each prompt prefilled in one flash forward and all live
+  lanes advanced by one token per batched decode step.  KV storage is
+  either one contiguous ``max_len`` stripe per slot
+  (``cache_layout="contiguous"``) or the paged block pool
+  (``cache_layout="paged"`` — block-budget admission, prefix sharing,
+  preempt/resume; ISSUE 6);
+- :mod:`~apex_tpu.serving.paged_cache` — the block pool:
+  :class:`~apex_tpu.serving.paged_cache.BlockManager` (free list,
+  refcounts, chained prefix hashes for copy-on-write sharing) plus the
+  jitted whole-page prefill scatter; the fused decode read is
+  ``ops/paged_attention.py``;
 - :mod:`~apex_tpu.serving.batching` — the bucketed prompt-length
   compile cache (prefill recompiles per *bucket*, O(log max_len)
   shapes, never per request) and slot bookkeeping;
 - observability — ``serving.{prefill_ms, decode_tokens_per_sec,
-  slot_occupancy, queue_depth}`` through the existing metrics registry
+  slot_occupancy, queue_depth, blocks_in_use, blocks_free,
+  prefix_shared_blocks}`` gauges and the ``serving.preemptions``
+  counter through the existing metrics registry
   (docs/observability.md), plus ``serving.prefill`` spans.
 
 See docs/inference.md for the engine lifecycle and bench.py
-``--decode`` for the measured prefill-heavy / decode-heavy mixes.
+``--decode --cache-layout contiguous,paged`` for the measured mixes.
 """
 
 from apex_tpu.serving.batching import (  # noqa: F401
@@ -35,13 +46,25 @@ from apex_tpu.serving.engine import (  # noqa: F401
     Response,
     ServingEngine,
 )
+from apex_tpu.serving.paged_cache import (  # noqa: F401
+    BlockManager,
+    blocks_for,
+    init_paged_pool,
+    paged_insert_prefill,
+    prefix_block_hashes,
+)
 
 __all__ = [
+    "BlockManager",
     "Request",
     "Response",
     "ServingEngine",
     "SlotPool",
+    "blocks_for",
     "default_buckets",
+    "init_paged_pool",
     "pad_prompt",
+    "paged_insert_prefill",
     "pick_bucket",
+    "prefix_block_hashes",
 ]

@@ -90,6 +90,12 @@ class SlotPool:
         self._free.append(slot)
         self._free.sort(reverse=True)
 
+    def is_active(self, slot: int) -> bool:
+        """O(1) membership — failure-path unwind code checks this on
+        every exception; don't make it build the sorted ``active``
+        tuple."""
+        return slot in self._active
+
     @property
     def active(self) -> Tuple[int, ...]:
         return tuple(sorted(self._active))

@@ -1,8 +1,9 @@
-"""The slot-based continuous-batching serving engine.
+"""The continuous-batching serving engine (slot or paged KV layout).
 
 Lifecycle (docs/inference.md has the full walkthrough)::
 
-    engine = ServingEngine(params, cfg, max_slots=8, max_len=1024)
+    engine = ServingEngine(params, cfg, max_slots=8, max_len=1024,
+                           cache_layout="paged")
     rid = engine.submit([1, 2, 3], max_new_tokens=32, eos_token_id=50256)
     while True:
         for resp in engine.step():       # 0+ completed Responses
@@ -13,38 +14,65 @@ Lifecycle (docs/inference.md has the full walkthrough)::
 
 Each :meth:`ServingEngine.step`:
 
-1. **admit** — while a cache slot is free and the queue is non-empty,
-   pop a request, pad its prompt to the smallest compile bucket, run
-   ONE batched flash :func:`~apex_tpu.models.generate.prefill` into a
-   bucket-sized cache, scatter that into the slot's row of the big
-   cache, and sample the first token from the prefill logits.  A
-   request can therefore enter the batch *mid-flight*, the moment an
-   earlier one frees its slot — the continuous-batching property that
-   keeps decode utilization flat under mixed-length traffic.
+1. **admit** — while a decode lane is free, the queue is non-empty and
+   the KV budget covers the next request, pop it, pad its prompt to the
+   smallest compile bucket, run ONE batched flash
+   :func:`~apex_tpu.models.generate.prefill` into a bucket-sized cache,
+   scatter that into the request's KV storage, and sample the first
+   token from the prefill logits.  A request can therefore enter the
+   batch *mid-flight*, the moment an earlier one frees its lane — the
+   continuous-batching property that keeps decode utilization flat
+   under mixed-length traffic.
 2. **decode** — one batched :func:`~apex_tpu.models.generate.decode_step`
-   over ALL slots (the batch stays rectangular; inactive slots ride
+   over ALL lanes (the batch stays rectangular; inactive lanes ride
    along masked, their cache positions frozen), then a vectorized
    sample with per-slot temperatures.  One host sync per step reads the
    new tokens for EOS / length bookkeeping.
-3. **complete** — slots whose token hit ``eos_token_id`` or whose
+3. **complete** — lanes whose token hit ``eos_token_id`` or whose
    budget ran out are converted to :class:`Response` and released.
+
+Two KV layouts (``cache_layout=``, ISSUE 6):
+
+- ``"contiguous"`` (PR 3) — one ``max_len`` cache stripe per slot.
+  Admission is slot-count-based; every admitted request reserves
+  worst-case HBM for its whole lifetime.
+- ``"paged"`` — a global block pool (``serving/paged_cache.py``) with
+  per-request block tables and the fused ragged-paged-attention decode
+  kernel (``ops/paged_attention.py``).  Admission is **block-budget**
+  based: a request enters while the free blocks cover its prompt plus
+  ``reserve_blocks``, so HBM commits per allocated block, not per
+  ``max_slots × max_len``.  Identical full prompt blocks are
+  **prefix-shared** (refcounted, copy-on-write discipline — the shared
+  blocks are immutable by construction).  When decode needs a tail
+  block and the pool is dry, the **youngest** live request is
+  preempted — its blocks free instantly (fixed-size blocks, nothing to
+  defragment), the request requeues with its progress, and resume
+  replays prompt+generated through the batched flash prefill path.
+  Greedy outputs are token-identical across a preempt→resume cycle
+  (tests/test_serving_paged.py pins it).
 
 Static-shape discipline: exactly one decode compile for the engine's
 lifetime (shape ``[max_slots]``), one prefill compile per prompt
-bucket, one scatter compile per bucket — the bucketed compile cache
-that bounds recompiles under production traffic.
+bucket, one KV-insert compile per bucket — the bucketed compile cache
+that bounds recompiles under production traffic, same budget in both
+layouts.
 
 Telemetry (no-op unless ``observability.configure`` ran):
 ``serving.prefill_ms`` (histogram, per admission),
 ``serving.decode_tokens_per_sec`` (gauge, per step),
-``serving.slot_occupancy`` / ``serving.queue_depth`` (gauges), and the
+``serving.slot_occupancy`` / ``serving.queue_depth`` (gauges), the
 ``serving.{requests,prefill_calls,decode_steps,tokens_generated}``
-counters the trace-count tests pin against.
+counters the trace-count tests pin against, and — paged layout —
+``serving.blocks_in_use`` / ``serving.blocks_free`` /
+``serving.prefix_shared_blocks`` (gauges) + ``serving.preemptions``
+(counter), the signals the PR 4 HBM accounting and admission-stall
+detector read.
 
 Diagnostics (ISSUE 4, same no-op contract): each request emits paired
 ``serving.request.begin`` / ``serving.request.end`` events (submit →
 completion, queue time included) that the Perfetto trace sink renders
-as per-request async rows, plus a ``serving.request_ms`` latency
+as per-request async rows — a preemption adds a ``serving.request.
+preempt`` event in between — plus a ``serving.request_ms`` latency
 histogram tagged with the finish reason; the queue/occupancy gauges
 feed the admission-stall/backlog anomaly detector; prefill and decode
 compiles are labeled for the recompile tracker
@@ -74,6 +102,9 @@ from apex_tpu.observability.device import (
     compile_label, sample_device_memory)
 from apex_tpu.serving.batching import (
     SlotPool, default_buckets, pad_prompt, pick_bucket)
+from apex_tpu.serving.paged_cache import (
+    BlockManager, blocks_for, init_paged_pool, paged_insert_prefill,
+    prefix_block_hashes)
 
 __all__ = ["Request", "Response", "ServingEngine"]
 
@@ -90,6 +121,24 @@ class Request:
     # stamped by ServingEngine.submit; end-to-end latency (queue time
     # included) is measured from here
     submitted_t: float = 0.0
+    # tokens generated before a preemption (paged layout): resume
+    # replays prompt+resume_tokens through prefill and keeps counting
+    # its budget from where it left off
+    resume_tokens: List[int] = dataclasses.field(
+        default_factory=list, repr=False)
+    # times this request was preempted (paged layout).  Each admission
+    # (initial or resume) samples one token from prefill logits, so the
+    # request's realized decode-step count is
+    # ``len(tokens) - 1 - preemptions``
+    preemptions: int = 0
+    # memoized (token_count, full_tokens, prefix_block_hashes) for the
+    # paged admission path: _blocks_needed runs every step() while the
+    # head request waits on the block budget, and _claim_blocks needs
+    # the same tokens + digests at admission — concatenate and hash
+    # once per resume state, not per poll (token count only grows, so
+    # it keys the cache)
+    _hash_cache: Optional[tuple] = dataclasses.field(
+        default=None, repr=False)
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -119,20 +168,34 @@ class Response:
 
 @dataclasses.dataclass
 class _Slot:
-    """Host bookkeeping for one live cache slot."""
+    """Host bookkeeping for one live decode lane."""
 
     request: Request
     tokens: List[int]
     prefill_ms: float
+    # paged layout only:
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    cache_len: int = 0            # tokens materialized in the KV cache
+    shared_blocks: int = 0        # prefix blocks mapped, not allocated
 
 
 class ServingEngine:
-    """Continuous-batching engine over a fixed pool of KV cache slots.
+    """Continuous-batching engine over a fixed pool of decode lanes.
 
-    ``max_len`` bounds prompt + generation per request (the per-slot
-    cache length).  ``cache_dtype`` (e.g. ``jnp.bfloat16``) shrinks the
-    resident cache under an fp32 compute config.  ``top_k`` / ``top_p``
-    / ``vocab_limit`` are engine-wide static sampling knobs (a jit
+    ``max_len`` bounds prompt + generation per request.
+    ``cache_layout`` picks the KV storage: ``"contiguous"`` reserves a
+    ``max_len`` stripe per slot; ``"paged"`` commits HBM per allocated
+    ``block_size``-token block from a ``num_blocks`` pool (default
+    ``max_slots × ceil(max_len/block_size)`` — byte-parity with the
+    slot layout; size it smaller to overcommit, the engine preempts on
+    exhaustion).  ``reserve_blocks`` is the paged admission margin: a
+    request is admitted only while the free pool covers its prompt
+    blocks PLUS this many, which keeps a little decode headroom and
+    damps admit→instant-preempt thrash.
+
+    ``cache_dtype`` (e.g. ``jnp.bfloat16``) shrinks the resident cache
+    under an fp32 compute config.  ``top_k`` / ``top_p`` /
+    ``vocab_limit`` are engine-wide static sampling knobs (a jit
     recompile each — per-request values would retrace); temperature is
     per-request (a traced ``[max_slots]`` vector).
     """
@@ -140,11 +203,18 @@ class ServingEngine:
     def __init__(self, params: dict, cfg: TransformerConfig, *,
                  max_slots: int = 8, max_len: Optional[int] = None,
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 cache_dtype=None, top_k: Optional[int] = None,
+                 cache_dtype=None, cache_layout: str = "contiguous",
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 reserve_blocks: int = 1,
+                 top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
                  vocab_limit: Optional[int] = None,
                  rng: Optional[jax.Array] = None):
         _check_decode_cfg(cfg)
+        if cache_layout not in ("contiguous", "paged"):
+            raise ValueError(
+                f"cache_layout={cache_layout!r}: expected 'contiguous' "
+                "or 'paged'")
         self.params = params
         self.cfg = cfg
         self.max_slots = int(max_slots)
@@ -160,8 +230,43 @@ class ServingEngine:
             raise ValueError(
                 f"largest prompt bucket {self.buckets[-1]} exceeds "
                 f"max_len {self.max_len}")
-        self.cache = init_kv_cache(cfg, self.max_slots, self.max_len,
+        # submit validates raw prompts against the CALLER's ladder in
+        # both layouts — the resume extension below must not silently
+        # widen the configured prompt-size gate
+        self._submit_buckets = self.buckets
+        if cache_layout == "paged" and self.buckets[-1] < self.max_len:
+            # preempt→resume replays prompt+generated through prefill,
+            # and that can be ANY length up to max_len — extend the
+            # admission ladder so a resume always has a bucket
+            self.buckets = tuple(sorted(
+                set(self.buckets)
+                | {b for b in default_buckets(self.max_len)
+                   if b > self.buckets[-1]}))
+        self.cache_layout = cache_layout
+        if cache_layout == "paged":
+            self.block_size = int(block_size)
+            mb = blocks_for(self.max_len, self.block_size)
+            self.num_blocks = int(
+                num_blocks or self.max_slots * mb)
+            if reserve_blocks < 0:
+                raise ValueError(
+                    f"reserve_blocks={reserve_blocks} must be >= 0")
+            self.reserve_blocks = int(reserve_blocks)
+            pool = init_paged_pool(cfg, self.num_blocks, self.block_size,
                                    cache_dtype=cache_dtype)
+            self.cache = {"k": pool["k"], "v": pool["v"],
+                          "pos": jnp.zeros((self.max_slots,), jnp.int32)}
+            self._mgr = BlockManager(self.num_blocks, self.block_size)
+            # per-lane block tables, host-mirrored; num_blocks is the
+            # UNMAPPED sentinel (reads clamp+mask, writes drop), so a
+            # released lane can never touch a reassigned block
+            self._tables = np.full((self.max_slots, mb), self.num_blocks,
+                                   np.int32)
+        else:
+            self.cache = init_kv_cache(cfg, self.max_slots, self.max_len,
+                                       cache_dtype=cache_dtype)
+            self._mgr = None
+            self._tables = None
         self._cache_dtype = self.cache["k"].dtype
         self._pool = SlotPool(self.max_slots)
         self._slots: List[Optional[_Slot]] = [None] * self.max_slots
@@ -172,9 +277,11 @@ class ServingEngine:
         self._temps = np.zeros((self.max_slots,), np.float32)
         self._next_id = 0
         self._decode_count = 0
+        self._preempt_count = 0
         self._sampling = dict(top_k=top_k, top_p=top_p,
                               vocab_limit=vocab_limit)
-        self._decode_fn = _make_decode_fn(cfg, top_k, top_p, vocab_limit)
+        self._decode_fn = _make_decode_fn(cfg, top_k, top_p, vocab_limit,
+                                          cache_layout == "paged")
         self._sample_fn = _make_sample_fn(top_k, top_p, vocab_limit)
 
     # -- public API --------------------------------------------------------
@@ -191,7 +298,19 @@ class ServingEngine:
                 f"prompt ({req.prompt.size}) + max_new_tokens "
                 f"({req.max_new_tokens}) exceeds the engine max_len "
                 f"({self.max_len}); raise max_len or shorten the request")
-        pick_bucket(req.prompt.size, self.buckets)   # validate early
+        pick_bucket(req.prompt.size, self._submit_buckets)  # validate early
+        if self._mgr is not None:
+            worst = (blocks_for(req.prompt.size + req.max_new_tokens,
+                                self.block_size) + self.reserve_blocks)
+            if worst > self.num_blocks:
+                raise ValueError(
+                    f"request needs up to {worst} blocks (prompt "
+                    f"{req.prompt.size} + max_new_tokens "
+                    f"{req.max_new_tokens} at block_size "
+                    f"{self.block_size}, + {self.reserve_blocks} "
+                    f"reserve) but the pool holds {self.num_blocks}; "
+                    "it could never run to completion even alone — "
+                    "raise num_blocks or shorten the request")
         self._next_id += 1
         req.submitted_t = time.perf_counter()
         self._queue.append(req)
@@ -210,7 +329,7 @@ class ServingEngine:
         return not self._queue and self._pool.n_active == 0
 
     def step(self) -> List[Response]:
-        """Admit what fits, decode one token for every live slot;
+        """Admit what fits, decode one token for every live lane;
         returns the requests completed by this step."""
         completed = self._admit()
         # feed the stall detector HERE — after admission, before
@@ -242,15 +361,26 @@ class ServingEngine:
         return sorted(out, key=lambda r: r.request_id)
 
     def stats(self) -> dict:
-        return {
+        out = {
             "queued": len(self._queue),
             "active": self._pool.n_active,
             "free_slots": self._pool.n_free,
             "max_slots": self.max_slots,
             "max_len": self.max_len,
             "buckets": self.buckets,
+            "cache_layout": self.cache_layout,
             "sampling": dict(self._sampling),
         }
+        if self._mgr is not None:
+            out.update({
+                "block_size": self.block_size,
+                "num_blocks": self.num_blocks,
+                "blocks_free": self._mgr.n_free,
+                "blocks_in_use": self._mgr.n_in_use,
+                "prefix_shared_blocks": self._mgr.n_shared,
+                "preemptions": self._preempt_count,
+            })
+        return out
 
     # -- internals ---------------------------------------------------------
 
@@ -258,6 +388,12 @@ class ServingEngine:
         _telemetry.gauge("serving.slot_occupancy").set(
             self._pool.n_active / self.max_slots)
         _telemetry.gauge("serving.queue_depth").set(len(self._queue))
+        if self._mgr is not None:
+            _telemetry.gauge("serving.blocks_in_use").set(
+                self._mgr.n_in_use)
+            _telemetry.gauge("serving.blocks_free").set(self._mgr.n_free)
+            _telemetry.gauge("serving.prefix_shared_blocks").set(
+                self._mgr.n_shared)
 
     def _feed_queue_detector(self) -> None:
         """Anomaly feed for the queue detector (see step() for why the
@@ -267,63 +403,191 @@ class ServingEngine:
             reg.detectors.feed_serving(
                 len(self._queue), self._pool.n_active / self.max_slots)
 
+    # -- admission ---------------------------------------------------------
+
+    def _admission_state(self, req: Request):
+        """(full token array, prefix digests) for the request's current
+        resume state, memoized on the Request (invalidated by growth —
+        a resume's token count is strictly larger than the state it was
+        computed at).  _blocks_needed polls this every step() while the
+        head request waits on the block budget, so neither the
+        prompt+resume concatenation nor the digests may be per-poll
+        work."""
+        n = req.prompt.size + len(req.resume_tokens)
+        if req._hash_cache is None or req._hash_cache[0] != n:
+            tokens = self._full_tokens(req)
+            full = n // self.block_size
+            req._hash_cache = (n, tokens, prefix_block_hashes(
+                tokens[: full * self.block_size], self.block_size))
+        return req._hash_cache[1], req._hash_cache[2]
+
+    def _blocks_needed(self, req: Request) -> int:
+        """NEW blocks the request must allocate at admission (prefix
+        hits against the published block table are free — they map, not
+        allocate)."""
+        tokens, hashes = self._admission_state(req)
+        need = blocks_for(tokens.size, self.block_size)
+        for h in hashes:
+            if self._mgr.lookup_prefix(h) is not None:
+                need -= 1
+        return need
+
+    @staticmethod
+    def _full_tokens(req: Request) -> np.ndarray:
+        """Prompt plus any pre-preemption progress — the token sequence
+        a (re-)admission prefills over."""
+        if not req.resume_tokens:
+            return req.prompt
+        return np.concatenate(
+            [req.prompt, np.asarray(req.resume_tokens, np.int32)])
+
     def _admit(self) -> List[Response]:
-        """Prefill queued requests into free slots (continuous
-        batching's entry edge).  Returns requests that completed at
-        admission (first token hit EOS, or a one-token budget)."""
+        """Prefill queued requests into free lanes (continuous
+        batching's entry edge).  Contiguous layout: admit while a slot
+        is free.  Paged layout: ALSO require the free block pool to
+        cover the request's prompt plus ``reserve_blocks`` — the
+        block-budget admission that replaces slot-count reservation.
+        Returns requests that completed at admission (first token hit
+        EOS, or a one-token budget)."""
         completed = []
         while self._queue and self._pool.n_free:
-            req = self._queue.popleft()
+            req = self._queue[0]
+            if (self._mgr is not None
+                    and self._mgr.n_free < (self._blocks_needed(req)
+                                            + self.reserve_blocks)):
+                # budget miss: wait for completions (or a preemption)
+                # to return blocks — lanes alone don't admit
+                break
+            self._queue.popleft()
             slot = self._pool.claim()
             try:
                 completed.extend(self._admit_one(req, slot))
             except Exception:
                 # a transient prefill failure (device OOM, XLA error)
-                # must not leak the slot or drop the request: restore
-                # both so the engine stays drainable and a retry can
-                # succeed, then surface the error.  Unwind ONLY the
-                # pre-handoff state — if the failure struck after the
-                # slot was handed over (or after _complete already
-                # served and released it), releasing again would
-                # double-free and requeueing would serve the request
-                # twice.
+                # must not leak the slot/blocks or drop the request:
+                # restore both so the engine stays drainable and a
+                # retry can succeed, then surface the error.  Unwind
+                # ONLY the pre-handoff state — if the failure struck
+                # after the slot was handed over (or after _complete
+                # already served and released it), releasing again
+                # would double-free and requeueing would serve the
+                # request twice.  (_admit_one unwinds its own block
+                # allocations; is_active is the O(1) membership check,
+                # not a scan over the sorted active tuple.)
                 if (self._slots[slot] is None
-                        and slot in self._pool.active):
+                        and self._pool.is_active(slot)):
                     self._pool.release(slot)
                     self._queue.appendleft(req)
                     self._set_gauges()
                 raise
         return completed
 
+    def _claim_blocks(self, tokens: np.ndarray, hashes: List[bytes]):
+        """Map/allocate the block list for ``tokens`` (``hashes`` =
+        its full-block prefix digests): full blocks come from the
+        prefix-hash table when published (refcounted share — their
+        pages are NOT rewritten), everything else allocates fresh.
+        Returns (blocks, write_ids, shared_count); raises RuntimeError
+        on pool exhaustion with everything already unwound."""
+        n = tokens.size
+        bs = self.block_size
+        blocks: List[int] = []
+        write_ids: List[int] = []
+        shared = 0
+        try:
+            for h in hashes:
+                blk = self._mgr.share_prefix(h)
+                if blk is not None:
+                    blocks.append(blk)
+                    write_ids.append(self.num_blocks)   # don't rewrite
+                    shared += 1
+                    continue
+                blk = self._mgr.alloc()
+                if blk is None:
+                    raise RuntimeError("block pool exhausted mid-admit")
+                self._mgr.publish_prefix(h, blk)
+                blocks.append(blk)
+                write_ids.append(blk)
+            if n % bs:
+                blk = self._mgr.alloc()                 # private tail
+                if blk is None:
+                    raise RuntimeError("block pool exhausted mid-admit")
+                blocks.append(blk)
+                write_ids.append(blk)
+        except Exception:
+            self._mgr.free_all(blocks)
+            raise
+        return blocks, write_ids, shared
+
     def _admit_one(self, req: Request, slot: int) -> List[Response]:
-        """Prefill one claimed request into its slot (split out so
-        :meth:`_admit` can unwind slot + queue state on failure)."""
+        """Prefill one claimed request into its lane (split out so
+        :meth:`_admit` can unwind slot + queue state on failure; block
+        allocations unwind HERE, closest to where they happen)."""
         completed: List[Response] = []
-        n = req.prompt.size
+        hashes: List[bytes] = []
+        if self._mgr is not None:
+            tokens, hashes = self._admission_state(req)
+        else:
+            tokens = self._full_tokens(req)
+        n = int(tokens.size)
         bucket = pick_bucket(n, self.buckets)
+        blocks: List[int] = []
+        write_ids: List[int] = []
+        shared = 0
+        if self._mgr is not None:
+            blocks, write_ids, shared = self._claim_blocks(tokens, hashes)
         t0 = time.perf_counter()
-        with span("serving.prefill"), \
-                compile_label("serving.prefill"):
-            padded = jnp.asarray(pad_prompt(req.prompt, bucket)[None])
-            lens = jnp.asarray([n], jnp.int32)
-            logits, small = prefill(
-                self.params, padded, self.cfg, prompt_lens=lens,
-                max_len=bucket, cache_dtype=self._cache_dtype)
-            self.cache = _insert_slot(
-                self.cache, small["k"], small["v"],
-                jnp.int32(slot), jnp.int32(n))
-            self._key, sub = jax.random.split(self._key)
-            first = self._sample_fn(
-                logits, jnp.asarray([req.temperature], jnp.float32),
-                sub)
-            tok = int(np.asarray(first)[0])      # host sync
-        ms = (time.perf_counter() - t0) * 1e3
-        _telemetry.counter("serving.prefill_calls").inc()
-        _telemetry.histogram("serving.prefill_ms").observe(ms)
-        _telemetry.counter("serving.tokens_generated").inc()
-        if _telemetry.enabled():
-            sample_device_memory()   # admission = cache growth edge
-        st = _Slot(request=req, tokens=[tok], prefill_ms=ms)
+        try:
+            with span("serving.prefill"), \
+                    compile_label("serving.prefill"):
+                padded = jnp.asarray(pad_prompt(tokens, bucket)[None])
+                lens = jnp.asarray([n], jnp.int32)
+                logits, small = prefill(
+                    self.params, padded, self.cfg, prompt_lens=lens,
+                    max_len=bucket, cache_dtype=self._cache_dtype)
+                if self._mgr is not None:
+                    wid = np.full((blocks_for(bucket, self.block_size),),
+                                  self.num_blocks, np.int32)
+                    wid[: len(write_ids)] = write_ids
+                    k, v = paged_insert_prefill(
+                        self.cache["k"], self.cache["v"],
+                        small["k"], small["v"], jnp.asarray(wid),
+                        jnp.int32(n), block_size=self.block_size)
+                    self.cache = {
+                        "k": k, "v": v,
+                        "pos": self.cache["pos"].at[slot].set(n),
+                    }
+                else:
+                    self.cache = _insert_slot(
+                        self.cache, small["k"], small["v"],
+                        jnp.int32(slot), jnp.int32(n))
+                self._key, sub = jax.random.split(self._key)
+                first = self._sample_fn(
+                    logits, jnp.asarray([req.temperature], jnp.float32),
+                    sub)
+                tok = int(np.asarray(first)[0])      # host sync
+            if self._mgr is not None:
+                self._tables[slot, :] = self.num_blocks
+                self._tables[slot, : len(blocks)] = blocks
+            ms = (time.perf_counter() - t0) * 1e3
+            _telemetry.counter("serving.prefill_calls").inc()
+            _telemetry.histogram("serving.prefill_ms").observe(ms)
+            _telemetry.counter("serving.tokens_generated").inc()
+            if _telemetry.enabled():
+                sample_device_memory()   # admission = cache growth edge
+            st = _Slot(request=req,
+                       tokens=list(req.resume_tokens) + [tok],
+                       prefill_ms=ms, blocks=blocks, cache_len=n,
+                       shared_blocks=shared)
+        except Exception:
+            # everything before the slot handoff below can raise (the
+            # prefill itself, but also a telemetry sink or the HBM
+            # sample) — the claimed blocks must unwind HERE or they
+            # leak: _admit's unwind restores only slot + queue state
+            if self._mgr is not None:
+                self._mgr.free_all(blocks)
+                self._tables[slot, :] = self.num_blocks
+            raise
         self._slots[slot] = st
         self._pending[slot] = tok
         self._temps[slot] = req.temperature
@@ -332,9 +596,67 @@ class ServingEngine:
             completed.append(self._complete(slot, done))
         return completed
 
+    # -- decode ------------------------------------------------------------
+
+    def _youngest_slot(self) -> int:
+        """The preemption victim: the most recently submitted live
+        request — it has the least sunk prefill+decode work and the
+        shortest replay."""
+        return max(self._pool.active,
+                   key=lambda s: self._slots[s].request.request_id)
+
+    def _preempt(self, slot: int) -> None:
+        """Evict one live request: free its blocks (decref — shared
+        prefix blocks survive under their other owners), park its
+        progress on the Request, requeue it at the FRONT (it resumes as
+        soon as the budget allows, replaying prompt+generated through
+        the batched flash prefill), release the lane."""
+        st = self._slots[slot]
+        self._slots[slot] = None
+        self._pending[slot] = 0
+        self._temps[slot] = 0.0
+        self._tables[slot, :] = self.num_blocks
+        self._mgr.free_all(st.blocks)
+        self._pool.release(slot)
+        req = st.request
+        req.resume_tokens = list(st.tokens)
+        req.preemptions += 1
+        self._queue.appendleft(req)
+        self._preempt_count += 1
+        _telemetry.counter("serving.preemptions").inc()
+        _telemetry.event("serving.request.preempt",
+                         id=req.request_id, tokens=len(st.tokens),
+                         blocks_freed=len(st.blocks))
+
+    def _ensure_tail_blocks(self) -> None:
+        """Paged pre-decode edge: every live lane whose next write
+        position opens a new block gets one allocated NOW (the jitted
+        decode step cannot allocate).  On pool exhaustion the youngest
+        live request is preempted — repeatedly, until the allocation
+        succeeds or the needy lane itself was evicted — instead of
+        stalling the whole batch."""
+        for slot in list(self._pool.active):
+            st = self._slots[slot]
+            if st is None:                     # preempted this pass
+                continue
+            if st.cache_len % self.block_size:
+                continue                       # tail block has room
+            idx = st.cache_len // self.block_size
+            while self._slots[slot] is st:
+                blk = self._mgr.alloc()
+                if blk is not None:
+                    st.blocks.append(blk)
+                    self._tables[slot, idx] = blk
+                    break
+                self._preempt(self._youngest_slot())
+
     def _decode_once(self) -> List[Response]:
-        """One batched decode step over every slot (live ones advance,
+        """One batched decode step over every lane (live ones advance,
         free ones ride along masked)."""
+        if self._mgr is not None:
+            self._ensure_tail_blocks()
+            if not self._pool.n_active:        # everything preempted
+                return []
         active = np.zeros((self.max_slots,), bool)
         for i, st in enumerate(self._slots):
             active[i] = st is not None
@@ -343,9 +665,15 @@ class ServingEngine:
         with compile_label("serving.decode"):
             # exactly ONE compile should ever land on this label; a
             # second is the static-shape discipline breaking
-            nxt, self.cache = self._decode_fn(
-                self.params, self.cache, jnp.asarray(self._pending),
-                jnp.asarray(self._temps), jnp.asarray(active), sub)
+            if self._mgr is not None:
+                nxt, self.cache = self._decode_fn(
+                    self.params, self.cache, jnp.asarray(self._tables),
+                    jnp.asarray(self._pending),
+                    jnp.asarray(self._temps), jnp.asarray(active), sub)
+            else:
+                nxt, self.cache = self._decode_fn(
+                    self.params, self.cache, jnp.asarray(self._pending),
+                    jnp.asarray(self._temps), jnp.asarray(active), sub)
             nxt_host = np.asarray(nxt)               # host sync
         dt = time.perf_counter() - t0
         _telemetry.counter("serving.decode_steps").inc()
@@ -359,6 +687,7 @@ class ServingEngine:
                 continue
             tok = int(nxt_host[slot])
             st.tokens.append(tok)
+            st.cache_len += 1
             self._pending[slot] = tok
             emitted += 1
             done = self._finish_reason(st, tok)
@@ -382,6 +711,9 @@ class ServingEngine:
         st = self._slots[slot]
         self._slots[slot] = None
         self._temps[slot] = 0.0
+        if self._mgr is not None:
+            self._tables[slot, :] = self.num_blocks
+            self._mgr.free_all(st.blocks)
         self._pool.release(slot)
         latency_ms = (time.perf_counter()
                       - st.request.submitted_t) * 1e3
@@ -398,7 +730,9 @@ class ServingEngine:
             tokens=np.asarray(st.tokens, np.int32),
             finish_reason=reason,
             prefill_ms=st.prefill_ms,
-            decode_steps=len(st.tokens) - 1,
+            # every admission (initial + each post-preemption resume)
+            # contributes one prefill-sampled token, not a decode step
+            decode_steps=len(st.tokens) - 1 - st.request.preemptions,
         )
 
 
@@ -424,14 +758,36 @@ def _make_sample_fn(top_k, top_p, vocab_limit):
 
 
 @functools.lru_cache(maxsize=None)
-def _make_decode_fn(cfg, top_k, top_p, vocab_limit):
+def _make_decode_fn(cfg, top_k, top_p, vocab_limit, paged):
     """One compiled decode+sample step for the engine's lifetime —
     memoized on the static knobs so engines sharing a config (tests,
     multi-engine processes) share the XLA compile too.
 
-    The cache is donated: the slot buffers are updated in place on
+    The cache is donated: the slot/pool buffers are updated in place on
     device rather than copied per token (on CPU test platforms the
-    donation degrades to a copy with a one-time warning)."""
+    donation degrades to a copy with a one-time warning).  Paged
+    engines pass the block tables SEPARATELY (not donated): the host
+    mutates its table mirror between steps (tail allocation,
+    preemption), so a fresh device copy rides in each step while the
+    big pool stays put."""
+
+    if paged:
+        @functools.partial(jax.jit, donate_argnames=("cache",))
+        def step_fn(params, cache, tables, tokens, temps, active, key):
+            prev_pos = cache["pos"]
+            logits, new = decode_step(
+                params, tokens, dict(cache, block_tables=tables), cfg)
+            # free lanes ride along: frozen position + sentinel table
+            # rows (writes drop), so they can't corrupt live blocks
+            cache = {
+                "k": new["k"], "v": new["v"],
+                "pos": jnp.where(active, new["pos"], prev_pos),
+            }
+            nxt = _mixed_sample(logits, temps, key, top_k=top_k,
+                                top_p=top_p, vocab_limit=vocab_limit)
+            return nxt, cache
+
+        return step_fn
 
     @functools.partial(jax.jit, donate_argnames=("cache",))
     def step_fn(params, cache, tokens, temps, active, key):

@@ -1,0 +1,256 @@
+"""Paged KV cache: global block pool + per-request block tables.
+
+The PR 3 engine reserved one contiguous ``max_len`` cache stripe per
+slot, so every admitted request held worst-case HBM for its whole
+lifetime and one long request starved the fleet (ROADMAP item 1).
+This module replaces that layout with the paged design of "Ragged
+Paged Attention" (PAPERS.md; vLLM's PagedAttention on the GPU side):
+
+- **block pool** — one device buffer per K/V side, shape
+  ``[num_layers, num_blocks, block_size, kv_groups, dh]``: HBM is
+  committed per *allocated block* (``block_size`` tokens), not per
+  ``max_slots × max_len``;
+- **block tables** — each request owns an ordered int32 list of pool
+  indices; entries ``>= num_blocks`` are the UNMAPPED sentinel (reads
+  clamp + mask, writes drop), so a released lane or a short table tail
+  can never corrupt another request's blocks;
+- **free-list reuse** — allocation pops a free block id, release
+  pushes it back.  Blocks are fixed-size and fully interchangeable, so
+  there is nothing to defragment, ever — the property that makes
+  preempt/resume and mid-flight admission cheap;
+- **prefix sharing (copy-on-write)** — every *full* prompt block is
+  published under a chained SHA-256 content digest (collision-proof —
+  a key hit maps physical K/V with no token re-check, so the key
+  cannot be a 64-bit hash); a later request whose prompt
+  starts with the same token blocks maps the existing physical blocks
+  into its table (refcounted) instead of allocating + recomputing.
+  Full prompt blocks are immutable by construction (decode appends
+  only to the tail block, which is always private), so sharing is
+  read-only and release is a decref; :meth:`BlockManager.
+  ensure_private` is the explicit CoW edge for any future writer.
+
+Host/device split: :class:`BlockManager` is pure host bookkeeping
+(ids, refcounts, hashes — the ``SlotPool`` discipline one level down);
+the device-side writes are the two jitted scatters below
+(:func:`paged_insert_prefill` for whole-page prefill writes; the
+per-token tail append lives in ``models/generate.py``'s paged decode
+layer) and the fused read is ``ops/paged_attention.py``.
+
+Telemetry (the names the PR 4 detectors/HBM accounting key on):
+``serving.blocks_in_use`` / ``serving.blocks_free`` /
+``serving.prefix_shared_blocks`` gauges and the
+``serving.preemptions`` counter — emitted by the engine, derived from
+this manager's properties.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.config import TransformerConfig
+
+__all__ = ["BlockManager", "blocks_for", "init_paged_pool",
+           "paged_insert_prefill", "prefix_block_hashes"]
+
+
+def blocks_for(n_tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``n_tokens`` (ceil division)."""
+    if n_tokens < 0:
+        raise ValueError(f"n_tokens={n_tokens} must be >= 0")
+    if block_size < 1:
+        raise ValueError(f"block_size={block_size} must be positive")
+    return -(-n_tokens // block_size)
+
+
+def init_paged_pool(cfg: TransformerConfig, num_blocks: int,
+                    block_size: int, cache_dtype=None) -> dict:
+    """Allocate the global K/V block pool:
+    ``[num_layers, num_blocks, block_size, kv_groups, dh]`` per side.
+
+    Same dtype contract as the contiguous ``init_kv_cache`` — GQA holds
+    only the group heads, ``cache_dtype`` downcasts under an fp32
+    compute config."""
+    if num_blocks < 1:
+        raise ValueError(f"num_blocks={num_blocks} must be positive")
+    if block_size < 1:
+        raise ValueError(f"block_size={block_size} must be positive")
+    dt = cfg.compute_dtype if cache_dtype is None else cache_dtype
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.kv_groups,
+             cfg.kv_channels)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def prefix_block_hashes(tokens: np.ndarray,
+                        block_size: int) -> List[bytes]:
+    """Chained content digests of every FULL block of ``tokens``.
+
+    ``digest(block i)`` covers tokens ``[0, (i+1)·block_size)`` via
+    chaining, so a digest hit guarantees the whole causal prefix
+    matches — the property that makes the shared K/V bit-identical
+    (K/V at position ``t`` depends only on tokens ``<= t``).  The
+    digest is chained SHA-256, not Python's 64-bit ``hash()``: sharing
+    maps another request's physical K/V on a key hit with no token
+    re-comparison, so the key must be collision-proof, not merely
+    collision-rare."""
+    tokens = np.asarray(tokens, np.int64).reshape(-1)
+    out: List[bytes] = []
+    h = b""
+    for i in range(tokens.size // block_size):
+        blk = tokens[i * block_size: (i + 1) * block_size]
+        h = hashlib.sha256(h + blk.tobytes()).digest()
+        out.append(h)
+    return out
+
+
+class BlockManager:
+    """Host-side ledger of the block pool: free list, per-block
+    refcounts, and the prefix-hash table behind copy-on-write sharing.
+
+    Pure bookkeeping — device blocks are never moved; owning a block id
+    only grants the right to write it (at refcount 1) and to map it
+    into a block table."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks={num_blocks} must be positive")
+        if block_size < 1:
+            raise ValueError(f"block_size={block_size} must be positive")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free = list(range(num_blocks - 1, -1, -1))   # pop -> 0 first
+        self._ref: Dict[int, int] = {}
+        self._hash_to_block: Dict[bytes, int] = {}
+        self._block_to_hash: Dict[int, bytes] = {}
+
+    # -- allocation ---------------------------------------------------------
+
+    def alloc(self) -> Optional[int]:
+        """Claim one free block (refcount 1), or None when exhausted."""
+        if not self._free:
+            return None
+        blk = self._free.pop()
+        self._ref[blk] = 1
+        return blk
+
+    def incref(self, blk: int) -> None:
+        if blk not in self._ref:
+            raise ValueError(f"block {blk} is not allocated")
+        self._ref[blk] += 1
+
+    def decref(self, blk: int) -> bool:
+        """Drop one reference; frees (and unpublishes) the block when
+        the count hits zero.  Returns True when it freed."""
+        if blk not in self._ref:
+            raise ValueError(f"block {blk} is not allocated")
+        self._ref[blk] -= 1
+        if self._ref[blk] > 0:
+            return False
+        del self._ref[blk]
+        h = self._block_to_hash.pop(blk, None)
+        if h is not None and self._hash_to_block.get(h) == blk:
+            del self._hash_to_block[h]
+        self._free.append(blk)
+        return True
+
+    def free_all(self, blocks: Sequence[int]) -> None:
+        for blk in blocks:
+            self.decref(blk)
+
+    # -- prefix sharing -----------------------------------------------------
+
+    def lookup_prefix(self, chain_hash) -> Optional[int]:
+        """Live block published under ``chain_hash``, or None."""
+        return self._hash_to_block.get(chain_hash)
+
+    def share_prefix(self, chain_hash) -> Optional[int]:
+        """Map the published block for ``chain_hash`` into a new table
+        (incref), or None on miss."""
+        blk = self._hash_to_block.get(chain_hash)
+        if blk is None:
+            return None
+        self.incref(blk)
+        return blk
+
+    def publish_prefix(self, chain_hash, blk: int) -> None:
+        """Publish an immutable FULL block under its chain hash so
+        later identical prompts can share it.  Last writer wins on a
+        hash collision between concurrent fills (both blocks hold the
+        same tokens; one simply stops being discoverable)."""
+        if blk not in self._ref:
+            raise ValueError(f"block {blk} is not allocated")
+        self._hash_to_block[chain_hash] = blk
+        self._block_to_hash[blk] = chain_hash
+
+    def ensure_private(self, blk: int) -> Tuple[Optional[int], bool]:
+        """Copy-on-write edge: return a block safe to WRITE.
+
+        At refcount 1 the block is already private → ``(blk, False)``.
+        Shared (refcount > 1) → allocate a fresh block, move this
+        table's reference onto it, and return ``(new_blk, True)`` so
+        the caller copies the device payload before writing; ``(None,
+        True)`` when the pool is exhausted (caller preempts).  The
+        engine's sharing is read-only by construction (only full,
+        never-appended prompt blocks are published), so this edge is
+        exercised by tests rather than steady-state traffic."""
+        if self._ref.get(blk, 0) <= 1:
+            return blk, False
+        fresh = self.alloc()
+        if fresh is None:
+            return None, True
+        self._ref[blk] -= 1
+        return fresh, True
+
+    # -- accounting ---------------------------------------------------------
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_in_use(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    @property
+    def n_shared(self) -> int:
+        """Physical blocks saved by prefix sharing: the references
+        beyond the first on every live block (the
+        ``serving.prefix_shared_blocks`` gauge)."""
+        return sum(r - 1 for r in self._ref.values() if r > 1)
+
+    def refcount(self, blk: int) -> int:
+        return self._ref.get(blk, 0)
+
+
+@functools.partial(jax.jit, donate_argnames=("pool_k", "pool_v"),
+                   static_argnames=("block_size",))
+def paged_insert_prefill(pool_k, pool_v, ks, vs, write_ids, length,
+                         *, block_size: int):
+    """Scatter a bucket-sized prefill cache ``[L, 1, S, g, dh]`` into
+    the listed pool blocks — the paged analog of the slot engine's
+    ``_insert_slot`` (pool donated, written in place).
+
+    ``write_ids`` ``[ceil(S/block_size)]`` int32 maps each page of the
+    bucket to its physical block; entries ``>= num_blocks`` DROP the
+    page's writes — how prefix-shared blocks (already filled,
+    refcount > 1, must not be touched) and the bucket's padding tail
+    are skipped in the same scatter.  Positions ``>= length`` (row
+    padding inside a mapped page) drop individually."""
+    L = ks.shape[0]
+    S = ks.shape[2]
+    nb = pool_k.shape[1]
+    t = jnp.arange(S)
+    blk = write_ids.astype(jnp.int32)[t // block_size]
+    blk = jnp.where(t < length, blk, nb)          # padding -> dropped
+    off = t % block_size
+    k = pool_k.at[:, blk, off].set(
+        ks[:, 0].astype(pool_k.dtype), mode="drop")
+    v = pool_v.at[:, blk, off].set(
+        vs[:, 0].astype(pool_v.dtype), mode="drop")
+    del L  # shape bound only for readability
+    return k, v

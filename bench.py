@@ -276,7 +276,7 @@ def bench_longctx_cp_compare(on_tpu, batch=2, seq=8192, iters=4):
     return out
 
 
-def bench_decode(on_tpu, query_groups=None):
+def bench_decode(on_tpu, query_groups=None, cache_layout="contiguous"):
     """Autoregressive inference throughput (beyond-reference row: apex
     ships no generation path; ours is models/generate.py).
 
@@ -286,8 +286,12 @@ def bench_decode(on_tpu, query_groups=None):
     forward (prompt tokens/s) and the per-token decode loop (new
     tokens/s, prefill time subtracted).  ``query_groups`` enables the
     GQA variant — the cache shrinks by heads/groups, the decode
-    bandwidth story GQA exists for."""
-    from apex_tpu.models.generate import generate, prefill
+    bandwidth story GQA exists for.  ``cache_layout`` (ISSUE 6) runs
+    the same geometry over the contiguous stripe cache or the paged
+    block pool + ragged-paged-attention kernel; every row carries the
+    layout so BENCH trajectory comparisons never mix the two."""
+    from apex_tpu.models.generate import (
+        generate, init_kv_cache, prefill)
     from apex_tpu.models.transformer_lm import init_gpt_params
 
     if on_tpu:
@@ -309,14 +313,19 @@ def bench_decode(on_tpu, query_groups=None):
                          jnp.int32)
 
     def run_prefill(_):
-        lg, _cache = prefill(params, tokens, cfg, max_len=prompt + new)
+        # the cache alloc rides inside the timed body in BOTH layouts
+        # (contiguous allocates inside prefill when cache=None)
+        cache = init_kv_cache(cfg, batch, prompt + new,
+                              cache_layout=cache_layout)
+        lg, _cache = prefill(params, tokens, cfg, cache=cache)
         return (lg, lg)
 
     pf_sec = _time_fn(run_prefill, n_warmup=1,
                       iters=5 if on_tpu else 2, name="prefill")
 
     def run(_):
-        out = generate(params, tokens, cfg, max_new_tokens=new)
+        out = generate(params, tokens, cfg, max_new_tokens=new,
+                       cache_layout=cache_layout)
         return (out, out)
 
     sec = _time_fn(run, n_warmup=1, iters=5 if on_tpu else 2,
@@ -335,6 +344,7 @@ def bench_decode(on_tpu, query_groups=None):
         "prefill_tokens_per_sec": round(batch * prompt / pf_sec, 1),
         "e2e_ms": round(sec * 1e3, 2),
         "batch": batch, "prompt": prompt, "new_tokens": new,
+        "cache_layout": cache_layout,
     }
     if noisy:
         out["noisy_prefill_timing"] = True
@@ -343,58 +353,154 @@ def bench_decode(on_tpu, query_groups=None):
     return out
 
 
-def bench_serving(on_tpu):
+def _serving_mixes(on_tpu):
+    """The shared request mixes: the two ends of production traffic
+    plus the long-prompt-starvation mix of ISSUE 6 — a few near-max_len
+    prompts pinning lanes for many steps amid a stream of short
+    requests.  Under slot admission each long request reserves a whole
+    max_len stripe, so concurrency (and slot occupancy) collapses to
+    the slot count; the mix is what the paged ablation row measures."""
+    if on_tpu:
+        return 8, gpt_125m(max_position_embeddings=1024), {
+            "prefill_heavy": dict(n=16, prompt=512, new=16),
+            "decode_heavy": dict(n=16, prompt=32, new=128),
+            "long_prompt_starvation": dict(
+                n=16, prompt=32, new=32, n_long=2, long_prompt=768,
+                long_new=64),
+        }
+    return 4, gpt_125m(num_layers=2, hidden_size=128,
+                       num_attention_heads=4, vocab_size=1024,
+                       max_position_embeddings=256), {
+        "prefill_heavy": dict(n=4, prompt=48, new=4),
+        "decode_heavy": dict(n=4, prompt=8, new=24),
+        "long_prompt_starvation": dict(
+            n=6, prompt=8, new=8, n_long=1, long_prompt=96, long_new=16),
+    }
+
+
+def _mix_requests(rng, vocab, m):
+    """Materialize one mix: ``n_long`` long requests submitted FIRST
+    (they pin lanes while the short stream queues behind them)."""
+    reqs = [dict(prompt=rng.randint(0, vocab, (m["long_prompt"],)),
+                 max_new_tokens=m["long_new"])
+            for _ in range(m.get("n_long", 0))]
+    reqs += [dict(prompt=rng.randint(0, vocab, (m["prompt"],)),
+                  max_new_tokens=m["new"]) for _ in range(m["n"])]
+    return reqs
+
+
+def _drive_engine(engine, reqs):
+    """Submit + step to drain, tracking the concurrency high-water mark
+    (``run()`` hides it); returns (responses, wall_s, max_concurrent)."""
+    import time as _time
+
+    for kw in reqs:
+        engine.submit(**kw)
+    resps, hw = [], 0
+    t0 = _time.perf_counter()
+    while not engine.idle:
+        resps.extend(engine.step())
+        hw = max(hw, engine.stats()["active"])
+    wall = _time.perf_counter() - t0          # step() syncs every token
+    return resps, wall, hw
+
+
+def bench_serving(on_tpu, cache_layout="contiguous"):
     """Continuous-batching serving engine (apex_tpu/serving) under a
-    prefill-heavy and a decode-heavy request mix — the two ends of
-    production traffic.  Each mix drives ``ServingEngine.run`` over
-    more requests than slots, so admission-into-freed-slots (the
-    continuous-batching property) is on the measured path; the reported
+    prefill-heavy mix, a decode-heavy mix, and the long-prompt
+    starvation mix (ISSUE 6) — each driving more requests than lanes so
+    admission-into-freed-lanes is on the measured path; the reported
     tokens/s is end-to-end (prefills + decode steps + the per-step host
-    sync a real serving loop pays)."""
+    sync a real serving loop pays).  ``cache_layout`` picks the KV
+    storage; the row carries it so trajectories never mix layouts."""
     from apex_tpu.models.transformer_lm import init_gpt_params
     from apex_tpu.serving import ServingEngine
 
-    if on_tpu:
-        slots = 8
-        cfg = gpt_125m(max_position_embeddings=1024)
-        mixes = {
-            "prefill_heavy": dict(n=16, prompt=512, new=16),
-            "decode_heavy": dict(n=16, prompt=32, new=128),
-        }
-    else:
-        slots = 4
-        cfg = gpt_125m(num_layers=2, hidden_size=128,
-                       num_attention_heads=4, vocab_size=1024,
-                       max_position_embeddings=256)
-        mixes = {
-            "prefill_heavy": dict(n=4, prompt=48, new=4),
-            "decode_heavy": dict(n=4, prompt=8, new=24),
-        }
+    slots, cfg, mixes = _serving_mixes(on_tpu)
     rng = np.random.RandomState(0)
     params = init_gpt_params(jax.random.PRNGKey(0), cfg)
-    rows = {"max_slots": slots}
+    rows = {"max_slots": slots, "cache_layout": cache_layout}
     for name, m in mixes.items():
-        engine = ServingEngine(
-            params, cfg, max_slots=slots,
-            max_len=min(cfg.max_position_embeddings,
-                        2 * (m["prompt"] + m["new"])))
-        reqs = [dict(prompt=rng.randint(0, cfg.vocab_size, (m["prompt"],)),
-                     max_new_tokens=m["new"]) for _ in range(m["n"])]
-        engine.run(reqs)                      # warmup: compiles
-        import time as _time
-
-        t0 = _time.perf_counter()
-        resps = engine.run(reqs)
-        wall = _time.perf_counter() - t0      # run() syncs every step
+        longest = max(m["prompt"] + m["new"],
+                      m.get("long_prompt", 0) + m.get("long_new", 0))
+        engine_kw = dict(max_slots=slots,
+                         max_len=min(cfg.max_position_embeddings,
+                                     2 * longest),
+                         cache_layout=cache_layout)
+        reqs = _mix_requests(rng, cfg.vocab_size, m)
+        ServingEngine(params, cfg, **engine_kw).run(reqs)  # warmup
+        engine = ServingEngine(params, cfg, **engine_kw)
+        resps, wall, hw = _drive_engine(engine, reqs)
         gen_tokens = sum(r.tokens.size for r in resps)
         rows[name] = {
-            "requests": m["n"], "prompt": m["prompt"],
+            "requests": len(reqs), "prompt": m["prompt"],
             "new_tokens": m["new"],
             "wall_ms": round(wall * 1e3, 2),
             "gen_tokens_per_sec": round(gen_tokens / wall, 1),
             "prefill_ms_mean": round(
                 sum(r.prefill_ms for r in resps) / len(resps), 3),
+            "max_concurrent_requests": hw,
         }
+        if m.get("n_long"):
+            rows[name]["long_requests"] = m["n_long"]
+            rows[name]["long_prompt"] = m["long_prompt"]
+        if cache_layout == "paged":
+            rows[name]["preemptions"] = engine.stats()["preemptions"]
+    return rows
+
+
+def bench_cache_layout_ablation(on_tpu, layouts):
+    """The ISSUE 6 headline ablation: both layouts under the
+    long-prompt starvation mix at MATCHED KV bytes.  The contiguous
+    engine gets S slots × max_len stripes; the paged engine gets the
+    SAME pool bytes (num_blocks = S·max_len/block_size) but 4× the
+    lanes — slot admission reserves worst-case HBM per request, block
+    admission reserves only touched blocks, so the paged row should
+    carry more concurrent requests (``max_concurrent_requests``) and
+    pay for overcommit with counted ``preemptions`` rather than
+    queue stalls."""
+    from apex_tpu.models.transformer_lm import init_gpt_params
+    from apex_tpu.serving import ServingEngine
+
+    slots, cfg, mixes = _serving_mixes(on_tpu)
+    m = mixes["long_prompt_starvation"]
+    max_len = min(cfg.max_position_embeddings,
+                  2 * (m["long_prompt"] + m["long_new"]))
+    block_size = 16
+    pool_blocks = slots * (max_len // block_size)   # slot-layout bytes
+    rng = np.random.RandomState(1)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    rows = {"mix": "long_prompt_starvation", "max_len": max_len,
+            "pool_tokens": pool_blocks * block_size}
+    for layout in layouts:
+        engine_kw = dict(max_slots=slots, max_len=max_len)
+        if layout == "paged":
+            engine_kw.update(cache_layout="paged", block_size=block_size,
+                             num_blocks=pool_blocks, max_slots=4 * slots)
+        reqs = _mix_requests(rng, cfg.vocab_size, m)
+        ServingEngine(params, cfg, **engine_kw).run(reqs)  # warmup
+        engine = ServingEngine(params, cfg, **engine_kw)
+        resps, wall, hw = _drive_engine(engine, reqs)
+        gen_tokens = sum(r.tokens.size for r in resps)
+        row = {
+            "cache_layout": layout,
+            "decode_tokens_per_sec": round(gen_tokens / wall, 1),
+            "max_concurrent_requests": hw,
+            "requests": len(reqs),
+            "wall_ms": round(wall * 1e3, 2),
+            "kv_bytes": int((engine.cache["k"].size
+                             + engine.cache["v"].size)
+                            * engine.cache["k"].dtype.itemsize),
+        }
+        if layout == "paged":
+            st = engine.stats()
+            row["preemptions"] = st["preemptions"]
+            row["num_blocks"] = st["num_blocks"]
+        rows[layout] = row
+    if "contiguous" in rows and "paged" in rows:
+        rows["paged_over_contiguous_concurrency"] = round(
+            rows["paged"]["max_concurrent_requests"]
+            / max(rows["contiguous"]["max_concurrent_requests"], 1), 2)
     return rows
 
 
@@ -752,7 +858,8 @@ def bench_tp_overlap(on_tpu):
 # run modes can never report differently-configured rows under one name
 _DECODE_ROWS = (
     ("gpt2_125m_decode", bench_decode),
-    ("gpt2_125m_gqa4_decode", lambda t: bench_decode(t, query_groups=4)),
+    ("gpt2_125m_gqa4_decode",
+     lambda t, **kw: bench_decode(t, query_groups=4, **kw)),
 )
 
 
@@ -808,7 +915,18 @@ def main():
         help="run ONLY the inference rows (prefill/decode split + GQA "
              "variant + the continuous-batching serving mixes) instead "
              "of the full matrix")
+    parser.add_argument(
+        "--cache-layout", default="contiguous", metavar="LAYOUTS",
+        help="comma list of KV cache layouts (contiguous, paged) for "
+             "the --decode rows; more than one also emits the "
+             "matched-HBM cache_layout_ablation row (ISSUE 6)")
     args = parser.parse_args()
+    layouts = tuple(
+        l.strip() for l in args.cache_layout.split(",") if l.strip())
+    bad = [l for l in layouts if l not in ("contiguous", "paged")]
+    if bad or not layouts:
+        parser.error(f"--cache-layout {args.cache_layout!r}: expected a "
+                     "comma list of contiguous, paged")
     # APEX_TPU_TELEMETRY=<path> streams every row's StepTimer span into
     # the shared JSONL schema alongside the headline JSON line
     # (APEX_TPU_TELEMETRY_TRACE=<path> adds the Perfetto timeline).
@@ -853,18 +971,35 @@ def main():
         return
     if args.decode:
         details = {}
-        for name, fn in (
-            *_DECODE_ROWS,
-            ("serving_continuous_batching", bench_serving),
-        ):
+        for layout in layouts:
+            # the contiguous rows keep their BENCH-continuity names;
+            # other layouts suffix (and every row body carries
+            # "cache_layout") so trajectories never mix layouts
+            sfx = "" if layout == "contiguous" else f"_{layout}"
+            for name, fn in (
+                *_DECODE_ROWS,
+                ("serving_continuous_batching", bench_serving),
+            ):
+                try:
+                    details[name + sfx] = fn(on_tpu, cache_layout=layout)
+                except Exception as e:
+                    details[name + sfx] = {
+                        "error": f"{type(e).__name__}: {e}"[:200]}
+        if len(layouts) > 1:
             try:
-                details[name] = fn(on_tpu)
+                details["cache_layout_ablation"] = (
+                    bench_cache_layout_ablation(on_tpu, layouts))
             except Exception as e:
-                details[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+                details["cache_layout_ablation"] = {
+                    "error": f"{type(e).__name__}: {e}"[:200]}
+        # headline = the first requested layout's decode row (a
+        # paged-only run must not report 0.0 just because the
+        # unsuffixed contiguous key is absent)
+        head_sfx = "" if layouts[0] == "contiguous" else f"_{layouts[0]}"
         print(json.dumps({
             "schema_version": SCHEMA_VERSION,
             "metric": "gpt2_125m_decode_tokens_per_sec",
-            "value": details.get("gpt2_125m_decode", {}).get(
+            "value": details.get("gpt2_125m_decode" + head_sfx, {}).get(
                 "decode_tokens_per_sec", 0.0),
             "unit": "tokens/s",
             "details": details,

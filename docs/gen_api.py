@@ -51,6 +51,8 @@ MODULES = [
     ("apex_tpu.ops.flat_adam", "ops", "ops.flat_adam — flat Adam"),
     ("apex_tpu.ops.collective_matmul", "ops",
      "ops.collective_matmul — overlapped ring TP collectives"),
+    ("apex_tpu.ops.paged_attention", "ops",
+     "ops.paged_attention — ragged paged-attention decode kernel"),
     # comm
     ("apex_tpu.comm", "comm",
      "apex_tpu.comm — compressed gradient collectives"),
@@ -114,6 +116,8 @@ MODULES = [
      "serving.engine — ServingEngine + Request/Response"),
     ("apex_tpu.serving.batching", "serving",
      "serving.batching — prompt buckets + slot pool"),
+    ("apex_tpu.serving.paged_cache", "serving",
+     "serving.paged_cache — block pool, block tables, prefix sharing"),
     # data
     ("apex_tpu.data.image_folder", "data",
      "data.image_folder — file-backed input pipeline"),

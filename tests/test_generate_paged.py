@@ -1,0 +1,221 @@
+"""Paged KV-cache decoding (models/generate.py cache_layout="paged"):
+layout equivalence against the contiguous stripe cache, prefill-vs-
+stepwise page equivalence, and the removed scalar-pos path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.generate import (
+    decode_step, generate, init_kv_cache, prefill)
+from apex_tpu.models.transformer_lm import gpt_forward, init_gpt_params
+
+
+def _cfg(**kw):
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("remat", False)
+    return TransformerConfig(**kw)
+
+
+def _ragged_batch(rng, vocab, lens):
+    prompts = [rng.randint(0, vocab, (n,)).astype(np.int32) for n in lens]
+    batch = np.zeros((len(lens), max(lens)), np.int32)
+    for i, p in enumerate(prompts):
+        batch[i, : len(p)] = p
+    return jnp.asarray(batch), prompts
+
+
+class TestPagedCacheInit:
+    def test_paged_shapes_and_linear_tables(self):
+        cfg = _cfg()
+        cache = init_kv_cache(cfg, 3, 20, cache_layout="paged",
+                              block_size=8)
+        mb = 3                                   # ceil(20/8)
+        assert cache["k"].shape == (2, 9, 8, 4, 16)   # [L, nb, bs, g, dh]
+        assert cache["block_tables"].shape == (3, mb)
+        np.testing.assert_array_equal(
+            np.asarray(cache["block_tables"]),
+            np.arange(9).reshape(3, 3))
+        assert cache["pos"].shape == (3,)
+
+    def test_bad_layout_raises(self):
+        cfg = _cfg()
+        with pytest.raises(ValueError, match="cache_layout"):
+            init_kv_cache(cfg, 1, 8, cache_layout="slabbed")
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        with pytest.raises(ValueError, match="cache_layout"):
+            generate(params, jnp.asarray([[1, 2]], jnp.int32), cfg,
+                     max_new_tokens=2, cache_layout="slabbed")
+
+    def test_cache_dtype_override(self):
+        cfg = _cfg()
+        cache = init_kv_cache(cfg, 2, 16, cache_dtype=jnp.bfloat16,
+                              cache_layout="paged", block_size=8)
+        assert cache["k"].dtype == jnp.bfloat16
+
+
+class TestScalarPosRemoved:
+    def test_scalar_pos_cache_raises(self):
+        """PR 6 satellite: the legacy scalar-counter broadcast path is
+        gone — a scalar pos is a stale-caller bug and must fail loudly,
+        not silently broadcast."""
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        cache = init_kv_cache(cfg, 2, 8)
+        cache["pos"] = jnp.int32(0)              # legacy scalar form
+        with pytest.raises(ValueError, match="scalar-counter"):
+            decode_step(params, jnp.asarray([1, 2], jnp.int32), cache,
+                        cfg)
+
+
+# the equivalence suites run every case under both layouts; paged adds
+# a deliberately awkward block_size (prompt lengths straddle blocks)
+LAYOUTS = [("contiguous", None), ("paged", 4), ("paged", 8)]
+
+
+class TestLayoutEquivalence:
+    @pytest.mark.parametrize("variant", [
+        {},
+        {"position_embedding_type": "rope", "num_query_groups": 2},
+        pytest.param({"activation": "swiglu", "normalization": "rmsnorm"},
+                     marks=pytest.mark.slow),
+    ])
+    def test_paged_greedy_matches_contiguous(self, variant):
+        """The tentpole acceptance pin: paged decode must be
+        token-for-token identical to contiguous decode under greedy
+        sampling, ragged batch included."""
+        cfg = _cfg(**variant)
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.RandomState(0)
+        lens = [3, 9, 6]                          # straddle bs=4 and 8
+        batch, _ = _ragged_batch(rng, cfg.vocab_size, lens)
+        new = 7
+        want = np.asarray(generate(
+            params, batch, cfg, max_new_tokens=new,
+            prompt_lens=jnp.asarray(lens)))
+        for bs in (4, 8):
+            got = np.asarray(generate(
+                params, batch, cfg, max_new_tokens=new,
+                prompt_lens=jnp.asarray(lens), cache_layout="paged",
+                block_size=bs))
+            np.testing.assert_array_equal(got, want,
+                                          err_msg=f"block_size={bs}")
+
+    def test_eos_early_exit_matches(self):
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(2), cfg)
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        ref = np.asarray(generate(params, prompt, cfg, max_new_tokens=8))
+        eos = int(ref[0, 4])
+        a = np.asarray(generate(params, prompt, cfg, max_new_tokens=8,
+                                eos_token_id=eos))
+        b = np.asarray(generate(params, prompt, cfg, max_new_tokens=8,
+                                eos_token_id=eos, cache_layout="paged",
+                                block_size=4))
+        np.testing.assert_array_equal(a, b)
+
+    def test_sampling_seeded_identical_across_layouts(self):
+        """Same rng + same logits ⇒ the sampled trajectory must agree
+        across layouts too (the sampler sees identical inputs)."""
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(3), cfg)
+        prompt = jnp.asarray([[5, 6, 7]], jnp.int32)
+        a = generate(params, prompt, cfg, max_new_tokens=6,
+                     temperature=0.9, top_k=8,
+                     rng=jax.random.PRNGKey(11))
+        b = generate(params, prompt, cfg, max_new_tokens=6,
+                     temperature=0.9, top_k=8,
+                     rng=jax.random.PRNGKey(11), cache_layout="paged",
+                     block_size=4)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestPagedPrefill:
+    @pytest.mark.parametrize("variant,n", [
+        ({}, 8),                                   # n % bs == 0
+        ({}, 9),                                   # n % bs == 1
+        ({"position_embedding_type": "rope", "num_query_groups": 2}, 7),
+    ])
+    def test_prefill_pages_match_stepwise_decode(self, variant, n):
+        """Filling the pool by whole-page prefill scatter and by
+        feeding tokens one-by-one through the paged decode must land
+        the same K/V in the same physical cells — the cache-equivalence
+        contract, paged edition, at block-boundary lengths."""
+        cfg = _cfg(**variant)
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.RandomState(0)
+        b = 2
+        tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, n)),
+                             jnp.int32)
+        step = init_kv_cache(cfg, b, n + 2, cache_layout="paged",
+                             block_size=4)
+        for i in range(n):
+            _, step = decode_step(params, tokens[:, i], step, cfg)
+        pre = init_kv_cache(cfg, b, n + 2, cache_layout="paged",
+                            block_size=4)
+        logits, pre = prefill(params, tokens, cfg, cache=pre)
+        np.testing.assert_allclose(
+            np.asarray(pre["k"]), np.asarray(step["k"]),
+            atol=2e-4, rtol=2e-4, err_msg=f"{variant} n={n} k")
+        np.testing.assert_allclose(
+            np.asarray(pre["v"]), np.asarray(step["v"]),
+            atol=2e-4, rtol=2e-4, err_msg=f"{variant} n={n} v")
+        np.testing.assert_array_equal(np.asarray(pre["pos"]),
+                                      np.full((b,), n))
+        want = np.asarray(gpt_forward(params, tokens, cfg))[:, -1]
+        np.testing.assert_allclose(np.asarray(logits), want,
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_prefill_then_decode_seam(self):
+        """Teacher-forcing across the prefill/decode seam on the paged
+        cache: decode logits must match the full forward at every
+        position past the prefill."""
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(1), cfg)
+        rng = np.random.RandomState(1)
+        b, s, tail = 2, 11, 4
+        tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, s)),
+                             jnp.int32)
+        want = np.asarray(gpt_forward(params, tokens, cfg))
+        head = s - tail
+        cache = init_kv_cache(cfg, b, s, cache_layout="paged",
+                              block_size=4)
+        logits, cache = prefill(params, tokens[:, :head], cfg,
+                                cache=cache)
+        np.testing.assert_allclose(np.asarray(logits), want[:, head - 1],
+                                   atol=2e-4, rtol=2e-4)
+        for i in range(head, s):
+            logits, cache = decode_step(params, tokens[:, i], cache, cfg)
+            np.testing.assert_allclose(
+                np.asarray(logits), want[:, i], atol=2e-4, rtol=2e-4,
+                err_msg=f"position {i}")
+
+    def test_ragged_prefill_never_writes_other_rows_blocks(self):
+        """Row padding must DROP, not spill into pool blocks owned by
+        other rows: prefill a ragged pair, then check every block not
+        in row 0's table is bit-identical to a solo prefill of row 1."""
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(2), cfg)
+        rng = np.random.RandomState(2)
+        lens = [3, 10]
+        batch, prompts = _ragged_batch(rng, cfg.vocab_size, lens)
+        cache = init_kv_cache(cfg, 2, 12, cache_layout="paged",
+                              block_size=4)
+        _, cache = prefill(params, batch, cfg,
+                           prompt_lens=jnp.asarray(lens), cache=cache)
+        solo = init_kv_cache(cfg, 1, 12, cache_layout="paged",
+                             block_size=4)
+        _, solo = prefill(params, jnp.asarray(prompts[1][None]), cfg,
+                          cache=solo)
+        # row 1 owns blocks [3, 6) of the shared pool; solo's row owns
+        # [0, 3) of its own — same logical content either way
+        np.testing.assert_allclose(
+            np.asarray(cache["k"])[:, 3:6], np.asarray(solo["k"])[:, :3],
+            atol=2e-4, rtol=2e-4)

@@ -1,0 +1,431 @@
+"""Paged serving engine: block manager, block-budget admission,
+prefix sharing, preempt→resume, and the HBM-scaling acceptance pin."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.generate import generate
+from apex_tpu.models.transformer_lm import init_gpt_params
+from apex_tpu.serving import (
+    BlockManager, ServingEngine, blocks_for, prefix_block_hashes)
+
+
+def _cfg(**kw):
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("remat", False)
+    return TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+class TestBlockManager:
+    def test_alloc_free_reuse(self):
+        mgr = BlockManager(3, 4)
+        a, b, c = mgr.alloc(), mgr.alloc(), mgr.alloc()
+        assert {a, b, c} == {0, 1, 2}
+        assert mgr.alloc() is None and mgr.n_free == 0
+        assert mgr.decref(b)
+        assert mgr.n_free == 1 and mgr.n_in_use == 2
+        assert mgr.alloc() == b                   # defrag-free reuse
+        with pytest.raises(ValueError, match="not allocated"):
+            mgr.decref(99)
+
+    def test_refcounted_prefix_sharing(self):
+        mgr = BlockManager(4, 4)
+        blk = mgr.alloc()
+        mgr.publish_prefix(123, blk)
+        assert mgr.share_prefix(123) == blk
+        assert mgr.refcount(blk) == 2 and mgr.n_shared == 1
+        assert not mgr.decref(blk)                # one owner left
+        assert mgr.decref(blk)                    # last owner frees
+        assert mgr.lookup_prefix(123) is None     # unpublished on free
+        assert mgr.share_prefix(123) is None
+
+    def test_ensure_private_cow(self):
+        mgr = BlockManager(3, 4)
+        blk = mgr.alloc()
+        assert mgr.ensure_private(blk) == (blk, False)   # already private
+        mgr.incref(blk)
+        fresh, copied = mgr.ensure_private(blk)
+        assert copied and fresh != blk
+        assert mgr.refcount(blk) == 1 and mgr.refcount(fresh) == 1
+        # exhausted pool: CoW reports (None, True) so the caller preempts
+        mgr.incref(blk)
+        mgr.alloc()                                # last free block gone
+        assert mgr.ensure_private(blk) == (None, True)
+
+    def test_prefix_block_hashes_chain(self):
+        toks = np.arange(12, dtype=np.int32)
+        h = prefix_block_hashes(toks, 4)
+        assert len(h) == 3                         # full blocks only
+        # chained: a different FIRST block changes every later hash
+        other = toks.copy()
+        other[0] += 1
+        h2 = prefix_block_hashes(other, 4)
+        assert h[0] != h2[0] and h[1] != h2[1] and h[2] != h2[2]
+        # identical prefix, divergent tail: shared prefix hashes match
+        div = toks.copy()
+        div[9] += 1
+        h3 = prefix_block_hashes(div, 4)
+        assert h3[0] == h[0] and h3[1] == h[1] and h3[2] != h[2]
+
+    def test_blocks_for(self):
+        assert blocks_for(0, 8) == 0
+        assert blocks_for(1, 8) == 1
+        assert blocks_for(8, 8) == 1
+        assert blocks_for(9, 8) == 2
+
+
+class TestPagedEngineParity:
+    def test_mixed_lengths_match_generate(self, model):
+        """The contiguous-engine oracle test, paged edition: more
+        requests than lanes, ragged lengths, greedy — every response
+        token-identical to generate()."""
+        cfg, params = model
+        rng = np.random.RandomState(0)
+        lens = [3, 7, 5]
+        new = 6
+        prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in lens]
+        batch = np.zeros((len(lens), max(lens)), np.int32)
+        for i, p in enumerate(prompts):
+            batch[i, : len(p)] = p
+        want = np.asarray(generate(
+            params, jnp.asarray(batch), cfg, max_new_tokens=new,
+            prompt_lens=jnp.asarray(lens)))
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               prompt_buckets=(8,), cache_layout="paged",
+                               block_size=8)
+        resps = engine.run([dict(prompt=p, max_new_tokens=new)
+                            for p in prompts])
+        assert [r.request_id for r in resps] == [0, 1, 2]
+        for r, n in zip(resps, lens):
+            np.testing.assert_array_equal(
+                r.tokens, want[r.request_id, n: n + new],
+                err_msg=f"request {r.request_id}")
+        assert engine.idle
+        assert engine.stats()["blocks_in_use"] == 0   # all freed
+
+    def test_bf16_pool_and_stats(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(3)
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               prompt_buckets=(8,), cache_layout="paged",
+                               block_size=8, cache_dtype=jnp.bfloat16)
+        assert engine.cache["k"].dtype == jnp.bfloat16
+        st = engine.stats()
+        assert st["cache_layout"] == "paged"
+        assert st["num_blocks"] == 2 * 4          # max_slots * ceil(32/8)
+        resps = engine.run([
+            dict(prompt=rng.randint(0, cfg.vocab_size, (5,)),
+                 max_new_tokens=4, temperature=0.9),
+            dict(prompt=rng.randint(0, cfg.vocab_size, (5,)),
+                 max_new_tokens=4),
+        ])
+        assert len(resps) == 2
+        assert engine.stats()["blocks_free"] == 8
+
+    def test_submit_rejects_uncompletable_request(self, model):
+        cfg, params = model
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               cache_layout="paged", block_size=4,
+                               num_blocks=4, reserve_blocks=0)
+        with pytest.raises(ValueError, match="never run to completion"):
+            engine.submit(np.arange(10), max_new_tokens=10)
+
+
+class TestPrefixSharing:
+    def test_identical_system_prompts_share_blocks(self, model):
+        """Three requests with the same 17-token prompt at bs=8: the 2
+        full prompt blocks are physically shared by the two later
+        admissions (4 saved blocks), the partial tail stays private —
+        and decode output is still exact."""
+        from apex_tpu.observability import metrics as telemetry
+
+        cfg, params = model
+        rng = np.random.RandomState(5)
+        sysp = rng.randint(0, cfg.vocab_size, (17,)).astype(np.int32)
+        want = np.asarray(generate(params, jnp.asarray(sysp[None]), cfg,
+                                   max_new_tokens=4))[0, 17:]
+        reg = telemetry.configure()
+        try:
+            engine = ServingEngine(params, cfg, max_slots=3, max_len=32,
+                                   prompt_buckets=(32,),
+                                   cache_layout="paged", block_size=8)
+            for _ in range(3):
+                engine.submit(sysp, max_new_tokens=4)
+            engine._admit()
+            st = engine.stats()
+            # 3 requests x (2 full + 1 tail) logical blocks on only
+            # 2 + 3x1 physical allocations
+            assert st["prefix_shared_blocks"] == 4, st
+            assert st["blocks_in_use"] == 5, st
+            resps = engine.run([])
+            for r in resps:
+                np.testing.assert_array_equal(r.tokens, want)
+            assert engine.stats()["blocks_in_use"] == 0
+            summ = reg.summary()
+            assert summ["gauges"]["serving.prefix_shared_blocks"] == 0.0
+        finally:
+            telemetry.shutdown()
+
+    def test_divergent_prompts_do_not_share(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(6)
+        a = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+        b = a.copy()
+        b[0] += 1                                  # first block differs
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               prompt_buckets=(16,),
+                               cache_layout="paged", block_size=8)
+        engine.submit(a, max_new_tokens=2)
+        engine.submit(b, max_new_tokens=2)
+        engine._admit()
+        assert engine.stats()["prefix_shared_blocks"] == 0
+
+
+class TestPreemption:
+    def test_preempt_resume_greedy_parity(self, model):
+        """The acceptance pin: greedy output must survive a
+        preempt→resume cycle token-for-token (resume replays
+        prompt+generated through the batched flash prefill)."""
+        from apex_tpu.observability import metrics as telemetry
+
+        cfg, params = model
+        rng = np.random.RandomState(7)
+        p1 = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+        p2 = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+        reg = telemetry.configure()
+        try:
+            # 6 blocks of 4: both admit (2 blocks each), both outgrow
+            # the pool mid-decode -> the youngest gets preempted
+            engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                                   prompt_buckets=(8,),
+                                   cache_layout="paged", block_size=4,
+                                   num_blocks=6, reserve_blocks=0)
+            resps = engine.run([dict(prompt=p1, max_new_tokens=10),
+                                dict(prompt=p2, max_new_tokens=10)])
+            assert reg.counter("serving.preemptions").value >= 1
+            for r, p in zip(resps, (p1, p2)):
+                solo = np.asarray(generate(
+                    params, jnp.asarray(p[None]), cfg,
+                    max_new_tokens=10))[0, 6:]
+                np.testing.assert_array_equal(
+                    r.tokens, solo, err_msg=f"request {r.request_id}")
+            assert engine.idle
+            assert engine.stats()["blocks_in_use"] == 0
+        finally:
+            telemetry.shutdown()
+
+    def test_preemption_frees_blocks_and_requeues(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(8)
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               prompt_buckets=(8,), cache_layout="paged",
+                               block_size=4, num_blocks=6,
+                               reserve_blocks=0)
+        engine.submit(rng.randint(0, cfg.vocab_size, (8,)),
+                      max_new_tokens=12)
+        engine.submit(rng.randint(0, cfg.vocab_size, (8,)),
+                      max_new_tokens=12)
+        engine._admit()
+        assert engine.stats()["blocks_in_use"] == 4
+        # drive decode until the pool forces a preemption
+        saw_preempt = False
+        for _ in range(30):
+            engine.step()
+            if engine.stats()["queued"] and engine.stats()["active"]:
+                saw_preempt = True
+                # the youngest (request 1) was evicted with progress
+                assert engine._queue[0].request_id == 1
+                assert engine._queue[0].resume_tokens
+                break
+        assert saw_preempt
+        resps = engine.run([])
+        assert sorted(r.request_id for r in resps) == [0, 1]
+        assert all(r.tokens.size == 12 for r in resps)
+        # every admission (initial + each resume) samples its first
+        # token from prefill logits, so a preempted request's decode
+        # steps must discount one token per preemption
+        by_id = {r.request_id: r for r in resps}
+        assert by_id[0].decode_steps == 11
+        preempts = engine.stats()["preemptions"]
+        assert preempts >= 1
+        assert by_id[1].decode_steps == 11 - preempts
+
+
+class TestAdmitUnwind:
+    @pytest.mark.parametrize("layout", ["contiguous", "paged"])
+    def test_prefill_failure_leaks_nothing_drops_nothing(
+            self, model, layout, monkeypatch):
+        """ISSUE 6 satellite: a prefill raising mid-``_admit_one`` (a
+        transient device OOM / XLA error) must neither leak the claimed
+        slot or blocks nor drop the request — the engine stays
+        drainable and a retry serves the request normally."""
+        import apex_tpu.serving.engine as engine_mod
+
+        cfg, params = model
+        rng = np.random.RandomState(11)
+        prompt = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+        want = np.asarray(generate(params, jnp.asarray(prompt[None]), cfg,
+                                   max_new_tokens=4))[0, 6:]
+        kw = dict(cache_layout="paged", block_size=4) \
+            if layout == "paged" else {}
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               prompt_buckets=(8,), **kw)
+        rid = engine.submit(prompt, max_new_tokens=4)
+
+        real_prefill = engine_mod.prefill
+        boom = {"armed": True}
+
+        def flaky_prefill(*a, **k):
+            if boom["armed"]:
+                boom["armed"] = False
+                raise RuntimeError("injected transient prefill failure")
+            return real_prefill(*a, **k)
+
+        monkeypatch.setattr(engine_mod, "prefill", flaky_prefill)
+        with pytest.raises(RuntimeError, match="injected"):
+            engine.step()
+        # nothing leaked: every lane free again, every block back in
+        # the pool (shared-prefix publications unwound with them)
+        assert engine._pool.n_free == 2
+        if layout == "paged":
+            assert engine._mgr.n_in_use == 0
+            assert engine.stats()["blocks_in_use"] == 0
+        # and the request was not dropped: still at the queue front
+        assert engine.stats()["queued"] == 1
+        assert engine._queue[0].request_id == rid
+        # the retry (prefill healthy again) serves it token-exactly
+        resps = engine.run([])
+        assert [r.request_id for r in resps] == [rid]
+        np.testing.assert_array_equal(resps[0].tokens, want)
+        assert engine.idle
+        assert engine._pool.n_free == 2
+
+    def test_post_prefill_failure_unwinds_blocks(
+            self, model, monkeypatch):
+        """A raise AFTER the prefill but before the slot handoff (a
+        telemetry sink, the HBM sample) must unwind the claimed blocks
+        too — they are attached to no ``_Slot`` yet, so nothing else
+        would ever free them."""
+        import apex_tpu.serving.engine as engine_mod
+
+        cfg, params = model
+        rng = np.random.RandomState(12)
+        prompt = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               prompt_buckets=(8,),
+                               cache_layout="paged", block_size=4)
+        rid = engine.submit(prompt, max_new_tokens=4)
+
+        real_hist = engine_mod._telemetry.histogram
+        boom = {"armed": True}
+
+        def flaky_histogram(name, *a, **k):
+            if name == "serving.prefill_ms" and boom["armed"]:
+                boom["armed"] = False
+                raise RuntimeError("injected post-prefill failure")
+            return real_hist(name, *a, **k)
+
+        monkeypatch.setattr(engine_mod._telemetry, "histogram",
+                            flaky_histogram)
+        with pytest.raises(RuntimeError, match="post-prefill"):
+            engine.step()
+        assert engine._mgr.n_in_use == 0
+        assert engine._pool.n_free == 2
+        assert engine._queue[0].request_id == rid
+        resps = engine.run([])
+        assert [r.request_id for r in resps] == [rid]
+        assert engine._mgr.n_in_use == 0
+
+
+class TestHBMScaling:
+    def test_paged_admits_2x_requests_at_matched_pool_bytes(self, model):
+        """The acceptance pin of the whole layout change: at MATCHED KV
+        bytes, the block pool must carry ≥ 2× the concurrent requests
+        of the slot layout under a long-prompt starvation mix — because
+        slot admission reserves max_len per request while paged
+        admission reserves only the blocks actually touched.  Also
+        exercises the serving.blocks_in_use telemetry stream."""
+        from apex_tpu.observability import metrics as telemetry
+
+        cfg, params = model
+        rng = np.random.RandomState(9)
+        S, M, bs = 2, 64, 8
+        pool_tokens = S * M                        # slot-layout KV bytes
+        # the starvation mix: one long-prompt request pinning a lane
+        # for many steps + a stream of short requests
+        reqs = [dict(prompt=rng.randint(0, cfg.vocab_size, (40,)),
+                     max_new_tokens=16)]
+        reqs += [dict(prompt=rng.randint(0, cfg.vocab_size, (4,)),
+                      max_new_tokens=4) for _ in range(6)]
+
+        def high_water(engine):
+            for kw in reqs:
+                engine.submit(**kw)
+            hw = 0
+            while not engine.idle:
+                engine.step()
+                hw = max(hw, engine.stats()["active"])
+            return hw
+
+        slot_eng = ServingEngine(params, cfg, max_slots=S, max_len=M)
+        slot_hw = high_water(slot_eng)
+        assert slot_hw <= S                        # slots cap it at 2
+
+        reg = telemetry.configure()
+        try:
+            paged_eng = ServingEngine(
+                params, cfg, max_slots=4 * S, max_len=M,
+                cache_layout="paged", block_size=bs,
+                num_blocks=pool_tokens // bs)      # same KV bytes
+            paged_hw = high_water(paged_eng)
+            assert paged_hw >= 2 * slot_hw, (paged_hw, slot_hw)
+            summ = reg.summary()
+            blocks_seen = summ["gauges"]["serving.blocks_in_use"]
+            assert blocks_seen == 0.0              # drained at the end
+            # and the stream actually moved while requests were live
+            hw_blocks = max(
+                reg.gauge("serving.blocks_in_use").value, 0)
+            assert "serving.blocks_free" in summ["gauges"]
+        finally:
+            telemetry.shutdown()
+
+    def test_cache_bytes_scale_with_blocks_not_slots(self, model):
+        """Direct byte accounting: doubling max_slots leaves the paged
+        pool untouched, while the slot layout doubles."""
+        cfg, params = model
+
+        def kv_bytes(engine):
+            return (engine.cache["k"].size + engine.cache["v"].size
+                    ) * engine.cache["k"].dtype.itemsize
+
+        slot2 = ServingEngine(params, cfg, max_slots=2, max_len=64)
+        slot4 = ServingEngine(params, cfg, max_slots=4, max_len=64)
+        assert kv_bytes(slot4) == 2 * kv_bytes(slot2)
+        paged2 = ServingEngine(params, cfg, max_slots=2, max_len=64,
+                               cache_layout="paged", block_size=8,
+                               num_blocks=16)
+        paged4 = ServingEngine(params, cfg, max_slots=4, max_len=64,
+                               cache_layout="paged", block_size=8,
+                               num_blocks=16)
+        assert kv_bytes(paged4) == kv_bytes(paged2)
+        # and at the default num_blocks the pool is byte-parity with
+        # the slot layout (same worst case, now divisible)
+        paged_dflt = ServingEngine(params, cfg, max_slots=2, max_len=64,
+                                   cache_layout="paged", block_size=8)
+        assert kv_bytes(paged_dflt) == kv_bytes(slot2)

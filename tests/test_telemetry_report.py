@@ -158,6 +158,54 @@ def test_ring_summary_derives_tp(report, tmp_path):
     assert mixed["tp"] is None
 
 
+def test_serving_summary_fixture(report, tmp_path):
+    """ISSUE 6 satellite: the paged serving gauges/counters get a
+    derived view — block-pool high-water, preemption rate per admitted
+    request, and the prefix-share ratio at the pool high-water."""
+    f = tmp_path / "serving.jsonl"
+    f.write_text(
+        '{"schema_version":2,"t":1,"type":"gauge",'
+        '"name":"serving.blocks_in_use","value":3}\n'
+        '{"schema_version":2,"t":2,"type":"gauge",'
+        '"name":"serving.blocks_in_use","value":10}\n'
+        '{"schema_version":2,"t":3,"type":"gauge",'
+        '"name":"serving.blocks_in_use","value":0}\n'
+        '{"schema_version":2,"t":4,"type":"gauge",'
+        '"name":"serving.prefix_shared_blocks","value":4}\n'
+        '{"schema_version":2,"t":5,"type":"counter",'
+        '"name":"serving.requests","value":8}\n'
+        '{"schema_version":2,"t":6,"type":"counter",'
+        '"name":"serving.preemptions","value":2}\n')
+    summ = report.summarize(report.load_records([str(f)]))
+    serving = report.serving_summary(summ)
+    assert serving["blocks_high_water"] == 10
+    assert serving["blocks_last"] == 0            # drained, no leak
+    assert serving["preemption_rate"] == 0.25
+    assert serving["prefix_shared_high_water"] == 4
+    # unequal series lengths (truncated stream): upper-bound fallback
+    assert serving["prefix_share_ratio"] == 0.4
+    # the engine emits both gauges in lockstep — equal-length series
+    # pair record-for-record, and the ratio is the shared count AT the
+    # high-water instant, not the stream max (which can postdate it)
+    paired = report.serving_summary({
+        "gauges": {"serving.blocks_in_use": [3.0, 10.0, 5.0],
+                   "serving.prefix_shared_blocks": [0.0, 2.0, 4.0]},
+        "counters": {"serving.requests": 8.0}})
+    assert paired["prefix_share_ratio"] == 0.2    # 2/10, not 4/10
+    assert paired["prefix_shared_high_water"] == 4
+    out = io.StringIO()
+    report.print_report(summ, out=out)
+    text = out.getvalue()
+    assert "paged serving" in text
+    assert "block-pool high-water 10" in text
+    assert "rate 0.25" in text
+    assert "share ratio 0.4" in text
+    # a contiguous-engine stream (no block gauges) -> no section
+    assert report.serving_summary(
+        {"gauges": {"serving.queue_depth": [1.0]},
+         "counters": {"serving.requests": 3.0}}) is None
+
+
 def test_since_step_cli_flag(report, tmp_path, capsys):
     f = tmp_path / "steps.jsonl"
     f.write_text(

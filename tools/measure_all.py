@@ -14,8 +14,10 @@ ended — costs the least-valuable stages:
 
 1. ``bench.py`` — the BASELINE.md workload matrix (GPT/RN50/BERT/RNN-T/
    MoE/decode/long-context/cp-compare rows), one JSON line; then
-   ``bench.py --decode`` — the inference fast path rows (prefill/decode
-   split + continuous-batching serving mixes) as their own JSON line;
+   ``bench.py --decode --cache-layout contiguous,paged`` — the
+   inference fast path rows (prefill/decode split + continuous-batching
+   serving mixes, both KV layouts + the matched-HBM paged ablation) as
+   their own JSON line;
    then ``bench.py --tp-overlap`` — the ring collective-matmul off/on
    ablation rows — and the ``tp_overlap`` dryrun parity phase
    (overlapped == monolithic fwd+bwd on the 8-virtual-device mesh).
@@ -136,10 +138,17 @@ def main():
                             timeout=3600)
     # the inference fast path (prefill/decode split + serving engine):
     # its own stage so the decode rows land in a dedicated JSON line
-    # (BENCH-comparable) even if the full matrix above partially failed
+    # (BENCH-comparable) even if the full matrix above partially
+    # failed.  --cache-layout contiguous,paged (ISSUE 6) adds the
+    # paged rows and the matched-HBM cache_layout_ablation row
+    # (starvation-mix concurrency + preemption counts); every row
+    # carries its layout so trajectory comparisons never mix the two
+    # 3600s: the two-layout sweep roughly triples the single-layout
+    # stage (every row twice + the starvation mixes + the ablation)
     results["bench_decode"] = _run(
-        "bench_decode", [sys.executable, "bench.py", "--decode"],
-        timeout=1800)
+        "bench_decode", [sys.executable, "bench.py", "--decode",
+                         "--cache-layout", "contiguous,paged"],
+        timeout=3600)
     # TP comm overlap (ISSUE 5): the ring collective-matmul off/on
     # ablation rows, then the tp_overlap dryrun parity phase alone on
     # the 8-virtual-device mesh (overlapped == monolithic fwd+bwd and

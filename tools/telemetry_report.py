@@ -16,7 +16,11 @@ prints:
   files, so both multi-host runs and repeated runs into one path
   aggregate correctly;
 - gauges: count, last, min, max;
-- events: count per name.
+- events: count per name;
+- derived views when their series are present: ring collectives
+  (``collectives.ring.*`` → implied tp) and the paged serving engine
+  (``serving.blocks_*`` + ``serving.preemptions`` → block-pool
+  high-water, preemption rate, prefix-share ratio).
 
 ``--since-step N`` keeps only records stamped with ``step >= N``
 (schema v2 stamps every record emitted after the loop declared a step
@@ -160,6 +164,43 @@ def ring_summary(counters: Dict[str, float]) -> Optional[dict]:
     }
 
 
+def serving_summary(summary: dict) -> Optional[dict]:
+    """Derived view of the paged serving engine's telemetry (ISSUE 6):
+    block-pool high-water mark, preemption rate per admitted request,
+    and the prefix-share ratio — shared physical blocks at the pool's
+    high-water instant are the HBM that sharing saved.  None when the
+    stream carries no paged-pool gauges (contiguous engines emit only
+    the slot/queue series)."""
+    gauges = summary["gauges"]
+    in_use = gauges.get("serving.blocks_in_use")
+    if not in_use:
+        return None
+    counters = summary["counters"]
+    high_water = max(in_use)
+    shared = gauges.get("serving.prefix_shared_blocks", [0.0])
+    # the engine sets both gauges in the same _set_gauges call, so the
+    # series align record-for-record and "shared at the high-water
+    # instant" is the paired sample; a truncated/merged stream where
+    # they diverge falls back to the series max (an upper bound)
+    if len(shared) == len(in_use):
+        shared_at_hw = shared[max(range(len(in_use)),
+                                  key=in_use.__getitem__)]
+    else:
+        shared_at_hw = max(shared)
+    requests = counters.get("serving.requests", 0.0)
+    preemptions = counters.get("serving.preemptions", 0.0)
+    return {
+        "blocks_high_water": high_water,
+        "blocks_last": in_use[-1],
+        "preemptions": preemptions,
+        "requests": requests,
+        "preemption_rate": (preemptions / requests) if requests else 0.0,
+        "prefix_shared_high_water": max(shared),
+        "prefix_share_ratio": (shared_at_hw / high_water) if high_water
+        else 0.0,
+    }
+
+
 def print_report(summary: dict, out=None) -> None:
     out = sys.stdout if out is None else out
     if summary["unknown_schema"]:
@@ -199,6 +240,19 @@ def print_report(summary: dict, out=None) -> None:
                   "integer: the stream mixes ring sizes (several tp "
                   "geometries in one run), per-call invariant still "
                   "hops == (tp-1) x calls within each", file=out)
+    serving = serving_summary(summary)
+    if serving:
+        print("== paged serving (serving.blocks_*) ==", file=out)
+        print(f"  block-pool high-water {serving['blocks_high_water']:g} "
+              f"(last {serving['blocks_last']:g} — nonzero after a "
+              "drained run means leaked blocks)", file=out)
+        print(f"  preemptions {serving['preemptions']:g} / "
+              f"{serving['requests']:g} requests -> rate "
+              f"{serving['preemption_rate']:.3g}", file=out)
+        print(f"  prefix-shared high-water "
+              f"{serving['prefix_shared_high_water']:g} -> share ratio "
+              f"{serving['prefix_share_ratio']:.3g} of pool high-water",
+              file=out)
     gauges = summary["gauges"]
     if gauges:
         print("== gauges ==", file=out)
