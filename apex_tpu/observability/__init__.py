@@ -70,7 +70,9 @@ from apex_tpu.observability.metrics import (  # noqa: F401
     registry,
     set_step,
     shutdown,
+    sketch,
 )
+from apex_tpu.observability.sketches import LogBucketSketch  # noqa: F401
 from apex_tpu.observability.recorder import FlightRecorder  # noqa: F401
 from apex_tpu.observability.sinks import JsonlSink, StderrSummarySink  # noqa: F401
 from apex_tpu.observability.spans import StepTimer, fence, span  # noqa: F401
@@ -79,6 +81,7 @@ from apex_tpu.observability.trace import TraceSink, load_trace  # noqa: F401
 __all__ = [
     "SCHEMA_VERSION",
     "FlightRecorder",
+    "LogBucketSketch",
     "MetricsRegistry",
     "JsonlSink",
     "StderrSummarySink",
@@ -102,5 +105,6 @@ __all__ = [
     "sample_device_memory",
     "set_step",
     "shutdown",
+    "sketch",
     "span",
 ]

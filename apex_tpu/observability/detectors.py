@@ -29,6 +29,12 @@ Detectors:
   retrace or HBM-pressure spill shows up here first).
 - :class:`QueueStallDetector` — serving-side: queue depth growing while
   cache slots sit free (an admission stall), or a sustained backlog.
+- :class:`SLOViolationDetector` — serving-side (ISSUE 7): per-class
+  missed-deadline rate over a sliding window of completed requests;
+  the engine feeds every completion's goodput verdict (met/missed
+  against the class's TTFT/TPOT deadlines), and a class missing more
+  than the threshold fraction fires once (with hysteresis) instead of
+  once per late request.
 
 Every firing becomes an ``anomaly.<kind>`` event in the telemetry
 stream, increments ``anomaly.count``, and notifies the flight recorder
@@ -48,6 +54,7 @@ __all__ = [
     "DetectorBank",
     "NanInfDetector",
     "QueueStallDetector",
+    "SLOViolationDetector",
     "ScalerThrashDetector",
     "ThroughputRegressionDetector",
     "ZScoreDetector",
@@ -326,6 +333,53 @@ class QueueStallDetector:
         return None
 
 
+class SLOViolationDetector:
+    """Per-class missed-SLO rate over a sliding window of completions.
+
+    The serving engine judges every completed request against its SLO
+    class's TTFT/TPOT deadlines (``serving/slo.py``) and feeds the
+    verdict here.  One late request is weather; a class whose missed
+    rate over the last ``window`` completions exceeds
+    ``rate_threshold`` is an incident (overload, a preemption storm, a
+    wedged prefill) — fire once per class, re-arming only when the rate
+    recovers below half the threshold (hysteresis, same discipline as
+    the scaler-thrash detector)."""
+
+    def __init__(self, *, window: int = 32, rate_threshold: float = 0.25,
+                 min_points: int = 8):
+        self.rate_threshold = float(rate_threshold)
+        self.min_points = int(min_points)
+        self.window = int(window)
+        self._wins: Dict[str, deque] = {}
+        self._armed: Dict[str, bool] = {}
+
+    def feed(self, slo_class: str, met: bool,
+             step: Optional[int] = None) -> Optional[Anomaly]:
+        win = self._wins.get(slo_class)
+        if win is None:
+            win = self._wins[slo_class] = deque(maxlen=self.window)
+            self._armed[slo_class] = True
+        win.append(bool(met))
+        if len(win) < self.min_points:
+            return None
+        rate = 1.0 - sum(win) / len(win)
+        if not self._armed[slo_class]:
+            if rate < self.rate_threshold / 2:
+                self._armed[slo_class] = True
+            return None
+        if rate >= self.rate_threshold:
+            self._armed[slo_class] = False
+            return Anomaly(
+                "slo_violation", step,
+                f"SLO class {slo_class!r} missed its TTFT/TPOT "
+                f"deadlines on {rate:.0%} of the last {len(win)} "
+                f"completed requests (threshold "
+                f"{self.rate_threshold:.0%})",
+                {"slo_class": slo_class, "missed_rate": round(rate, 4),
+                 "window": len(win)})
+        return None
+
+
 class DetectorBank:
     """The per-registry detector set + firing pipeline.
 
@@ -357,6 +411,8 @@ class DetectorBank:
         self.throughput = ThroughputRegressionDetector(
             ratio=cfg.get("throughput_ratio", 1.5))
         self.serving = QueueStallDetector()
+        self.slo = SLOViolationDetector(
+            rate_threshold=cfg.get("slo_miss_rate_threshold", 0.25))
 
     # -- feeds (called by metrics.record_step_metrics & friends) -----------
 
@@ -407,6 +463,13 @@ class DetectorBank:
     def feed_serving(self, queue_depth: float,
                      occupancy: float) -> Optional[Anomaly]:
         a = self.serving.feed(queue_depth, occupancy)
+        if a is not None:
+            self._fire(a)
+        return a
+
+    def feed_slo(self, slo_class: str, met: bool,
+                 step: Optional[int] = None) -> Optional[Anomaly]:
+        a = self.slo.feed(slo_class, met, step)
         if a is not None:
             self._fire(a)
         return a

@@ -50,9 +50,14 @@ import time
 from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
-# v2: records may carry the optional "step" field (set_step); the trace
-# and flight-recorder artifacts are versioned separately.
-SCHEMA_VERSION = 2
+from apex_tpu.observability.sketches import LogBucketSketch
+
+# v2: records may carry the optional "step" field (set_step).  v3
+# (ISSUE 7): flush additionally emits "sketch" records (serialized
+# mergeable log-bucket sketches) and "summary" records (per-histogram
+# observed-vs-retained truncation accounting); the trace and
+# flight-recorder artifacts are versioned separately.
+SCHEMA_VERSION = 3
 
 __all__ = [
     "SCHEMA_VERSION",
@@ -61,6 +66,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "Sketch",
     "configure",
     "configure_from_env",
     "counter",
@@ -72,6 +78,7 @@ __all__ = [
     "registry",
     "set_step",
     "shutdown",
+    "sketch",
 ]
 
 
@@ -94,6 +101,24 @@ class _NoopMetric:
 
 
 NOOP_METRIC = _NoopMetric()
+
+
+def _tags_key(tags: Optional[dict]) -> tuple:
+    """Tags are a real metric dimension (ISSUE 7: per-``slo_class``
+    sketches and goodput counters): two call sites naming the same
+    metric with different tags get distinct instances, which the
+    OpenMetrics exporter renders as one family with distinct label
+    sets.  Untagged call sites keep their original identity."""
+    return tuple(sorted(tags.items())) if tags else ()
+
+
+def _summary_key(name: str, tags: Optional[dict]) -> str:
+    """Display key for summaries/dumps: ``name`` or
+    ``name{k=v,...}`` when tagged."""
+    if not tags:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+    return f"{name}{{{inner}}}"
 
 
 class Counter:
@@ -186,14 +211,83 @@ class Histogram:
         return vals[idx]
 
     def summary(self) -> dict:
+        # observed vs retained (ISSUE 7 satellite): quantiles below are
+        # computed over the bounded window; when observed > retained
+        # they are NOT exact and every consumer (stderr summary table,
+        # flight dumps, the "summary" flush record, the OpenMetrics
+        # summary family) can now say so instead of looking exact.
+        # count/total/retained snapshot under ONE lock hold, or a
+        # concurrent observe between the reads fakes a truncation.
+        with self._reg._lock:
+            count, total, vmax = self.count, self.total, self.max
+            retained = len(self._window)
         return {
-            "count": self.count,
-            "total": self.total,
-            "mean": self.total / self.count if self.count else 0.0,
+            "count": count,
+            "observed": count,
+            "retained": retained,
+            "truncated": count > retained,
+            "total": total,
+            "mean": total / count if count else 0.0,
             "p50": self.quantile(0.50),
             "p95": self.quantile(0.95),
-            "max": self.max if self.count else 0.0,
+            "max": vmax if count else 0.0,
         }
+
+
+class Sketch:
+    """Mergeable log-bucket histogram sketch — the registry metric kind
+    for high-volume series (per-request serving latencies): bounded
+    memory, bounded-relative-error quantiles, exact cross-stream merge
+    (:mod:`~apex_tpu.observability.sketches`).
+
+    Unlike :class:`Histogram`, an observation emits **no record** — a
+    soak's million TPOT samples must not become a million JSONL lines.
+    The serialized sketch state is emitted as one ``sketch`` record per
+    flush (cumulative, like counters), which is what
+    ``tools/aggregate_telemetry.py`` merges exactly across hosts and
+    the OpenMetrics exporter exposes as native histogram buckets.
+    """
+
+    __slots__ = ("name", "tags", "_sketch", "_lock")
+
+    def __init__(self, name: str, lock: threading.Lock,
+                 tags: Optional[dict] = None):
+        self.name = name
+        self.tags = tags
+        self._sketch = LogBucketSketch()
+        self._lock = lock
+
+    def observe(self, value, **extra) -> None:
+        with self._lock:
+            self._sketch.observe(float(value))
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            return self._sketch.quantile(q)
+
+    def summary(self) -> dict:
+        with self._lock:
+            return self._sketch.summary()
+
+    def state(self) -> dict:
+        """Serialized sketch (the ``sketch`` record value)."""
+        with self._lock:
+            return self._sketch.to_dict()
+
+    def buckets(self):
+        """Cumulative ``(le, count)`` buckets (OpenMetrics form)."""
+        with self._lock:
+            return self._sketch.cumulative_buckets()
+
+    def export(self):
+        """(serialized state, cumulative buckets) under ONE lock hold:
+        the exporter needs ``_count``/``_sum`` and the bucket series to
+        describe the same instant, or a concurrent observe makes the
+        scrape violate the OpenMetrics ``_count == +Inf bucket``
+        invariant."""
+        with self._lock:
+            return (self._sketch.to_dict(),
+                    self._sketch.cumulative_buckets())
 
 
 class MetricsRegistry:
@@ -217,9 +311,12 @@ class MetricsRegistry:
         self._closed = False
         # ISSUE 4 diagnostics, attached by configure(): a DetectorBank
         # and (when a dump path is set) a FlightRecorder.  None means
-        # absent — feeding call sites bind + None-check.
+        # absent — feeding call sites bind + None-check.  ISSUE 7 adds
+        # the live OpenMetrics exporter under the same contract (only
+        # exists when configure(export_port=...) asked for it).
         self.detectors: Optional[Any] = None
         self.recorder: Optional[Any] = None
+        self.exporter: Optional[Any] = None
         # current train-step index; stamped onto every record once known
         self.step: Optional[int] = None
         self._auto_step = 0
@@ -255,8 +352,9 @@ class MetricsRegistry:
 
     # -- metric accessors (get-or-create) ----------------------------------
 
-    def _get(self, kind: str, name: str, factory):
-        key = (kind, name)
+    def _get(self, kind: str, name: str, factory,
+             tags: Optional[dict] = None):
+        key = (kind, name, _tags_key(tags))
         m = self._metrics.get(key)
         if m is None:
             with self._lock:
@@ -268,16 +366,24 @@ class MetricsRegistry:
 
     def counter(self, name: str, tags: Optional[dict] = None) -> Counter:
         return self._get("counter", name,
-                         lambda: Counter(name, self._lock, tags))
+                         lambda: Counter(name, self._lock, tags),
+                         tags=tags)
 
     def gauge(self, name: str, tags: Optional[dict] = None) -> Gauge:
-        return self._get("gauge", name, lambda: Gauge(name, self, tags))
+        return self._get("gauge", name, lambda: Gauge(name, self, tags),
+                         tags=tags)
 
     def histogram(self, name: str, tags: Optional[dict] = None,
                   record_type: str = "observe") -> Histogram:
         return self._get(
             f"histogram:{record_type}", name,
-            lambda: Histogram(name, self, tags, record_type=record_type))
+            lambda: Histogram(name, self, tags, record_type=record_type),
+            tags=tags)
+
+    def sketch(self, name: str, tags: Optional[dict] = None) -> Sketch:
+        return self._get("sketch", name,
+                         lambda: Sketch(name, self._lock, tags),
+                         tags=tags)
 
     def observe_span(self, name: str, dur_s: float, **extra) -> None:
         """Record one span duration (seconds) — a ``span``-typed
@@ -301,25 +407,77 @@ class MetricsRegistry:
     def summary(self) -> dict:
         with self._lock:
             metrics = list(self._metrics.values())
-        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {},
+                     "sketches": {}}
+        for m in metrics:
+            key = _summary_key(m.name, m.tags)
+            if isinstance(m, Counter):
+                out["counters"][key] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][key] = m.value
+            elif isinstance(m, Histogram):
+                out["histograms"][key] = m.summary()
+            elif isinstance(m, Sketch):
+                out["sketches"][key] = m.summary()
+        return out
+
+    def snapshot(self) -> list:
+        """The live per-metric state the OpenMetrics exporter renders:
+        one dict per metric instance (tags preserved as label
+        dimensions) — counters/gauges with their value, sketches with
+        cumulative buckets, deque histograms as bounded-window
+        summaries carrying their truncation accounting."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: list = []
         for m in metrics:
             if isinstance(m, Counter):
-                out["counters"][m.name] = m.value
+                out.append({"kind": "counter", "name": m.name,
+                            "tags": m.tags, "value": m.value})
             elif isinstance(m, Gauge):
-                out["gauges"][m.name] = m.value
+                out.append({"kind": "gauge", "name": m.name,
+                            "tags": m.tags, "value": m.value})
+            elif isinstance(m, Sketch):
+                s, buckets = m.export()
+                out.append({"kind": "sketch", "name": m.name,
+                            "tags": m.tags, "count": s["count"],
+                            "sum": s["total"],
+                            "buckets": buckets})
             elif isinstance(m, Histogram):
-                out["histograms"][m.name] = m.summary()
+                s = m.summary()
+                out.append({"kind": "summary", "name": m.name,
+                            "tags": m.tags, "observed": s["observed"],
+                            "retained": s["retained"],
+                            "truncated": s["truncated"],
+                            "sum": s["total"], "p50": s["p50"],
+                            "p95": s["p95"], "max": s["max"]})
         return out
 
     def flush(self) -> None:
-        """Emit cumulative counter totals, then flush every sink."""
+        """Emit cumulative counter totals, serialized sketch states,
+        and per-histogram truncation summaries, then flush every
+        sink."""
         with self._lock:
-            counters = [m for m in self._metrics.values()
-                        if isinstance(m, Counter)]
-        for c in counters:
+            metrics = list(self._metrics.values())
+        for c in (m for m in metrics if isinstance(m, Counter)):
             rec = {"type": "counter", "name": c.name, "value": c.value}
             if c.tags:
                 rec["tags"] = c.tags
+            self._emit(rec)
+        for s in (m for m in metrics if isinstance(m, Sketch)):
+            rec = {"type": "sketch", "name": s.name, "value": s.state()}
+            if s.tags:
+                rec["tags"] = s.tags
+            self._emit(rec)
+        for h in (m for m in metrics if isinstance(m, Histogram)):
+            summ = h.summary()
+            rec = {"type": "summary", "name": h.name,
+                   "value": {"observed": summ["observed"],
+                             "retained": summ["retained"],
+                             "truncated": summ["truncated"],
+                             "p50": summ["p50"], "p95": summ["p95"]}}
+            if h.tags:
+                rec["tags"] = h.tags
             self._emit(rec)
         with self._lock:
             for sink in self.sinks:
@@ -328,6 +486,11 @@ class MetricsRegistry:
     def close(self) -> None:
         if self._closed:
             return
+        if self.exporter is not None:
+            # stop serving scrapes before the state they render starts
+            # tearing down
+            self.exporter.close()
+            self.exporter = None
         self.flush()
         self._closed = True
         if self.recorder is not None:
@@ -368,6 +531,15 @@ def gauge(name: str, tags: Optional[dict] = None):
 def histogram(name: str, tags: Optional[dict] = None):
     reg = _REGISTRY
     return reg.histogram(name, tags) if reg is not None else NOOP_METRIC
+
+
+def sketch(name: str, tags: Optional[dict] = None):
+    """Mergeable log-bucket histogram sketch (bounded memory, exact
+    cross-host merge) — use for high-volume series; no-op singleton on
+    the disabled fast path (no sketch allocation when telemetry is
+    off)."""
+    reg = _REGISTRY
+    return reg.sketch(name, tags) if reg is not None else NOOP_METRIC
 
 
 def event(name: str, /, **data) -> None:
@@ -418,6 +590,7 @@ def configure(
     dump_on_anomaly: bool = True,
     detectors: bool = True,
     detector_config: Optional[dict] = None,
+    export_port: Optional[int] = None,
 ) -> MetricsRegistry:
     """Enable telemetry for this process; returns the live registry.
 
@@ -437,9 +610,15 @@ def configure(
       the first detector firing.
     - ``detectors``: run the step-boundary anomaly detectors
       (loss-spike / grad-norm / NaN-first-seen / scaler-thrash /
-      throughput-regression / serving-queue —
+      throughput-regression / serving-queue / SLO-violation —
       :mod:`~apex_tpu.observability.detectors`).  ``detector_config``
       overrides thresholds (see ``DetectorBank``).
+    - ``export_port``: serve the live registry over HTTP on this
+      localhost port (``0`` = ephemeral; read it back from
+      ``registry().exporter.port``): ``/metrics`` (OpenMetrics),
+      ``/healthz`` (flips 503 on detector firings), ``/statusz``
+      (JSON summary) — :mod:`~apex_tpu.observability.exporter`.  When
+      absent (the default) no server thread or socket exists.
 
     Configuring also installs the process-wide recompilation tracker
     (:func:`~apex_tpu.observability.device.install_recompile_tracker`)
@@ -477,6 +656,12 @@ def configure(
         rec._registry = reg
         rec.install_excepthook()
         reg.recorder = rec
+    if export_port is not None:
+        # lazy import: the exporter module (and its HTTP machinery)
+        # must never load on the unconfigured path
+        from apex_tpu.observability.exporter import TelemetryExporter
+
+        reg.exporter = TelemetryExporter(reg, port=export_port)
     from apex_tpu.observability import device as device_mod
 
     device_mod.install_recompile_tracker()
@@ -504,6 +689,9 @@ ENV_VARS = {
                       "flight-recorder ring size (steps)"),
     "_DETECTORS": ("bool", "detectors",
                    "step-boundary anomaly detectors (default on)"),
+    "_PORT": ("int", "export_port",
+              "serve /metrics + /healthz + /statusz on this localhost "
+              "port (0 = ephemeral)"),
 }
 
 _TRUE = ("1", "true", "yes", "on")
@@ -561,12 +749,15 @@ def configure_from_env(env=None) -> Optional[MetricsRegistry]:
             known = ", ".join(ENV_PREFIX + s for s in ENV_VARS)
             _env_warn(f"unknown telemetry variable {name} (known: "
                       f"{known}); it has no effect")
-    # telemetry turns ON only when an output is requested (a sink path
-    # or the stderr summary); _PROFILER/_DETECTORS/_FLIGHT_STEPS alone
-    # only modify a configuration that something else enabled
-    if not any(kwargs.get(k) for k in ("jsonl_path", "trace_path",
-                                       "flight_recorder",
-                                       "stderr_summary")):
+    # telemetry turns ON only when an output is requested (a sink
+    # path, the stderr summary, or the live export port — port 0 means
+    # "ephemeral", so it is an is-not-None check, not truthiness);
+    # _PROFILER/_DETECTORS/_FLIGHT_STEPS alone only modify a
+    # configuration that something else enabled
+    if (not any(kwargs.get(k) for k in ("jsonl_path", "trace_path",
+                                        "flight_recorder",
+                                        "stderr_summary"))
+            and kwargs.get("export_port") is None):
         return None
     return configure(**kwargs)
 
@@ -646,7 +837,7 @@ def record_step_metrics(metrics: dict, prefix: str = "train") -> None:
         # cheap in-memory counter reads, no device traffic
         for cname in ("collectives.compressed.bytes",
                       "collectives.compressed.raw_bytes"):
-            c = reg._metrics.get(("counter", cname))
+            c = reg._metrics.get(("counter", cname, ()))
             if c is not None:
                 row[cname.rsplit(".", 1)[-1] + "_comm"] = c.value
         recorder.record_step(reg.step, row)

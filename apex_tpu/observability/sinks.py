@@ -100,11 +100,28 @@ class StderrSummarySink:
         if hists:
             print(f"{'span/observation':<40} {'count':>7} {'total_s':>10} "
                   f"{'mean':>10} {'p50':>10} {'p95':>10}", file=out)
+            truncated = False
             for name in sorted(hists):
                 s = hists[name]
-                print(f"{name:<40} {s['count']:>7} {s['total']:>10.4g} "
+                # '*' = quantiles over a truncated window (ISSUE 7:
+                # the live deque keeps the last 4096 observations)
+                mark = "*" if s.get("truncated") else " "
+                truncated = truncated or s.get("truncated", False)
+                print(f"{name:<39}{mark} {s['count']:>7} "
+                      f"{s['total']:>10.4g} "
                       f"{s['mean']:>10.4g} {s['p50']:>10.4g} "
                       f"{s['p95']:>10.4g}", file=out)
+            if truncated:
+                print("(* = p50/p95 over the retained window only — "
+                      "the JSONL stream is exact)", file=out)
+        sketches = summary.get("sketches", {})
+        if sketches:
+            print(f"{'sketch':<40} {'count':>7} {'p50':>10} "
+                  f"{'p95':>10} {'p99':>10}", file=out)
+            for name in sorted(sketches):
+                s = sketches[name]
+                print(f"{name:<40} {s['count']:>7} {s['p50']:>10.4g} "
+                      f"{s['p95']:>10.4g} {s['p99']:>10.4g}", file=out)
         counters = summary.get("counters", {})
         if counters:
             print(f"{'counter':<40} {'total':>12}", file=out)

@@ -119,6 +119,16 @@ class TraceSink:
                 value = float(record.get("value"))
             except (TypeError, ValueError):
                 return
+            tags = record.get("tags")
+            if tags:
+                # tags are a metric dimension (ISSUE 7: per-slo_class
+                # goodput counters) — without the suffix every class
+                # would fold into one counter track.  Same key format
+                # as registry summaries/dumps, so tools/health_report
+                # can parse both with one inverse.
+                from apex_tpu.observability.metrics import _summary_key
+
+                name = _summary_key(name, tags)
             self._write({"ph": "C", "name": name, "cat": rtype,
                          "pid": self._pid, "tid": 0, "ts": t_us,
                          "args": {"value": value}})

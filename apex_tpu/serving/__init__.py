@@ -25,11 +25,21 @@ engine:
 - :mod:`~apex_tpu.serving.batching` — the bucketed prompt-length
   compile cache (prefill recompiles per *bucket*, O(log max_len)
   shapes, never per request) and slot bookkeeping;
+- :mod:`~apex_tpu.serving.slo` — SLO classes and deadlines (ISSUE 7):
+  :class:`~apex_tpu.serving.slo.SLOTarget` per-class TTFT/TPOT
+  deadlines, resolved by ``ServingEngine(slo_targets=...)``; every
+  completion is judged into goodput counters and per-class latency
+  sketches;
 - observability — ``serving.{prefill_ms, decode_tokens_per_sec,
   slot_occupancy, queue_depth, blocks_in_use, blocks_free,
   prefix_shared_blocks}`` gauges and the ``serving.preemptions``
   counter through the existing metrics registry
-  (docs/observability.md), plus ``serving.prefill`` spans.
+  (docs/observability.md), plus ``serving.prefill`` spans, plus the
+  ISSUE 7 SLO layer: per-``slo_class`` mergeable sketches
+  ``serving.{queue_wait_ms,ttft_ms,tpot_ms,e2e_ms,
+  preempt_overhead_ms}`` and ``serving.goodput.{met,missed}``
+  counters, live on ``/metrics`` when
+  ``observability.configure(export_port=...)`` is set.
 
 See docs/inference.md for the engine lifecycle and bench.py
 ``--decode --cache-layout contiguous,paged`` for the measured mixes.
@@ -53,11 +63,18 @@ from apex_tpu.serving.paged_cache import (  # noqa: F401
     paged_insert_prefill,
     prefix_block_hashes,
 )
+from apex_tpu.serving.slo import (  # noqa: F401
+    DEFAULT_SLO_TARGETS,
+    SLOTarget,
+    resolve_slo_targets,
+)
 
 __all__ = [
     "BlockManager",
+    "DEFAULT_SLO_TARGETS",
     "Request",
     "Response",
+    "SLOTarget",
     "ServingEngine",
     "SlotPool",
     "blocks_for",
@@ -67,4 +84,5 @@ __all__ = [
     "paged_insert_prefill",
     "pick_bucket",
     "prefix_block_hashes",
+    "resolve_slo_targets",
 ]

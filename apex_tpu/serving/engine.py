@@ -68,6 +68,20 @@ counters the trace-count tests pin against, and — paged layout —
 (counter), the signals the PR 4 HBM accounting and admission-stall
 detector read.
 
+SLO accounting (ISSUE 7, same no-op contract): every request carries
+lifecycle stamps (submit → first admission → first token → finish,
+with preemption cycles clocked separately) that land at completion in
+per-class mergeable sketches
+``serving.{queue_wait_ms,ttft_ms,tpot_ms,e2e_ms,preempt_overhead_ms}``
+(tagged ``slo_class=``), the ``serving.goodput.{met,missed}`` counters
+(judged against the per-class TTFT/TPOT deadlines of
+``serving/slo.py``), and the SLO-violation detector.  The same numbers
+ride on each :class:`Response`, and the
+``serving.request.{begin,first_token,end}`` events let a trace/JSONL
+consumer reconstruct TTFT/TPOT independently of the engine's
+arithmetic (the soak test pins the two derivations against each
+other).
+
 Diagnostics (ISSUE 4, same no-op contract): each request emits paired
 ``serving.request.begin`` / ``serving.request.end`` events (submit →
 completion, queue time included) that the Perfetto trace sink renders
@@ -105,6 +119,8 @@ from apex_tpu.serving.batching import (
 from apex_tpu.serving.paged_cache import (
     BlockManager, blocks_for, init_paged_pool, paged_insert_prefill,
     prefix_block_hashes)
+from apex_tpu.serving.slo import judge as _judge_slo
+from apex_tpu.serving.slo import resolve_slo_targets
 
 __all__ = ["Request", "Response", "ServingEngine"]
 
@@ -118,9 +134,24 @@ class Request:
     temperature: float = 0.0
     eos_token_id: Optional[int] = None
     request_id: Optional[int] = None
+    # SLO class (ISSUE 7): keys the engine's per-class deadline table
+    # (``slo_targets=``) and labels the request's latency sketches and
+    # goodput verdict.  Any string is a valid class; classes without a
+    # configured target carry no deadline.
+    slo_class: str = "default"
     # stamped by ServingEngine.submit; end-to-end latency (queue time
     # included) is measured from here
     submitted_t: float = 0.0
+    # SLO lifecycle stamps (perf_counter seconds; 0.0 = not yet):
+    # queue_wait ends at the first admission's start, TTFT at the first
+    # prefill-sampled token.  preempted_t is live only between a
+    # preemption and its resume; the requeue-wait + replay-prefill cost
+    # of every such cycle accumulates into preempt_overhead_s.
+    admitted_t: float = 0.0
+    first_token_t: float = 0.0
+    queue_wait_s: float = 0.0
+    preempted_t: float = 0.0
+    preempt_overhead_s: float = 0.0
     # tokens generated before a preemption (paged layout): resume
     # replays prompt+resume_tokens through prefill and keeps counting
     # its budget from where it left off
@@ -156,7 +187,10 @@ class Request:
 
 @dataclasses.dataclass
 class Response:
-    """A completed request: generated tokens (prompt excluded)."""
+    """A completed request: generated tokens (prompt excluded) plus
+    its SLO accounting (ISSUE 7) — the same numbers the engine's
+    per-class sketches aggregate, carried per request so callers
+    (``bench_serving``, a router) can bucket them their own way."""
 
     request_id: int
     prompt: np.ndarray
@@ -164,6 +198,16 @@ class Response:
     finish_reason: str            # 'eos' | 'length'
     prefill_ms: float
     decode_steps: int
+    slo_class: str = "default"
+    queue_wait_ms: float = 0.0    # submit -> first admission start
+    ttft_ms: float = 0.0          # submit -> first sampled token
+    # mean inter-token interval after the first token (0.0 for a
+    # one-token response — no interval exists)
+    tpot_ms: float = 0.0
+    e2e_ms: float = 0.0           # submit -> completion
+    preemptions: int = 0
+    preempt_overhead_ms: float = 0.0
+    slo_met: bool = True          # against the class's deadlines
 
 
 @dataclasses.dataclass
@@ -209,6 +253,7 @@ class ServingEngine:
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
                  vocab_limit: Optional[int] = None,
+                 slo_targets: Optional[dict] = None,
                  rng: Optional[jax.Array] = None):
         _check_decode_cfg(cfg)
         if cache_layout not in ("contiguous", "paged"):
@@ -280,6 +325,10 @@ class ServingEngine:
         self._preempt_count = 0
         self._sampling = dict(top_k=top_k, top_p=top_p,
                               vocab_limit=vocab_limit)
+        # per-class TTFT/TPOT deadlines (serving/slo.py): defaults
+        # overlaid with the caller's overrides; completions are judged
+        # into serving.goodput.{met,missed} and the SLO detector
+        self._slo_targets = resolve_slo_targets(slo_targets)
         self._decode_fn = _make_decode_fn(cfg, top_k, top_p, vocab_limit,
                                           cache_layout == "paged")
         self._sample_fn = _make_sample_fn(top_k, top_p, vocab_limit)
@@ -288,11 +337,14 @@ class ServingEngine:
 
     def submit(self, prompt, *, max_new_tokens: int = 32,
                temperature: float = 0.0,
-               eos_token_id: Optional[int] = None) -> int:
-        """Queue one request; returns its request id."""
+               eos_token_id: Optional[int] = None,
+               slo_class: str = "default") -> int:
+        """Queue one request; returns its request id.  ``slo_class``
+        keys the engine's deadline table (``slo_targets=``) and labels
+        the request's latency sketches + goodput verdict."""
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
-                      request_id=self._next_id)
+                      request_id=self._next_id, slo_class=str(slo_class))
         if req.prompt.size + req.max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({req.prompt.size}) + max_new_tokens "
@@ -319,7 +371,8 @@ class ServingEngine:
         # renders the pair as one async per-request latency row
         _telemetry.event("serving.request.begin", id=req.request_id,
                          prompt_tokens=int(req.prompt.size),
-                         max_new_tokens=req.max_new_tokens)
+                         max_new_tokens=req.max_new_tokens,
+                         slo_class=req.slo_class)
         self._set_gauges()
         return req.request_id
 
@@ -537,6 +590,14 @@ class ServingEngine:
         if self._mgr is not None:
             blocks, write_ids, shared = self._claim_blocks(tokens, hashes)
         t0 = time.perf_counter()
+        if req.admitted_t == 0.0:
+            # first admission only: queue wait ends the moment the
+            # engine starts working the request (a post-preemption
+            # resume is overhead, not queue wait).  The stamp survives
+            # a failed-admission unwind on purpose — a retry's queue
+            # wait still ends at the first attempt.
+            req.admitted_t = t0
+            req.queue_wait_s = t0 - req.submitted_t
         try:
             with span("serving.prefill"), \
                     compile_label("serving.prefill"):
@@ -569,7 +630,23 @@ class ServingEngine:
             if self._mgr is not None:
                 self._tables[slot, :] = self.num_blocks
                 self._tables[slot, : len(blocks)] = blocks
-            ms = (time.perf_counter() - t0) * 1e3
+            now = time.perf_counter()
+            ms = (now - t0) * 1e3
+            if req.first_token_t == 0.0:
+                # TTFT ends here: the first sampled token exists on the
+                # host.  The paired event lets a trace/JSONL consumer
+                # reconstruct TTFT independently of the engine's own
+                # arithmetic (the soak test pins the two against each
+                # other).
+                req.first_token_t = now
+                _telemetry.event("serving.request.first_token",
+                                 id=req.request_id,
+                                 slo_class=req.slo_class)
+            if req.preempted_t:
+                # resume complete: the preemption cycle's cost (requeue
+                # wait + this replay prefill) is now fully realized
+                req.preempt_overhead_s += now - req.preempted_t
+                req.preempted_t = 0.0
             _telemetry.counter("serving.prefill_calls").inc()
             _telemetry.histogram("serving.prefill_ms").observe(ms)
             _telemetry.counter("serving.tokens_generated").inc()
@@ -621,6 +698,9 @@ class ServingEngine:
         req = st.request
         req.resume_tokens = list(st.tokens)
         req.preemptions += 1
+        # the overhead clock: runs from here until the resume prefill
+        # completes (closed out in _admit_one)
+        req.preempted_t = time.perf_counter()
         self._queue.appendleft(req)
         self._preempt_count += 1
         _telemetry.counter("serving.preemptions").inc()
@@ -715,24 +795,77 @@ class ServingEngine:
             self._tables[slot, :] = self.num_blocks
             self._mgr.free_all(st.blocks)
         self._pool.release(slot)
-        latency_ms = (time.perf_counter()
-                      - st.request.submitted_t) * 1e3
+        req = st.request
+        now = time.perf_counter()
+        # -- SLO accounting (ISSUE 7): the per-request measurements,
+        # their per-class sketches, and the goodput verdict ------------
+        latency_ms = (now - req.submitted_t) * 1e3
+        queue_wait_ms = req.queue_wait_s * 1e3
+        ttft_ms = (req.first_token_t - req.submitted_t) * 1e3
+        intervals = len(st.tokens) - 1
+        # mean inter-token interval AFTER the first token, preemption
+        # stalls included — what streaming feels like.  None for a
+        # one-token response: no interval exists, so no TPOT verdict.
+        tpot_ms = ((now - req.first_token_t) / intervals * 1e3
+                   if intervals > 0 else None)
+        overhead_ms = req.preempt_overhead_s * 1e3
+        tags = {"slo_class": req.slo_class}
+        _telemetry.sketch("serving.queue_wait_ms", tags).observe(
+            queue_wait_ms)
+        _telemetry.sketch("serving.ttft_ms", tags).observe(ttft_ms)
+        if tpot_ms is not None:
+            _telemetry.sketch("serving.tpot_ms", tags).observe(tpot_ms)
+        _telemetry.sketch("serving.e2e_ms", tags).observe(latency_ms)
+        if req.preemptions:
+            # only preempted requests land here: the sketch answers
+            # "what does a preemption cost when it happens", not a
+            # zero-diluted average over the whole fleet
+            _telemetry.sketch("serving.preempt_overhead_ms",
+                              tags).observe(overhead_ms)
+        met = _judge_slo(self._slo_targets.get(req.slo_class),
+                         ttft_ms, tpot_ms)
+        _telemetry.counter(
+            "serving.goodput.met" if met else "serving.goodput.missed",
+            tags).inc()
+        reg = _telemetry.registry()
+        if reg is not None and reg.detectors is not None:
+            reg.detectors.feed_slo(req.slo_class, met)
         _telemetry.histogram("serving.request_ms").observe(
-            latency_ms, rid=st.request.request_id, finish_reason=reason,
+            latency_ms, rid=req.request_id, finish_reason=reason,
             tokens=len(st.tokens))
-        _telemetry.event("serving.request.end",
-                         id=st.request.request_id, finish_reason=reason,
-                         tokens=len(st.tokens),
-                         latency_ms=round(latency_ms, 3))
+        end_data = dict(
+            id=req.request_id, finish_reason=reason,
+            tokens=len(st.tokens),
+            latency_ms=round(latency_ms, 3),
+            slo_class=req.slo_class,
+            queue_wait_ms=round(queue_wait_ms, 3),
+            ttft_ms=round(ttft_ms, 3),
+            preemptions=req.preemptions,
+            preempt_overhead_ms=round(overhead_ms, 3),
+            slo_met=met)
+        if tpot_ms is not None:
+            # a one-token response HAS no TPOT — omitting the key (not
+            # stamping 0.0) keeps trace-side reconstructions from
+            # counting a fake 0 ms interval into their percentiles
+            end_data["tpot_ms"] = round(tpot_ms, 4)
+        _telemetry.event("serving.request.end", **end_data)
         return Response(
-            request_id=st.request.request_id,
-            prompt=st.request.prompt,
+            request_id=req.request_id,
+            prompt=req.prompt,
             tokens=np.asarray(st.tokens, np.int32),
             finish_reason=reason,
             prefill_ms=st.prefill_ms,
             # every admission (initial + each post-preemption resume)
             # contributes one prefill-sampled token, not a decode step
-            decode_steps=len(st.tokens) - 1 - st.request.preemptions,
+            decode_steps=len(st.tokens) - 1 - req.preemptions,
+            slo_class=req.slo_class,
+            queue_wait_ms=queue_wait_ms,
+            ttft_ms=ttft_ms,
+            tpot_ms=tpot_ms or 0.0,
+            e2e_ms=latency_ms,
+            preemptions=req.preemptions,
+            preempt_overhead_ms=overhead_ms,
+            slo_met=met,
         )
 
 

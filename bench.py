@@ -362,31 +362,76 @@ def _serving_mixes(on_tpu):
     the slot count; the mix is what the paged ablation row measures."""
     if on_tpu:
         return 8, gpt_125m(max_position_embeddings=1024), {
-            "prefill_heavy": dict(n=16, prompt=512, new=16),
-            "decode_heavy": dict(n=16, prompt=32, new=128),
+            "prefill_heavy": dict(n=16, prompt=512, new=16,
+                                  slo_class="standard"),
+            "decode_heavy": dict(n=16, prompt=32, new=128,
+                                 slo_class="interactive"),
             "long_prompt_starvation": dict(
                 n=16, prompt=32, new=32, n_long=2, long_prompt=768,
-                long_new=64),
+                long_new=64, slo_class="interactive"),
         }
     return 4, gpt_125m(num_layers=2, hidden_size=128,
                        num_attention_heads=4, vocab_size=1024,
                        max_position_embeddings=256), {
-        "prefill_heavy": dict(n=4, prompt=48, new=4),
-        "decode_heavy": dict(n=4, prompt=8, new=24),
+        "prefill_heavy": dict(n=4, prompt=48, new=4,
+                              slo_class="standard"),
+        "decode_heavy": dict(n=4, prompt=8, new=24,
+                             slo_class="interactive"),
         "long_prompt_starvation": dict(
-            n=6, prompt=8, new=8, n_long=1, long_prompt=96, long_new=16),
+            n=6, prompt=8, new=8, n_long=1, long_prompt=96, long_new=16,
+            slo_class="interactive"),
     }
 
 
 def _mix_requests(rng, vocab, m):
     """Materialize one mix: ``n_long`` long requests submitted FIRST
-    (they pin lanes while the short stream queues behind them)."""
+    (they pin lanes while the short stream queues behind them).  SLO
+    classes (ISSUE 7): long requests are ``batch`` (no deadline — they
+    meet their SLO by completing), short ones take the mix's class
+    (default ``standard``), so the per-class goodput split in the
+    BENCH row reflects the traffic shape."""
     reqs = [dict(prompt=rng.randint(0, vocab, (m["long_prompt"],)),
-                 max_new_tokens=m["long_new"])
+                 max_new_tokens=m["long_new"], slo_class="batch")
             for _ in range(m.get("n_long", 0))]
     reqs += [dict(prompt=rng.randint(0, vocab, (m["prompt"],)),
-                  max_new_tokens=m["new"]) for _ in range(m["n"])]
+                  max_new_tokens=m["new"],
+                  slo_class=m.get("slo_class", "standard"))
+             for _ in range(m["n"])]
     return reqs
+
+
+def _pct_of(vals, q):
+    vals = sorted(vals)
+    if not vals:
+        return 0.0
+    idx = min(len(vals) - 1, max(0, round(q * (len(vals) - 1))))
+    return vals[idx]
+
+
+def _slo_fields(resps):
+    """Per-class TTFT/TPOT/goodput summary from the responses' own SLO
+    accounting (ISSUE 7) — the baseline BENCH format the first
+    ``--serve-trace`` bench (ROADMAP item 4) extends.  Exact
+    percentiles over the mix's requests (this is per-run bench data,
+    not the fleet sketch path)."""
+    out = {}
+    by_cls = {}
+    for r in resps:
+        by_cls.setdefault(r.slo_class, []).append(r)
+    for cls, rs in sorted(by_cls.items()):
+        tpots = [r.tpot_ms for r in rs if r.tokens.size > 1]
+        met = sum(1 for r in rs if r.slo_met)
+        out[cls] = {
+            "requests": len(rs),
+            "ttft_ms_p50": round(_pct_of([r.ttft_ms for r in rs], .5), 3),
+            "ttft_ms_p95": round(_pct_of([r.ttft_ms for r in rs], .95), 3),
+            "tpot_ms_p50": round(_pct_of(tpots, .5), 4),
+            "tpot_ms_p95": round(_pct_of(tpots, .95), 4),
+            "queue_wait_ms_p95": round(
+                _pct_of([r.queue_wait_ms for r in rs], .95), 3),
+            "goodput_rate": round(met / len(rs), 4),
+        }
+    return out
 
 
 def _drive_engine(engine, reqs):
@@ -440,6 +485,9 @@ def bench_serving(on_tpu, cache_layout="contiguous"):
             "prefill_ms_mean": round(
                 sum(r.prefill_ms for r in resps) / len(resps), 3),
             "max_concurrent_requests": hw,
+            # ISSUE 7: per-class TTFT/TPOT/goodput from the responses'
+            # SLO accounting — the --serve-trace baseline format
+            "slo": _slo_fields(resps),
         }
         if m.get("n_long"):
             rows[name]["long_requests"] = m["n_long"]

@@ -118,6 +118,8 @@ MODULES = [
      "serving.batching — prompt buckets + slot pool"),
     ("apex_tpu.serving.paged_cache", "serving",
      "serving.paged_cache — block pool, block tables, prefix sharing"),
+    ("apex_tpu.serving.slo", "serving",
+     "serving.slo — SLO classes, TTFT/TPOT deadlines, goodput judge"),
     # data
     ("apex_tpu.data.image_folder", "data",
      "data.image_folder — file-backed input pipeline"),
@@ -152,6 +154,12 @@ MODULES = [
      "observability.detectors — step-boundary anomaly detectors"),
     ("apex_tpu.observability.device", "observability",
      "observability.device — recompile tracking + HBM gauges"),
+    ("apex_tpu.observability.sketches", "observability",
+     "observability.sketches — mergeable log-bucket histogram sketch"),
+    ("apex_tpu.observability.openmetrics", "observability",
+     "observability.openmetrics — OpenMetrics text render/parse"),
+    ("apex_tpu.observability.exporter", "observability",
+     "observability.exporter — live /metrics + /healthz HTTP endpoint"),
     # misc
     ("apex_tpu.normalization", "misc", "apex_tpu.normalization"),
     ("apex_tpu.fused_dense", "misc", "apex_tpu.fused_dense"),

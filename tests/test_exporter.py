@@ -1,0 +1,441 @@
+"""ISSUE 7 coverage: mergeable sketches, OpenMetrics exposition, and
+the live exporter lifecycle.
+
+Covers: LogBucketSketch algebra (exact merge — associative,
+commutative, count-preserving; bounded-relative-error quantiles;
+serialization round-trip; parameter-mismatch refusal), the registry's
+Sketch metric kind (tags as dimensions, flush emits ``sketch``
+records, no per-observation record), the OpenMetrics render/parse pair
+(the parser IS the in-test line-format validator), the exporter's
+endpoints (``/metrics`` parseable, ``/healthz`` flipping 503 on a
+detector firing, ``/statusz``, 404), teardown (thread exits on
+shutdown, configure re-entry closes the old server), and the
+zero-overhead contract (a fresh unconfigured process never imports the
+exporter module or starts its thread — asserted from a subprocess).
+"""
+
+import contextlib
+import json
+import logging
+import math
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+import apex_tpu.observability as obs
+from apex_tpu.observability import openmetrics
+from apex_tpu.observability.metrics import NOOP_METRIC
+from apex_tpu.observability.sketches import LogBucketSketch
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    yield
+    obs.shutdown()
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+@contextlib.contextmanager
+def _capture_warnings():
+    """The apex_tpu logger is propagate=False (its own stderr handler),
+    so caplog never sees it — attach a capturing handler directly."""
+    records = []
+
+    class _H(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    h = _H(level=logging.WARNING)
+    logger = logging.getLogger("apex_tpu")
+    logger.addHandler(h)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(h)
+
+
+# ---------------------------------------------------------------------------
+# the sketch
+# ---------------------------------------------------------------------------
+
+
+class TestLogBucketSketch:
+    def test_count_total_min_max_exact(self):
+        s = LogBucketSketch()
+        vals = [0.5, 12.0, 12.0, 700.0, 0.003, 1e9]
+        for v in vals:
+            s.observe(v)
+        assert s.count == len(vals)
+        assert s.total == pytest.approx(sum(vals))
+        assert s.min == min(vals) and s.max == max(vals)
+
+    def test_quantile_relative_error_bound(self):
+        s = LogBucketSketch()
+        import random
+
+        rng = random.Random(0)
+        vals = sorted(rng.uniform(0.01, 5e4) for _ in range(5000))
+        for v in vals:
+            s.observe(v)
+        for q in (0.5, 0.95, 0.99):
+            exact = vals[math.ceil(q * len(vals)) - 1]
+            got = s.quantile(q)
+            # reported value = bucket upper bound: >= exact, and within
+            # one growth factor of it
+            assert exact <= got <= exact * s.growth * (1 + 1e-9)
+
+    def test_overflow_bucket_reports_exact_max(self):
+        s = LogBucketSketch(max_value=100.0)
+        s.observe(123456.0)
+        assert s.quantile(0.99) == 123456.0
+
+    def test_merge_is_exact_associative_commutative(self):
+        import random
+
+        rng = random.Random(1)
+        vals = [rng.uniform(1e-4, 1e6) for _ in range(900)]
+        full = LogBucketSketch()
+        parts = [LogBucketSketch() for _ in range(3)]
+        for i, v in enumerate(vals):
+            full.observe(v)
+            parts[i % 3].observe(v)
+        a, b, c = parts
+        # (a+b)+c
+        abc = LogBucketSketch.merged(
+            [LogBucketSketch.from_dict(a.to_dict()),
+             LogBucketSketch.from_dict(b.to_dict()),
+             LogBucketSketch.from_dict(c.to_dict())])
+        # c+(b+a): different order
+        cba = LogBucketSketch.merged(
+            [LogBucketSketch.from_dict(c.to_dict()),
+             LogBucketSketch.from_dict(b.to_dict()),
+             LogBucketSketch.from_dict(a.to_dict())])
+        for m in (abc, cba):
+            assert m.count == full.count                 # exact counts
+            assert m.counts == full.counts               # bucket-exact
+            assert m.total == pytest.approx(full.total)
+            for q in (0.01, 0.5, 0.95, 0.99, 1.0):
+                assert m.quantile(q) == full.quantile(q)  # exactly
+
+    def test_merge_refuses_parameter_mismatch(self):
+        a = LogBucketSketch(growth=1.04)
+        b = LogBucketSketch(growth=1.10)
+        with pytest.raises(ValueError, match="parameter mismatch"):
+            a.merge(b)
+
+    def test_serialization_round_trip(self):
+        s = LogBucketSketch()
+        for v in (0.1, 3.0, 3.0, 900.0):
+            s.observe(v)
+        r = LogBucketSketch.from_dict(
+            json.loads(json.dumps(s.to_dict())))
+        assert r.counts == s.counts and r.count == s.count
+        assert r.min == s.min and r.max == s.max
+        assert r.quantile(0.5) == s.quantile(0.5)
+
+    def test_empty_sketch(self):
+        s = LogBucketSketch()
+        assert s.quantile(0.5) == 0.0
+        assert s.summary()["count"] == 0
+        assert LogBucketSketch.merged([]) is None
+
+    def test_nan_is_dropped_not_poisoning(self):
+        s = LogBucketSketch()
+        s.observe(float("nan"))
+        s.observe(2.0)
+        assert s.count == 1 and s.max == 2.0
+
+    def test_invalid_parameters_raise(self):
+        with pytest.raises(ValueError):
+            LogBucketSketch(min_value=-1.0)
+        with pytest.raises(ValueError):
+            LogBucketSketch(growth=1.0)
+        with pytest.raises(ValueError):
+            LogBucketSketch(min_value=10.0, max_value=1.0)
+
+
+# ---------------------------------------------------------------------------
+# the registry metric kind
+# ---------------------------------------------------------------------------
+
+
+class TestRegistrySketch:
+    def test_disabled_returns_noop_singleton(self):
+        assert obs.sketch("serving.ttft_ms") is NOOP_METRIC
+        obs.sketch("serving.ttft_ms").observe(1.0)   # inert
+
+    def test_tags_are_a_dimension(self, tmp_path):
+        obs.configure(jsonl_path=str(tmp_path / "t.jsonl"))
+        a = obs.sketch("s", {"slo_class": "a"})
+        b = obs.sketch("s", {"slo_class": "b"})
+        assert a is not b
+        assert obs.sketch("s", {"slo_class": "a"}) is a
+        a.observe(1.0)
+        assert a.summary()["count"] == 1
+        assert b.summary()["count"] == 0
+
+    def test_observations_emit_no_records_flush_emits_state(
+            self, tmp_path):
+        path = tmp_path / "t.jsonl"
+        reg = obs.configure(jsonl_path=str(path))
+        sk = obs.sketch("serving.tpot_ms", {"slo_class": "x"})
+        for i in range(1000):
+            sk.observe(float(i + 1))
+        reg.flush()
+        recs = [json.loads(l) for l in open(path)]
+        # a thousand observations, zero per-observation records
+        assert not [r for r in recs if r["type"] == "observe"
+                    and r["name"] == "serving.tpot_ms"]
+        sketches = [r for r in recs if r["type"] == "sketch"]
+        assert len(sketches) == 1
+        rec = sketches[0]
+        assert rec["tags"] == {"slo_class": "x"}
+        assert rec["schema_version"] == 3
+        restored = LogBucketSketch.from_dict(rec["value"])
+        assert restored.count == 1000
+        assert restored.quantile(0.5) == sk.quantile(0.5)
+
+    def test_histogram_summary_reports_truncation(self, tmp_path):
+        obs.configure(jsonl_path=str(tmp_path / "t.jsonl"))
+        h = obs.histogram("h")
+        for i in range(10):
+            h.observe(float(i))
+        s = h.summary()
+        assert s["observed"] == 10 and s["retained"] == 10
+        assert s["truncated"] is False
+        for i in range(h.WINDOW + 5):
+            h.observe(float(i))
+        s = h.summary()
+        assert s["observed"] == h.WINDOW + 15
+        assert s["retained"] == h.WINDOW
+        assert s["truncated"] is True
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics render/parse
+# ---------------------------------------------------------------------------
+
+
+class TestOpenMetrics:
+    def _snapshot(self):
+        sk = LogBucketSketch()
+        for v in (1.0, 5.0, 5.0, 80.0, 2000.0):
+            sk.observe(v)
+        return [
+            {"kind": "counter", "name": "serving.goodput.met",
+             "tags": {"slo_class": "interactive"}, "value": 7},
+            {"kind": "gauge", "name": "serving.queue_depth",
+             "tags": None, "value": 3.0},
+            {"kind": "sketch", "name": "serving.ttft_ms",
+             "tags": {"slo_class": "interactive"}, "count": sk.count,
+             "sum": sk.total, "buckets": sk.cumulative_buckets()},
+            {"kind": "summary", "name": "serving.prefill_ms",
+             "tags": None, "observed": 12, "retained": 12,
+             "truncated": False, "sum": 40.0, "p50": 3.0, "p95": 9.0,
+             "max": 9.5},
+        ], sk
+
+    def test_render_parses_back(self):
+        snap, sk = self._snapshot()
+        text = openmetrics.render(snap)
+        parsed = openmetrics.parse(text)   # strict: raises = fail
+        assert parsed["eof"]
+        assert parsed["types"]["serving_goodput_met"] == "counter"
+        assert parsed["types"]["serving_ttft_ms"] == "histogram"
+        assert parsed["types"]["serving_prefill_ms"] == "summary"
+        assert openmetrics.sample_value(
+            parsed, "serving_goodput_met_total",
+            {"slo_class": "interactive"}) == 7
+        assert openmetrics.sample_value(
+            parsed, "serving_queue_depth") == 3.0
+        assert openmetrics.sample_value(
+            parsed, "serving_ttft_ms_count") == sk.count
+
+    def test_scraped_quantiles_match_sketch_exactly(self):
+        snap, sk = self._snapshot()
+        parsed = openmetrics.parse(openmetrics.render(snap))
+        buckets = openmetrics.bucket_series(
+            parsed, "serving_ttft_ms", {"slo_class": "interactive"})
+        for q in (0.5, 0.95):
+            assert openmetrics.histogram_quantile(buckets, q) \
+                == sk.quantile(q)
+
+    def test_parser_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            openmetrics.parse("this is not a metric line{")
+        with pytest.raises(ValueError):
+            openmetrics.parse("# EOF\ntrailing_metric 1\n")
+
+    @pytest.mark.parametrize("value", [
+        'a"b\\c\nd',
+        "win\\network",     # backslash adjacent to 'n': a sequential
+        "\\\\n",            # unescape pass would corrupt these
+        "trail\\",
+    ])
+    def test_label_escaping_round_trips(self, value):
+        text = openmetrics.render([
+            {"kind": "gauge", "name": "g",
+             "tags": {"k": value}, "value": 1.0}])
+        parsed = openmetrics.parse(text)
+        assert parsed["samples"][0][1]["k"] == value
+
+    def test_brace_in_label_value_parses(self):
+        # any string is a valid slo_class — a '}' inside a quoted label
+        # value must not end the label block early
+        text = openmetrics.render([
+            {"kind": "counter", "name": "c",
+             "tags": {"slo_class": "a}b{c"}, "value": 2}])
+        parsed = openmetrics.parse(text)
+        assert openmetrics.sample_value(
+            parsed, "c_total", {"slo_class": "a}b{c"}) == 2
+
+    def test_name_sanitization(self):
+        assert openmetrics.sanitize_name("serving.ttft_ms") \
+            == "serving_ttft_ms"
+        assert openmetrics.sanitize_name("9lives") == "_9lives"
+
+
+# ---------------------------------------------------------------------------
+# exporter lifecycle
+# ---------------------------------------------------------------------------
+
+
+class TestExporterLifecycle:
+    def test_endpoints_serve(self):
+        reg = obs.configure(export_port=0)
+        url = reg.exporter.url
+        obs.counter("c").inc(3)
+        obs.sketch("serving.e2e_ms", {"slo_class": "x"}).observe(10.0)
+        status, text = _get(url + "/metrics")
+        assert status == 200
+        parsed = openmetrics.parse(text)       # the line-format validator
+        assert parsed["eof"]
+        assert openmetrics.sample_value(parsed, "c_total") == 3
+        assert openmetrics.sample_value(
+            parsed, "serving_e2e_ms_count", {"slo_class": "x"}) == 1
+        status, body = _get(url + "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+        status, body = _get(url + "/statusz")
+        doc = json.loads(body)
+        assert status == 200 and doc["summary"]["counters"]["c"] == 3
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(url + "/nope")
+        assert e.value.code == 404
+
+    def test_healthz_flips_on_detector_firing(self):
+        reg = obs.configure(export_port=0)
+        url = reg.exporter.url
+        # drive the SLO-violation detector to a firing: 8 straight
+        # missed-deadline completions exceed the 25% miss-rate window
+        for _ in range(8):
+            reg.detectors.feed_slo("interactive", met=False)
+        assert any(a.kind == "slo_violation"
+                   for a in reg.detectors.anomalies)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(url + "/healthz")
+        assert e.value.code == 503
+        doc = json.loads(e.value.read().decode())
+        assert doc["status"] == "unhealthy"
+        assert "slo_violation" in doc["kinds"]
+
+    def test_shutdown_stops_thread_and_socket(self):
+        from apex_tpu.observability.exporter import THREAD_NAME
+
+        reg = obs.configure(export_port=0)
+        url = reg.exporter.url
+        assert any(t.name == THREAD_NAME for t in threading.enumerate())
+        obs.shutdown()
+        assert not any(t.name == THREAD_NAME
+                       for t in threading.enumerate())
+        with pytest.raises(Exception):
+            _get(url + "/metrics", timeout=1)
+
+    def test_reconfigure_closes_previous_exporter(self):
+        from apex_tpu.observability.exporter import THREAD_NAME
+
+        reg1 = obs.configure(export_port=0)
+        port1 = reg1.exporter.port
+        reg2 = obs.configure(export_port=0)
+        assert reg2.exporter.port != 0
+        threads = [t for t in threading.enumerate()
+                   if t.name == THREAD_NAME]
+        assert len(threads) == 1
+        with pytest.raises(Exception):
+            _get(f"http://127.0.0.1:{port1}/metrics", timeout=1)
+
+    def test_env_var_enables_export(self):
+        from apex_tpu.observability.metrics import configure_from_env
+
+        reg = configure_from_env({"APEX_TPU_TELEMETRY_PORT": "0"})
+        assert reg is not None and reg.exporter is not None
+        status, text = _get(reg.exporter.url + "/metrics")
+        assert status == 200 and openmetrics.parse(text)["eof"]
+
+    def test_env_var_malformed_warns_not_crashes(self):
+        from apex_tpu.observability.metrics import configure_from_env
+
+        with _capture_warnings() as warnings:
+            reg = configure_from_env(
+                {"APEX_TPU_TELEMETRY_PORT": "not-a-port"})
+        # the malformed port falls back to "no export"; with no other
+        # output requested telemetry stays off entirely
+        assert reg is None
+        assert any("APEX_TPU_TELEMETRY_PORT" in w for w in warnings)
+
+    def test_scrape_error_does_not_kill_server(self):
+        reg = obs.configure(export_port=0)
+        url = reg.exporter.url
+
+        # sabotage one snapshot: a metric whose value read raises must
+        # 500 that request, not the server
+        from apex_tpu.observability.metrics import Counter
+
+        class _Bomb(Counter):
+            @property
+            def value(self):
+                raise RuntimeError("boom")
+
+        bomb = _Bomb.__new__(_Bomb)
+        bomb.name, bomb.tags = "bomb", None
+        reg._metrics[("boom", "bomb", ())] = bomb
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(url + "/statusz")
+        assert e.value.code == 500
+        del reg._metrics[("boom", "bomb", ())]
+        status, _ = _get(url + "/metrics")
+        assert status == 200
+
+
+UNCONFIGURED_SNIPPET = """
+import sys, threading
+import apex_tpu.observability as obs
+import apex_tpu.serving.engine                     # the instrumented user
+assert obs.registry() is None
+from apex_tpu.observability.metrics import NOOP_METRIC
+assert obs.sketch("s") is NOOP_METRIC              # no sketch allocation
+assert "apex_tpu.observability.exporter" not in sys.modules, (
+    "exporter module imported on the unconfigured path")
+names = [t.name for t in threading.enumerate()]
+assert not any(n == "apex-tpu-telemetry-exporter" for n in names), names
+print("CLEAN")
+"""
+
+
+def test_unconfigured_process_never_starts_exporter():
+    """The zero-overhead contract, asserted from a fresh process: no
+    exporter import, no server thread, no sketch allocation — even
+    with the serving engine (the heaviest instrumented user)
+    imported."""
+    out = subprocess.run(
+        [sys.executable, "-c", UNCONFIGURED_SNIPPET],
+        capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr
+    assert "CLEAN" in out.stdout

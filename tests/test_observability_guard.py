@@ -33,7 +33,15 @@ OBS_DIR = os.path.join(PKG, "observability")
 # chained registry().<metric>(...) — bypasses the bind-and-check idiom
 _CHAINED = re.compile(
     r"registry\(\)\s*\.\s*"
-    r"(counter|gauge|histogram|event|observe_span|set_step|summary)\b")
+    r"(counter|gauge|histogram|sketch|event|observe_span|set_step"
+    r"|summary|snapshot)\b")
+# the live exporter (ISSUE 7) must only ever be imported lazily inside
+# configure(export_port=...): a module-level import would load HTTP
+# machinery on the unconfigured path (tests/test_exporter.py asserts
+# the runtime side — no thread, no module — from a fresh process)
+_EXPORTER_IMPORT = re.compile(
+    r"^(from\s+apex_tpu\.observability\.exporter\s+import"
+    r"|import\s+apex_tpu\.observability\.exporter)\b")
 # a second MetricsRegistry outside the observability package
 _DIRECT_REGISTRY = re.compile(r"\bMetricsRegistry\s*\(")
 # the private module global
@@ -125,19 +133,65 @@ def test_device_memory_sampling_is_gated():
         + "\n".join(offenders))
 
 
+def test_exporter_import_is_module_level_nowhere():
+    """The exporter module must never be imported at module level
+    anywhere in ``apex_tpu/`` (``configure`` imports it lazily, inside
+    the ``export_port is not None`` branch): a top-level import would
+    pay for the HTTP server machinery — and open the door to a stray
+    socket — on every unconfigured ``import apex_tpu``."""
+    offenders = []
+    for path in _py_files():
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                if _EXPORTER_IMPORT.search(line):   # ^-anchored =
+                    offenders.append(                # module level only
+                        f"{path}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "import the exporter lazily inside configure(export_port=...) "
+        "only:\n" + "\n".join(offenders))
+
+
+def test_unconfigured_engine_starts_no_exporter_thread():
+    """ISSUE 7's zero-overhead extension, runtime side: a fresh
+    process that imports the observability package AND drives nothing
+    through configure() must have no exporter thread and no exporter
+    module in sys.modules."""
+    import subprocess
+    import sys
+
+    snippet = (
+        "import sys, threading\n"
+        "import apex_tpu.observability as obs\n"
+        "assert obs.registry() is None\n"
+        "assert 'apex_tpu.observability.exporter' not in sys.modules\n"
+        "assert not [t for t in threading.enumerate()\n"
+        "            if t.name == 'apex-tpu-telemetry-exporter']\n"
+        "print('NO-THREAD')\n")
+    out = subprocess.run([sys.executable, "-c", snippet],
+                         capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr
+    assert "NO-THREAD" in out.stdout
+
+
 def test_guard_patterns_actually_match():
     """The guard is only as good as its regexes: each must match its
     own anti-pattern (a regression here silently disables the guard)."""
     assert _CHAINED.search("reg = registry().counter('x')")
     assert _CHAINED.search("metrics.registry().gauge('x').set(1)")
+    assert _CHAINED.search("registry().sketch('x').observe(1)")
     assert not _CHAINED.search("reg = _telemetry.registry()")
     assert _DIRECT_REGISTRY.search("r = MetricsRegistry(sinks)")
     assert _PRIVATE_GLOBAL.search("from x import _REGISTRY")
     assert _MEM_SAMPLE.search("sample_device_memory()")
+    assert _EXPORTER_IMPORT.search(
+        "from apex_tpu.observability.exporter import TelemetryExporter")
+    assert not _EXPORTER_IMPORT.search(
+        "        from apex_tpu.observability.exporter import "
+        "TelemetryExporter")
 
 
 @pytest.mark.parametrize("helper", [
-    "counter", "gauge", "histogram", "event", "set_step",
+    "counter", "gauge", "histogram", "sketch", "event", "set_step",
     "record_step_metrics",
 ])
 def test_module_helpers_embed_the_check(helper):

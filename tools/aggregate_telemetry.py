@@ -1,0 +1,225 @@
+"""Merge N per-host telemetry JSONL streams into one fleet summary.
+
+    python tools/aggregate_telemetry.py host0.jsonl host1.jsonl ...
+    python tools/aggregate_telemetry.py --json fleet.json measure_logs/*.jsonl
+
+``tools/telemetry_report.py`` summarizes streams; this tool *merges*
+them — the distinction that matters is quantiles.  Averaging two
+hosts' p95s is not the fleet p95 (the canonical averaged-percentile
+lie); but the serving SLO series are emitted as **mergeable log-bucket
+sketches** (``apex_tpu/observability/sketches.py``, schema-v3 ``sketch``
+records), and sketches built from the same boundaries merge by
+element-wise count addition — so the fleet p50/p95/p99 this tool
+prints are *exactly* what one sketch observing every host's stream
+would report.  That makes the output the autoscaling-signal substrate
+ROADMAP item 4 (multi-host router, SLO-class admission) consumes:
+per-class fleet TTFT/TPOT percentiles + goodput rates that are real
+numbers, not means of means.
+
+What merges, and how:
+
+- **sketch** records — cumulative per flush: the LAST record per
+  (file, run-segment, name, tags) is that stream's final state; states
+  are merged exactly across segments and hosts.  A parameter mismatch
+  (differently-bucketed sketches) is a hard error, never a silent
+  wrong merge.
+- **counter** records — cumulative: last per (file, segment, name,
+  tags), summed across segments/hosts (goodput met/missed totals add).
+- **goodput** — derived per SLO class from the merged
+  ``serving.goodput.{met,missed}`` counters.
+
+Run segments follow the ``meta``-record discipline of
+``telemetry_report.py`` (one file can hold several appended runs).
+Garbage lines warn and skip — a fleet merge must read wounded hosts.
+
+Deliberately dependency-free: runs on any box with the repo checkout
+(the sketch module is loaded by file path and is itself stdlib-only —
+no jax required).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Tuple
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SUPPORTED_SCHEMA = 3
+
+
+def load_sketch_module():
+    """Load ``apex_tpu/observability/sketches.py`` by path (stdlib-only
+    by contract — see its module docstring), so aggregation never
+    imports the package (and therefore never needs jax)."""
+    path = os.path.join(_ROOT, "apex_tpu", "observability", "sketches.py")
+    spec = importlib.util.spec_from_file_location("_apex_sketch", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tags_suffix(tags) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+    return "{" + inner + "}"
+
+
+def load_records(paths: Iterable[str], out=None) -> List[dict]:
+    """Tolerant line-by-line load; records are tagged with their source
+    file index (``_src``) and meta-delimited run segment (``_epoch``)."""
+    out = sys.stderr if out is None else out
+    records: List[dict] = []
+    for src, path in enumerate(paths):
+        epoch = 0
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    print(f"warning: {path}:{lineno}: unparseable line "
+                          "skipped", file=out)
+                    continue
+                if not isinstance(rec, dict):
+                    print(f"warning: {path}:{lineno}: non-object record "
+                          "skipped", file=out)
+                    continue
+                if rec.get("type") == "meta":
+                    epoch += 1
+                rec["_src"] = src
+                rec["_epoch"] = epoch
+                records.append(rec)
+    return records
+
+
+def aggregate(records: List[dict], out=None) -> dict:
+    """Merge sketches exactly and sum counters across (file, segment)
+    streams.  Returns ``{"sketches": {key: summary}, "counters":
+    {key: total}, "goodput": {class: {met, missed, rate}},
+    "streams": n}``."""
+    out = sys.stderr if out is None else out
+    sketch_mod = load_sketch_module()
+    # cumulative records: last state per (src, epoch, name, tags)
+    last_sketch: Dict[Tuple, dict] = {}
+    last_counter: Dict[Tuple, float] = {}
+    streams = set()
+    for rec in records:
+        rtype, name = rec.get("type"), rec.get("name")
+        if name is None:
+            continue
+        tkey = _tags_suffix(rec.get("tags"))
+        key = (rec["_src"], rec["_epoch"], name, tkey)
+        streams.add((rec["_src"], rec["_epoch"]))
+        if rtype == "sketch" and isinstance(rec.get("value"), dict):
+            last_sketch[key] = rec["value"]
+        elif rtype == "counter":
+            try:
+                last_counter[key] = float(rec["value"])
+            except (KeyError, TypeError, ValueError):
+                pass
+    # merge across streams
+    by_series: Dict[str, list] = {}
+    for (_s, _e, name, tkey), state in last_sketch.items():
+        try:
+            by_series.setdefault(name + tkey, []).append(
+                sketch_mod.LogBucketSketch.from_dict(state))
+        except (KeyError, TypeError, ValueError) as e:
+            print(f"warning: bad sketch state for {name}{tkey}: {e}",
+                  file=out)
+    sketches = {}
+    for series in sorted(by_series):
+        merged = sketch_mod.LogBucketSketch.merged(by_series[series])
+        if merged is not None:
+            s = merged.summary()
+            s["streams"] = len(by_series[series])
+            sketches[series] = s
+    counters: Dict[str, float] = {}
+    for (_s, _e, name, tkey), val in last_counter.items():
+        counters[name + tkey] = counters.get(name + tkey, 0.0) + val
+    return {
+        "sketches": sketches,
+        "counters": counters,
+        "goodput": goodput_summary(counters),
+        "streams": len(streams),
+    }
+
+
+def goodput_summary(counters: Dict[str, float]) -> Dict[str, dict]:
+    """Per-SLO-class goodput from the merged
+    ``serving.goodput.{met,missed}{slo_class=...}`` counter totals."""
+    classes: Dict[str, dict] = {}
+    for key, val in counters.items():
+        for verdict in ("met", "missed"):
+            prefix = f"serving.goodput.{verdict}{{slo_class="
+            if key.startswith(prefix) and key.endswith("}"):
+                cls = key[len(prefix):-1]
+                classes.setdefault(cls, {"met": 0.0, "missed": 0.0})
+                classes[cls][verdict] += val
+    for cls, row in classes.items():
+        total = row["met"] + row["missed"]
+        row["requests"] = total
+        row["rate"] = (row["met"] / total) if total else 1.0
+    return classes
+
+
+def print_report(agg: dict, out=None) -> None:
+    out = sys.stdout if out is None else out
+    p = lambda *a: print(*a, file=out)   # noqa: E731
+    p(f"== fleet aggregate ({agg['streams']} stream(s)) ==")
+    sketches = agg["sketches"]
+    if sketches:
+        p("\n== merged sketches (exact fleet quantiles) ==")
+        p(f"{'series':<52} {'count':>8} {'p50':>10} {'p95':>10} "
+          f"{'p99':>10} {'max':>10}")
+        for series in sorted(sketches):
+            s = sketches[series]
+            p(f"{series:<52} {s['count']:>8} {s['p50']:>10.4g} "
+              f"{s['p95']:>10.4g} {s['p99']:>10.4g} {s['max']:>10.4g}")
+        p("(quantile relative error bounded by the sketch growth "
+          f"factor: {next(iter(sketches.values()))['relative_error']:.0%})")
+    goodput = agg["goodput"]
+    if goodput:
+        p("\n== goodput (per SLO class, fleet-wide) ==")
+        p(f"{'class':<20} {'met':>8} {'missed':>8} {'rate':>8}")
+        for cls in sorted(goodput):
+            g = goodput[cls]
+            p(f"{cls:<20} {g['met']:>8g} {g['missed']:>8g} "
+              f"{g['rate']:>8.1%}")
+    counters = agg["counters"]
+    if counters:
+        p("\n== summed counters ==")
+        p(f"{'name':<52} {'total':>13}")
+        for name in sorted(counters):
+            p(f"{name:<52} {counters[name]:>13g}")
+    if not (sketches or counters):
+        p("(no mergeable records found — are these schema-v3 streams "
+          "with at least one flush?)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Merge per-host telemetry JSONL streams into one "
+                    "fleet summary (exact sketch-merged quantiles).")
+    ap.add_argument("files", nargs="+", help="telemetry .jsonl file(s), "
+                                             "one or more per host")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write the aggregate as JSON (the "
+                         "machine-readable autoscaling substrate)")
+    args = ap.parse_args(argv)
+    agg = aggregate(load_records(args.files))
+    print_report(agg)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(agg, f, indent=1, sort_keys=True)
+        print(f"\nwrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,94 @@
+"""Exporter smoke: engine up with live export, one scrape, validate,
+tear down.
+
+    python tools/exporter_smoke.py
+
+The ``tools/measure_all.py`` campaign stage for ISSUE 7: boots a tiny
+serving engine with ``observability.configure(export_port=0)`` (an
+ephemeral localhost port — the stage can never collide with a real
+exporter), drives a handful of requests across two SLO classes, then
+
+1. scrapes ``/metrics`` once and validates it with the strict
+   OpenMetrics parser (``observability/openmetrics.parse`` — a
+   malformed exposition is a hard failure, not a warning);
+2. checks the scrape carries the serving SLO families
+   (``serving_ttft_ms`` histogram buckets, goodput counters);
+3. checks ``/healthz`` answers (any status — health is a latch on
+   detector firings, and a smoke run may legitimately trip the
+   admission-stall detector while the queue drains);
+4. shuts down and verifies the exporter thread actually exited (a
+   leaked daemon thread would outlive every later stage).
+
+Exit 0 = the live export surface works end to end on this box.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from apex_tpu import observability as obs
+    from apex_tpu.models.config import gpt_125m
+    from apex_tpu.models.transformer_lm import init_gpt_params
+    from apex_tpu.observability import openmetrics
+    from apex_tpu.observability.exporter import THREAD_NAME
+    from apex_tpu.serving import ServingEngine
+
+    reg = obs.configure(export_port=0)
+    url = reg.exporter.url
+    print(f"[exporter_smoke] exporter up at {url}")
+    cfg = gpt_125m(num_layers=2, hidden_size=64, num_attention_heads=4,
+                   vocab_size=256, max_position_embeddings=128)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    engine = ServingEngine(params, cfg, max_slots=2, max_len=64)
+    rng = np.random.RandomState(0)
+    for i in range(4):
+        engine.submit(rng.randint(0, 256, (8,)), max_new_tokens=4,
+                      slo_class="interactive" if i % 2 else "standard")
+    while not engine.idle:
+        engine.step()
+
+    text = urllib.request.urlopen(url + "/metrics", timeout=5).read()
+    parsed = openmetrics.parse(text.decode("utf-8"))   # raises = fail
+    if not parsed["eof"]:
+        print("[exporter_smoke] FAIL: exposition missing # EOF")
+        return 1
+    names = {n for n, _l, _v in parsed["samples"]}
+    for want in ("serving_ttft_ms_bucket", "serving_ttft_ms_count",
+                 "serving_requests_total", "serving_slot_occupancy"):
+        if want not in names:
+            print(f"[exporter_smoke] FAIL: {want} missing from scrape "
+                  f"({len(names)} sample names)")
+            return 1
+    goodput = [n for n in names if n.startswith("serving_goodput_")]
+    if not goodput:
+        print("[exporter_smoke] FAIL: no serving_goodput_* samples")
+        return 1
+    try:
+        health = json.loads(urllib.request.urlopen(
+            url + "/healthz", timeout=5).read().decode("utf-8"))
+    except urllib.error.HTTPError as e:        # 503 = latched unhealthy;
+        health = json.loads(e.read().decode("utf-8"))   # still answers
+    print(f"[exporter_smoke] {len(parsed['samples'])} samples, "
+          f"types {len(parsed['types'])}, healthz={health.get('status')}")
+    obs.shutdown()
+    leaked = [t.name for t in threading.enumerate()
+              if t.name == THREAD_NAME]
+    if leaked:
+        print("[exporter_smoke] FAIL: exporter thread survived shutdown")
+        return 1
+    print("[exporter_smoke] OK: scrape valid, SLO families present, "
+          "clean teardown")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

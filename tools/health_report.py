@@ -12,6 +12,13 @@ trace_events timeline.  Both are machine artifacts — this tool is the
 human end: what went wrong, at which step, what the run looked like
 around it, and what to check first.
 
+Serving artifacts additionally get the SLO section (ISSUE 7): per-class
+goodput rate, TTFT/TPOT p95 with the worst class flagged, and
+preemption overhead — from the registry summary's tagged sketches in a
+flight dump, or reconstructed exactly from the per-request
+``serving.request`` end events in a trace — plus a next-action hint
+when the ``slo_violation`` detector fired.
+
 File type is auto-detected (a dump is a JSON object with
 ``dump_schema_version``; a trace is a JSON array / ``traceEvents``
 object, truncated tails tolerated).  Dependency-free on purpose: a
@@ -51,7 +58,27 @@ _HINTS = {
                                "exception or a bucket mismatch)",
     "serving_backlog": "sustained overload: add slots/replicas or "
                        "shed load",
+    "slo_violation": "a class is missing its TTFT/TPOT deadlines: "
+                     "check queue_wait vs ttft (queueing -> add "
+                     "replicas or shed lower classes), preemption "
+                     "overhead (pool too small -> raise num_blocks), "
+                     "and compile.serving.* (a retrace storm stalls "
+                     "first tokens)",
 }
+
+
+def _parse_series_key(key: str):
+    """``name{k=v,...}`` display keys (registry summaries and ISSUE 7
+    tagged series) -> (name, tags dict)."""
+    if not key.endswith("}") or "{" not in key:
+        return key, {}
+    name, _, inner = key.partition("{")
+    tags = {}
+    for part in inner[:-1].split(","):
+        k, _, v = part.partition("=")
+        if k:
+            tags[k] = v
+    return name, tags
 
 
 def _fmt_t(t) -> str:
@@ -101,6 +128,101 @@ def load_artifact(path: str):
     raise ValueError(
         f"{path}: neither a flight-recorder dump nor a trace_events "
         "file")
+
+
+# ---------------------------------------------------------------------------
+# serving SLO sections (ISSUE 7) — shared by dump and trace renderers
+# ---------------------------------------------------------------------------
+
+
+def _render_slo_rows(rows: dict, p) -> bool:
+    """One table from ``{class: {met, missed, ttft_p95, tpot_p95,
+    preempt_overhead_p95}}`` (absent fields tolerated); flags the
+    worst-TTFT class.  Returns whether anything rendered."""
+    if not rows:
+        return False
+    p("\n== serving SLO (per class) ==")
+    p(f"{'class':<16} {'requests':>9} {'goodput':>8} {'ttft p95':>11} "
+      f"{'tpot p95':>11} {'preempt p95':>12}")
+    worst_cls, worst_ttft = None, -1.0
+    for cls in sorted(rows):
+        r = rows[cls]
+        total = r.get("met", 0.0) + r.get("missed", 0.0)
+        rate = f"{r.get('met', 0.0) / total:.1%}" if total else "-"
+        ttft = r.get("ttft_p95")
+        if ttft is not None and ttft > worst_ttft:
+            worst_cls, worst_ttft = cls, ttft
+        fmt = lambda v, s="{:.4g}": "-" if v is None else s.format(v)  # noqa: E731,E501
+        p(f"{cls:<16} {fmt(total, '{:.0f}'):>9} {rate:>8} "
+          f"{fmt(ttft):>11} {fmt(r.get('tpot_p95')):>11} "
+          f"{fmt(r.get('preempt_overhead_p95')):>12}")
+    if worst_cls is not None:
+        p(f"worst-class TTFT p95: {worst_ttft:.4g} ms ({worst_cls})")
+    return True
+
+
+def _slo_rows_from_summary(summary: dict) -> dict:
+    """SLO rows from a registry summary (the flight dump's
+    ``metrics_summary``: tagged goodput counters + latency sketch
+    summaries, both keyed ``name{slo_class=...}``)."""
+    rows: dict = {}
+    for key, val in (summary.get("counters") or {}).items():
+        name, tags = _parse_series_key(key)
+        cls = tags.get("slo_class")
+        if cls is None:
+            continue
+        if name == "serving.goodput.met":
+            rows.setdefault(cls, {})["met"] = \
+                rows.get(cls, {}).get("met", 0.0) + float(val)
+        elif name == "serving.goodput.missed":
+            rows.setdefault(cls, {})["missed"] = \
+                rows.get(cls, {}).get("missed", 0.0) + float(val)
+    for key, s in (summary.get("sketches") or {}).items():
+        name, tags = _parse_series_key(key)
+        cls = tags.get("slo_class")
+        if cls is None or not isinstance(s, dict):
+            continue
+        field = {"serving.ttft_ms": "ttft_p95",
+                 "serving.tpot_ms": "tpot_p95",
+                 "serving.preempt_overhead_ms":
+                     "preempt_overhead_p95"}.get(name)
+        if field is not None and s.get("count"):
+            rows.setdefault(cls, {})[field] = s.get("p95")
+    return rows
+
+
+def _slo_rows_from_trace(end_args: List[dict]) -> dict:
+    """SLO rows reconstructed from the per-request
+    ``serving.request.end`` async events' args (the engine stamps
+    slo_class / slo_met / ttft_ms / tpot_ms / preempt_overhead_ms on
+    every completion) — exact percentiles, since a trace carries every
+    request."""
+    by_cls: dict = {}
+    for args in end_args:
+        cls = args.get("slo_class")
+        if cls is None:
+            continue
+        by_cls.setdefault(cls, []).append(args)
+    rows: dict = {}
+    for cls, events in by_cls.items():
+        def _p95(field, events=events):
+            vals = sorted(float(a[field]) for a in events
+                          if isinstance(a.get(field), (int, float)))
+            return _pct(vals, 0.95) if vals else None
+        rows[cls] = {
+            "met": sum(1.0 for a in events if a.get("slo_met") is True),
+            "missed": sum(1.0 for a in events
+                          if a.get("slo_met") is False),
+            "ttft_p95": _p95("ttft_ms"),
+            "tpot_p95": _p95("tpot_ms"),
+        }
+        overhead = sorted(
+            float(a["preempt_overhead_ms"]) for a in events
+            if isinstance(a.get("preempt_overhead_ms"), (int, float))
+            and a.get("preemptions"))
+        if overhead:
+            rows[cls]["preempt_overhead_p95"] = _pct(overhead, 0.95)
+    return rows
 
 
 # ---------------------------------------------------------------------------
@@ -178,6 +300,8 @@ def render_dump(doc: dict, out=None, last: int = 12) -> None:
         p(f"in use: {_fmt_bytes(h.get('bytes_in_use'))}   peak: "
           f"{_fmt_bytes(h.get('peak_bytes'))}   devices: "
           f"{h.get('devices', '?')}")
+    _render_slo_rows(
+        _slo_rows_from_summary(doc.get("metrics_summary") or {}), p)
     kinds = {a.get("kind") for a in anomalies}
     hints = [(k, _HINTS[k]) for k in sorted(k for k in kinds if k in _HINTS)]
     if hints:
@@ -207,6 +331,7 @@ def render_trace(events: List[dict], out=None) -> None:
     begins: dict = {}
     asyncs: dict = {}
     instants: dict = {}
+    end_args: List[dict] = []
     for ev in events:
         ph = ev.get("ph")
         name = ev.get("name", "?")
@@ -222,6 +347,9 @@ def render_trace(events: List[dict], out=None) -> None:
             if t0 is not None:
                 asyncs.setdefault(name, []).append(
                     (float(ev.get("ts", 0.0)) - t0) / 1e6)
+            if name == "serving.request" and isinstance(
+                    ev.get("args"), dict):
+                end_args.append(ev["args"])
         elif ph == "i":
             instants[name] = instants.get(name, 0) + 1
     if slices:
@@ -246,6 +374,7 @@ def render_trace(events: List[dict], out=None) -> None:
           "(begin without end — in-progress or lost to a crash):")
         for (name, rid) in sorted(begins)[:20]:
             p(f"  {name} id={rid}")
+    _render_slo_rows(_slo_rows_from_trace(end_args), p)
     if counters:
         p("\n== counter tracks (final values) ==")
         for name in sorted(counters):

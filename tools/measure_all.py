@@ -32,6 +32,13 @@ ended — costs the least-valuable stages:
 6. ``tools/step_breakdown.py --model resnet50`` — the ablation/roofline
    profile that must precede the RN50 MFU attack (VERDICT r4 #3).
 
+Plus (ISSUE 7): an ``exporter_smoke`` stage early in the campaign
+(serving engine up with live ``/metrics`` export, one scrape validated
+by the strict OpenMetrics parser, clean teardown) and a final
+``aggregate_telemetry`` stage that merges the run's JSONL stream(s)
+into ``measure_logs/fleet_aggregate.json`` — exact sketch-merged
+percentiles, the autoscaling-signal substrate of ROADMAP item 4.
+
 The flat-Adam / LN / flash-s512 win-or-delete decisions fired on the
 2026-07-31 03:46 first contact (BASELINE.md round-5 note); the one
 still-open decision rule is the flash FUSED_MAX crossover at s1024.
@@ -153,6 +160,13 @@ def main():
     # ablation rows, then the tp_overlap dryrun parity phase alone on
     # the 8-virtual-device mesh (overlapped == monolithic fwd+bwd and
     # the hops == (tp-1) x calls telemetry invariant)
+    # live export surface (ISSUE 7): engine up with export_port=0, one
+    # /metrics scrape validated by the strict OpenMetrics parser, clean
+    # teardown.  Cheap, and it gates the serving SLO telemetry the
+    # decode stage's BENCH rows now carry.
+    results["exporter_smoke"] = _run(
+        "exporter_smoke", [sys.executable, "tools/exporter_smoke.py"],
+        timeout=900)
     results["bench_tp_overlap"] = _run(
         "bench_tp_overlap",
         [sys.executable, "bench.py", "--tp-overlap"], timeout=1800)
@@ -218,6 +232,18 @@ def main():
     # subprocesses and carry their own in their BENCH JSON lines)
     print("[measure_all] runtime:", json.dumps(runtime_summary()))
     shutdown()   # flush stage spans + print the stderr summary table
+    # final stage (ISSUE 7): merge the run's telemetry stream(s) into
+    # the fleet summary — AFTER shutdown, so the driver's own flush
+    # (counters + sketch states) is in the file.  On a single host this
+    # is one stream, but the output format is exactly what ROADMAP
+    # item 4's multi-host autoscaler consumes.
+    agg_json = os.path.join(LOGS, "fleet_aggregate.json")
+    results["aggregate_telemetry"] = _run(
+        "aggregate_telemetry",
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "aggregate_telemetry.py"),
+         "--json", agg_json, telemetry_path], timeout=600)
+    print(f"[measure_all] fleet aggregate -> {agg_json}")
     print("[measure_all] post-mortem/trace rendering: "
           f"python tools/health_report.py {trace_path}")
     return 1 if any(rc != 0 for rc in results.values()) else 0

@@ -1,0 +1,202 @@
+"""Live terminal dashboard over a serving engine's ``/metrics``.
+
+    python tools/serve_dash.py http://127.0.0.1:9100
+    python tools/serve_dash.py --interval 2 127.0.0.1:9100
+    python tools/serve_dash.py --once $URL        # one frame, no clear
+
+Polls the OpenMetrics endpoint the exporter serves
+(``observability.configure(export_port=...)`` /
+``APEX_TPU_TELEMETRY_PORT``) and renders the numbers a serving fleet
+is actually operated on:
+
+- lane occupancy, queue depth, decode tokens/sec;
+- paged-pool blocks in use / free + preemption count;
+- per-SLO-class TTFT / TPOT p50 & p95 (computed from the exported
+  native histogram buckets with the same nearest-rank algorithm the
+  in-process sketch uses — the dashboard and the engine answer
+  quantile queries identically);
+- per-class goodput rate (``serving.goodput.{met,missed}``) and
+  ``/healthz`` (which latches unhealthy on any anomaly-detector
+  firing, SLO violations included).
+
+Deliberately dependency-free: stdlib HTTP + the repo's
+``openmetrics.py`` parser loaded by file path (itself stdlib-only), so
+the dashboard runs on any box that can reach the port — no jax, no
+prometheus client.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, Optional
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_openmetrics_module():
+    path = os.path.join(_ROOT, "apex_tpu", "observability",
+                        "openmetrics.py")
+    spec = importlib.util.spec_from_file_location("_apex_openmetrics",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fetch(url: str, timeout: float = 3.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8")
+
+
+def _healthz(base: str) -> str:
+    try:
+        doc = json.loads(_fetch(base + "/healthz"))
+        return doc.get("status", "?")
+    except urllib.error.HTTPError as e:       # 503 = latched unhealthy
+        try:
+            doc = json.loads(e.read().decode("utf-8"))
+            kinds = ",".join(doc.get("kinds", []))
+            return f"{doc.get('status', 'unhealthy')} ({kinds})"
+        except Exception:
+            return f"unhealthy (HTTP {e.code})"
+    except Exception as e:
+        return f"unreachable ({e.__class__.__name__})"
+
+
+def _classes(om, parsed) -> list:
+    """Every slo_class label seen on any serving SLO family."""
+    seen = []
+    for name, labels, _v in parsed["samples"]:
+        cls = labels.get("slo_class")
+        if cls is not None and cls not in seen:
+            seen.append(cls)
+    return sorted(seen)
+
+
+def snapshot(om, parsed) -> dict:
+    """The dashboard's data model from one parsed scrape."""
+    val = lambda n, l=None: om.sample_value(parsed, n, l)   # noqa: E731
+    rows: Dict[str, dict] = {}
+    for cls in _classes(om, parsed):
+        want = {"slo_class": cls}
+        row: dict = {}
+        for fam, key in (("serving_ttft_ms", "ttft"),
+                         ("serving_tpot_ms", "tpot")):
+            buckets = om.bucket_series(parsed, fam, want)
+            if buckets and buckets[-1][1] > 0:
+                row[key + "_p50"] = om.histogram_quantile(buckets, 0.50)
+                row[key + "_p95"] = om.histogram_quantile(buckets, 0.95)
+                row[key + "_n"] = buckets[-1][1]
+        met = val("serving_goodput_met_total", want) or 0.0
+        missed = val("serving_goodput_missed_total", want) or 0.0
+        if met or missed:
+            row["goodput"] = met / (met + missed)
+            row["requests"] = met + missed
+        if row:
+            rows[cls] = row
+    return {
+        "occupancy": val("serving_slot_occupancy"),
+        "queue_depth": val("serving_queue_depth"),
+        "decode_tps": val("serving_decode_tokens_per_sec"),
+        "blocks_in_use": val("serving_blocks_in_use"),
+        "blocks_free": val("serving_blocks_free"),
+        "preemptions": val("serving_preemptions_total"),
+        "requests": val("serving_requests_total"),
+        "classes": rows,
+    }
+
+
+def _fmt(v, spec="{:.4g}") -> str:
+    return "-" if v is None else spec.format(v)
+
+
+def render(snap: dict, health: str, url: str, out=None) -> None:
+    out = sys.stdout if out is None else out
+    p = lambda *a: print(*a, file=out)   # noqa: E731
+    p(f"apex_tpu serve dash — {url}   [{time.strftime('%H:%M:%S')}]   "
+      f"health: {health}")
+    occ = snap["occupancy"]
+    bar = ""
+    if occ is not None:
+        filled = int(round(min(max(occ, 0.0), 1.0) * 20))
+        bar = "[" + "#" * filled + "." * (20 - filled) + f"] {occ:.0%}"
+    p(f"  lanes {bar}   queue {_fmt(snap['queue_depth'], '{:.0f}')}   "
+      f"decode tok/s {_fmt(snap['decode_tps'])}   "
+      f"requests {_fmt(snap['requests'], '{:.0f}')}")
+    if snap["blocks_in_use"] is not None:
+        p(f"  blocks in-use {_fmt(snap['blocks_in_use'], '{:.0f}')} / "
+          f"free {_fmt(snap['blocks_free'], '{:.0f}')}   "
+          f"preemptions {_fmt(snap['preemptions'], '{:.0f}')}")
+    if snap["classes"]:
+        p(f"  {'slo_class':<14} {'reqs':>6} {'goodput':>8} "
+          f"{'ttft p50':>10} {'ttft p95':>10} {'tpot p50':>10} "
+          f"{'tpot p95':>10}")
+        for cls, row in sorted(snap["classes"].items()):
+            p(f"  {cls:<14} {_fmt(row.get('requests'), '{:.0f}'):>6} "
+              f"{_fmt(row.get('goodput'), '{:.1%}'):>8} "
+              f"{_fmt(row.get('ttft_p50')):>10} "
+              f"{_fmt(row.get('ttft_p95')):>10} "
+              f"{_fmt(row.get('tpot_p50')):>10} "
+              f"{_fmt(row.get('tpot_p95')):>10}")
+    else:
+        p("  (no completed requests yet — SLO series appear at the "
+          "first completion)")
+
+
+def one_frame(om, base: str, out=None) -> dict:
+    """Scrape + validate + render one frame; returns the snapshot
+    (the --once/test entry point)."""
+    parsed = om.parse(_fetch(base + "/metrics"))   # raises on malformed
+    snap = snapshot(om, parsed)
+    render(snap, _healthz(base), base, out=out)
+    return snap
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Terminal dashboard polling a serving engine's "
+                    "/metrics endpoint.")
+    ap.add_argument("url", help="exporter base URL (host:port or "
+                                "http://host:port)")
+    ap.add_argument("--interval", type=float, default=2.0, metavar="S",
+                    help="poll interval in seconds (default 2)")
+    ap.add_argument("--iterations", type=int, default=None, metavar="N",
+                    help="stop after N frames (default: run until ^C)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clear)")
+    args = ap.parse_args(argv)
+    base = args.url if "://" in args.url else "http://" + args.url
+    base = base.rstrip("/")
+    om = load_openmetrics_module()
+    if args.once:
+        one_frame(om, base)
+        return 0
+    n = 0
+    try:
+        while args.iterations is None or n < args.iterations:
+            frame_t = time.time()
+            sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
+            try:
+                one_frame(om, base)
+            except Exception as e:
+                print(f"scrape failed: {e!r} — retrying in "
+                      f"{args.interval:g}s")
+            n += 1
+            delay = args.interval - (time.time() - frame_t)
+            if delay > 0 and (args.iterations is None
+                              or n < args.iterations):
+                time.sleep(delay)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,20 @@ prints:
   aggregate correctly;
 - gauges: count, last, min, max;
 - events: count per name;
+- sketches (schema v3): the mergeable log-bucket histogram states the
+  registry flushes for high-volume serving series — merged exactly
+  across segments/files (same discipline as
+  ``tools/aggregate_telemetry.py``, which is the dedicated fleet-merge
+  tool) and reported as p50/p95/p99 with the sketch's bounded relative
+  error;
+- truncation flags (schema v3 ``summary`` records): any series whose
+  *live in-process* quantiles were computed over a truncated window
+  (the deque histograms keep the last 4096 observations — before v3, a
+  p95 over the last 4096 of N≫4096 observations looked exact) is
+  called out by name with observed-vs-retained counts.  The JSONL
+  span/observe series themselves are exact — the flag is about what
+  the in-process summary (stderr table, flight dumps, OpenMetrics
+  summary families) could see;
 - derived views when their series are present: ring collectives
   (``collectives.ring.*`` → implied tp) and the paged serving engine
   (``serving.blocks_*`` + ``serving.preemptions`` → block-pool
@@ -39,11 +53,25 @@ hides a whole campaign's data.
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import json
+import os
 import sys
 from typing import Dict, Iterable, List, Optional, Tuple
 
-SUPPORTED_SCHEMA = 2
+SUPPORTED_SCHEMA = 3
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_sketch_module():
+    """``apex_tpu/observability/sketches.py`` by path (stdlib-only by
+    contract) — this report must run on boxes without jax."""
+    path = os.path.join(_ROOT, "apex_tpu", "observability", "sketches.py")
+    spec = importlib.util.spec_from_file_location("_apex_sketch", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _pct(sorted_vals: List[float], q: float) -> float:
@@ -90,9 +118,21 @@ def filter_since_step(records: List[dict],
             or r["step"] >= since_step]
 
 
+def _tags_suffix(tags) -> str:
+    """``{k=v,...}`` display suffix for tagged series (ISSUE 7: the
+    per-``slo_class`` goodput counters and latency sketches are real
+    metric dimensions — collapsing them would re-mix the classes)."""
+    if not tags or not isinstance(tags, dict):
+        return ""
+    inner = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+    return "{" + inner + "}"
+
+
 def summarize(records: List[dict]) -> dict:
     spans: Dict[str, List[float]] = {}
     counters: Dict[Tuple[int, int, str], float] = {}
+    sketches: Dict[Tuple[int, int, str], dict] = {}
+    truncated: Dict[str, dict] = {}
     gauges: Dict[str, List[float]] = {}
     events: Dict[str, int] = {}
     unknown_schema = set()
@@ -119,10 +159,25 @@ def summarize(records: List[dict]) -> dict:
             try:
                 # cumulative within a run: keep the last flush value per
                 # (file, run segment)
-                key = (rec["_src"], epoch.get(rec["_src"], 0), name)
+                key = (rec["_src"], epoch.get(rec["_src"], 0),
+                       name + _tags_suffix(rec.get("tags")))
                 counters[key] = float(rec["value"])
             except (KeyError, TypeError, ValueError):
                 pass
+        elif rtype == "sketch" and name is not None:
+            # cumulative like counters: last serialized state per
+            # (file, run segment) is that stream's final sketch
+            if isinstance(rec.get("value"), dict):
+                key = (rec["_src"], epoch.get(rec["_src"], 0),
+                       name + _tags_suffix(rec.get("tags")))
+                sketches[key] = rec["value"]
+        elif rtype == "summary" and name is not None:
+            # per-histogram truncation accounting (ISSUE 7 satellite):
+            # remember any series whose live quantile window dropped
+            # observations — last state per display key wins
+            v = rec.get("value")
+            if isinstance(v, dict) and v.get("truncated"):
+                truncated[name + _tags_suffix(rec.get("tags"))] = v
         elif rtype == "gauge" and name is not None:
             try:
                 gauges.setdefault(name, []).append(float(rec["value"]))
@@ -131,11 +186,27 @@ def summarize(records: List[dict]) -> dict:
         elif rtype == "event" and name is not None:
             events[name] = events.get(name, 0) + 1
     counter_totals: Dict[str, float] = {}
-    for (_src, _epoch, name), val in counters.items():
-        counter_totals[name] = counter_totals.get(name, 0.0) + val
+    for (_src, _epoch, cname), val in counters.items():
+        counter_totals[cname] = counter_totals.get(cname, 0.0) + val
+    sketch_summaries: Dict[str, dict] = {}
+    if sketches:
+        sk = _load_sketch_module()
+        by_series: Dict[str, list] = {}
+        for (_src, _epoch, sname), state in sketches.items():
+            try:
+                by_series.setdefault(sname, []).append(
+                    sk.LogBucketSketch.from_dict(state))
+            except (KeyError, TypeError, ValueError):
+                pass
+        for sname, parts in by_series.items():
+            merged = sk.LogBucketSketch.merged(parts)
+            if merged is not None:
+                sketch_summaries[sname] = merged.summary()
     return {
         "spans": spans,
         "counters": counter_totals,
+        "sketches": sketch_summaries,
+        "truncated": truncated,
         "gauges": gauges,
         "events": events,
         "unknown_schema": sorted(unknown_schema),
@@ -221,6 +292,27 @@ def print_report(summary: dict, out=None) -> None:
             print(f"{name:<44} {len(vals):>7} {total:>11.5g} "
                   f"{total / len(vals):>11.5g} {_pct(vals, 0.50):>11.5g} "
                   f"{_pct(vals, 0.95):>11.5g} {vals[-1]:>11.5g}", file=out)
+    sketches = summary.get("sketches") or {}
+    if sketches:
+        print("== sketches (merged exactly across segments/files) ==",
+              file=out)
+        print(f"{'name':<44} {'count':>8} {'p50':>11} {'p95':>11} "
+              f"{'p99':>11} {'max':>11}", file=out)
+        for name in sorted(sketches):
+            s = sketches[name]
+            print(f"{name:<44} {s['count']:>8} {s['p50']:>11.5g} "
+                  f"{s['p95']:>11.5g} {s['p99']:>11.5g} "
+                  f"{s['max']:>11.5g}", file=out)
+    truncated = summary.get("truncated") or {}
+    if truncated:
+        print("== TRUNCATED live quantile windows ==", file=out)
+        for name in sorted(truncated):
+            v = truncated[name]
+            print(f"  {name}: live p50/p95 covered only the last "
+                  f"{v.get('retained', '?')} of {v.get('observed', '?')} "
+                  "observations — in-process summaries (stderr table, "
+                  "flight dumps) are NOT exact for this series; the "
+                  "span table above (full stream) is", file=out)
     counters = summary["counters"]
     if counters:
         print("== counters ==", file=out)
