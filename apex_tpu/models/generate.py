@@ -55,9 +55,10 @@ from apex_tpu.models.config import TransformerConfig
 from apex_tpu.models.transformer_lm import (
     apply_norm, lm_head_weight, rope_cos_sin)
 from apex_tpu.observability import metrics as _telemetry
+from apex_tpu.ops.fused_sampling import fused_sample
 
-__all__ = ["init_kv_cache", "decode_step", "prefill", "generate",
-           "sample_logits"]
+__all__ = ["init_kv_cache", "decode_step", "decode_verify", "prefill",
+           "generate", "sample_logits"]
 
 
 DEFAULT_BLOCK_SIZE = 16
@@ -162,11 +163,14 @@ def _vector_pos(cache: dict) -> jax.Array:
 
 
 def _decode_qkv(cfg, lp, x, pos, rope):
-    """Shared one-token pre-attention math (norm → qkv projection →
-    GQA split → per-sequence rotary): the contiguous and paged layer
-    bodies differ only in where K/V land and how the cache is read, so
-    this is ONE implementation of everything before that fork."""
-    b = x.shape[0]
+    """Shared pre-attention math (norm → qkv projection → GQA split →
+    per-sequence rotary) for ``x`` [b, s, h] appended at per-sequence
+    offsets ``pos`` [b] — token (i, j) sits at absolute position
+    ``pos[i] + j`` (s=1 is the decode step, s=k+1 the speculative
+    verify block): the contiguous and paged layer bodies differ only in
+    where K/V land and how the cache is read, so this is ONE
+    implementation of everything before that fork."""
+    b, s = x.shape[0], x.shape[1]
     nh = cfg.num_attention_heads
     dh = cfg.kv_channels
     h = apply_norm(cfg, x, lp["ln1_scale"], lp["ln1_bias"])
@@ -174,9 +178,9 @@ def _decode_qkv(cfg, lp, x, pos, rope):
         x.dtype)
     if cfg.is_gqa:
         from apex_tpu.models.transformer_lm import split_qkv_gqa
-        q, k, v = split_qkv_gqa(cfg, qkv, b, 1, nh)
+        q, k, v = split_qkv_gqa(cfg, qkv, b, s, nh)
     else:
-        qkv = qkv.reshape(b, 1, nh, 3 * dh)
+        qkv = qkv.reshape(b, s, nh, 3 * dh)
         q, k, v = jnp.split(qkv, 3, axis=-1)
     if rope is not None:
         cos, sin = rope          # [max_len, d]
@@ -188,8 +192,8 @@ def _decode_qkv(cfg, lp, x, pos, rope):
 
 
 def _decode_out(cfg, lp, x, h, ctx_flat):
-    """Shared one-token post-attention math (output projection →
-    residual → MLP); ``ctx_flat`` [b, 1, nh*dh]."""
+    """Shared post-attention math (output projection → residual →
+    MLP); ``ctx_flat`` [b, s, nh*dh] (s=1 decode, s=k+1 verify)."""
     a = ctx_flat @ lp["proj_kernel"].astype(x.dtype)
     a = a + lp["proj_bias"].astype(x.dtype)
     res = h if cfg.apply_residual_connection_post_layernorm else x
@@ -326,6 +330,145 @@ def decode_step(params: dict, token: jax.Array, cache: dict,
         "bsh,vh->bsv", x, lm_head_weight(params, cfg).astype(cd),
         preferred_element_type=jnp.float32)[:, 0]
     cache = {"k": new_k, "v": new_v, "pos": pos + 1}
+    if paged:
+        cache["block_tables"] = tables
+    return logits, cache
+
+
+def _verify_attention(cfg, x, h, lp, q, kk, vv, pos):
+    """Dense masked attention of ``m`` appended query tokens over a
+    gathered/contiguous cache view ``kk``/``vv`` [b, T, g, dh]: query
+    ``j`` of sequence ``i`` sees positions ``t <= pos[i] + j`` — the
+    causal pattern of a verification block (each drafted token attends
+    to the cache prefix plus the drafts before it)."""
+    b, m = q.shape[0], q.shape[1]
+    nh = cfg.num_attention_heads
+    dh = cfg.kv_channels
+    g = cfg.kv_groups
+    rep = nh // g
+    scale = 1.0 / dh ** 0.5
+    qg = q.reshape(b, m, g, rep, dh)
+    s = jnp.einsum("bqgrd,btgd->bgrqt", qg, kk,
+                   preferred_element_type=jnp.float32) * scale
+    t_idx = jnp.arange(kk.shape[1])
+    qpos = pos[:, None] + jnp.arange(m, dtype=jnp.int32)[None]  # [b, m]
+    live = (t_idx[None, None] <= qpos[:, :, None])[:, None, None]
+    s = jnp.where(live, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    ctxv = jnp.einsum("bgrqt,btgd->bqgrd", p.astype(vv.dtype), vv,
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+    return _decode_out(cfg, lp, x, h, ctxv.reshape(b, m, nh * dh))
+
+
+def _layer_verify(cfg, lp, x, cache_k, cache_v, pos, rope):
+    """One layer, ``m`` appended tokens, contiguous layout: x [b, m, h]
+    + cache slice [b, T, nh, dh]; writes land at rows
+    ``(i, pos[i]+j)`` (out-of-bounds writes drop — rejected tails past
+    the stripe are rolled back by the caller's position decrement)."""
+    b, m = x.shape[0], x.shape[1]
+    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope)
+    b_idx = jnp.arange(b)[:, None]
+    wpos = pos[:, None] + jnp.arange(m, dtype=jnp.int32)[None]
+    cache_k = cache_k.at[b_idx, wpos].set(
+        k.astype(cache_k.dtype), mode="drop")
+    cache_v = cache_v.at[b_idx, wpos].set(
+        v.astype(cache_v.dtype), mode="drop")
+    x = _verify_attention(cfg, x, h, lp, q, cache_k, cache_v, pos)
+    return x, cache_k, cache_v
+
+
+def _layer_verify_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope):
+    """One layer, ``m`` appended tokens, paged layout: the new K/V
+    scatter through the block tables (cells ``(tables[i, p//bs],
+    p % bs)``, unmapped entries drop), then attention runs over the
+    gathered block view.  Unlike the sq=1 decode step this
+    materializes the gather — a verification block amortizes the one
+    gather over its m tokens, which is exactly the batched-prefill
+    economics speculative decoding exists to exploit."""
+    b, m = x.shape[0], x.shape[1]
+    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope)
+    nb, bs = cache_k.shape[0], cache_k.shape[1]
+    mb = tables.shape[1]
+    wpos = pos[:, None] + jnp.arange(m, dtype=jnp.int32)[None]  # [b, m]
+    blk = jnp.take_along_axis(
+        tables, jnp.clip(wpos // bs, 0, mb - 1), axis=1)
+    blk = jnp.where(wpos < mb * bs, blk, nb)
+    off = wpos % bs
+    cache_k = cache_k.at[blk, off].set(
+        k.astype(cache_k.dtype), mode="drop")
+    cache_v = cache_v.at[blk, off].set(
+        v.astype(cache_v.dtype), mode="drop")
+    tbl = jnp.minimum(tables, nb - 1)
+    kk = cache_k[tbl].reshape(b, mb * bs, cache_k.shape[2],
+                              cache_k.shape[3])
+    vv = cache_v[tbl].reshape(b, mb * bs, cache_v.shape[2],
+                              cache_v.shape[3])
+    x = _verify_attention(cfg, x, h, lp, q, kk, vv, pos)
+    return x, cache_k, cache_v
+
+
+def decode_verify(params: dict, tokens: jax.Array, cache: dict,
+                  cfg: TransformerConfig):
+    """Verification forward: ``m`` tokens per sequence in ONE batched
+    pass → (logits [b, m, v], cache with ``pos`` advanced by m).
+
+    ``tokens`` [b, m] append at each sequence's ``cache['pos']``; token
+    (i, j) lands at absolute position ``pos[i]+j``, attends to the
+    cache prefix plus the tokens before it in the block, and its
+    logits row predicts position ``pos[i]+j+1`` — feeding the gold
+    sequence through this must reproduce ``decode_step`` run m times
+    (tests/test_speculative.py pins it).
+
+    This is speculative decoding's verify half (``models/
+    speculative.py``): k drafted tokens cost one forward instead of k
+    sequential decode steps, the per-step weight read amortized m ways
+    — the batched-prefill economics of PR 3 applied to decode.
+    Rollback of rejected tokens is the caller decrementing ``pos``:
+    in BOTH layouts the rejected K/V entries become invisible (masks
+    read ``t <= pos``) and are overwritten in place by the next
+    append — no copy, and in the paged layout not even a block
+    operation (the tail block simply has fewer live cells)."""
+    _check_decode_cfg(cfg)
+    cd = cfg.compute_dtype
+    paged = "block_tables" in cache
+    pos = _vector_pos(cache)
+    b, m = tokens.shape
+    x = jnp.take(params["embedding"]["word"].astype(cd), tokens, axis=0)
+    if cfg.position_embedding_type == "learned":
+        rows = jnp.clip(pos[:, None] + jnp.arange(m, dtype=jnp.int32),
+                        0, cfg.max_position_embeddings - 1)
+        pe = jnp.take(params["embedding"]["position"], rows, axis=0)
+        x = x + pe.astype(cd)
+    rope = None
+    if cfg.position_embedding_type == "rope":
+        if paged:
+            max_pos = cache["block_tables"].shape[1] * cache["k"].shape[2]
+        else:
+            max_pos = cache["k"].shape[2]
+        rope = rope_cos_sin(max_pos, cfg.kv_channels)
+
+    if paged:
+        tables = cache["block_tables"].astype(jnp.int32)
+
+        def body(x, layer_in):
+            lp, ck, cv = layer_in
+            x, ck, cv = _layer_verify_paged(cfg, lp, x, ck, cv, tables,
+                                            pos, rope)
+            return x, (ck, cv)
+    else:
+        def body(x, layer_in):
+            lp, ck, cv = layer_in
+            x, ck, cv = _layer_verify(cfg, lp, x, ck, cv, pos, rope)
+            return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = apply_norm(cfg, x, params["final_ln"]["scale"],
+                   params["final_ln"]["bias"])
+    logits = jnp.einsum(
+        "bsh,vh->bsv", x, lm_head_weight(params, cfg).astype(cd),
+        preferred_element_type=jnp.float32)
+    cache = {"k": new_k, "v": new_v, "pos": pos + m}
     if paged:
         cache["block_tables"] = tables
     return logits, cache
@@ -477,45 +620,20 @@ def sample_logits(logits, key, *, temperature: float = 0.0,
     50257 to 50304; the zero-logit pad ids would otherwise be sampleable
     and can even win argmax when all real logits are negative).
 
-    Without ``top_p`` the top-k cutoff uses ``jax.lax.top_k``
-    (O(v·log k)) instead of a full descending sort (O(v·log v)) —
-    sample_logits runs once per decoded token, and at GPT-2's 50k vocab
-    the full sort is real money.  The single-sort path survives only
-    where the nucleus mass genuinely needs the sorted cumulative sum.
+    Since ISSUE 8 this is a thin wrapper over
+    :func:`apex_tpu.ops.fused_sampling.fused_sample`, which fuses the
+    whole temperature → top-k/top-p → draw chain into one kernel on the
+    decode hot path (``APEX_TPU_FUSED_SAMPLING`` routes; the XLA
+    reference path is bit-identical to the historical op sequence
+    given the same key, so seeded callers see no change off-TPU).
+    ``temperature == 0`` short-circuits every filter and returns the
+    argmax — the cutoffs cannot change which token is largest
+    (regression-pinned in tests/test_fused_sampling.py).
     """
     _check_sampling_args(temperature, top_k)
-    if vocab_limit is not None:
-        over = jnp.arange(logits.shape[-1]) >= vocab_limit
-        logits = jnp.where(over[None], -1e30, logits)
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
-    if top_p is None:
-        if top_k is not None:
-            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-            logits = jnp.where(logits < kth, -1e30, logits)
-        return jax.random.categorical(key, logits).astype(jnp.int32)
-    # one descending sort serves both cutoffs (the nucleus mass below
-    # needs the sorted cumulative sum anyway)
-    sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
-    if top_k is not None:
-        kth = sorted_l[:, top_k - 1][:, None]
-        logits = jnp.where(logits < kth, -1e30, logits)
-        # reflect the cutoff in sorted space so the nucleus mass
-        # below is computed over the top_k-filtered distribution
-        rank = jnp.arange(sorted_l.shape[-1])[None]
-        sorted_l = jnp.where(rank >= top_k, -1e30, sorted_l)
-    # nucleus: drop tokens outside the smallest prob-sorted prefix
-    # reaching mass top_p; n_keep clamps to 1 so the head token always
-    # stays (top_p<=0 means near-greedy, not a silent no-op)
-    probs = jax.nn.softmax(sorted_l, axis=-1)
-    csum = jnp.cumsum(probs, axis=-1)
-    keep_sorted = (csum - probs) < top_p
-    n_keep = jnp.maximum(jnp.sum(keep_sorted, axis=-1), 1)
-    cutoff = jnp.take_along_axis(
-        sorted_l, (n_keep - 1)[:, None], axis=-1)
-    logits = jnp.where(logits < cutoff, -1e30, logits)
-    return jax.random.categorical(key, logits).astype(jnp.int32)
+    return fused_sample(logits, key, temperature=temperature,
+                        top_k=top_k, top_p=top_p,
+                        vocab_limit=vocab_limit)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -602,9 +720,21 @@ def generate(
     cache_dtype=None,
     cache_layout: str = "contiguous",
     block_size: int = DEFAULT_BLOCK_SIZE,
+    spec=None,
 ) -> jax.Array:
     """Decode up to ``max_new_tokens`` past ``prompt`` [b, s] →
     [b, s+max_new_tokens].
+
+    ``spec`` enables speculative decoding (``"ngram"`` for n-gram
+    self-drafting with the default knobs, a ``models.speculative.
+    SpecConfig`` for tuning or a draft-model hook, ``None``/``"off"``
+    for the plain path): k drafted tokens are verified by ONE batched
+    :func:`decode_verify` forward per round instead of k sequential
+    decode steps.  Greedy output is token-identical to ``spec=None``
+    on both cache layouts and sampling is distribution-identical
+    (``models/speculative.py`` has the correctness argument); the
+    realized ``generate.spec.{draft_tokens,accepted_tokens,
+    verify_calls}`` counters land in telemetry when configured.
 
     ``cache_layout="paged"`` runs the same prefill + while-loop decode
     over the block-pool cache (``block_size`` tokens per block, tables
@@ -659,6 +789,25 @@ def generate(
         raise ValueError(
             f"cache_layout={cache_layout!r}: expected 'contiguous' or "
             "'paged'")
+    from apex_tpu.models.speculative import resolve_spec, spec_generate
+
+    if resolve_spec(spec) is not None:
+        tokens, stats = spec_generate(
+            params, prompt, cfg, spec=spec,
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, rng=rng, vocab_limit=vocab_limit,
+            prompt_lens=prompt_lens, eos_token_id=eos_token_id,
+            cache_dtype=cache_dtype, cache_layout=cache_layout,
+            block_size=block_size)
+        if _telemetry.enabled():
+            _telemetry.counter("generate.prefill_calls").inc()
+            _telemetry.counter("generate.spec.draft_tokens").inc(
+                stats["draft_tokens"])
+            _telemetry.counter("generate.spec.accepted_tokens").inc(
+                stats["accepted_tokens"])
+            _telemetry.counter("generate.spec.verify_calls").inc(
+                stats["verify_calls"])
+        return tokens
     tokens, n_steps = _generate_impl(
         params, prompt, prompt_lens, rng, cfg=cfg,
         max_new_tokens=max_new_tokens, temperature=temperature,

@@ -1,0 +1,441 @@
+"""Speculative decoding: n-gram self-drafting + batched verification.
+
+Decode is bandwidth-bound: every ``decode_step`` reads the whole
+parameter set to produce ONE token.  Speculative decoding amortizes
+that weight read — a cheap *drafter* proposes ``k`` tokens, one
+batched :func:`~apex_tpu.models.generate.decode_verify` forward scores
+all of them (the PR 3 flash-prefill economics applied to decode), and
+standard leftover-distribution rejection sampling keeps exactly the
+prefix the target model agrees with (ROADMAP item 2).
+
+Correctness contract (tests/test_speculative.py pins both halves):
+
+- **greedy** (temperature 0): a draft token is accepted iff it equals
+  the target argmax, and the correction token IS the target argmax at
+  the first disagreement — so spec-on output is *token-identical* to
+  spec-off greedy decoding, on both cache layouts;
+- **sampling**: a draft ``d`` proposed with probability ``q(d)`` is
+  accepted with probability ``min(1, p(d)/q(d))``; on rejection the
+  replacement is drawn from ``norm(max(p − q, 0))``.  The emitted
+  marginal is exactly ``p`` (the Leviathan/Chen speculative-sampling
+  identity), so spec-on sampling is *distribution-identical* —
+  drafting quality affects only speed, never the distribution.  The
+  n-gram drafter is a point mass (``q(d) = 1``), for which the rule
+  degenerates to: accept with probability ``p(d)``, else resample from
+  ``p`` with ``d`` removed.
+
+The default drafter needs NO draft model: :func:`ngram_draft` is
+prompt-lookup decoding — find the most recent earlier occurrence of
+the current suffix n-gram in prompt+generated tokens and propose the
+tokens that followed it.  It is fully vectorized (device-side, jits
+into the decode ``while_loop`` — no host sync per round) and wins
+exactly where LLM serving traffic repeats itself: code, quoted
+context, templated text, and the self-repetition loops of greedy
+decoding.  A small draft *model* plugs in through
+``SpecConfig(draft_fn=...)`` — any traceable callable proposing
+``(draft, q_probs)``.
+
+Cache interplay: verification writes k+1 speculative K/V entries;
+rollback of the rejected tail is just the position decrement
+``decode_verify`` documents — in the paged layout (PR 6) not even a
+block operation, which is why the two compose so cheaply.
+
+Telemetry: ``generate(spec=...)`` and the serving engine surface the
+realized counters ``generate.spec.{draft_tokens,accepted_tokens,
+verify_calls}`` (host-side — the values are data-dependent;
+``verify_calls`` counts per-sequence verify passes, so a batched
+forward books once per live row and every ratio below is
+batch-size-independent); accept rate = accepted/draft and
+tokens-per-verify = (accepted+verify)/verify (ceiling k+1) are the
+two derived numbers ``tools/telemetry_report.py`` prints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.generate import (
+    _check_decode_cfg, _check_sampling_args, decode_verify,
+    init_kv_cache, prefill, sample_logits)
+from apex_tpu.ops.fused_sampling import filter_logits
+
+__all__ = ["SpecConfig", "resolve_spec", "ngram_draft", "spec_round",
+           "spec_generate"]
+
+_NEG_INF = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Speculative-decoding knobs (static — part of the jit key).
+
+    ``k``: drafted tokens per verify round; each round emits between 1
+    and k+1 tokens for one verify forward, so k bounds the speedup at
+    (k+1)x and the per-round wasted FLOPs at kx.  ``max_ngram`` /
+    ``min_ngram``: suffix sizes the n-gram drafter tries, longest
+    first (longer suffixes make rarer but more reliable matches).
+    ``draft_fn``: optional draft-model hook — a traceable
+    ``f(tokens [b, T], lens [b], k) -> (draft [b, k] int32, q_probs
+    [b, k, v] | None)``; ``None`` q_probs means a point-mass proposal
+    (the n-gram case).  The callable must be hashable (a plain
+    function or functools.partial), since it keys the jit cache."""
+
+    k: int = 8
+    max_ngram: int = 3
+    min_ngram: int = 1
+    draft_fn: Optional[Callable] = None
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"spec k={self.k} must be >= 1")
+        if not (1 <= self.min_ngram <= self.max_ngram):
+            raise ValueError(
+                f"need 1 <= min_ngram ({self.min_ngram}) <= max_ngram "
+                f"({self.max_ngram})")
+
+
+def resolve_spec(spec) -> Optional[SpecConfig]:
+    """Normalize the ``spec=`` argument: None/"off" disables,
+    ``"ngram"`` takes the defaults, a :class:`SpecConfig` passes
+    through."""
+    if spec is None or spec == "off":
+        return None
+    if spec == "ngram":
+        return SpecConfig()
+    if isinstance(spec, SpecConfig):
+        return spec
+    raise ValueError(
+        f"spec={spec!r}: expected None, 'off', 'ngram', or a SpecConfig")
+
+
+def ngram_draft(tokens: jax.Array, lens: jax.Array, *, k: int,
+                max_ngram: int = 3, min_ngram: int = 1) -> jax.Array:
+    """Prompt-lookup drafting, fully vectorized: propose the ``k``
+    tokens that followed the most recent earlier occurrence of the
+    current suffix n-gram.
+
+    ``tokens`` [b, T] is the emitted history (prompt + generated,
+    entries at and past ``lens[i]`` ignored), ``lens`` [b] the live
+    length — the suffix ends at ``tokens[i, lens[i]-1]``.  Sizes
+    ``max_ngram..min_ngram`` are tried longest-first; the first size
+    with a match wins, and within a size the MOST RECENT match wins
+    (recency tracks the local pattern, the property prompt-lookup
+    decoding relies on).  Rows with no match (or a match at the very
+    end) draft the clamped continuation — reads past ``lens-1`` repeat
+    the final token, a deliberately cheap guess that simply gets
+    rejected when wrong."""
+    b, T = tokens.shape
+    lens = lens.astype(jnp.int32)
+    idx = jnp.arange(T, dtype=jnp.int32)
+    best_j = jnp.maximum(lens - 1, 0)       # fallback: repeat last token
+    found = jnp.zeros((b,), bool)
+    for n in range(max_ngram, min_ngram - 1, -1):
+        eq = jnp.ones((b, T), bool)
+        for i in range(n):
+            suf = jnp.take_along_axis(
+                tokens, jnp.maximum(lens - 1 - i, 0)[:, None], axis=1)
+            # token at j-i aligned under j (rolled entries at j < i are
+            # masked out by the validity window below)
+            shifted = jnp.roll(tokens, i, axis=1)
+            eq = eq & (shifted == suf)
+        # window: full n-gram exists (j >= n-1), strictly earlier than
+        # the suffix itself (j <= lens-2), and the row holds >= n tokens
+        valid = ((idx[None] >= n - 1) & (idx[None] <= lens[:, None] - 2)
+                 & (lens[:, None] >= n))
+        cand = jnp.where(eq & valid, idx[None], -1)
+        jn = jnp.max(cand, axis=1)
+        best_j = jnp.where(~found & (jn >= 0), jn, best_j)
+        found = found | (jn >= 0)
+    gidx = best_j[:, None] + 1 + jnp.arange(k, dtype=jnp.int32)[None]
+    gidx = jnp.clip(gidx, 0, jnp.maximum(lens[:, None] - 1, 0))
+    return jnp.take_along_axis(tokens, gidx, axis=1).astype(jnp.int32)
+
+
+def _spec_probs(logits, temperature, top_k, top_p, vocab_limit):
+    """Per-position target distributions [b, m, v] for acceptance: the
+    SAME filter chain the sampler applies (``filter_logits``), so the
+    accept/resample arithmetic runs against exactly the distribution a
+    non-speculative step would have sampled from.  Greedy rows
+    (temperature 0) become one-hot argmax — under which the generic
+    rejection rule degenerates to exact token matching."""
+    b, m, v = logits.shape
+    flat = logits.reshape(b * m, v)
+    if vocab_limit is not None:
+        over = jnp.arange(v) >= vocab_limit
+        flat = jnp.where(over[None], _NEG_INF, flat)
+    onehot = jax.nn.one_hot(jnp.argmax(flat, axis=-1), v,
+                            dtype=jnp.float32)
+    if hasattr(temperature, "ndim") and getattr(temperature, "ndim", 0):
+        temps = jnp.repeat(temperature.astype(jnp.float32), m)
+        scaled = flat / jnp.maximum(temps, 1e-6)[:, None]
+        soft = jax.nn.softmax(
+            filter_logits(scaled, top_k=top_k, top_p=top_p), axis=-1)
+        probs = jnp.where((temps > 0)[:, None], soft, onehot)
+    elif float(temperature) == 0.0:
+        probs = onehot
+    else:
+        scaled = flat / float(temperature)
+        probs = jax.nn.softmax(
+            filter_logits(scaled, top_k=top_k, top_p=top_p), axis=-1)
+    return probs.reshape(b, m, v)
+
+
+def _accept(draft, probs, q_probs, key):
+    """Leftover-distribution rejection sampling over one verify block.
+
+    ``draft`` [b, k], ``probs`` [b, k+1, v] target distributions (row
+    j for the position draft j+1 sits at; row k is the bonus
+    position), ``q_probs`` [b, k, v] proposal distributions or None
+    (point mass).  Returns ``(n_acc [b], y [b])``: the accepted-prefix
+    length and the correction token (drawn from
+    ``norm(max(p − q, 0))`` at the first rejection) or bonus token
+    (drawn from ``p`` when everything was accepted)."""
+    b, k = draft.shape
+    v = probs.shape[-1]
+    key_u, key_y = jax.random.split(key)
+    u = jax.random.uniform(key_u, (b, k), jnp.float32)
+    pd = jnp.take_along_axis(probs[:, :k], draft[..., None],
+                             axis=-1)[..., 0]
+    if q_probs is None:
+        ratio = pd                                   # q(d) = 1
+    else:
+        qd = jnp.take_along_axis(q_probs, draft[..., None],
+                                 axis=-1)[..., 0]
+        ratio = pd / jnp.maximum(qd, 1e-20)
+    accept = u < ratio
+    n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                    axis=1)
+    p_at = jnp.take_along_axis(probs, n_acc[:, None, None],
+                               axis=1)[:, 0]          # [b, v]
+    rej_col = jnp.minimum(n_acc, k - 1)
+    d_rej = jnp.take_along_axis(draft, rej_col[:, None], axis=1)[:, 0]
+    if q_probs is None:
+        q_at = jax.nn.one_hot(d_rej, v, dtype=jnp.float32)
+    else:
+        q_at = jnp.take_along_axis(q_probs, rej_col[:, None, None],
+                                   axis=1)[:, 0]
+    leftover = jnp.maximum(p_at - q_at, 0.0)
+    z = jnp.sum(leftover, axis=-1, keepdims=True)
+    rejected = (n_acc < k)[:, None]
+    # all-accept rows draw the bonus token from p; rejected rows from
+    # the leftover (falling back to p in the measure-zero corner where
+    # the leftover mass underflows — p(d) ≈ 1 yet u >= p(d))
+    dist = jnp.where(rejected & (z > 1e-9),
+                     leftover / jnp.maximum(z, 1e-9), p_at)
+    y = jax.random.categorical(
+        key_y, jnp.log(jnp.maximum(dist, 1e-38)))
+    return n_acc, y.astype(jnp.int32)
+
+
+def spec_round(params, cfg, cache, nxt, tokens, lens, key, *, spec,
+               temperature, top_k=None, top_p=None, vocab_limit=None):
+    """One draft → verify → accept round (the shared core of
+    ``generate(spec=...)``'s jitted loop and the serving engine's
+    jitted multi-token step).
+
+    ``nxt`` [b]: the pending token — emitted, not yet in the cache
+    (``cache['pos']`` points at its position).  ``tokens`` [b, T]:
+    emitted history including ``nxt`` (the drafter's haystack);
+    ``lens`` [b]: its live length.  Returns ``(em, n_acc, y, cache,
+    prev_pos)`` where ``em`` [b, k+1] holds the round's candidate
+    emission (accepted drafts then the correction/bonus token ``y`` at
+    column ``n_acc`` — columns past it are dead), the cache has all
+    k+1 speculative entries written and ``pos`` advanced by k+1, and
+    ``prev_pos`` is the entry position: the caller commits
+    ``pos = prev_pos + n_emit`` once it has applied its own EOS/budget
+    truncation — the rollback-is-a-decrement contract."""
+    k = spec.k
+    if spec.draft_fn is not None:
+        draft, q_probs = spec.draft_fn(tokens, lens, k)
+        draft = draft.astype(jnp.int32)
+    else:
+        draft = ngram_draft(tokens, lens, k=k, max_ngram=spec.max_ngram,
+                            min_ngram=spec.min_ngram)
+        q_probs = None
+    prev_pos = cache["pos"]
+    seq = jnp.concatenate([nxt[:, None].astype(jnp.int32), draft],
+                          axis=1)
+    logits, cache = decode_verify(params, seq, cache, cfg)
+    probs = _spec_probs(logits, temperature, top_k, top_p, vocab_limit)
+    n_acc, y = _accept(draft, probs, q_probs, key)
+    # candidate emission: draft prefix with y scattered at column n_acc
+    em = jnp.concatenate([draft, draft[:, -1:]], axis=1)
+    em = jnp.where(jnp.arange(k + 1)[None] == n_acc[:, None],
+                   y[:, None], em)
+    return em, n_acc, y, cache, prev_pos
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "cfg", "spec", "max_new_tokens", "temperature", "top_k", "top_p",
+    "vocab_limit", "eos_token_id", "cache_dtype", "cache_layout",
+    "block_size"))
+def _spec_generate_impl(params, prompt, prompt_lens, rng, *, cfg, spec,
+                        max_new_tokens, temperature, top_k, top_p,
+                        vocab_limit, eos_token_id, cache_dtype,
+                        cache_layout, block_size):
+    """Prefill + while-loop of spec rounds; returns (tokens [b,
+    s+max_new], stats [3] = draft/accepted/verify counters)."""
+    b, s = prompt.shape
+    total = s + max_new_tokens
+    k = spec.k
+    # k+1 headroom: a verify block may write past the budget before its
+    # tail is rolled back — those cells must exist in both layouts
+    cache = init_kv_cache(cfg, b, total + k + 1, cache_dtype=cache_dtype,
+                          cache_layout=cache_layout,
+                          block_size=block_size)
+    lens = (jnp.full((b,), s, jnp.int32) if prompt_lens is None
+            else prompt_lens.astype(jnp.int32))
+    logits, cache = prefill(params, prompt, cfg,
+                            prompt_lens=prompt_lens, cache=cache)
+    tokens = jnp.concatenate(
+        [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1)
+    b_idx = jnp.arange(b)[:, None]
+    col = jnp.arange(total)
+
+    # first token from the prefill logits — the same pick (and the same
+    # key schedule) as the non-speculative path
+    key, sub = jax.random.split(rng)
+    nxt = sample_logits(logits, sub, temperature=temperature,
+                        top_k=top_k, top_p=top_p,
+                        vocab_limit=vocab_limit)
+    tokens = jnp.where(col[None] == lens[:, None],
+                       nxt[:, None].astype(tokens.dtype), tokens)
+    done = (nxt == eos_token_id) if eos_token_id is not None else (
+        jnp.zeros((b,), bool))
+    done = done | (max_new_tokens <= 1)
+    emitted = jnp.ones((b,), jnp.int32)
+    stats = jnp.zeros((3,), jnp.int32)    # draft, accepted, verify
+
+    def cond(carry):
+        return ~jnp.all(carry[0])
+
+    def body(carry):
+        done, tokens, cache, key, nxt, emitted, stats = carry
+        key, sub = jax.random.split(key)
+        em, n_acc, y, cache, prev_pos = spec_round(
+            params, cfg, cache, nxt, tokens, lens + emitted, sub,
+            spec=spec, temperature=temperature, top_k=top_k,
+            top_p=top_p, vocab_limit=vocab_limit)
+        n_raw = n_acc + 1
+        budget = max_new_tokens - emitted
+        n_emit = jnp.minimum(n_raw, budget)
+        if eos_token_id is not None:
+            # truncate at the first emitted EOS (the EOS itself is
+            # written; nothing after it)
+            is_eos = em == eos_token_id
+            first = jnp.min(jnp.where(
+                is_eos, jnp.arange(k + 1)[None], k + 1), axis=1)
+            n_emit = jnp.minimum(n_emit, first + 1)
+        n_emit = jnp.where(done, 0, n_emit)
+        # masked columns are pushed out of bounds and DROPPED — a
+        # clipped in-bounds dummy column could collide with a real
+        # write at the array edge and scatter-order would pick the
+        # winner arbitrarily
+        wm = (jnp.arange(k + 1)[None] < n_emit[:, None])
+        wcols = jnp.where(
+            wm, (lens + emitted)[:, None]
+            + jnp.arange(k + 1, dtype=jnp.int32)[None], total)
+        tokens = tokens.at[b_idx, wcols].set(
+            em.astype(tokens.dtype), mode="drop")
+        # the new pending token: the last committed one this round
+        last = jnp.take_along_axis(
+            em, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+        nxt = jnp.where(done, nxt, last)
+        new_done = done | (emitted + n_emit >= max_new_tokens)
+        if eos_token_id is not None:
+            hit = jnp.any(jnp.where(wm, em == eos_token_id, False),
+                          axis=1)
+            new_done = new_done | hit
+        emitted = emitted + n_emit
+        # rollback: keep the committed entries, decrement away the
+        # rejected tail (done rows freeze where they were)
+        cache = dict(cache, pos=jnp.where(done, prev_pos,
+                                          prev_pos + n_emit))
+        # verify_calls counts PER-SEQUENCE verify passes (a batched
+        # forward counts once per live row): it is the amortization
+        # denominator — (accepted + verify) / verify tokens emitted
+        # per verify, ceiling k+1 — and stays batch-size-independent
+        live = (~done).astype(jnp.int32)
+        stats = stats + jnp.stack([
+            jnp.int32(k) * jnp.sum(live),
+            jnp.sum(n_acc * live),
+            jnp.sum(live)])
+        return (new_done, tokens, cache, key, nxt, emitted, stats)
+
+    carry = (done, tokens, cache, key, nxt, emitted, stats)
+    done, tokens, _, _, _, _, stats = jax.lax.while_loop(cond, body,
+                                                         carry)
+    return tokens, stats
+
+
+def spec_generate(
+    params: dict,
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    spec="ngram",
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    rng: Optional[jax.Array] = None,
+    vocab_limit: Optional[int] = None,
+    prompt_lens: Optional[jax.Array] = None,
+    eos_token_id: Optional[int] = None,
+    cache_dtype=None,
+    cache_layout: str = "contiguous",
+    block_size: int = 16,
+):
+    """Speculative decoding past ``prompt`` [b, s] → (tokens
+    [b, s+max_new_tokens], stats dict).
+
+    Same surface and output contract as
+    :func:`~apex_tpu.models.generate.generate` — greedy output is
+    token-identical to the non-speculative path on both cache layouts
+    and stochastic output is distribution-identical (module
+    docstring) — plus the realized counters ``stats = {"draft_tokens",
+    "accepted_tokens", "verify_calls"}`` so callers (``bench.py
+    --spec``) can report accept rates without a telemetry registry.
+    ``generate(spec=...)`` wraps this and feeds the same numbers into
+    the ``generate.spec.*`` telemetry counters."""
+    spec_cfg = resolve_spec(spec)
+    if spec_cfg is None:
+        raise ValueError("spec_generate needs an enabled spec config; "
+                         "call generate() for the plain path")
+    _check_sampling_args(temperature, top_k)
+    _check_decode_cfg(cfg)
+    b, s = prompt.shape
+    if (cfg.position_embedding_type == "learned"
+            and s + max_new_tokens + spec_cfg.k + 1
+            > cfg.max_position_embeddings):
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) + spec "
+            f"verify headroom ({spec_cfg.k + 1}) exceeds "
+            f"max_position_embeddings ({cfg.max_position_embeddings}); "
+            "the learned position lookup would silently clamp")
+    if cache_layout not in ("contiguous", "paged"):
+        raise ValueError(
+            f"cache_layout={cache_layout!r}: expected 'contiguous' or "
+            "'paged'")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    if prompt_lens is not None:
+        prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+    tokens, stats = _spec_generate_impl(
+        params, prompt, prompt_lens, rng, cfg=cfg, spec=spec_cfg,
+        max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, top_p=top_p, vocab_limit=vocab_limit,
+        eos_token_id=eos_token_id, cache_dtype=cache_dtype,
+        cache_layout=cache_layout, block_size=block_size)
+    stats = {
+        "draft_tokens": int(stats[0]),
+        "accepted_tokens": int(stats[1]),
+        "verify_calls": int(stats[2]),
+    }
+    return tokens, stats

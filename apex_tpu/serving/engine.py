@@ -27,7 +27,10 @@ Each :meth:`ServingEngine.step`:
    over ALL lanes (the batch stays rectangular; inactive lanes ride
    along masked, their cache positions frozen), then a vectorized
    sample with per-slot temperatures.  One host sync per step reads the
-   new tokens for EOS / length bookkeeping.
+   new tokens for EOS / length bookkeeping.  With ``spec=`` (ISSUE 8)
+   the step is instead one speculative draft→verify→accept round and
+   each live lane emits 1..k+1 tokens per poll — same single host
+   sync, several tokens of progress.
 3. **complete** — lanes whose token hit ``eos_token_id`` or whose
    budget ran out are converted to :class:`Response` and released.
 
@@ -110,6 +113,7 @@ import numpy as np
 from apex_tpu.models.config import TransformerConfig
 from apex_tpu.models.generate import (
     _check_decode_cfg, decode_step, init_kv_cache, prefill, sample_logits)
+from apex_tpu.models.speculative import resolve_spec, spec_round
 from apex_tpu.observability import metrics as _telemetry
 from apex_tpu.observability import span
 from apex_tpu.observability.device import (
@@ -121,6 +125,7 @@ from apex_tpu.serving.paged_cache import (
     prefix_block_hashes)
 from apex_tpu.serving.slo import judge as _judge_slo
 from apex_tpu.serving.slo import resolve_slo_targets
+from apex_tpu.serving.slo import tpot_ms as _tpot_ms
 
 __all__ = ["Request", "Response", "ServingEngine"]
 
@@ -158,10 +163,13 @@ class Request:
     resume_tokens: List[int] = dataclasses.field(
         default_factory=list, repr=False)
     # times this request was preempted (paged layout).  Each admission
-    # (initial or resume) samples one token from prefill logits, so the
-    # request's realized decode-step count is
-    # ``len(tokens) - 1 - preemptions``
+    # (initial or resume) samples one token from prefill logits, not a
+    # decode poll
     preemptions: int = 0
+    # decode polls accumulated BEFORE the latest preemption, so the
+    # poll count survives preempt→resume (the resumed slot continues
+    # counting from here); Response.decode_steps reports the total
+    resume_polls: int = 0
     # memoized (token_count, full_tokens, prefix_block_hashes) for the
     # paged admission path: _blocks_needed runs every step() while the
     # head request waits on the block budget, and _claim_blocks needs
@@ -221,6 +229,10 @@ class _Slot:
     blocks: List[int] = dataclasses.field(default_factory=list)
     cache_len: int = 0            # tokens materialized in the KV cache
     shared_blocks: int = 0        # prefix blocks mapped, not allocated
+    # engine polls this lane was live for — under speculative decoding
+    # (ISSUE 8) one poll emits several tokens, so polls and tokens are
+    # DIFFERENT numbers and Response.decode_steps reports this one
+    decode_polls: int = 0
 
 
 class ServingEngine:
@@ -242,6 +254,18 @@ class ServingEngine:
     ``vocab_limit`` are engine-wide static sampling knobs (a jit
     recompile each — per-request values would retrace); temperature is
     per-request (a traced ``[max_slots]`` vector).
+
+    ``spec`` (ISSUE 8) turns each poll into a speculative round
+    (``"ngram"`` or a ``models.speculative.SpecConfig``): every live
+    lane drafts ``spec.k`` tokens from its own history, ONE batched
+    verify forward scores all lanes' drafts, and each lane emits its
+    accepted prefix plus the correction token — up to ``k+1`` tokens
+    per poll for one forward.  Greedy lanes stay token-identical to a
+    spec-off engine (incl. across preempt→resume — tests/
+    test_speculative.py), sampled lanes distribution-identical;
+    ``Response.decode_steps`` counts POLLS, the SLO TPOT divides by
+    tokens delivered, and the ``generate.spec.*`` counters carry the
+    realized accept rate.
     """
 
     def __init__(self, params: dict, cfg: TransformerConfig, *,
@@ -254,6 +278,7 @@ class ServingEngine:
                  top_p: Optional[float] = None,
                  vocab_limit: Optional[int] = None,
                  slo_targets: Optional[dict] = None,
+                 spec=None,
                  rng: Optional[jax.Array] = None):
         _check_decode_cfg(cfg)
         if cache_layout not in ("contiguous", "paged"):
@@ -264,6 +289,15 @@ class ServingEngine:
         self.cfg = cfg
         self.max_slots = int(max_slots)
         self.max_len = int(max_len or cfg.max_position_embeddings)
+        # speculative decoding (ISSUE 8): each poll drafts spec.k
+        # tokens per lane, verifies them in ONE batched forward, and
+        # emits the accepted prefix + correction — several tokens per
+        # poll.  _spec_ahead is the KV write horizon a poll may touch
+        # past a lane's materialized length (the pending token plus k
+        # drafts), which sizes paged tail-block pre-allocation and the
+        # admission worst case.
+        self._spec = resolve_spec(spec)
+        self._spec_ahead = 1 if self._spec is None else self._spec.k + 1
         if (cfg.position_embedding_type == "learned"
                 and self.max_len > cfg.max_position_embeddings):
             raise ValueError(
@@ -320,6 +354,18 @@ class ServingEngine:
         # decode lane state, host-side mirrors of the device batch
         self._pending = np.zeros((self.max_slots,), np.int32)
         self._temps = np.zeros((self.max_slots,), np.float32)
+        # spec only: per-lane emitted-token history (prompt+generated,
+        # pending token included), the n-gram drafter's haystack.  It
+        # LIVES ON DEVICE and is donated through the decode step like
+        # the KV cache — the step itself appends each poll's delivered
+        # tokens, so steady-state polls pay no host→device re-upload;
+        # only admissions/resumes write a row from the host.
+        if self._spec is not None:
+            self._history = jnp.zeros(
+                (self.max_slots, self.max_len), jnp.int32)
+            self._hist_len = jnp.zeros((self.max_slots,), jnp.int32)
+        else:
+            self._history = self._hist_len = None
         self._next_id = 0
         self._decode_count = 0
         self._preempt_count = 0
@@ -330,7 +376,8 @@ class ServingEngine:
         # into serving.goodput.{met,missed} and the SLO detector
         self._slo_targets = resolve_slo_targets(slo_targets)
         self._decode_fn = _make_decode_fn(cfg, top_k, top_p, vocab_limit,
-                                          cache_layout == "paged")
+                                          cache_layout == "paged",
+                                          self._spec)
         self._sample_fn = _make_sample_fn(top_k, top_p, vocab_limit)
 
     # -- public API --------------------------------------------------------
@@ -352,8 +399,17 @@ class ServingEngine:
                 f"({self.max_len}); raise max_len or shorten the request")
         pick_bucket(req.prompt.size, self._submit_buckets)  # validate early
         if self._mgr is not None:
-            worst = (blocks_for(req.prompt.size + req.max_new_tokens,
-                                self.block_size) + self.reserve_blocks)
+            # spec adds a write horizon: a verify block touches up to
+            # spec.k cells past the materialized length before its
+            # rejected tail rolls back, so the solo worst case must
+            # cover those blocks too (clamped to the table reach)
+            horizon = min(
+                req.prompt.size + req.max_new_tokens
+                + (self._spec_ahead - 1),
+                blocks_for(self.max_len, self.block_size)
+                * self.block_size)
+            worst = (blocks_for(horizon, self.block_size)
+                     + self.reserve_blocks)
             if worst > self.num_blocks:
                 raise ValueError(
                     f"request needs up to {worst} blocks (prompt "
@@ -423,6 +479,7 @@ class ServingEngine:
             "buckets": self.buckets,
             "cache_layout": self.cache_layout,
             "sampling": dict(self._sampling),
+            "spec_k": None if self._spec is None else self._spec.k,
         }
         if self._mgr is not None:
             out.update({
@@ -655,7 +712,8 @@ class ServingEngine:
             st = _Slot(request=req,
                        tokens=list(req.resume_tokens) + [tok],
                        prefill_ms=ms, blocks=blocks, cache_len=n,
-                       shared_blocks=shared)
+                       shared_blocks=shared,
+                       decode_polls=req.resume_polls)
         except Exception:
             # everything before the slot handoff below can raise (the
             # prefill itself, but also a telemetry sink or the HBM
@@ -668,6 +726,15 @@ class ServingEngine:
         self._slots[slot] = st
         self._pending[slot] = tok
         self._temps[slot] = req.temperature
+        if self._spec is not None:
+            # the drafter's haystack: everything emitted so far,
+            # pending token included.  Padded host-side so the device
+            # row write is ONE fixed-shape op regardless of length.
+            row = np.zeros((self.max_len,), np.int32)
+            row[: n] = tokens
+            row[n] = tok
+            self._history = self._history.at[slot].set(jnp.asarray(row))
+            self._hist_len = self._hist_len.at[slot].set(n + 1)
         done = self._finish_reason(st, tok)
         if done:
             completed.append(self._complete(slot, done))
@@ -698,6 +765,7 @@ class ServingEngine:
         req = st.request
         req.resume_tokens = list(st.tokens)
         req.preemptions += 1
+        req.resume_polls = st.decode_polls
         # the overhead clock: runs from here until the resume prefill
         # completes (closed out in _admit_one)
         req.preempted_t = time.perf_counter()
@@ -709,30 +777,38 @@ class ServingEngine:
                          blocks_freed=len(st.blocks))
 
     def _ensure_tail_blocks(self) -> None:
-        """Paged pre-decode edge: every live lane whose next write
-        position opens a new block gets one allocated NOW (the jitted
-        decode step cannot allocate).  On pool exhaustion the youngest
-        live request is preempted — repeatedly, until the allocation
-        succeeds or the needy lane itself was evicted — instead of
-        stalling the whole batch."""
+        """Paged pre-decode edge: every live lane gets blocks mapped to
+        cover its next write horizon NOW (the jitted step cannot
+        allocate) — one token on the plain path, the pending token plus
+        ``spec.k`` drafts under speculative decoding (writes past the
+        table reach drop; they are beyond every budget by
+        construction).  On pool exhaustion the youngest live request is
+        preempted — repeatedly, until the allocation succeeds or the
+        needy lane itself was evicted — instead of stalling the whole
+        batch."""
+        mb = self._tables.shape[1]
         for slot in list(self._pool.active):
             st = self._slots[slot]
             if st is None:                     # preempted this pass
                 continue
-            if st.cache_len % self.block_size:
-                continue                       # tail block has room
-            idx = st.cache_len // self.block_size
-            while self._slots[slot] is st:
+            need = min(-(-(st.cache_len + self._spec_ahead)
+                         // self.block_size), mb)
+            while self._slots[slot] is st and len(st.blocks) < need:
                 blk = self._mgr.alloc()
                 if blk is not None:
+                    self._tables[slot, len(st.blocks)] = blk
                     st.blocks.append(blk)
-                    self._tables[slot, idx] = blk
-                    break
+                    continue
                 self._preempt(self._youngest_slot())
 
     def _decode_once(self) -> List[Response]:
         """One batched decode step over every lane (live ones advance,
-        free ones ride along masked)."""
+        free ones ride along masked).  Under speculative decoding the
+        step is one draft→verify→accept round and each live lane
+        delivers 1..k+1 tokens — multi-token emission per poll; EOS and
+        budget truncation stay host-side (a truncated lane completes
+        this poll, so no continuing lane ever diverges from its device
+        cache position)."""
         if self._mgr is not None:
             self._ensure_tail_blocks()
             if not self._pool.n_active:        # everything preempted
@@ -742,19 +818,33 @@ class ServingEngine:
             active[i] = st is not None
         t0 = time.perf_counter()
         self._key, sub = jax.random.split(self._key)
+        em_host = acc_host = nxt_host = None
         with compile_label("serving.decode"):
             # exactly ONE compile should ever land on this label; a
             # second is the static-shape discipline breaking
-            if self._mgr is not None:
+            if self._spec is not None:
+                args = [self.params, self.cache]
+                if self._mgr is not None:
+                    args.append(jnp.asarray(self._tables))
+                args += [self._history, self._hist_len,
+                         jnp.asarray(self._pending),
+                         jnp.asarray(self._temps),
+                         jnp.asarray(active), sub]
+                (em, n_acc, self.cache, self._history,
+                 self._hist_len) = self._decode_fn(*args)
+                em_host = np.asarray(em)             # host sync
+                acc_host = np.asarray(n_acc)
+            elif self._mgr is not None:
                 nxt, self.cache = self._decode_fn(
                     self.params, self.cache, jnp.asarray(self._tables),
                     jnp.asarray(self._pending),
                     jnp.asarray(self._temps), jnp.asarray(active), sub)
+                nxt_host = np.asarray(nxt)           # host sync
             else:
                 nxt, self.cache = self._decode_fn(
                     self.params, self.cache, jnp.asarray(self._pending),
                     jnp.asarray(self._temps), jnp.asarray(active), sub)
-            nxt_host = np.asarray(nxt)               # host sync
+                nxt_host = np.asarray(nxt)           # host sync
         dt = time.perf_counter() - t0
         _telemetry.counter("serving.decode_steps").inc()
         self._decode_count += 1
@@ -762,18 +852,46 @@ class ServingEngine:
             sample_device_memory()   # HBM creep shows on the decode cadence
         completed = []
         emitted = 0
+        accepted = 0
+        live = 0
         for slot, st in enumerate(self._slots):
             if st is None:
                 continue
-            tok = int(nxt_host[slot])
-            st.tokens.append(tok)
-            st.cache_len += 1
-            self._pending[slot] = tok
-            emitted += 1
-            done = self._finish_reason(st, tok)
+            live += 1
+            st.decode_polls += 1
+            if self._spec is None:
+                n_raw = 1
+                toks = [int(nxt_host[slot])]
+            else:
+                n_raw = int(acc_host[slot]) + 1
+                accepted += n_raw - 1
+                toks = [int(t) for t in em_host[slot, :n_raw]]
+            # the device wrote and committed n_raw entries; the host
+            # delivers them in order, stopping at EOS / budget — a lane
+            # that truncates here always completes below, so cache_len
+            # only ever drifts on a lane being released anyway
+            st.cache_len += n_raw
+            done = None
+            for tok in toks:
+                st.tokens.append(tok)
+                self._pending[slot] = tok
+                emitted += 1
+                done = self._finish_reason(st, tok)
+                if done:
+                    break
             if done:
                 completed.append(self._complete(slot, done))
         _telemetry.counter("serving.tokens_generated").inc(emitted)
+        if self._spec is not None and live:
+            # the same realized counters generate(spec=...) emits, so
+            # one report/dashboard path serves both entry points;
+            # verify_calls counts per-sequence verify passes (the
+            # amortization denominator), not batched forwards
+            _telemetry.counter("generate.spec.draft_tokens").inc(
+                self._spec.k * live)
+            _telemetry.counter("generate.spec.accepted_tokens").inc(
+                accepted)
+            _telemetry.counter("generate.spec.verify_calls").inc(live)
         if dt > 0:
             _telemetry.gauge("serving.decode_tokens_per_sec").set(
                 emitted / dt)
@@ -802,12 +920,14 @@ class ServingEngine:
         latency_ms = (now - req.submitted_t) * 1e3
         queue_wait_ms = req.queue_wait_s * 1e3
         ttft_ms = (req.first_token_t - req.submitted_t) * 1e3
-        intervals = len(st.tokens) - 1
         # mean inter-token interval AFTER the first token, preemption
-        # stalls included — what streaming feels like.  None for a
-        # one-token response: no interval exists, so no TPOT verdict.
-        tpot_ms = ((now - req.first_token_t) / intervals * 1e3
-                   if intervals > 0 else None)
+        # stalls included — what streaming feels like.  The divisor is
+        # TOKENS DELIVERED, never polls (serving/slo.py:tpot_ms):
+        # under speculative decoding one poll emits several tokens and
+        # the per-poll interval would overstate TPOT by the emission
+        # factor.  None for a one-token response: no interval exists,
+        # so no TPOT verdict.
+        tpot_ms = _tpot_ms(req.first_token_t, now, len(st.tokens))
         overhead_ms = req.preempt_overhead_s * 1e3
         tags = {"slo_class": req.slo_class}
         _telemetry.sketch("serving.queue_wait_ms", tags).observe(
@@ -855,9 +975,14 @@ class ServingEngine:
             tokens=np.asarray(st.tokens, np.int32),
             finish_reason=reason,
             prefill_ms=st.prefill_ms,
-            # every admission (initial + each post-preemption resume)
-            # contributes one prefill-sampled token, not a decode step
-            decode_steps=len(st.tokens) - 1 - req.preemptions,
+            # the engine polls this request was live for (accumulated
+            # across preempt→resume).  Without spec this equals
+            # len(tokens) - 1 - preemptions (every admission samples
+            # one prefill token, every poll adds one); with spec on,
+            # polls < tokens - 1 is exactly the amortization win and
+            # the two stay coherent via tokens = 1 + preemptions +
+            # sum(per-poll emissions)
+            decode_steps=st.decode_polls,
             slo_class=req.slo_class,
             queue_wait_ms=queue_wait_ms,
             ttft_ms=ttft_ms,
@@ -891,7 +1016,7 @@ def _make_sample_fn(top_k, top_p, vocab_limit):
 
 
 @functools.lru_cache(maxsize=None)
-def _make_decode_fn(cfg, top_k, top_p, vocab_limit, paged):
+def _make_decode_fn(cfg, top_k, top_p, vocab_limit, paged, spec=None):
     """One compiled decode+sample step for the engine's lifetime —
     memoized on the static knobs so engines sharing a config (tests,
     multi-engine processes) share the XLA compile too.
@@ -902,7 +1027,66 @@ def _make_decode_fn(cfg, top_k, top_p, vocab_limit, paged):
     engines pass the block tables SEPARATELY (not donated): the host
     mutates its table mirror between steps (tail allocation,
     preemption), so a fresh device copy rides in each step while the
-    big pool stays put."""
+    big pool stays put.
+
+    With ``spec`` set the step is one speculative round
+    (``models.speculative.spec_round``): draft from the lanes' token
+    history, verify k+1 tokens in one forward, return the candidate
+    emission matrix + accepted counts; live lanes commit
+    ``pos += n_acc + 1`` (the pending token and the accepted drafts),
+    frozen lanes keep their position and — paged — their sentinel
+    table rows, so a parked lane can never corrupt live blocks."""
+
+    if spec is not None:
+        def _spec_step(params, cache, tables, history, hist_lens,
+                       tokens, temps, active, key):
+            prev_pos = cache["pos"]
+            full = cache if tables is None else dict(
+                cache, block_tables=tables)
+            em, n_acc, _y, new, _prev = spec_round(
+                params, cfg, full, tokens, history, hist_lens, key,
+                spec=spec, temperature=temps, top_k=top_k, top_p=top_p,
+                vocab_limit=vocab_limit)
+            n_raw = n_acc + 1
+            cache = {"k": new["k"], "v": new["v"],
+                     "pos": jnp.where(active, prev_pos + n_raw,
+                                      prev_pos)}
+            # device-side history append: this poll's delivered tokens
+            # scatter in at each live lane's length (frozen lanes and
+            # past-the-buffer columns drop) — the steady-state poll
+            # never re-uploads the haystack from the host
+            b, max_len = history.shape
+            k1 = em.shape[1]
+            cols = hist_lens[:, None] + jnp.arange(k1,
+                                                   dtype=jnp.int32)[None]
+            keep = ((jnp.arange(k1)[None] < n_raw[:, None])
+                    & active[:, None])
+            cols = jnp.where(keep, cols, max_len)
+            history = history.at[jnp.arange(b)[:, None], cols].set(
+                em, mode="drop")
+            hist_lens = jnp.where(
+                active, jnp.minimum(hist_lens + n_raw, max_len),
+                hist_lens)
+            return em, n_acc, cache, history, hist_lens
+
+        if paged:
+            @functools.partial(jax.jit, donate_argnames=(
+                "cache", "history", "hist_lens"))
+            def step_fn(params, cache, tables, history, hist_lens,
+                        tokens, temps, active, key):
+                return _spec_step(params, cache, tables, history,
+                                  hist_lens, tokens, temps, active, key)
+
+            return step_fn
+
+        @functools.partial(jax.jit, donate_argnames=(
+            "cache", "history", "hist_lens"))
+        def step_fn(params, cache, history, hist_lens, tokens, temps,
+                    active, key):
+            return _spec_step(params, cache, None, history, hist_lens,
+                              tokens, temps, active, key)
+
+        return step_fn
 
     if paged:
         @functools.partial(jax.jit, donate_argnames=("cache",))

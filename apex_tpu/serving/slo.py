@@ -32,7 +32,24 @@ import dataclasses
 from typing import Dict, Mapping, Optional, Union
 
 __all__ = ["SLOTarget", "DEFAULT_SLO_TARGETS", "resolve_slo_targets",
-           "judge"]
+           "judge", "tpot_ms"]
+
+
+def tpot_ms(first_token_t: float, finish_t: float,
+            tokens: int) -> Optional[float]:
+    """Mean inter-token interval in milliseconds after the first
+    token: ``(finish − first_token) / (tokens − 1)``.
+
+    The denominator is **tokens delivered**, never engine polls: under
+    multi-token emission (speculative decoding — ISSUE 8) one poll can
+    deliver several tokens, and a 3-tokens-per-poll stream must report
+    one third of the per-poll interval (tests/test_serving_slo.py pins
+    it).  ``None`` for a one-token response — no interval exists, so
+    there is no TPOT verdict to take."""
+    intervals = int(tokens) - 1
+    if intervals <= 0:
+        return None
+    return (finish_t - first_token_t) / intervals * 1e3
 
 
 @dataclasses.dataclass(frozen=True)

@@ -42,6 +42,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# jax<0.9 compatibility shim (a no-op on the target toolchain, exactly
+# like tests/conftest.py): containers pinned to jax 0.4.x lack
+# jax.typeof, which the flash-attention gate consults on every call —
+# without this every inference row reports an AttributeError instead
+# of a measurement
+if not hasattr(jax, "typeof"):
+    jax.typeof = lambda x: jax.core.get_aval(x)
+
 from apex_tpu.models.config import bert_large, gpt_125m
 from apex_tpu.models.bert import make_bert_train_step
 from apex_tpu.models.gpt import make_gpt_train_step
@@ -552,6 +560,151 @@ def bench_cache_layout_ablation(on_tpu, layouts):
     return rows
 
 
+def bench_spec_ablation(on_tpu, specs, cache_layout="contiguous"):
+    """Speculative-decoding ablation (ISSUE 8): ``generate`` timed with
+    spec off vs n-gram self-drafting, over the accept-rate sweep —
+    ``repetition`` (synthetic-repetition prompts, greedy: the
+    high-accept end, where prompt-lookup drafting should land most of
+    its k tokens) vs ``random`` (uniform random prompts sampled at
+    temperature 1 over the full vocab: the adversarial low-accept end,
+    where almost every draft is rejected and spec pays verify overhead
+    for nothing).  Each row carries the layout tag, the realized
+    draft/accepted/verify counters, the accept rate, and
+    ``decode_tokens_per_sec`` — so the headline multiple AND its
+    sensitivity to traffic shape are both on the record."""
+    from apex_tpu.models.generate import generate, init_kv_cache, prefill
+    from apex_tpu.models.speculative import SpecConfig, spec_generate
+    from apex_tpu.models.transformer_lm import init_gpt_params
+
+    if on_tpu:
+        batch, prompt_len, new, iters, k = 8, 64, 128, 5, 8
+        cfg = gpt_125m(max_position_embeddings=512)
+    else:
+        batch, prompt_len, new, iters, k = 2, 16, 48, 2, 8
+        cfg = gpt_125m(num_layers=2, hidden_size=128,
+                       num_attention_heads=4, vocab_size=1024,
+                       max_position_embeddings=256)
+    rng = np.random.RandomState(0)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    pattern = rng.randint(0, cfg.vocab_size, (4,))
+    rep_prompt = jnp.asarray(
+        np.tile(pattern, (batch, -(-prompt_len // 4)))[:, :prompt_len],
+        jnp.int32)
+    rnd_prompt = jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (batch, prompt_len)), jnp.int32)
+    sweeps = {
+        "repetition": (rep_prompt, 0.0),
+        "random": (rnd_prompt, 1.0),
+    }
+    rows = {"cache_layout": cache_layout, "spec_k": k,
+            "batch": batch, "prompt": prompt_len, "new_tokens": new}
+    for sweep, (prompt, temp) in sweeps.items():
+        def run_prefill(_, prompt=prompt):
+            cache = init_kv_cache(cfg, batch, prompt_len + new,
+                                  cache_layout=cache_layout)
+            lg, _c = prefill(params, prompt, cfg, cache=cache)
+            return (lg, lg)
+
+        pf_sec = _time_fn(run_prefill, n_warmup=1, iters=iters,
+                          name=f"spec_{sweep}_prefill")
+        srow = {}
+        for mode in specs:
+            if mode == "off":
+                def run(_, prompt=prompt, temp=temp):
+                    out = generate(params, prompt, cfg,
+                                   max_new_tokens=new, temperature=temp,
+                                   cache_layout=cache_layout)
+                    return (out, out)
+
+                stats = None
+            else:
+                spec_cfg = SpecConfig(k=k)
+
+                def run(_, prompt=prompt, temp=temp, spec_cfg=spec_cfg):
+                    out, _s = spec_generate(
+                        params, prompt, cfg, spec=spec_cfg,
+                        max_new_tokens=new, temperature=temp,
+                        cache_layout=cache_layout)
+                    return (out, out)
+
+                _out, stats = spec_generate(
+                    params, prompt, cfg, spec=spec_cfg,
+                    max_new_tokens=new, temperature=temp,
+                    cache_layout=cache_layout)
+            sec = _time_fn(run, n_warmup=1, iters=iters,
+                           name=f"spec_{sweep}_{mode}")
+            decode_sec = sec - pf_sec
+            noisy = decode_sec <= 0
+            if noisy:
+                decode_sec = sec
+            entry = {
+                "decode_tokens_per_sec": round(batch * new / decode_sec,
+                                               1),
+                "ms_per_token": round(decode_sec / new * 1e3, 3),
+                "e2e_ms": round(sec * 1e3, 2),
+                "cache_layout": cache_layout,
+            }
+            if noisy:
+                entry["noisy_prefill_timing"] = True
+            if stats is not None:
+                draft = max(stats["draft_tokens"], 1)
+                verify = max(stats["verify_calls"], 1)
+                entry.update({
+                    "draft_tokens": stats["draft_tokens"],
+                    "accepted_tokens": stats["accepted_tokens"],
+                    "verify_calls": stats["verify_calls"],
+                    "accept_rate": round(
+                        stats["accepted_tokens"] / draft, 4),
+                    # emitted tokens amortized per verify forward —
+                    # the number the decode multiple tracks
+                    "tokens_per_verify": round(
+                        (stats["accepted_tokens"] + verify) / verify, 3),
+                })
+            srow[mode] = entry
+        if "off" in srow and "ngram" in srow:
+            srow["ngram_over_off"] = round(
+                srow["ngram"]["decode_tokens_per_sec"]
+                / max(srow["off"]["decode_tokens_per_sec"], 1e-9), 3)
+        rows[sweep] = srow
+    return rows
+
+
+def _print_spec_table(details, out=None):
+    """Human-readable stderr table for the --spec ablation (the JSON
+    line is the machine record; this is the at-a-glance one) — the
+    accept-rate column is the satellite the campaign log reads."""
+    import sys
+
+    out = sys.stderr if out is None else out
+    print("== spec ablation (decode) ==", file=out)
+    print(f"{'layout':<12} {'sweep':<12} {'spec':<7} {'tok/s':>9} "
+          f"{'accept%':>8} {'tok/verify':>10} {'draft':>7} {'acc':>7} "
+          f"{'verify':>7}", file=out)
+    for name, rows in sorted(details.items()):
+        if not isinstance(rows, dict) or "spec_k" not in rows:
+            continue
+        layout = rows.get("cache_layout", "?")
+        for sweep, srow in rows.items():
+            if not isinstance(srow, dict) or "off" not in srow:
+                continue
+            for mode, e in srow.items():
+                if not isinstance(e, dict):
+                    continue
+                acc = e.get("accept_rate")
+                print(
+                    f"{layout:<12} {sweep:<12} {mode:<7} "
+                    f"{e.get('decode_tokens_per_sec', 0.0):>9.1f} "
+                    f"{'-' if acc is None else f'{100 * acc:.1f}':>8} "
+                    f"{e.get('tokens_per_verify', '-'):>10} "
+                    f"{e.get('draft_tokens', '-'):>7} "
+                    f"{e.get('accepted_tokens', '-'):>7} "
+                    f"{e.get('verify_calls', '-'):>7}", file=out)
+            if "ngram_over_off" in srow:
+                print(f"{layout:<12} {sweep:<12} {'x':<7} "
+                      f"{srow['ngram_over_off']:>9} (ngram/off)",
+                      file=out)
+
+
 def bench_resnet50(on_tpu):
     from apex_tpu.models.resnet import make_resnet_train_step, resnet50
 
@@ -968,7 +1121,24 @@ def main():
         help="comma list of KV cache layouts (contiguous, paged) for "
              "the --decode rows; more than one also emits the "
              "matched-HBM cache_layout_ablation row (ISSUE 6)")
+    parser.add_argument(
+        "--spec", default=None, metavar="SPECS",
+        help="comma list of speculative-decoding modes (off, ngram): "
+             "with --decode, run ONLY the spec ablation rows "
+             "(bench_spec_ablation — accept-rate sweep per cache "
+             "layout, stderr table with the accept-rate column) "
+             "instead of the full inference matrix (ISSUE 8)")
     args = parser.parse_args()
+    spec_modes = None
+    if args.spec is not None:
+        spec_modes = tuple(
+            s.strip() for s in args.spec.split(",") if s.strip())
+        bad = [s for s in spec_modes if s not in ("off", "ngram")]
+        if bad or not spec_modes:
+            parser.error(f"--spec {args.spec!r}: expected a comma list "
+                         "of off, ngram")
+        if not args.decode:
+            parser.error("--spec only applies to the --decode rows")
     layouts = tuple(
         l.strip() for l in args.cache_layout.split(",") if l.strip())
     bad = [l for l in layouts if l not in ("contiguous", "paged")]
@@ -1014,6 +1184,30 @@ def main():
             "value": rows.get("off", {}).get("tokens_per_sec", 0.0),
             "unit": "tokens/s",
             "details": rows,
+            "runtime": runtime_summary(),
+        }))
+        return
+    if args.decode and spec_modes:
+        details = {}
+        for layout in layouts:
+            sfx = "" if layout == "contiguous" else f"_{layout}"
+            try:
+                details["spec_ablation" + sfx] = bench_spec_ablation(
+                    on_tpu, spec_modes, cache_layout=layout)
+            except Exception as e:
+                details["spec_ablation" + sfx] = {
+                    "error": f"{type(e).__name__}: {e}"[:200]}
+        _print_spec_table(details)
+        head_sfx = "" if layouts[0] == "contiguous" else f"_{layouts[0]}"
+        head = details.get("spec_ablation" + head_sfx, {})
+        head_mode = "ngram" if "ngram" in spec_modes else spec_modes[0]
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "metric": "gpt2_125m_decode_spec_ablation",
+            "value": head.get("repetition", {}).get(head_mode, {}).get(
+                "decode_tokens_per_sec", 0.0),
+            "unit": "tokens/s",
+            "details": details,
             "runtime": runtime_summary(),
         }))
         return

@@ -53,6 +53,8 @@ MODULES = [
      "ops.collective_matmul — overlapped ring TP collectives"),
     ("apex_tpu.ops.paged_attention", "ops",
      "ops.paged_attention — ragged paged-attention decode kernel"),
+    ("apex_tpu.ops.fused_sampling", "ops",
+     "ops.fused_sampling — fused temperature/top-k/top-p/sample kernel"),
     # comm
     ("apex_tpu.comm", "comm",
      "apex_tpu.comm — compressed gradient collectives"),
@@ -107,6 +109,8 @@ MODULES = [
     ("apex_tpu.models.gpt", "models", "models.gpt — GPT wiring"),
     ("apex_tpu.models.generate", "models",
      "models.generate — flash prefill + ragged KV-cache decoding"),
+    ("apex_tpu.models.speculative", "models",
+     "models.speculative — n-gram drafting + batched verification"),
     ("apex_tpu.models.bert", "models", "models.bert"),
     ("apex_tpu.models.resnet", "models", "models.resnet"),
     # serving

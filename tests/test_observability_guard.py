@@ -50,6 +50,12 @@ _PRIVATE_GLOBAL = re.compile(r"\b_REGISTRY\b")
 # hot paths must gate it
 _MEM_SAMPLE = re.compile(r"\bsample_device_memory\s*\(")
 _MEM_GATE = re.compile(r"enabled\(\)|is not None|is None|emit=False")
+# the speculative-decoding counters (ISSUE 8): any string-literal use
+# of a generate.spec.* name must ride the module-level counter helper
+# on the same statement — a bare registry hop or a renamed copy would
+# fork the accept-rate accounting telemetry_report/serve_dash read
+_SPEC_COUNTER = re.compile(r"[\"']generate\.spec\.")
+_SPEC_HELPER = re.compile(r"_telemetry\s*\.\s*counter\s*\(")
 
 
 def _py_files():
@@ -173,6 +179,29 @@ def test_unconfigured_engine_starts_no_exporter_thread():
     assert "NO-THREAD" in out.stdout
 
 
+def test_spec_counters_use_the_helper_only():
+    """Every ``generate.spec.*`` counter touch in ``apex_tpu/`` must go
+    through ``_telemetry.counter(...)`` on the same statement (the
+    no-op-fast-path helper): the accept-rate numbers feed
+    telemetry_report's spec summary and serve_dash, so a second access
+    idiom would be a second (unguarded) accounting path."""
+    offenders = []
+    for path in _py_files():
+        if _in_obs(path):
+            continue
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                if not _SPEC_COUNTER.search(line):
+                    continue
+                if _SPEC_HELPER.search(line):
+                    continue
+                offenders.append(f"{path}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "generate.spec.* counters must be accessed via "
+        "_telemetry.counter(...) on the same statement:\n"
+        + "\n".join(offenders))
+
+
 def test_guard_patterns_actually_match():
     """The guard is only as good as its regexes: each must match its
     own anti-pattern (a regression here silently disables the guard)."""
@@ -181,6 +210,12 @@ def test_guard_patterns_actually_match():
     assert _CHAINED.search("registry().sketch('x').observe(1)")
     assert not _CHAINED.search("reg = _telemetry.registry()")
     assert _DIRECT_REGISTRY.search("r = MetricsRegistry(sinks)")
+    assert _SPEC_COUNTER.search(
+        'reg.counter("generate.spec.draft_tokens").inc()')
+    assert _SPEC_HELPER.search(
+        '_telemetry.counter("generate.spec.draft_tokens").inc(2)')
+    assert not _SPEC_COUNTER.search(
+        "the generate.spec.draft_tokens counter (docs)")
     assert _PRIVATE_GLOBAL.search("from x import _REGISTRY")
     assert _MEM_SAMPLE.search("sample_device_memory()")
     assert _EXPORTER_IMPORT.search(

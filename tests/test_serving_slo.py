@@ -344,3 +344,95 @@ class TestSLOSoak:
         assert row["ttft_p50"] > 0 and row["tpot_p95"] > 0
         text = out.getvalue()
         assert "interactive" in text and "goodput" in text
+
+
+# ---------------------------------------------------------------------------
+# multi-token emission (ISSUE 8): TPOT by tokens, decode_steps coherence
+# ---------------------------------------------------------------------------
+
+
+class TestMultiTokenEmission:
+    def test_tpot_divides_by_tokens_not_polls(self):
+        """A 3-tokens-per-poll stream must report ~1/3 the per-poll
+        interval: 3 polls 100ms apart delivering 3 tokens each (plus
+        the first token at t=0) = 10 tokens over 300ms -> 33.3ms TPOT,
+        NOT the 100ms a polls-based divisor would claim."""
+        from apex_tpu.serving.slo import tpot_ms
+
+        assert tpot_ms(10.0, 10.3, 10) == pytest.approx(1e3 * 0.3 / 9)
+        # non-spec degenerate case (one token per poll): equals the
+        # per-poll interval, i.e. the historical semantics
+        assert tpot_ms(10.0, 10.3, 4) == pytest.approx(100.0)
+        # a one-token response has no interval, hence no TPOT verdict
+        assert tpot_ms(10.0, 10.3, 1) is None
+        assert tpot_ms(10.0, 10.3, 0) is None
+
+    def test_decode_steps_vs_tokens_coherent_with_spec(self, model):
+        """With spec on, Response.decode_steps counts POLLS: strictly
+        fewer than tokens when drafts are accepted, and never fewer
+        than tokens/(k+1) — the coherence envelope.  Spec-off keeps the
+        historical identity decode_steps == tokens - 1 - preemptions,
+        and the per-request TPOT is consistent with e2e timing."""
+        cfg, params = model
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(0, 100, (4 + i,)) for i in range(3)]
+        off = ServingEngine(params, cfg, max_slots=2, max_len=48)
+        off_resps = off.run([dict(prompt=p, max_new_tokens=12)
+                             for p in prompts])
+        for r in off_resps:
+            assert r.decode_steps == r.tokens.size - 1 - r.preemptions
+        eng = ServingEngine(params, cfg, max_slots=2, max_len=48,
+                            spec="ngram")
+        k = eng.stats()["spec_k"]
+        resps = eng.run([dict(prompt=p, max_new_tokens=12)
+                         for p in prompts])
+        for r, ro in zip(resps, off_resps):
+            np.testing.assert_array_equal(r.tokens, ro.tokens)
+            emitted = r.tokens.size - 1 - r.preemptions
+            assert 1 <= r.decode_steps <= emitted
+            assert emitted <= r.decode_steps * (k + 1)
+            if r.tokens.size > 1:
+                assert r.tpot_ms > 0.0
+        # the greedy self-repetition of a tiny model accepts drafts, so
+        # at least one request must realize the multi-token win
+        assert any(r.decode_steps < r.tokens.size - 1 for r in resps)
+
+    def test_serve_dash_shows_accept_rate_with_spec_counters(
+            self, model):
+        """ISSUE 8 satellite: the dashboard surfaces the spec accept
+        rate when the generate.spec.* counters are present — and hides
+        the row when they are not."""
+        import io
+
+        cfg, params = model
+        reg = obs.configure(export_port=0)
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=48,
+                               spec="ngram")
+        rng = np.random.RandomState(7)
+        for i in range(3):
+            engine.submit(rng.randint(0, 100, (5,)), max_new_tokens=8)
+        while not engine.idle:
+            engine.step()
+        dash = _load_tool("serve_dash")
+        om = dash.load_openmetrics_module()
+        out = io.StringIO()
+        snap = dash.one_frame(om, reg.exporter.url, out=out)
+        assert snap["spec_accept_rate"] is not None
+        assert 0.0 <= snap["spec_accept_rate"] <= 1.0
+        assert snap["spec_verify_calls"] >= 1
+        assert "spec accept-rate" in out.getvalue()
+        # counters must reconcile with the registry's own view
+        draft = reg.counter("generate.spec.draft_tokens").value
+        acc = reg.counter("generate.spec.accepted_tokens").value
+        assert snap["spec_accept_rate"] == pytest.approx(acc / draft)
+        obs.shutdown()
+        # spec-off engine: no counters, no row
+        reg = obs.configure(export_port=0)
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=48)
+        engine.submit(rng.randint(0, 100, (5,)), max_new_tokens=4)
+        while not engine.idle:
+            engine.step()
+        out = io.StringIO()
+        snap = dash.one_frame(om, reg.exporter.url, out=out)
+        assert snap["spec_accept_rate"] is None
+        assert "spec accept-rate" not in out.getvalue()

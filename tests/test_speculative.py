@@ -1,0 +1,386 @@
+"""Speculative decoding (ISSUE 8): verify-forward parity, n-gram
+drafting, rejection-sampling correctness, and the acceptance pins —
+spec-on greedy token-identical to spec-off greedy on BOTH cache
+layouts (generate and the serving engine, preempt→resume included)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.generate import (
+    decode_step, decode_verify, generate, init_kv_cache, prefill)
+from apex_tpu.models.speculative import (
+    SpecConfig, _accept, ngram_draft, resolve_spec, spec_generate)
+from apex_tpu.models.transformer_lm import init_gpt_params
+
+
+def _cfg(**kw):
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_position_embeddings", 96)
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("remat", False)
+    return TransformerConfig(**kw)
+
+
+class TestDecodeVerify:
+    """Feeding the gold sequence through decode_verify must reproduce
+    decode_step run m times — the strongest pin of the multi-token
+    cache math (write positions, per-query causal masks, rope
+    offsets)."""
+
+    @pytest.mark.parametrize("variant", [
+        {},
+        {"position_embedding_type": "rope", "num_query_groups": 2},
+    ])
+    @pytest.mark.parametrize("layout,bs", [("contiguous", 16),
+                                           ("paged", 4)])
+    def test_verify_matches_stepwise_decode(self, variant, layout, bs):
+        cfg = _cfg(**variant)
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.RandomState(0)
+        b, s = 2, 10
+        toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, s)),
+                           jnp.int32)
+        cache = init_kv_cache(cfg, b, s, cache_layout=layout,
+                              block_size=bs)
+        want = []
+        for i in range(s):
+            lg, cache = decode_step(params, toks[:, i], cache, cfg)
+            want.append(np.asarray(lg))
+        want = np.stack(want, 1)
+        vcache = init_kv_cache(cfg, b, s, cache_layout=layout,
+                               block_size=bs)
+        got, vcache = decode_verify(params, toks, vcache, cfg)
+        np.testing.assert_allclose(np.asarray(got), want, atol=2e-4,
+                                   rtol=2e-4, err_msg=f"{variant}")
+        np.testing.assert_array_equal(np.asarray(vcache["pos"]),
+                                      np.full((b,), s))
+        # the written caches must agree too (verify's K/V land where
+        # the stepwise decode would have put them)
+        np.testing.assert_allclose(np.asarray(vcache["k"]),
+                                   np.asarray(cache["k"]), atol=2e-4,
+                                   rtol=2e-4)
+
+    def test_verify_after_prefill_at_ragged_offsets(self):
+        """Verify appended mid-sequence (after a ragged prefill) sees
+        per-sequence offsets — the spec-round geometry."""
+        cfg = _cfg(position_embedding_type="rope")
+        params = init_gpt_params(jax.random.PRNGKey(1), cfg)
+        rng = np.random.RandomState(1)
+        b, s, m = 2, 8, 3
+        toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, s + m)),
+                           jnp.int32)
+        lens = jnp.asarray([4, 8], jnp.int32)
+        cache = init_kv_cache(cfg, b, s + m)
+        _, cache = prefill(params, toks[:, :s], cfg, prompt_lens=lens,
+                           cache=cache)
+        # continue each row from its own length with the gold tokens
+        nxt = jnp.stack([toks[i, lens[i]: lens[i] + m]
+                         for i in range(b)])
+        got, _ = decode_verify(params, nxt, cache, cfg)
+        for i in range(b):
+            scache = init_kv_cache(cfg, 1, s + m)
+            _, scache = prefill(params, toks[i: i + 1, : lens[i]], cfg,
+                                cache=scache)
+            for j in range(m):
+                lg, scache = decode_step(
+                    params, nxt[i: i + 1, j], scache, cfg)
+                np.testing.assert_allclose(
+                    np.asarray(got)[i, j], np.asarray(lg)[0],
+                    atol=2e-4, rtol=2e-4, err_msg=f"row {i} pos {j}")
+
+
+class TestNgramDraft:
+    def test_suffix_match_proposes_continuation(self):
+        # history: 5 6 7 9 5 6 7 | suffix (5 6 7) matched at j=2 ->
+        # draft the tokens that followed: 9, then 5, 6 (most recent
+        # occurrence of the trigram ends at index 2)
+        toks = jnp.asarray([[5, 6, 7, 9, 5, 6, 7, 0, 0]], jnp.int32)
+        lens = jnp.asarray([7], jnp.int32)
+        d = np.asarray(ngram_draft(toks, lens, k=3, max_ngram=3))
+        np.testing.assert_array_equal(d, [[9, 5, 6]])
+
+    def test_most_recent_match_wins(self):
+        # bigram (1 2) occurs twice; the later occurrence (followed by
+        # 8) must win over the earlier one (followed by 7)
+        toks = jnp.asarray([[1, 2, 7, 1, 2, 8, 3, 1, 2]], jnp.int32)
+        lens = jnp.asarray([9], jnp.int32)
+        d = np.asarray(ngram_draft(toks, lens, k=1, max_ngram=2))
+        np.testing.assert_array_equal(d, [[8]])
+
+    def test_longer_ngram_preferred(self):
+        # unigram 2 matches in several places, but the full bigram
+        # (9 2) pins the 4 continuation; a unigram-only drafter could
+        # pick the 5 after the other 2
+        toks = jnp.asarray([[2, 5, 9, 2, 4, 6, 9, 2]], jnp.int32)
+        lens = jnp.asarray([8], jnp.int32)
+        d = np.asarray(ngram_draft(toks, lens, k=1, max_ngram=2))
+        np.testing.assert_array_equal(d, [[4]])
+
+    def test_no_match_repeats_last_token(self):
+        toks = jnp.asarray([[1, 2, 3, 4, 5, 0]], jnp.int32)
+        lens = jnp.asarray([5], jnp.int32)
+        d = np.asarray(ngram_draft(toks, lens, k=3, max_ngram=3))
+        np.testing.assert_array_equal(d, [[5, 5, 5]])
+
+    def test_respects_per_row_lens(self):
+        # row garbage past lens must not produce matches
+        toks = jnp.asarray([[7, 8, 7, 99, 99, 99],
+                            [3, 3, 3, 3, 3, 3]], jnp.int32)
+        lens = jnp.asarray([3, 6], jnp.int32)
+        d = np.asarray(ngram_draft(toks, lens, k=2, max_ngram=2))
+        np.testing.assert_array_equal(d[0], [8, 7])   # 7 matched at 0
+        np.testing.assert_array_equal(d[1], [3, 3])
+
+
+class TestRejectionSampling:
+    def test_greedy_onehot_accepts_iff_argmax(self):
+        v = 8
+        logits = jnp.asarray(np.random.RandomState(0).randn(3, 3, v),
+                             jnp.float32)
+        tgt = np.asarray(logits).argmax(-1)          # [3, 3]
+        probs = jax.nn.one_hot(jnp.argmax(logits, -1), v,
+                               dtype=jnp.float32)
+        # draft row 0: both match; row 1: first mismatches; row 2:
+        # first matches, second mismatches
+        draft = jnp.asarray([
+            [tgt[0, 0], tgt[0, 1]],
+            [(tgt[1, 0] + 1) % v, tgt[1, 1]],
+            [tgt[2, 0], (tgt[2, 1] + 1) % v],
+        ], jnp.int32)
+        n_acc, y = _accept(draft, probs, None, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(n_acc), [2, 0, 1])
+        # the correction/bonus token is the target argmax at the
+        # first-divergence position
+        np.testing.assert_array_equal(
+            np.asarray(y), [tgt[0, 2], tgt[1, 0], tgt[2, 1]])
+
+    def test_point_mass_marginal_is_target_distribution(self):
+        """The speculative-sampling identity for a point-mass drafter:
+        accept d with prob p(d), else resample from p with d removed —
+        the emitted marginal must equal p exactly.  N independent rows
+        in ONE _accept call (per-row uniforms), χ² against p."""
+        rng = np.random.RandomState(1)
+        v, n = 6, 8192
+        p_row = jax.nn.softmax(jnp.asarray(rng.randn(v), jnp.float32))
+        p = np.asarray(p_row)
+        draft_tok = int(np.argmax(p))                # draft the mode
+        probs = jnp.tile(p_row[None, None], (n, 2, 1))
+        draft = jnp.full((n, 1), draft_tok, jnp.int32)
+        n_acc, y = _accept(draft, probs, None, jax.random.PRNGKey(2))
+        emitted = np.where(np.asarray(n_acc) >= 1, draft_tok,
+                           np.asarray(y))
+        counts = np.bincount(emitted, minlength=v)
+        chi2 = (((counts - n * p) ** 2) / (n * p)).sum()
+        assert chi2 < 20.52, chi2     # chi2(5).ppf(0.999)
+
+    def test_draft_model_hook_ratio_accept(self):
+        """q_probs given: accept iff u < p(d)/q(d) — a draft whose q
+        UNDERSTATES p must always be accepted (ratio > 1)."""
+        v = 4
+        p = jnp.asarray([[0.7, 0.1, 0.1, 0.1]], jnp.float32)
+        probs = jnp.tile(p[:, None], (1, 2, 1))
+        q = jnp.asarray([[[0.25, 0.25, 0.25, 0.25]]], jnp.float32)
+        draft = jnp.asarray([[0]], jnp.int32)        # p=0.7 > q=0.25
+        for seed in range(10):
+            n_acc, _ = _accept(draft, probs, q, jax.random.PRNGKey(seed))
+            assert int(n_acc[0]) == 1, seed
+
+
+class TestSpecGenerateParity:
+    """The acceptance pin: spec-on greedy output token-identical to
+    spec-off greedy, both cache layouts, ragged + EOS included."""
+
+    @pytest.mark.parametrize("layout,bs", [("contiguous", 16),
+                                           ("paged", 4)])
+    def test_greedy_token_identical(self, layout, bs):
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(1), cfg)
+        rng = np.random.RandomState(1)
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 6)),
+                             jnp.int32)
+        base = np.asarray(generate(params, prompt, cfg,
+                                   max_new_tokens=20,
+                                   cache_layout=layout, block_size=bs))
+        out, stats = spec_generate(params, prompt, cfg,
+                                   spec=SpecConfig(k=4),
+                                   max_new_tokens=20,
+                                   cache_layout=layout, block_size=bs)
+        np.testing.assert_array_equal(base, np.asarray(out))
+        assert stats["verify_calls"] >= 1
+        assert 0 <= stats["accepted_tokens"] <= stats["draft_tokens"]
+        # the generate(spec=...) wrapper takes the same path
+        wrapped = generate(params, prompt, cfg, max_new_tokens=20,
+                           cache_layout=layout, block_size=bs,
+                           spec=SpecConfig(k=4))
+        np.testing.assert_array_equal(base, np.asarray(wrapped))
+
+    def test_greedy_identical_with_eos_and_ragged(self):
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(2), cfg)
+        rng = np.random.RandomState(2)
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 8)),
+                             jnp.int32)
+        lens = jnp.asarray([3, 8], jnp.int32)
+        base = np.asarray(generate(params, prompt, cfg,
+                                   max_new_tokens=14,
+                                   prompt_lens=lens))
+        out, _ = spec_generate(params, prompt, cfg, spec="ngram",
+                               max_new_tokens=14, prompt_lens=lens)
+        np.testing.assert_array_equal(base, np.asarray(out))
+        eos = int(base[0, 6])    # a mid-generation token of row 0
+        base_e = np.asarray(generate(params, prompt, cfg,
+                                     max_new_tokens=14,
+                                     prompt_lens=lens,
+                                     eos_token_id=eos))
+        out_e, _ = spec_generate(params, prompt, cfg, spec="ngram",
+                                 max_new_tokens=14, prompt_lens=lens,
+                                 eos_token_id=eos)
+        np.testing.assert_array_equal(base_e, np.asarray(out_e))
+
+    def test_high_accept_on_self_repetition(self):
+        """Greedy decoding of a tiny model self-repeats; the n-gram
+        drafter must catch the loop — the amortization the whole
+        feature exists for (and the bench high-accept sweep's
+        mechanism)."""
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(3), cfg)
+        rng = np.random.RandomState(3)
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 6)),
+                             jnp.int32)
+        _, stats = spec_generate(params, prompt, cfg,
+                                 spec=SpecConfig(k=4),
+                                 max_new_tokens=32)
+        accept = stats["accepted_tokens"] / max(stats["draft_tokens"], 1)
+        assert accept > 0.5, stats
+        # far fewer verify passes than tokens
+        assert stats["verify_calls"] < 32
+
+    def test_stochastic_seeded_and_supported(self):
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(4), cfg)
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        a, _ = spec_generate(params, prompt, cfg, spec="ngram",
+                             max_new_tokens=10, temperature=1.0,
+                             top_k=5, rng=jax.random.PRNGKey(7))
+        b, _ = spec_generate(params, prompt, cfg, spec="ngram",
+                             max_new_tokens=10, temperature=1.0,
+                             top_k=5, rng=jax.random.PRNGKey(7))
+        c, _ = spec_generate(params, prompt, cfg, spec="ngram",
+                             max_new_tokens=10, temperature=1.0,
+                             top_k=5, rng=jax.random.PRNGKey(8))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+        assert np.asarray(a).max() < cfg.vocab_size
+
+    def test_spec_counters_reach_telemetry(self):
+        from apex_tpu.observability import metrics as telemetry
+
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(5), cfg)
+        prompt = jnp.asarray([[4, 5, 6]], jnp.int32)
+        reg = telemetry.configure()
+        try:
+            generate(params, prompt, cfg, max_new_tokens=12,
+                     spec="ngram")
+            draft = reg.counter("generate.spec.draft_tokens").value
+            acc = reg.counter("generate.spec.accepted_tokens").value
+            verify = reg.counter("generate.spec.verify_calls").value
+            assert draft > 0 and verify > 0
+            assert 0 <= acc <= draft
+        finally:
+            telemetry.shutdown()
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="spec"):
+            resolve_spec("warp")
+        with pytest.raises(ValueError, match="k="):
+            SpecConfig(k=0)
+        with pytest.raises(ValueError, match="ngram"):
+            SpecConfig(max_ngram=0)
+        assert resolve_spec(None) is None
+        assert resolve_spec("off") is None
+        assert resolve_spec("ngram").k == 8
+
+    def test_draft_model_hook_greedy_identity(self):
+        """A (bad) draft model must still be CORRECT: rejection
+        sampling fixes up every wrong draft, so greedy output stays
+        token-identical — drafting quality is a speed knob only."""
+        cfg = _cfg()
+        params = init_gpt_params(jax.random.PRNGKey(6), cfg)
+        prompt = jnp.asarray([[9, 8, 7]], jnp.int32)
+
+        def bad_draft(tokens, lens, k):
+            # always propose token 1 with a uniform q
+            b = tokens.shape[0]
+            q = jnp.full((b, k, cfg.vocab_size),
+                         1.0 / cfg.vocab_size, jnp.float32)
+            return jnp.ones((b, k), jnp.int32), q
+
+        base = np.asarray(generate(params, prompt, cfg,
+                                   max_new_tokens=12))
+        out, stats = spec_generate(
+            params, prompt, cfg, spec=SpecConfig(k=3,
+                                                 draft_fn=bad_draft),
+            max_new_tokens=12)
+        np.testing.assert_array_equal(base, np.asarray(out))
+
+
+class TestServingEngineSpec:
+    def _model(self):
+        cfg = _cfg(max_position_embeddings=128)
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        return cfg, params
+
+    @pytest.mark.parametrize("layout,bs", [("contiguous", 16),
+                                           ("paged", 8)])
+    def test_engine_greedy_identical(self, layout, bs):
+        from apex_tpu.serving import ServingEngine
+
+        cfg, params = self._model()
+        rng = np.random.RandomState(0)
+        reqs = [dict(prompt=rng.randint(0, cfg.vocab_size, (n,)),
+                     max_new_tokens=16) for n in (5, 9, 3)]
+        base = ServingEngine(params, cfg, max_slots=2, max_len=64,
+                             cache_layout=layout, block_size=bs
+                             ).run([dict(r) for r in reqs])
+        spec = ServingEngine(params, cfg, max_slots=2, max_len=64,
+                             cache_layout=layout, block_size=bs,
+                             spec="ngram").run([dict(r) for r in reqs])
+        for b, s in zip(base, spec):
+            np.testing.assert_array_equal(b.tokens, s.tokens)
+            # multi-token emission: polls < tokens for at least the
+            # self-repeating rows, never more than tokens
+            assert s.decode_steps <= b.decode_steps
+
+    def test_engine_spec_preempt_resume_identical(self):
+        """Spec + paged preemption compose: a starved pool that forces
+        preempt→resume must still produce token-identical greedy
+        output vs an unstarved spec engine."""
+        from apex_tpu.serving import ServingEngine
+
+        cfg, params = self._model()
+        rng = np.random.RandomState(3)
+        reqs = [dict(prompt=rng.randint(0, cfg.vocab_size, (12,)),
+                     max_new_tokens=24) for _ in range(3)]
+        big = ServingEngine(params, cfg, max_slots=3, max_len=64,
+                            cache_layout="paged", block_size=4,
+                            spec="ngram").run([dict(r) for r in reqs])
+        small = ServingEngine(params, cfg, max_slots=3, max_len=64,
+                              cache_layout="paged", block_size=4,
+                              num_blocks=24, spec="ngram")
+        out = small.run([dict(r) for r in reqs])
+        assert small.stats()["preemptions"] >= 1    # starvation forced
+        for b, s in zip(big, out):
+            np.testing.assert_array_equal(b.tokens, s.tokens)
+        # polls survive the preemption accounting (coherence envelope)
+        k = small.stats()["spec_k"]
+        for r in out:
+            emitted = r.tokens.size - 1 - r.preemptions
+            assert 1 <= r.decode_steps <= max(emitted, 1)
+            assert emitted <= r.decode_steps * (k + 1)

@@ -217,3 +217,36 @@ def test_since_step_cli_flag(report, tmp_path, capsys):
     out = capsys.readouterr().out
     line = next(ln for ln in out.splitlines() if "train.loss" in ln)
     assert "99" in line and line.split()[1] == "1"   # count == 1
+
+
+def test_spec_summary_fixture(report, tmp_path):
+    """ISSUE 8 satellite: the speculative-decoding counters get a
+    derived view — accept rate = accepted/draft and the verify-call
+    amortization (emitted tokens per per-sequence verify pass)."""
+    f = tmp_path / "spec.jsonl"
+    f.write_text(
+        '{"schema_version":3,"t":1,"type":"counter",'
+        '"name":"generate.spec.draft_tokens","value":80}\n'
+        '{"schema_version":3,"t":2,"type":"counter",'
+        '"name":"generate.spec.accepted_tokens","value":60}\n'
+        '{"schema_version":3,"t":3,"type":"counter",'
+        '"name":"generate.spec.verify_calls","value":10}\n')
+    summ = report.summarize(report.load_records([str(f)]))
+    spec = report.spec_summary(summ["counters"])
+    assert spec["accept_rate"] == 0.75            # 60 / 80
+    assert spec["tokens_per_verify"] == 7.0       # (60 + 10) / 10
+    out = io.StringIO()
+    report.print_report(summ, out=out)
+    text = out.getvalue()
+    assert "speculative decoding" in text
+    assert "accept rate 0.75" in text
+    assert "tokens/verify 7" in text
+    # a spec-off stream (no draft counter) -> no section
+    assert report.spec_summary({"serving.requests": 3.0}) is None
+    # verify counter missing entirely (wounded stream): rate still
+    # reported, amortization honestly absent
+    partial = report.spec_summary({
+        "generate.spec.draft_tokens": 8.0,
+        "generate.spec.accepted_tokens": 4.0})
+    assert partial["accept_rate"] == 0.5
+    assert partial["tokens_per_verify"] is None

@@ -18,6 +18,9 @@ ended — costs the least-valuable stages:
    inference fast path rows (prefill/decode split + continuous-batching
    serving mixes, both KV layouts + the matched-HBM paged ablation) as
    their own JSON line;
+   then ``bench.py --decode --spec off,ngram --cache-layout
+   contiguous,paged`` — the speculative-decoding ablation (ISSUE 8):
+   accept-rate sweep rows + the stderr accept-rate table;
    then ``bench.py --tp-overlap`` — the ring collective-matmul off/on
    ablation rows — and the ``tp_overlap`` dryrun parity phase
    (overlapped == monolithic fwd+bwd on the 8-virtual-device mesh).
@@ -155,6 +158,16 @@ def main():
     results["bench_decode"] = _run(
         "bench_decode", [sys.executable, "bench.py", "--decode",
                          "--cache-layout", "contiguous,paged"],
+        timeout=3600)
+    # speculative decoding + fused sampling (ISSUE 8): the --spec
+    # ablation stage — off vs n-gram self-drafting over the
+    # accept-rate sweep (repetition high-accept / random low-accept),
+    # both KV layouts, layout-tagged rows with draft/accepted counters
+    # and the stderr accept-rate table in the stage log
+    results["bench_spec"] = _run(
+        "bench_spec", [sys.executable, "bench.py", "--decode",
+                       "--spec", "off,ngram",
+                       "--cache-layout", "contiguous,paged"],
         timeout=3600)
     # TP comm overlap (ISSUE 5): the ring collective-matmul off/on
     # ablation rows, then the tp_overlap dryrun parity phase alone on

@@ -11,6 +11,9 @@ is actually operated on:
 
 - lane occupancy, queue depth, decode tokens/sec;
 - paged-pool blocks in use / free + preemption count;
+- speculative-decoding accept rate (``generate.spec.*`` counters,
+  ISSUE 8) when the engine runs with spec on — absent counters simply
+  hide the row;
 - per-SLO-class TTFT / TPOT p50 & p95 (computed from the exported
   native histogram buckets with the same nearest-rank algorithm the
   in-process sketch uses — the dashboard and the engine answer
@@ -101,6 +104,15 @@ def snapshot(om, parsed) -> dict:
             row["requests"] = met + missed
         if row:
             rows[cls] = row
+    # speculative decoding (ISSUE 8): accept rate from the realized
+    # draft/accepted counters — present only when the engine runs with
+    # spec on, so the row renders conditionally.  A partial scrape can
+    # carry one counter without the other (the exporter thread can
+    # interleave with the first poll's counter creation): require both.
+    draft = val("generate_spec_draft_tokens_total")
+    accepted = val("generate_spec_accepted_tokens_total")
+    if accepted is None:
+        draft = None
     return {
         "occupancy": val("serving_slot_occupancy"),
         "queue_depth": val("serving_queue_depth"),
@@ -109,6 +121,8 @@ def snapshot(om, parsed) -> dict:
         "blocks_free": val("serving_blocks_free"),
         "preemptions": val("serving_preemptions_total"),
         "requests": val("serving_requests_total"),
+        "spec_accept_rate": (accepted / draft) if draft else None,
+        "spec_verify_calls": val("generate_spec_verify_calls_total"),
         "classes": rows,
     }
 
@@ -134,6 +148,10 @@ def render(snap: dict, health: str, url: str, out=None) -> None:
         p(f"  blocks in-use {_fmt(snap['blocks_in_use'], '{:.0f}')} / "
           f"free {_fmt(snap['blocks_free'], '{:.0f}')}   "
           f"preemptions {_fmt(snap['preemptions'], '{:.0f}')}")
+    if snap.get("spec_accept_rate") is not None:
+        p(f"  spec accept-rate {snap['spec_accept_rate']:.1%}   "
+          f"verify passes "
+          f"{_fmt(snap.get('spec_verify_calls'), '{:.0f}')}")
     if snap["classes"]:
         p(f"  {'slo_class':<14} {'reqs':>6} {'goodput':>8} "
           f"{'ttft p50':>10} {'ttft p95':>10} {'tpot p50':>10} "

@@ -32,9 +32,11 @@ prints:
   the in-process summary (stderr table, flight dumps, OpenMetrics
   summary families) could see;
 - derived views when their series are present: ring collectives
-  (``collectives.ring.*`` → implied tp) and the paged serving engine
-  (``serving.blocks_*`` + ``serving.preemptions`` → block-pool
-  high-water, preemption rate, prefix-share ratio).
+  (``collectives.ring.*`` → implied tp), speculative decoding
+  (``generate.spec.*`` → accept rate + verify-call amortization), and
+  the paged serving engine (``serving.blocks_*`` +
+  ``serving.preemptions`` → block-pool high-water, preemption rate,
+  prefix-share ratio).
 
 ``--since-step N`` keeps only records stamped with ``step >= N``
 (schema v2 stamps every record emitted after the loop declared a step
@@ -235,6 +237,30 @@ def ring_summary(counters: Dict[str, float]) -> Optional[dict]:
     }
 
 
+def spec_summary(counters: Dict[str, float]) -> Optional[dict]:
+    """Derived view of the speculative-decoding counters
+    (``generate.spec.*``, ISSUE 8): accept rate = accepted/draft —
+    how much of the drafter's work the target model agreed with — and
+    the verify-call amortization, emitted tokens per verify forward =
+    ``(accepted + verify_calls) / verify_calls`` (every verify also
+    yields its correction/bonus token, so the floor is 1.0 and the
+    ceiling is k+1).  None when the stream carries no draft counters
+    (spec off, or a pre-ISSUE-8 writer)."""
+    draft = counters.get("generate.spec.draft_tokens", 0.0)
+    if not draft:
+        return None
+    accepted = counters.get("generate.spec.accepted_tokens", 0.0)
+    verify = counters.get("generate.spec.verify_calls", 0.0)
+    return {
+        "draft_tokens": draft,
+        "accepted_tokens": accepted,
+        "verify_calls": verify,
+        "accept_rate": accepted / draft,
+        "tokens_per_verify": ((accepted + verify) / verify) if verify
+        else None,
+    }
+
+
 def serving_summary(summary: dict) -> Optional[dict]:
     """Derived view of the paged serving engine's telemetry (ISSUE 6):
     block-pool high-water mark, preemption rate per admitted request,
@@ -332,6 +358,16 @@ def print_report(summary: dict, out=None) -> None:
                   "integer: the stream mixes ring sizes (several tp "
                   "geometries in one run), per-call invariant still "
                   "hops == (tp-1) x calls within each", file=out)
+    spec = spec_summary(counters) if counters else None
+    if spec:
+        print("== speculative decoding (generate.spec.*) ==", file=out)
+        print(f"  draft {spec['draft_tokens']:g}  accepted "
+              f"{spec['accepted_tokens']:g} -> accept rate "
+              f"{spec['accept_rate']:.3g}", file=out)
+        if spec["tokens_per_verify"] is not None:
+            print(f"  verify calls {spec['verify_calls']:g} -> "
+                  f"tokens/verify {spec['tokens_per_verify']:.3g} "
+                  "(amortization; ceiling is k+1)", file=out)
     serving = serving_summary(summary)
     if serving:
         print("== paged serving (serving.blocks_*) ==", file=out)
