@@ -50,6 +50,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.models.config import TransformerConfig
 from apex_tpu.models.transformer_lm import (
@@ -58,7 +59,7 @@ from apex_tpu.observability import metrics as _telemetry
 from apex_tpu.ops.fused_sampling import fused_sample
 
 __all__ = ["init_kv_cache", "decode_step", "decode_verify", "prefill",
-           "generate", "sample_logits"]
+           "generate", "sample_logits", "extract_kv", "inject_kv"]
 
 
 DEFAULT_BLOCK_SIZE = 16
@@ -120,6 +121,114 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
     pool["pos"] = jnp.zeros((batch,), jnp.int32)
     pool["block_tables"] = tables
     return pool
+
+
+def extract_kv(cache: dict, length: int, *, row: int = 0):
+    """Pull sequence ``row``'s first ``length`` tokens of K/V out of a
+    cache in EITHER layout → ``(k, v)`` of shape
+    ``[L, length, kv_groups, dh]`` (device arrays; ``np.asarray`` them
+    to cross a process boundary).
+
+    This is the model-path half of the cluster KV handoff (ISSUE 9): a
+    prefill worker extracts the freshly written prompt K/V and ships it
+    to a decode pool.  Paged caches dereference the row's block table
+    (only the blocks the table names are touched — token order, not
+    pool order); contiguous caches slice the row's stripe.  Exactly
+    inverted by :func:`inject_kv` on any cache with room:
+    ``inject_kv(dst, *extract_kv(src, n))`` leaves ``dst`` decoding
+    token-identically to ``src`` (tests/test_serving_handoff.py pins it
+    across layout pairs)."""
+    if length < 1:
+        raise ValueError(f"length={length} must be >= 1")
+    if "block_tables" in cache:
+        from apex_tpu.serving.paged_cache import (
+            blocks_for, gather_block_kv)
+
+        bs = cache["k"].shape[2]
+        tables = cache["block_tables"]
+        need = blocks_for(int(length), bs)
+        if need > tables.shape[1]:
+            raise ValueError(
+                f"length {length} needs {need} blocks but the table "
+                f"holds {tables.shape[1]}")
+        ids = np.asarray(tables)[row, :need]
+        nb = cache["k"].shape[1]
+        if (ids >= nb).any() or (ids < 0).any():
+            # an unmapped sentinel inside the requested range means
+            # `length` exceeds the row's materialized tokens — the
+            # gather would CLAMP onto a real pool block and silently
+            # ship another request's pages over the wire
+            raise ValueError(
+                f"length {length} reaches unmapped table entries for "
+                f"row {row} (sentinel >= {nb}); it exceeds the row's "
+                "materialized tokens")
+        k, v = gather_block_kv(cache["k"], cache["v"], ids)
+        return k[:, :length], v[:, :length]
+    if length > cache["k"].shape[2]:
+        raise ValueError(
+            f"length {length} exceeds the cache max_len "
+            f"{cache['k'].shape[2]}")
+    return cache["k"][:, row, :length], cache["v"][:, row, :length]
+
+
+def inject_kv(cache: dict, k, v, *, row: int = 0) -> dict:
+    """Write per-token K/V ``[L, n, kv_groups, dh]`` into positions
+    ``[0, n)`` of sequence ``row`` and set ``pos[row] = n`` — the
+    decode-side half of the cluster KV handoff (inverse of
+    :func:`extract_kv`).  Paged caches scatter each token through the
+    row's block table (cells ``(tables[row, t//bs], t % bs)``; unmapped
+    sentinel entries drop, so a short table cannot be corrupted);
+    contiguous caches overwrite the row's stripe head.  The arrays are
+    cast to the cache dtype — a raw-wire handoff between same-dtype
+    caches is bit-exact."""
+    k = jnp.asarray(k)
+    v = jnp.asarray(v)
+    if k.ndim != 4 or k.shape != v.shape:
+        raise ValueError(
+            f"expected matching [L, n, g, dh] K/V, got {k.shape} / "
+            f"{v.shape}")
+    n = k.shape[1]
+    if "block_tables" in cache:
+        from apex_tpu.serving.paged_cache import blocks_for
+
+        tables = cache["block_tables"].astype(jnp.int32)
+        nb, bs = cache["k"].shape[1], cache["k"].shape[2]
+        mb = tables.shape[1]
+        need = blocks_for(int(n), bs)
+        if need > mb:
+            raise ValueError(
+                f"{n} handoff tokens need {need} blocks but the "
+                f"table holds {mb}")
+        ids = np.asarray(cache["block_tables"])[row, :need]
+        if (ids >= nb).any() or (ids < 0).any():
+            # scattering through an unmapped sentinel would DROP the
+            # write while pos still claims the token — the cache
+            # would silently attend over stale pool data
+            raise ValueError(
+                f"{n} handoff tokens reach unmapped table entries "
+                f"for row {row} (sentinel >= {nb}); map blocks for "
+                "the full range before injecting")
+        t = jnp.arange(n)
+        blk = tables[row, jnp.minimum(t // bs, mb - 1)]
+        blk = jnp.where(t < mb * bs, blk, nb)
+        off = t % bs
+        return {
+            "k": cache["k"].at[:, blk, off].set(
+                k.astype(cache["k"].dtype), mode="drop"),
+            "v": cache["v"].at[:, blk, off].set(
+                v.astype(cache["v"].dtype), mode="drop"),
+            "pos": cache["pos"].at[row].set(n),
+            "block_tables": cache["block_tables"],
+        }
+    if n > cache["k"].shape[2]:
+        raise ValueError(
+            f"{n} handoff tokens exceed the cache max_len "
+            f"{cache['k'].shape[2]}")
+    return {
+        "k": cache["k"].at[:, row, :n].set(k.astype(cache["k"].dtype)),
+        "v": cache["v"].at[:, row, :n].set(v.astype(cache["v"].dtype)),
+        "pos": cache["pos"].at[row].set(n),
+    }
 
 
 def _check_sampling_args(temperature: float,

@@ -35,6 +35,13 @@ Detectors:
   against the class's TTFT/TPOT deadlines), and a class missing more
   than the threshold fraction fires once (with hysteresis) instead of
   once per late request.
+- :class:`PoolStallDetector` — cluster-side (ISSUE 9): consecutive
+  RPC failures against a named worker pool (a prefill or decode pool
+  of the disaggregated serving tier).  The router feeds every
+  dispatch/poll outcome; ``threshold`` consecutive failures on one
+  pool fire a ``pool_stall`` anomaly — which latches ``/healthz`` to
+  503, the signal a load balancer or autoscaler acts on — and the
+  pool re-arms only after the same number of consecutive successes.
 
 Every firing becomes an ``anomaly.<kind>`` event in the telemetry
 stream, increments ``anomaly.count``, and notifies the flight recorder
@@ -53,6 +60,7 @@ __all__ = [
     "Anomaly",
     "DetectorBank",
     "NanInfDetector",
+    "PoolStallDetector",
     "QueueStallDetector",
     "SLOViolationDetector",
     "ScalerThrashDetector",
@@ -380,6 +388,52 @@ class SLOViolationDetector:
         return None
 
 
+class PoolStallDetector:
+    """Consecutive-failure latch per worker pool (cluster tier,
+    ISSUE 9).
+
+    The router feeds one boolean per RPC against a pool ("prefill",
+    "decode", or a finer label).  A single refused connection is
+    weather (a worker restarting mid-deploy); ``threshold``
+    consecutive failures mean the pool is stalled — fire once, and
+    stay latched until ``threshold`` consecutive *successes* prove
+    recovery (so a flapping pool cannot fire per flap)."""
+
+    def __init__(self, *, threshold: int = 3):
+        if threshold < 1:
+            raise ValueError(f"threshold={threshold} must be >= 1")
+        self.threshold = int(threshold)
+        self._fails: Dict[str, int] = {}
+        self._oks: Dict[str, int] = {}
+        self._latched: Dict[str, bool] = {}
+
+    def feed(self, pool: str, ok: bool,
+             detail: Optional[str] = None) -> Optional[Anomaly]:
+        if ok:
+            self._fails[pool] = 0
+            self._oks[pool] = self._oks.get(pool, 0) + 1
+            if (self._latched.get(pool)
+                    and self._oks[pool] >= self.threshold):
+                self._latched[pool] = False
+            return None
+        self._oks[pool] = 0
+        self._fails[pool] = self._fails.get(pool, 0) + 1
+        if self._latched.get(pool) or self._fails[pool] < self.threshold:
+            return None
+        self._latched[pool] = True
+        return Anomaly(
+            "pool_stall", None,
+            f"worker pool {pool!r} failed {self._fails[pool]} "
+            f"consecutive RPCs{': ' + detail if detail else ''} — "
+            "routing around it; requests requeue, they are not lost",
+            {"pool": pool, "consecutive_failures": self._fails[pool],
+             **({"detail": detail} if detail else {})})
+
+    def stalled(self, pool: str) -> bool:
+        """Is the pool currently latched stalled?"""
+        return bool(self._latched.get(pool))
+
+
 class DetectorBank:
     """The per-registry detector set + firing pipeline.
 
@@ -413,6 +467,8 @@ class DetectorBank:
         self.serving = QueueStallDetector()
         self.slo = SLOViolationDetector(
             rate_threshold=cfg.get("slo_miss_rate_threshold", 0.25))
+        self.pool = PoolStallDetector(
+            threshold=cfg.get("pool_stall_threshold", 3))
 
     # -- feeds (called by metrics.record_step_metrics & friends) -----------
 
@@ -470,6 +526,13 @@ class DetectorBank:
     def feed_slo(self, slo_class: str, met: bool,
                  step: Optional[int] = None) -> Optional[Anomaly]:
         a = self.slo.feed(slo_class, met, step)
+        if a is not None:
+            self._fire(a)
+        return a
+
+    def feed_pool(self, pool: str, ok: bool,
+                  detail: Optional[str] = None) -> Optional[Anomaly]:
+        a = self.pool.feed(pool, ok, detail)
         if a is not None:
             self._fire(a)
         return a

@@ -30,6 +30,14 @@ engine:
   deadlines, resolved by ``ServingEngine(slo_targets=...)``; every
   completion is judged into goodput counters and per-class latency
   sketches;
+- :mod:`~apex_tpu.serving.cluster` — the disaggregated tier
+  (ISSUE 9): an SLO-aware router dispatching to separate prefill and
+  decode worker pools over a stdlib-socket protocol, with the KV
+  cache handed off between them (raw = token-identical, or
+  bf16/int8-compressed via ``comm/``), requeue-on-worker-death, and
+  ``cluster.*`` telemetry.  Imported on demand
+  (``from apex_tpu.serving.cluster import Router``) — single-process
+  serving never pays for it;
 - observability — ``serving.{prefill_ms, decode_tokens_per_sec,
   slot_occupancy, queue_depth, blocks_in_use, blocks_free,
   prefix_shared_blocks}`` gauges and the ``serving.preemptions``

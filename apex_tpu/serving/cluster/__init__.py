@@ -1,0 +1,71 @@
+"""apex_tpu.serving.cluster — the disaggregated serving tier (ISSUE 9).
+
+A single :class:`~apex_tpu.serving.ServingEngine` is one process on
+one chip.  Real fleets split the request lifecycle across POOLS:
+prefill is compute-bound (one big batched forward per prompt), decode
+is HBM-bandwidth-bound (one small forward per token over a resident
+cache) — running both on the same pool means each phase idles the
+resource the other is starving for.  This package is the tier that
+splits them:
+
+- :mod:`~apex_tpu.serving.cluster.protocol` — length-prefixed
+  stdlib-socket frames (JSON control header + raw tensor blobs);
+- :mod:`~apex_tpu.serving.cluster.handoff` — the KV wire format:
+  per-token K/V extracted through the paged block table (contiguous
+  fallback kept), shipped raw (bit-exact — greedy token-identity
+  across the handoff) or compressed to bf16/int8 via ``comm/``
+  block-scaled quantization;
+- :mod:`~apex_tpu.serving.cluster.worker` — pool members: prefill
+  executors and decode engines behind the socket RPC surface, runnable
+  in-process (tests) or as their own OS processes
+  (``python -m apex_tpu.serving.cluster.worker``);
+- :mod:`~apex_tpu.serving.cluster.router` — the SLO-aware control
+  plane: per-class admission caps, priority dispatch, headroom-based
+  decode placement, requeue-on-worker-death, ``cluster.*`` telemetry,
+  ``/healthz`` degradation latching via the pool-stall detector, and
+  autoscaling hints fused from live scrapes + windowed
+  ``aggregate_telemetry`` fleet summaries.
+
+``bench.py --serve-trace`` replays a bursty open-loop trace against a
+single engine and the two-process disaggregated topology on one host;
+``examples/serve_cluster.py`` is the runnable demo.  docs/serving.md
+has the topology diagram and the wire format.
+"""
+
+from apex_tpu.serving.cluster.handoff import (  # noqa: F401
+    WIRE_DTYPES,
+    decode_kv,
+    encode_kv,
+    wire_bytes,
+)
+from apex_tpu.serving.cluster.protocol import (  # noqa: F401
+    ProtocolError,
+    recv_msg,
+    send_msg,
+)
+from apex_tpu.serving.cluster.router import (  # noqa: F401
+    DEFAULT_CLASS_PRIORITY,
+    ClusterResponse,
+    Router,
+    RouterBusy,
+)
+from apex_tpu.serving.cluster.worker import (  # noqa: F401
+    WorkerServer,
+    spawn_worker,
+)
+
+__all__ = [
+    "DEFAULT_CLASS_PRIORITY",
+    "ClusterResponse",
+    "ProtocolError",
+    "Router",
+    "RouterBusy",
+    "WIRE_DTYPES",
+    "WorkerServer",
+    "decode_kv",
+    "encode_kv",
+    "recv_msg",
+    "send_msg",
+    "spawn_worker",
+    "wire_bytes",
+]

@@ -1,0 +1,157 @@
+"""KV-cache handoff serialization: prefill pool → wire → decode pool.
+
+Disaggregated serving (ISSUE 9) splits a request's life across
+machines: a compute-bound prefill worker builds the prompt's KV cache,
+a bandwidth-bound decode worker continues from it.  The bytes crossing
+that wire are the whole cost of the split, so this module owns their
+format:
+
+- :func:`encode_kv` — per-token K/V ``[L, n, g, dh]`` (from
+  :func:`~apex_tpu.models.generate.extract_kv`, which dereferences the
+  paged block table or slices the contiguous stripe) → a JSON-able
+  header + raw blobs for :mod:`~apex_tpu.serving.cluster.protocol`.
+- :func:`decode_kv` — the inverse, yielding arrays ready for
+  :func:`~apex_tpu.models.generate.inject_kv` /
+  ``ServingEngine.submit_prefilled``.
+
+Wire dtypes (``wire_dtype=``, the parity knob):
+
+- ``"raw"`` — the cache dtype's bytes verbatim.  Bit-exact: greedy
+  decode after injection is token-identical to never having crossed
+  the wire (the acceptance pin).  fp32 caches pay 4 B/elem.
+- ``"bf16"`` — elementwise downcast (no-op for bf16 caches, halves
+  fp32 wire bytes).  Lossy for fp32 caches — outputs may diverge.
+- ``"int8"`` — block-scaled int8 via :mod:`apex_tpu.comm.quantize`
+  (EQuARX, PAPERS.md): ~4× fewer bytes than fp32 plus ``4/block``
+  scale overhead.  Lossy by design; the serve-trace bench carries the
+  realized ``handoff_bytes`` so the byte/parity trade is measured, not
+  asserted.
+
+The header is self-describing (shape, cache dtype, wire dtype, block)
+so a decode worker can refuse a mismatched handoff instead of
+reinterpreting bytes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.comm.quantize import dequantize_blocks, quantize_blocks
+
+__all__ = ["WIRE_DTYPES", "encode_kv", "decode_kv", "wire_bytes"]
+
+WIRE_DTYPES = ("raw", "bf16", "int8")
+
+# numpy-compatible dtypes by canonical name — bfloat16/float16 resolve
+# through jnp (ml_dtypes-registered), so np.frombuffer round-trips them
+_DTYPES = {
+    "float32": np.float32,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+}
+
+_INT8_BLOCK = 256     # the comm/ gradient-collective default
+
+
+def _np(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+def encode_kv(k, v, *, wire_dtype: str = "raw",
+              block: int = _INT8_BLOCK) -> Tuple[dict, List[bytes]]:
+    """Serialize per-token K/V ``[L, n, g, dh]`` → ``(header, blobs)``
+    for :func:`~apex_tpu.serving.cluster.protocol.send_msg`."""
+    if wire_dtype not in WIRE_DTYPES:
+        raise ValueError(
+            f"wire_dtype={wire_dtype!r}: expected one of {WIRE_DTYPES}")
+    k = _np(k)
+    v = _np(v)
+    if k.ndim != 4 or k.shape != v.shape:
+        raise ValueError(
+            f"expected matching [L, n, g, dh] K/V, got {k.shape} / "
+            f"{v.shape}")
+    name = jnp.dtype(k.dtype).name
+    if name not in _DTYPES:
+        raise ValueError(f"unsupported cache dtype {name!r} "
+                         f"(expected one of {sorted(_DTYPES)})")
+    header = {
+        "kind": "kv",
+        "shape": list(k.shape),
+        "cache_dtype": name,
+        "wire_dtype": wire_dtype,
+    }
+    if wire_dtype == "raw":
+        return header, [k.tobytes(), v.tobytes()]
+    if wire_dtype == "bf16":
+        bk = _np(jnp.asarray(k).astype(jnp.bfloat16))
+        bv = _np(jnp.asarray(v).astype(jnp.bfloat16))
+        return header, [bk.tobytes(), bv.tobytes()]
+    header["block"] = int(block)
+    blobs: List[bytes] = []
+    for x in (k, v):
+        flat = jnp.asarray(x, jnp.float32).reshape(-1)
+        wire, scales = quantize_blocks(flat, "int8", block)
+        blobs.append(_np(wire).tobytes())
+        blobs.append(_np(scales).astype(np.float32).tobytes())
+    return header, blobs
+
+
+def decode_kv(header: dict, blobs: List[bytes]
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    """Invert :func:`encode_kv` → ``(k, v)`` numpy arrays in the
+    ORIGINAL cache dtype and shape, ready for ``inject_kv``.  Raises
+    ``ValueError`` on a self-inconsistent header/blob set — a decode
+    pool must reject a torn handoff, never reinterpret it."""
+    try:
+        shape = tuple(int(s) for s in header["shape"])
+        cache_dtype = _DTYPES[header["cache_dtype"]]
+        wire_dtype = header["wire_dtype"]
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"malformed KV header: {e}") from e
+    if len(shape) != 4 or any(s < 1 for s in shape):
+        raise ValueError(f"malformed KV shape {shape}")
+    n_elem = int(np.prod(shape))
+    if wire_dtype in ("raw", "bf16"):
+        if len(blobs) != 2:
+            raise ValueError(
+                f"{wire_dtype} handoff needs 2 blobs, got {len(blobs)}")
+        wdt = cache_dtype if wire_dtype == "raw" else jnp.bfloat16
+        itemsize = np.dtype(wdt).itemsize
+        out = []
+        for blob in blobs:
+            if len(blob) != n_elem * itemsize:
+                raise ValueError(
+                    f"blob holds {len(blob)} bytes, header declares "
+                    f"{n_elem * itemsize}")
+            arr = np.frombuffer(blob, dtype=wdt).reshape(shape)
+            out.append(np.asarray(arr, dtype=cache_dtype))
+        return out[0], out[1]
+    if wire_dtype != "int8":
+        raise ValueError(f"unknown wire_dtype {wire_dtype!r}")
+    if len(blobs) != 4:
+        raise ValueError(f"int8 handoff needs 4 blobs, got {len(blobs)}")
+    block = int(header.get("block", _INT8_BLOCK))
+    if block < 1:
+        raise ValueError(f"malformed block {block}")
+    n_pad = -(-n_elem // block) * block
+    n_scales = n_pad // block
+    out = []
+    for wire_b, scale_b in ((blobs[0], blobs[1]), (blobs[2], blobs[3])):
+        if len(wire_b) != n_pad or len(scale_b) != n_scales * 4:
+            raise ValueError(
+                f"int8 blobs hold {len(wire_b)}/{len(scale_b)} bytes, "
+                f"header declares {n_pad}/{n_scales * 4}")
+        wire = jnp.asarray(np.frombuffer(wire_b, dtype=np.int8))
+        scales = jnp.asarray(np.frombuffer(scale_b, dtype=np.float32))
+        flat = dequantize_blocks(wire, scales, block, n_elem)
+        out.append(_np(flat.reshape(shape).astype(cache_dtype)))
+    return out[0], out[1]
+
+
+def wire_bytes(blobs: List[bytes]) -> int:
+    """Payload bytes of an encoded handoff (the
+    ``cluster.handoff_bytes`` accounting unit)."""
+    return sum(len(b) for b in blobs)

@@ -1,0 +1,674 @@
+"""SLO-aware router over disaggregated prefill/decode worker pools.
+
+The cluster tier's control plane (ISSUE 9, ROADMAP item 4).  A request
+arrives with an SLO class; the router
+
+1. **admits** it against a per-class queue-depth cap (an overloaded
+   fleet sheds *batch* load first, and an interactive burst can never
+   wedge itself behind a thousand queued batch requests — the cap
+   returns :class:`RouterBusy` to the caller instead of queueing into
+   oblivion);
+2. **dispatches** by class priority (``class_priority`` — interactive
+   ahead of standard ahead of batch): one RPC to a prefill worker
+   (compute-bound pool) produces the first token + the serialized KV
+   handoff, which is forwarded — blobs untouched, the router never
+   deserializes a cache — to the decode worker (HBM-bandwidth-bound
+   pool) with the most free-block headroom, where it is injected and
+   continuously batched;
+3. **collects** completions by polling decode workers (the poll reply
+   piggybacks ``engine.stats()``, the live admission signal);
+4. **degrades loudly**: RPC failures feed the
+   :class:`~apex_tpu.observability.detectors.PoolStallDetector`, so a
+   stalled pool latches ``/healthz`` to 503 when the router process
+   exports telemetry; a dead decode worker's in-flight requests
+   REQUEUE at the front of their class queue (re-prefilled and
+   re-dispatched to a surviving worker — requests are never lost, the
+   soak test kills a worker to pin it).
+
+Telemetry (``cluster.*``, same no-op-unless-configured contract):
+``cluster.route`` (counter, per pool × class), ``cluster.handoff_bytes``
+(counter), ``cluster.pool_occupancy{pool=}`` / ``cluster.queue_depth
+{slo_class=}`` / ``cluster.inflight`` (gauges), ``cluster.rebalance`` /
+``cluster.requeued`` / ``cluster.rejected`` (counters), and
+``cluster.scale_hint{pool=}`` from :meth:`Router.autoscale_signal` —
+which fuses the live scrapes with a windowed fleet summary from
+``tools/aggregate_telemetry.py --json --window N``.
+
+The router's data path never touches jax: prompts are integer lists,
+KV handoffs are opaque blobs forwarded verbatim, deadlines come from
+:mod:`apex_tpu.serving.slo` (pure Python).  No device, compile, or
+model state exists in the router process — only sockets and
+bookkeeping.  (Importing it through the package still pulls the
+repo's stack in, like everything under ``apex_tpu``; a truly
+dependency-free wire consumer should load ``protocol.py`` by file
+path, the ``tools/`` discipline.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import socket
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from apex_tpu.observability import metrics as _telemetry
+from apex_tpu.serving.cluster import protocol
+from apex_tpu.serving.slo import judge as _judge_slo
+from apex_tpu.serving.slo import resolve_slo_targets
+from apex_tpu.serving.slo import tpot_ms as _tpot_ms
+
+__all__ = ["Router", "RouterBusy", "ClusterResponse",
+           "DEFAULT_CLASS_PRIORITY"]
+
+# dispatch order: latency-sensitive classes first.  Unknown classes
+# slot in just before "batch" (they at least beat the explicitly
+# latency-insensitive tier).
+DEFAULT_CLASS_PRIORITY = ("interactive", "standard", "default", "batch")
+
+
+class RouterBusy(RuntimeError):
+    """Admission refused: the request's SLO class is at its queue cap."""
+
+
+class WorkerDied(RuntimeError):
+    """An RPC against a worker failed; the worker is marked dead."""
+
+
+@dataclasses.dataclass
+class ClusterResponse:
+    """One completed request as the ROUTER measured it: latency stamps
+    span submit → handoff → remote decode → poll receipt, so TTFT/e2e
+    include every wire hop (the honest disaggregation cost).  Field
+    names match the engine's :class:`~apex_tpu.serving.Response` where
+    they mean the same thing, so ``bench.py``'s per-class summary code
+    serves both topologies."""
+
+    request_id: int
+    prompt: np.ndarray
+    tokens: np.ndarray
+    finish_reason: str
+    slo_class: str = "default"
+    queue_wait_ms: float = 0.0     # submit -> dispatch start
+    ttft_ms: float = 0.0           # submit -> first token at router
+    tpot_ms: float = 0.0
+    e2e_ms: float = 0.0            # submit -> completion at router
+    prefill_ms: float = 0.0        # remote prefill forward
+    decode_steps: int = 0
+    preemptions: int = 0
+    requeues: int = 0              # decode-worker deaths survived
+    handoff_bytes: int = 0
+    pool: str = ""                 # decode worker that finished it
+    slo_met: bool = True
+
+
+@dataclasses.dataclass
+class _Pending:
+    """Router-side state of one live request."""
+
+    rid: int
+    prompt: np.ndarray
+    kwargs: dict
+    slo_class: str
+    submitted_t: float
+    dispatch_t: float = 0.0
+    first_token_t: float = 0.0
+    prefill_ms: float = 0.0
+    handoff_bytes: int = 0
+    requeues: int = 0
+
+
+class _Worker:
+    """Client half of one worker connection (blocking RPC with a
+    timeout; any failure marks the worker dead — the router routes
+    around it and the pool detector decides when that's an incident)."""
+
+    def __init__(self, addr: str, pool: str, timeout: float):
+        self.addr = addr
+        self.pool = pool
+        self.timeout = timeout
+        self.alive = True
+        self.stats: dict = {}
+        self.in_flight: Dict[int, _Pending] = {}
+        # dispatches since the last stats refresh: the stats snapshot
+        # goes stale inside one dispatch burst, and without this the
+        # whole burst would land on whichever worker looked best at
+        # the last poll
+        self.dispatched_since_poll = 0
+        host, _, port = addr.rpartition(":")
+        self._sock = socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=timeout)
+        self._sock.settimeout(timeout)
+
+    def rpc(self, header: dict, blobs: Sequence[bytes] = ()
+            ) -> Tuple[dict, List[bytes]]:
+        if not self.alive:
+            raise WorkerDied(f"{self.pool} worker {self.addr} is dead")
+        try:
+            protocol.send_msg(self._sock, header, blobs)
+            msg = protocol.recv_msg(self._sock)
+        except (OSError, protocol.ProtocolError) as e:
+            self.kill()
+            raise WorkerDied(
+                f"{self.pool} worker {self.addr}: {e}") from e
+        if msg is None:
+            self.kill()
+            raise WorkerDied(
+                f"{self.pool} worker {self.addr} closed the connection")
+        reply, rblobs = msg
+        if not reply.get("ok"):
+            # an application-level refusal is an error, not a death —
+            # the worker answered coherently
+            raise RuntimeError(
+                f"{self.pool} worker {self.addr}: "
+                f"{reply.get('error', 'rejected')}")
+        return reply, rblobs
+
+    def kill(self) -> None:
+        self.alive = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class Router:
+    """SLO-aware dispatch over prefill/decode pools (see module doc).
+
+    ``prefill`` / ``decode`` are worker addresses (``host:port``).
+    ``queue_caps`` maps SLO class → max queued at the router (absent =
+    uncapped); ``class_priority`` orders dispatch.  ``wire_dtype`` is
+    the KV handoff format the prefill pool is asked for (``"raw"`` =
+    bit-exact, the token-identity default; ``"bf16"``/``"int8"``
+    compress the wire at a parity cost — see
+    ``serving/cluster/handoff.py``).
+
+    Drive it like the engine: :meth:`submit` + :meth:`step` in a loop
+    (or :meth:`run` / :meth:`run_trace`), collect
+    :class:`ClusterResponse` from each step's return."""
+
+    def __init__(self, prefill: Sequence[str], decode: Sequence[str], *,
+                 slo_targets: Optional[dict] = None,
+                 queue_caps: Optional[Dict[str, int]] = None,
+                 class_priority: Sequence[str] = DEFAULT_CLASS_PRIORITY,
+                 wire_dtype: str = "raw",
+                 max_worker_queue: int = 4,
+                 rpc_timeout: float = 60.0):
+        if not prefill or not decode:
+            raise ValueError("need at least one prefill and one decode "
+                             "worker address")
+        self._prefill = [_Worker(a, "prefill", rpc_timeout)
+                         for a in prefill]
+        self._decode = [_Worker(a, "decode", rpc_timeout)
+                        for a in decode]
+        for w in self._prefill + self._decode:
+            reply, _ = w.rpc({"op": "hello"})
+            if reply.get("role") != w.pool:
+                w.kill()
+                raise ValueError(
+                    f"{w.addr} answered role={reply.get('role')!r}, "
+                    f"expected {w.pool!r} — check the pool wiring")
+        self._slo_targets = resolve_slo_targets(slo_targets)
+        self._caps = dict(queue_caps or {})
+        self._priority = tuple(class_priority)
+        self.wire_dtype = wire_dtype
+        self._max_worker_queue = int(max_worker_queue)
+        self._queues: Dict[str, deque] = {}
+        self._next_rid = 0
+        self._pf_rr = 0                      # prefill round-robin cursor
+        self._last_decode_pick: Optional[str] = None
+        self._requeued_total = 0
+        self._completed_total = 0
+
+    # -- admission ----------------------------------------------------------
+
+    def submit(self, prompt, *, max_new_tokens: int = 32,
+               temperature: float = 0.0,
+               eos_token_id: Optional[int] = None,
+               slo_class: str = "default") -> int:
+        """Admit one request → rid, or raise :class:`RouterBusy` when
+        the class's router queue is at its cap (shed load explicitly;
+        the caller decides whether to retry, downgrade the class, or
+        surface a 429)."""
+        slo_class = str(slo_class)
+        q = self._queues.setdefault(slo_class, deque())
+        cap = self._caps.get(slo_class)
+        if cap is not None and len(q) >= cap:
+            _telemetry.counter("cluster.rejected",
+                               {"slo_class": slo_class}).inc()
+            raise RouterBusy(
+                f"class {slo_class!r} queue is at its cap ({cap}); "
+                "shedding load")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        rid = self._next_rid
+        self._next_rid += 1
+        pend = _Pending(
+            rid=rid, prompt=prompt,
+            kwargs=dict(max_new_tokens=int(max_new_tokens),
+                        temperature=float(temperature),
+                        eos_token_id=eos_token_id),
+            slo_class=slo_class, submitted_t=time.perf_counter())
+        q.append(pend)
+        self._set_gauges()
+        return rid
+
+    # -- the dispatch/collect cycle ----------------------------------------
+
+    def step(self) -> List[ClusterResponse]:
+        """One router cycle: collect completions from every decode
+        worker, then dispatch as much queued work as the pools have
+        appetite for.  Returns the requests completed this cycle."""
+        completed = self._poll_decode()
+        self._dispatch()
+        self._set_gauges()
+        return completed
+
+    def run(self, max_wall_s: float = 300.0,
+            poll_s: float = 0.005) -> List[ClusterResponse]:
+        """Drive :meth:`step` until every queued/in-flight request
+        completed (or the wall budget runs out — whatever is still
+        pending stays pending, visible in :meth:`stats`)."""
+        out: List[ClusterResponse] = []
+        deadline = time.time() + max_wall_s
+        while self.pending and time.time() < deadline:
+            got = self.step()
+            out.extend(got)
+            if not got and self.pending:
+                if not any(w.alive for w in self._decode):
+                    raise RuntimeError(
+                        f"all decode workers dead with {self.pending} "
+                        "requests pending — nothing left to requeue "
+                        "onto")
+                time.sleep(poll_s)
+        return out
+
+    def run_trace(self, trace: Sequence[Tuple[float, dict]],
+                  max_wall_s: float = 300.0) -> List[ClusterResponse]:
+        """Open-loop replay: submit each ``(t_offset_s, submit_kwargs)``
+        at its offset from now — arrivals do NOT wait for completions
+        (the load a real fleet sees) — stepping continuously; then
+        drain.  Requests a cap rejects are dropped from the replay (the
+        shed-load outcome) and counted in ``cluster.rejected``."""
+        t0 = time.perf_counter()
+        order = sorted(trace, key=lambda item: item[0])
+        i = 0
+        out: List[ClusterResponse] = []
+        while i < len(order) or self.pending:
+            now = time.perf_counter() - t0
+            while i < len(order) and order[i][0] <= now:
+                try:
+                    self.submit(**order[i][1])
+                except RouterBusy:
+                    pass
+                i += 1
+            got = self.step()
+            out.extend(got)
+            if i < len(order):
+                wait = min(order[i][0] - (time.perf_counter() - t0),
+                           0.002)
+                if wait > 0:
+                    time.sleep(wait)
+            elif not got and self.pending:
+                # drain phase: pace the poll loop instead of hammering
+                # the workers' control plane between completions
+                time.sleep(0.002)
+            if time.perf_counter() - t0 > max_wall_s:
+                break
+        return out
+
+    @property
+    def pending(self) -> int:
+        """Requests queued at the router or in flight on a pool."""
+        queued = sum(len(q) for q in self._queues.values())
+        inflight = sum(len(w.in_flight) for w in self._decode)
+        return queued + inflight
+
+    # -- internals ----------------------------------------------------------
+
+    def _feed_pool(self, pool: str, ok: bool,
+                   detail: Optional[str] = None) -> None:
+        reg = _telemetry.registry()
+        if reg is not None and reg.detectors is not None:
+            reg.detectors.feed_pool(pool, ok, detail)
+
+    def _set_gauges(self) -> None:
+        for cls, q in self._queues.items():
+            _telemetry.gauge("cluster.queue_depth",
+                             {"slo_class": cls}).set(len(q))
+        _telemetry.gauge("cluster.inflight").set(
+            sum(len(w.in_flight) for w in self._decode))
+        for w in self._decode:
+            if w.alive and w.stats.get("max_slots"):
+                _telemetry.gauge("cluster.pool_occupancy",
+                                 {"pool": w.addr}).set(
+                    w.stats.get("active", 0) / w.stats["max_slots"])
+
+    def _next_class(self) -> Optional[str]:
+        """Highest-priority class with queued work; classes not in the
+        priority list rank just above 'batch'."""
+        ranked = sorted(
+            (cls for cls, q in self._queues.items() if q),
+            key=lambda cls: (self._priority.index(cls)
+                             if cls in self._priority
+                             else len(self._priority) - 1.5))
+        return ranked[0] if ranked else None
+
+    def _pick_prefill(self) -> Optional[_Worker]:
+        alive = [w for w in self._prefill if w.alive]
+        if not alive:
+            return None
+        w = alive[self._pf_rr % len(alive)]
+        self._pf_rr += 1
+        return w
+
+    def _pick_decode(self) -> Optional[_Worker]:
+        """The decode worker with the most free-block headroom whose
+        internal queue is below the router's per-worker cap — the
+        admission signal :meth:`ServingEngine.stats` exports for
+        exactly this choice.  ``None`` = every worker is saturated
+        (backpressure: the request stays queued at the ROUTER, where
+        class priority still applies — parking it on a worker's FIFO
+        would forfeit the interactive-ahead-of-batch property)."""
+        best, best_key = None, None
+        for w in self._decode:
+            if not w.alive:
+                continue
+            backlog = (w.stats.get("queued", 0)
+                       + w.dispatched_since_poll)
+            if backlog >= self._max_worker_queue:
+                continue
+            key = (w.stats.get("free_block_headroom", 0)
+                   - w.dispatched_since_poll, -backlog)
+            if best_key is None or key > best_key:
+                best, best_key = w, key
+        return best
+
+    def _dispatch(self) -> None:
+        while True:
+            cls = self._next_class()
+            if cls is None:
+                return
+            target = self._pick_decode()
+            if target is None:
+                # work is queued and nowhere to put it.  Saturated
+                # workers are backpressure (healthy); ZERO live
+                # workers is a pool stall — feed the detector every
+                # cycle so consecutive stalled cycles latch /healthz
+                if not any(w.alive for w in self._decode):
+                    self._feed_pool("decode", False,
+                                    "no live decode workers")
+                return
+            pend = self._queues[cls][0]
+            pf = self._pick_prefill()
+            if pf is None:
+                self._feed_pool("prefill", False,
+                                "no live prefill workers")
+                return
+            self._queues[cls].popleft()
+            if pend.dispatch_t == 0.0:
+                pend.dispatch_t = time.perf_counter()
+            try:
+                reply, blobs = pf.rpc({
+                    "op": "prefill",
+                    "prompt": [int(t) for t in pend.prompt],
+                    "temperature": pend.kwargs["temperature"],
+                    "wire_dtype": self.wire_dtype,
+                })
+            except WorkerDied as e:
+                self._feed_pool("prefill", False, str(e))
+                self._queues[cls].appendleft(pend)
+                if not any(w.alive for w in self._prefill):
+                    return
+                continue                    # retry on the next worker
+            except RuntimeError as e:
+                # an application-level refusal is deterministic —
+                # requeueing would loop forever.  Fail the request
+                # loudly instead of wedging the class queue.
+                _telemetry.counter("cluster.failed",
+                                   {"slo_class": cls}).inc()
+                _telemetry.event("cluster.request.failed",
+                                 rid=pend.rid, error=str(e)[:200])
+                continue
+            self._feed_pool("prefill", True)
+            # the first token exists NOW — TTFT ends here, before the
+            # decode pool ever sees the request
+            if pend.first_token_t == 0.0:
+                pend.first_token_t = time.perf_counter()
+            pend.prefill_ms = float(reply.get("prefill_ms", 0.0))
+            pend.handoff_bytes = int(reply.get("handoff_bytes", 0))
+            try:
+                target.rpc({
+                    "op": "decode",
+                    "rid": pend.rid,
+                    "prompt": [int(t) for t in pend.prompt],
+                    "first_token": int(reply["first_token"]),
+                    "prefill_ms": pend.prefill_ms,
+                    "kv": reply["kv"],
+                    "slo_class": pend.slo_class,
+                    **pend.kwargs,
+                }, blobs)
+            except WorkerDied as e:
+                self._feed_pool("decode", False, str(e))
+                pend.requeues += 1
+                self._requeued_total += 1
+                _telemetry.counter("cluster.requeued").inc()
+                self._queues[cls].appendleft(pend)
+                if not any(w.alive for w in self._decode):
+                    return
+                continue
+            except RuntimeError as e:
+                _telemetry.counter("cluster.failed",
+                                   {"slo_class": cls}).inc()
+                _telemetry.event("cluster.request.failed",
+                                 rid=pend.rid, error=str(e)[:200])
+                continue
+            self._feed_pool("decode", True)
+            target.in_flight[pend.rid] = pend
+            target.dispatched_since_poll += 1
+            if (self._last_decode_pick is not None
+                    and target.addr != self._last_decode_pick):
+                # the headroom ordering moved us off the previously
+                # preferred worker — the load-balancing edge the
+                # rebalance counter measures
+                _telemetry.counter("cluster.rebalance").inc()
+            self._last_decode_pick = target.addr
+            _telemetry.counter(
+                "cluster.route",
+                {"pool": target.addr, "slo_class": cls}).inc()
+            _telemetry.counter("cluster.handoff_bytes").inc(
+                pend.handoff_bytes)
+
+    def _poll_decode(self) -> List[ClusterResponse]:
+        completed: List[ClusterResponse] = []
+        for w in self._decode:
+            if not w.alive:
+                # a death can be observed anywhere (a dispatch RPC,
+                # scrape_stats, a previous poll) — whoever saw it only
+                # marked the worker dead.  The sweep here is the ONE
+                # place that guarantees every dead worker's in-flight
+                # requests requeue, whatever path killed it.
+                if w.in_flight:
+                    self._requeue_worker(w)
+                continue
+            try:
+                reply, _ = w.rpc({"op": "poll"})
+            except WorkerDied as e:
+                self._feed_pool("decode", False, str(e))
+                self._requeue_worker(w)
+                continue
+            self._feed_pool("decode", True)
+            w.stats = reply.get("stats", {})
+            w.dispatched_since_poll = 0
+            for rec in reply.get("responses", []):
+                pend = w.in_flight.pop(rec["rid"], None)
+                if pend is None:
+                    continue                # a requeued duplicate
+                completed.append(self._finalize(pend, rec, w))
+        self._completed_total += len(completed)
+        return completed
+
+    def _requeue_worker(self, w: _Worker) -> None:
+        """A decode worker died: everything in flight on it goes BACK
+        to the front of its class queue (re-prefill + re-dispatch —
+        requests are never lost, the kill-a-worker soak pins it)."""
+        for rid, pend in sorted(w.in_flight.items(), reverse=True):
+            pend.requeues += 1
+            self._requeued_total += 1
+            _telemetry.counter("cluster.requeued").inc()
+            self._queues.setdefault(pend.slo_class,
+                                    deque()).appendleft(pend)
+        w.in_flight.clear()
+
+    def _finalize(self, pend: _Pending, rec: dict,
+                  w: _Worker) -> ClusterResponse:
+        now = time.perf_counter()
+        tokens = np.asarray(rec.get("tokens", []), np.int32)
+        e2e_ms = (now - pend.submitted_t) * 1e3
+        ttft_ms = ((pend.first_token_t or now)
+                   - pend.submitted_t) * 1e3
+        tpot = _tpot_ms(pend.first_token_t or now, now, tokens.size)
+        met = _judge_slo(self._slo_targets.get(pend.slo_class),
+                         ttft_ms, tpot)
+        reg = _telemetry.registry()
+        if reg is not None and reg.detectors is not None:
+            reg.detectors.feed_slo(pend.slo_class, met)
+        tags = {"slo_class": pend.slo_class}
+        _telemetry.sketch("cluster.ttft_ms", tags).observe(ttft_ms)
+        _telemetry.sketch("cluster.e2e_ms", tags).observe(e2e_ms)
+        _telemetry.counter(
+            "cluster.goodput.met" if met else "cluster.goodput.missed",
+            tags).inc()
+        return ClusterResponse(
+            request_id=pend.rid,
+            prompt=pend.prompt,
+            tokens=tokens,
+            finish_reason=rec.get("finish_reason", "?"),
+            slo_class=pend.slo_class,
+            queue_wait_ms=((pend.dispatch_t or now)
+                           - pend.submitted_t) * 1e3,
+            ttft_ms=ttft_ms,
+            tpot_ms=tpot or 0.0,
+            e2e_ms=e2e_ms,
+            prefill_ms=pend.prefill_ms,
+            decode_steps=int(rec.get("decode_steps", 0)),
+            preemptions=int(rec.get("preemptions", 0)),
+            requeues=pend.requeues,
+            handoff_bytes=pend.handoff_bytes,
+            pool=w.addr,
+            slo_met=met,
+        )
+
+    # -- operator surface ---------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "queued_by_class": {cls: len(q)
+                                for cls, q in self._queues.items()},
+            "queued": sum(len(q) for q in self._queues.values()),
+            "inflight": sum(len(w.in_flight) for w in self._decode),
+            "completed": self._completed_total,
+            "requeued": self._requeued_total,
+            "pools": {
+                "prefill": [{"addr": w.addr, "alive": w.alive}
+                            for w in self._prefill],
+                "decode": [{"addr": w.addr, "alive": w.alive,
+                            "stats": w.stats} for w in self._decode],
+            },
+            "wire_dtype": self.wire_dtype,
+        }
+
+    def scrape_stats(self) -> None:
+        """Refresh every live worker's stats snapshot out-of-band (the
+        poll path refreshes decode workers for free; this also covers
+        prefill workers and a router that is idle)."""
+        for w in self._prefill + self._decode:
+            if not w.alive:
+                continue
+            try:
+                reply, _ = w.rpc({"op": "stats"})
+                w.stats = reply.get("stats", {})
+                self._feed_pool(w.pool, True)
+            except (WorkerDied, RuntimeError) as e:
+                self._feed_pool(w.pool, False, str(e))
+
+    def autoscale_signal(self,
+                         fleet_summary: Optional[dict] = None) -> dict:
+        """Per-pool scaling hints from the live admission signals,
+        optionally sharpened by a *windowed* fleet aggregate
+        (``tools/aggregate_telemetry.py --json --window N`` — recent
+        percentiles, not lifetime totals).  ``+1`` = grow the pool,
+        ``-1`` = it can shrink, ``0`` = hold.  Emitted as
+        ``cluster.scale_hint{pool=}`` gauges; the mapping is
+        deliberately simple — the VALUE is that the inputs are real
+        (exact merged percentiles + live headroom), not that the
+        policy is clever."""
+        out: dict = {}
+        queued = sum(len(q) for q in self._queues.values())
+        alive_d = [w for w in self._decode if w.alive]
+        alive_p = [w for w in self._prefill if w.alive]
+        # decode pool: headroom exhaustion or router backpressure says
+        # grow; broad idle headroom says shrink
+        headroom = sum(w.stats.get("free_block_headroom", 0)
+                       for w in alive_d)
+        occ = [w.stats.get("active", 0) / w.stats["max_slots"]
+               for w in alive_d if w.stats.get("max_slots")]
+        mean_occ = sum(occ) / len(occ) if occ else 0.0
+        d_hint = 0
+        if not alive_d or headroom == 0 or queued > 2 * max(
+                len(alive_d), 1):
+            d_hint = 1
+        elif mean_occ < 0.2 and queued == 0 and len(alive_d) > 1:
+            d_hint = -1
+        p_hint = 0
+        if not alive_p:
+            p_hint = 1
+        # the windowed fleet evidence: a class whose RECENT p95 TTFT
+        # violates its deadline wants more prefill (TTFT is prefill +
+        # queue); a violated TPOT wants more decode
+        violations: List[str] = []
+        for cls, target in self._slo_targets.items():
+            row = (fleet_summary or {}).get("sketches", {}).get(
+                f"serving.ttft_ms{{slo_class={cls}}}")
+            if (row and target.ttft_ms is not None
+                    and row.get("p95", 0) > target.ttft_ms):
+                p_hint = 1
+                violations.append(f"{cls}:ttft")
+            row = (fleet_summary or {}).get("sketches", {}).get(
+                f"serving.tpot_ms{{slo_class={cls}}}")
+            if (row and target.tpot_ms is not None
+                    and row.get("p95", 0) > target.tpot_ms):
+                d_hint = 1
+                violations.append(f"{cls}:tpot")
+        out["decode"] = {"workers": len(alive_d), "hint": d_hint,
+                         "free_block_headroom": headroom,
+                         "mean_occupancy": round(mean_occ, 4),
+                         "router_queue": queued}
+        out["prefill"] = {"workers": len(alive_p), "hint": p_hint}
+        if violations:
+            out["slo_violations"] = violations
+        _telemetry.gauge("cluster.scale_hint", {"pool": "decode"}).set(
+            d_hint)
+        _telemetry.gauge("cluster.scale_hint", {"pool": "prefill"}).set(
+            p_hint)
+        return out
+
+    @staticmethod
+    def load_fleet_summary(path: str) -> dict:
+        """Read an ``aggregate_telemetry --json`` artifact (the
+        autoscaling substrate)."""
+        with open(path) as f:
+            return json.load(f)
+
+    def close(self, shutdown_workers: bool = False) -> None:
+        for w in self._prefill + self._decode:
+            if shutdown_workers and w.alive:
+                try:
+                    w.rpc({"op": "shutdown"})
+                except (WorkerDied, RuntimeError):
+                    pass
+            w.kill()

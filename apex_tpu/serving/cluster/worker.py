@@ -1,0 +1,531 @@
+"""Prefill and decode workers of the disaggregated serving tier.
+
+One :class:`WorkerServer` is one pool member: a single-threaded
+select() loop that multiplexes the socket protocol
+(:mod:`~apex_tpu.serving.cluster.protocol`) with engine stepping, so
+RPC handling and decode progress interleave without any locking — the
+engine is only ever touched from this loop.
+
+Two roles (``role=``):
+
+- ``"prefill"`` — the compute-bound half.  Holds the model parameters
+  and the bucketed prefill compile cache; a ``prefill`` RPC runs ONE
+  batched flash prefill into a scratch cache (paged by default — the
+  KV handoff is extracted through the block table exactly as a
+  resident paged engine would hand its pages over; ``"contiguous"``
+  scratch is the kept fallback), samples the first token with the same
+  mixed greedy/temperature sampler the resident engine uses, and
+  returns ``first_token`` + the serialized KV
+  (:mod:`~apex_tpu.serving.cluster.handoff`).  Shapes are
+  bucket-identical to a single-engine admission, so a raw-wire handoff
+  is bit-exact against never disaggregating.
+- ``"decode"`` — the bandwidth-bound half.  Wraps a full
+  :class:`~apex_tpu.serving.ServingEngine`; a ``decode`` RPC injects
+  the handoff (``submit_prefilled``) and the serve loop steps the
+  engine between RPCs.  ``poll`` drains completed responses and
+  piggybacks ``engine.stats()`` — the router's live
+  ``serving.{blocks_free,queue_depth}`` admission signal rides on the
+  same frame, no extra round trip.
+
+RPC surface (JSON headers; KV rides as raw blobs):
+
+====================  ====================================================
+``hello``             role/model handshake
+``stats``             engine/executor stats snapshot
+``prefill``           ``{prompt, temperature, wire_dtype?}`` → first
+                      token + KV handoff blobs
+``decode``            handoff + generation params → accepted ack
+``poll``              completed responses + stats
+``shutdown``          clean stop (the loop exits after replying)
+====================  ====================================================
+
+``python -m apex_tpu.serving.cluster.worker --role prefill ...`` runs a
+worker as its own OS process (the two-process demo / ``bench.py
+--serve-trace`` topology); :func:`spawn_worker` wraps that for drivers.
+Both sides build the model from ``(--seed, geometry flags)``, so every
+process materializes identical parameters without shipping weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import select
+import socket
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from apex_tpu.serving.cluster import protocol
+from apex_tpu.serving.cluster.handoff import (
+    WIRE_DTYPES, decode_kv, encode_kv, wire_bytes)
+
+__all__ = ["WorkerServer", "spawn_worker", "READY_PREFIX"]
+
+READY_PREFIX = "APEX_TPU_CLUSTER_WORKER ready"
+
+
+@dataclasses.dataclass
+class _PrefillExec:
+    """The prefill worker's executor state: params + the bucket ladder
+    + a scratch-cache prefill per request (no resident lanes — prefill
+    is stateless between requests, which is what makes the pool
+    horizontally scalable)."""
+
+    params: dict
+    cfg: object
+    buckets: tuple
+    cache_dtype: object
+    scratch_layout: str
+    block_size: int
+    sample_fn: object
+    key: object
+    calls: int = 0
+
+
+class WorkerServer:
+    """One cluster worker: socket loop + (decode) engine pump."""
+
+    def __init__(self, role: str, params, cfg, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_slots: int = 4, max_len: Optional[int] = None,
+                 cache_layout: str = "contiguous", block_size: int = 16,
+                 cache_dtype=None, top_k=None, top_p=None,
+                 vocab_limit=None, slo_targets=None,
+                 scratch_layout: str = "paged",
+                 wire_dtype: str = "raw", seed: int = 0):
+        if role not in ("prefill", "decode"):
+            raise ValueError(f"role={role!r}: expected 'prefill' or "
+                             "'decode'")
+        if wire_dtype not in WIRE_DTYPES:
+            raise ValueError(f"wire_dtype={wire_dtype!r}: expected one "
+                             f"of {WIRE_DTYPES}")
+        if scratch_layout not in ("contiguous", "paged"):
+            raise ValueError(
+                f"scratch_layout={scratch_layout!r}: expected "
+                "'contiguous' or 'paged'")
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.serving.batching import default_buckets
+        from apex_tpu.serving.engine import ServingEngine, _make_sample_fn
+
+        self.role = role
+        self.cfg = cfg
+        self.wire_dtype = wire_dtype
+        self._max_len = int(max_len or cfg.max_position_embeddings)
+        self._stop = False
+        self.engine: Optional[ServingEngine] = None
+        self._exec: Optional[_PrefillExec] = None
+        # engine request id -> (router rid, submit wall time)
+        self._ridmap: Dict[int, tuple] = {}
+        self._outbox: List[dict] = []
+        if role == "decode":
+            self.engine = ServingEngine(
+                params, cfg, max_slots=max_slots, max_len=self._max_len,
+                cache_layout=cache_layout, block_size=block_size,
+                cache_dtype=cache_dtype, top_k=top_k, top_p=top_p,
+                vocab_limit=vocab_limit, slo_targets=slo_targets,
+                rng=jax.random.PRNGKey(seed))
+        else:
+            dt = cfg.compute_dtype if cache_dtype is None else cache_dtype
+            self._exec = _PrefillExec(
+                params=params, cfg=cfg,
+                buckets=tuple(sorted(default_buckets(self._max_len))),
+                cache_dtype=jnp.dtype(dt),
+                scratch_layout=scratch_layout, block_size=block_size,
+                sample_fn=_make_sample_fn(top_k, top_p, vocab_limit),
+                key=jax.random.PRNGKey(seed))
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(8)
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._clients: List[socket.socket] = []
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- serve loop ---------------------------------------------------------
+
+    def serve_forever(self, poll_s: float = 0.02) -> None:
+        """Run until a ``shutdown`` RPC or :meth:`stop`.  One loop
+        iteration: service every readable socket, then (decode role)
+        advance the engine one step and bank completions — so a long
+        decode backlog never starves the control plane for more than
+        one step."""
+        try:
+            while not self._stop:
+                busy = (self.engine is not None
+                        and not self.engine.idle)
+                r, _w, _x = select.select(
+                    [self._listener] + self._clients, [], [],
+                    0.0 if busy else poll_s)
+                for sock in r:
+                    if sock is self._listener:
+                        conn, _ = self._listener.accept()
+                        conn.settimeout(30.0)
+                        self._clients.append(conn)
+                        continue
+                    self._service(sock)
+                if busy:
+                    self._pump()
+        finally:
+            self.close()
+
+    def stop(self) -> None:
+        self._stop = True
+
+    def close(self) -> None:
+        for sock in self._clients:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._clients = []
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def _pump(self) -> None:
+        """One engine step; completed responses land in the outbox
+        (drained by the next ``poll``)."""
+        for resp in self.engine.step():
+            rid, _t = self._ridmap.pop(resp.request_id,
+                                       (resp.request_id, 0.0))
+            self._outbox.append(self._serialize(rid, resp))
+
+    def _service(self, sock: socket.socket) -> None:
+        try:
+            msg = protocol.recv_msg(sock)
+        except (protocol.ProtocolError, OSError):
+            # malformed frame, recv timeout (a peer stalled mid-send),
+            # or any other socket failure: drop THAT client — one
+            # misbehaving connection must never take the pool member
+            # (and every session on it) down
+            msg = None
+        if msg is None:                       # peer gone
+            try:
+                sock.close()
+            finally:
+                if sock in self._clients:
+                    self._clients.remove(sock)
+            return
+        header, blobs = msg
+        try:
+            reply, rblobs = self.handle(header, blobs)
+        except Exception as e:                # noqa: BLE001 — one bad
+            # RPC must not kill the pool member
+            reply, rblobs = {"ok": False,
+                             "error": f"{type(e).__name__}: {e}"}, []
+        try:
+            protocol.send_msg(sock, reply, rblobs)
+        except OSError:
+            if sock in self._clients:
+                self._clients.remove(sock)
+
+    # -- RPC handlers -------------------------------------------------------
+
+    def handle(self, header: dict, blobs: List[bytes]):
+        """Dispatch one RPC → ``(reply_header, reply_blobs)`` (public
+        so in-process tests can drive a worker without sockets)."""
+        op = header.get("op")
+        if op == "hello":
+            return {"ok": True, "role": self.role,
+                    "max_len": self._max_len,
+                    "wire_dtype": self.wire_dtype}, []
+        if op == "stats":
+            return {"ok": True, "role": self.role,
+                    "stats": self._stats()}, []
+        if op == "prefill":
+            return self._handle_prefill(header)
+        if op == "decode":
+            return self._handle_decode(header, blobs)
+        if op == "poll":
+            if self.engine is None:
+                return {"ok": False,
+                        "error": "poll on a prefill worker"}, []
+            # drain whatever is ready without blocking the caller on
+            # decode progress (the serve loop pumps between polls)
+            if not self.engine.idle:
+                self._pump()
+            out, self._outbox = self._outbox, []
+            return {"ok": True, "responses": out,
+                    "stats": self._stats()}, []
+        if op == "shutdown":
+            self._stop = True
+            return {"ok": True}, []
+        return {"ok": False, "error": f"unknown op {op!r}"}, []
+
+    def _stats(self) -> dict:
+        if self.engine is not None:
+            st = dict(self.engine.stats())
+            st["buckets"] = list(st["buckets"])
+            st["pending_responses"] = len(self._outbox)
+            return st
+        return {"role": "prefill",
+                "buckets": list(self._exec.buckets),
+                "prefill_calls": self._exec.calls,
+                "scratch_layout": self._exec.scratch_layout,
+                "queued": 0, "queued_by_class": {},
+                "free_block_headroom": 1}
+
+    def _handle_prefill(self, header: dict):
+        if self._exec is None:
+            return {"ok": False,
+                    "error": "prefill on a decode worker"}, []
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.models.generate import (
+            extract_kv, init_kv_cache, prefill)
+        from apex_tpu.serving.batching import pad_prompt, pick_bucket
+
+        ex = self._exec
+        prompt = np.asarray(header["prompt"], np.int32).reshape(-1)
+        if prompt.size < 1:
+            return {"ok": False, "error": "empty prompt"}, []
+        temperature = float(header.get("temperature", 0.0))
+        wire_dtype = header.get("wire_dtype", self.wire_dtype)
+        n = int(prompt.size)
+        t0 = time.perf_counter()
+        bucket = pick_bucket(n, ex.buckets)
+        padded = jnp.asarray(pad_prompt(prompt, bucket)[None])
+        lens = jnp.asarray([n], jnp.int32)
+        if ex.scratch_layout == "paged":
+            scratch = init_kv_cache(
+                ex.cfg, 1, bucket, cache_dtype=ex.cache_dtype,
+                cache_layout="paged", block_size=ex.block_size)
+            logits, cache = prefill(ex.params, padded, ex.cfg,
+                                    prompt_lens=lens, cache=scratch)
+        else:
+            logits, cache = prefill(ex.params, padded, ex.cfg,
+                                    prompt_lens=lens, max_len=bucket,
+                                    cache_dtype=ex.cache_dtype)
+        ex.key, sub = jax.random.split(ex.key)
+        first = ex.sample_fn(
+            logits, jnp.asarray([temperature], jnp.float32), sub)
+        tok = int(np.asarray(first)[0])
+        k, v = extract_kv(cache, n, row=0)
+        kv_header, kv_blobs = encode_kv(np.asarray(k), np.asarray(v),
+                                        wire_dtype=wire_dtype)
+        ms = (time.perf_counter() - t0) * 1e3
+        ex.calls += 1
+        return {"ok": True, "first_token": tok, "n": n,
+                "prefill_ms": round(ms, 3),
+                "handoff_bytes": wire_bytes(kv_blobs),
+                "kv": kv_header}, kv_blobs
+
+    def _handle_decode(self, header: dict, blobs: List[bytes]):
+        if self.engine is None:
+            return {"ok": False,
+                    "error": "decode on a prefill worker"}, []
+        k, v = decode_kv(header["kv"], blobs)
+        prompt = np.asarray(header["prompt"], np.int32).reshape(-1)
+        rid = header.get("rid")
+        eng_rid = self.engine.submit_prefilled(
+            prompt, k, v, int(header["first_token"]),
+            max_new_tokens=int(header.get("max_new_tokens", 32)),
+            temperature=float(header.get("temperature", 0.0)),
+            eos_token_id=header.get("eos_token_id"),
+            slo_class=str(header.get("slo_class", "default")),
+            prefill_ms=float(header.get("prefill_ms", 0.0)))
+        self._ridmap[eng_rid] = (rid if rid is not None else eng_rid,
+                                 time.time())
+        return {"ok": True, "accepted": True, "engine_rid": eng_rid}, []
+
+    @staticmethod
+    def _serialize(rid, resp) -> dict:
+        return {
+            "rid": rid,
+            "tokens": [int(t) for t in resp.tokens],
+            "finish_reason": resp.finish_reason,
+            "prefill_ms": resp.prefill_ms,
+            "decode_steps": resp.decode_steps,
+            "slo_class": resp.slo_class,
+            "queue_wait_ms": resp.queue_wait_ms,
+            "ttft_ms": resp.ttft_ms,
+            "tpot_ms": resp.tpot_ms,
+            "e2e_ms": resp.e2e_ms,
+            "preemptions": resp.preemptions,
+            "preempt_overhead_ms": resp.preempt_overhead_ms,
+            "slo_met": resp.slo_met,
+        }
+
+
+# -- process entry point -----------------------------------------------------
+
+
+def _build_model(args):
+    """Deterministic model construction from CLI geometry + seed: every
+    pool member (and the single-engine baseline) materializes IDENTICAL
+    parameters from the same few integers — the two-process demo never
+    ships weights over the wire."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.config import TransformerConfig
+    from apex_tpu.models.transformer_lm import init_gpt_params
+
+    cfg = TransformerConfig(
+        num_layers=args.layers, hidden_size=args.hidden,
+        num_attention_heads=args.heads, vocab_size=args.vocab,
+        max_position_embeddings=args.max_pos,
+        compute_dtype=jnp.dtype(args.compute_dtype), remat=False)
+    params = init_gpt_params(jax.random.PRNGKey(args.seed), cfg)
+    return params, cfg
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    # standalone process on a jax<0.9 container: same shim as bench.py
+    import jax
+
+    if not hasattr(jax, "typeof"):
+        jax.typeof = lambda x: jax.core.get_aval(x)
+    import jax.numpy as jnp
+
+    ap = argparse.ArgumentParser(
+        description="Run one cluster serving worker (prefill or "
+                    "decode pool member).")
+    ap.add_argument("--role", required=True,
+                    choices=("prefill", "decode"))
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 = ephemeral (read the READY line)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--max-pos", type=int, default=128)
+    ap.add_argument("--compute-dtype", default="float32")
+    ap.add_argument("--cache-dtype", default=None)
+    ap.add_argument("--max-slots", type=int, default=4)
+    ap.add_argument("--max-len", type=int, default=None)
+    ap.add_argument("--cache-layout", default="contiguous",
+                    choices=("contiguous", "paged"))
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--scratch-layout", default="paged",
+                    choices=("contiguous", "paged"),
+                    help="prefill scratch-cache layout (paged = the "
+                         "block-table extraction path)")
+    ap.add_argument("--wire-dtype", default="raw",
+                    choices=WIRE_DTYPES)
+    ap.add_argument("--top-k", type=int, default=None)
+    ap.add_argument("--top-p", type=float, default=None)
+    ap.add_argument("--vocab-limit", type=int, default=None)
+    ap.add_argument("--export-port", type=int, default=None,
+                    help="also serve /metrics + /healthz on this "
+                         "localhost port (0 = ephemeral)")
+    args = ap.parse_args(argv)
+
+    metrics_url = ""
+    if args.export_port is not None:
+        from apex_tpu import observability as obs
+
+        reg = obs.configure(export_port=args.export_port,
+                            tags={"pool": args.role})
+        metrics_url = reg.exporter.url
+    params, cfg = _build_model(args)
+    server = WorkerServer(
+        args.role, params, cfg, host=args.host, port=args.port,
+        max_slots=args.max_slots, max_len=args.max_len,
+        cache_layout=args.cache_layout, block_size=args.block_size,
+        cache_dtype=(None if args.cache_dtype is None
+                     else jnp.dtype(args.cache_dtype)),
+        top_k=args.top_k, top_p=args.top_p,
+        vocab_limit=args.vocab_limit,
+        scratch_layout=args.scratch_layout,
+        wire_dtype=args.wire_dtype, seed=args.seed)
+    print(f"{READY_PREFIX} role={args.role} addr={server.addr} "
+          f"metrics={metrics_url}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if args.export_port is not None:
+            from apex_tpu import observability as obs
+
+            obs.shutdown()
+    return 0
+
+
+def spawn_worker(role: str, *, extra_args: Optional[List[str]] = None,
+                 timeout: float = 120.0, env: Optional[dict] = None):
+    """Start ``python -m apex_tpu.serving.cluster.worker`` as a child
+    process and block until its READY line → ``(Popen, addr,
+    metrics_url)``.  The caller owns the process (terminate it; the
+    soak test kills one on purpose)."""
+    import os
+    import subprocess
+    import sys
+
+    cmd = [sys.executable, "-m", "apex_tpu.serving.cluster.worker",
+           "--role", role] + list(extra_args or [])
+    child_env = dict(os.environ)
+    if env:
+        child_env.update(env)
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            env=child_env)
+    deadline = time.time() + timeout
+    addr = metrics = None
+    lines: List[str] = []
+    while time.time() < deadline:
+        # select before readline: a child wedged in backend init emits
+        # NOTHING, and a bare readline() would block past any deadline
+        r, _w, _x = select.select([proc.stdout], [], [],
+                                  min(1.0, max(deadline - time.time(),
+                                               0.01)))
+        if not r:
+            if proc.poll() is not None:
+                break
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                break
+            continue
+        lines.append(line.rstrip())
+        if line.startswith(READY_PREFIX):
+            for part in line.split():
+                if part.startswith("addr="):
+                    addr = part[5:]
+                elif part.startswith("metrics="):
+                    metrics = part[8:] or None
+            break
+    if addr is None:
+        proc.kill()
+        tail = "\n".join(lines[-20:])
+        raise RuntimeError(
+            f"{role} worker failed to become ready in {timeout:.0f}s:"
+            f"\n{tail}")
+
+    # keep draining the child's output: a full pipe buffer would block
+    # the worker mid-decode (CPU donation warnings alone can fill 64 KB
+    # over a long soak).  The tail stays inspectable for post-mortems.
+    import collections
+    import threading
+
+    tail: collections.deque = collections.deque(maxlen=200)
+
+    def _drain():
+        for line in proc.stdout:
+            tail.append(line.rstrip())
+
+    threading.Thread(target=_drain, daemon=True).start()
+    proc.output_tail = tail
+    return proc, addr, metrics
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

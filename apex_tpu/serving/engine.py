@@ -178,6 +178,13 @@ class Request:
     # it keys the cache)
     _hash_cache: Optional[tuple] = dataclasses.field(
         default=None, repr=False)
+    # cluster KV handoff (ISSUE 9): ``(k, v, first_token, prefill_ms)``
+    # from a remote prefill worker — admission INJECTS this K/V instead
+    # of running prefill.  Dropped on preemption (the blocks are gone;
+    # resume replays prompt+generated through the local prefill path,
+    # which reproduces the same K/V bit-for-bit for a raw-wire handoff).
+    handoff: Optional[tuple] = dataclasses.field(
+        default=None, repr=False)
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -398,27 +405,7 @@ class ServingEngine:
                 f"({req.max_new_tokens}) exceeds the engine max_len "
                 f"({self.max_len}); raise max_len or shorten the request")
         pick_bucket(req.prompt.size, self._submit_buckets)  # validate early
-        if self._mgr is not None:
-            # spec adds a write horizon: a verify block touches up to
-            # spec.k cells past the materialized length before its
-            # rejected tail rolls back, so the solo worst case must
-            # cover those blocks too (clamped to the table reach)
-            horizon = min(
-                req.prompt.size + req.max_new_tokens
-                + (self._spec_ahead - 1),
-                blocks_for(self.max_len, self.block_size)
-                * self.block_size)
-            worst = (blocks_for(horizon, self.block_size)
-                     + self.reserve_blocks)
-            if worst > self.num_blocks:
-                raise ValueError(
-                    f"request needs up to {worst} blocks (prompt "
-                    f"{req.prompt.size} + max_new_tokens "
-                    f"{req.max_new_tokens} at block_size "
-                    f"{self.block_size}, + {self.reserve_blocks} "
-                    f"reserve) but the pool holds {self.num_blocks}; "
-                    "it could never run to completion even alone — "
-                    "raise num_blocks or shorten the request")
+        self._check_pool_budget(req)
         self._next_id += 1
         req.submitted_t = time.perf_counter()
         self._queue.append(req)
@@ -431,6 +418,90 @@ class ServingEngine:
                          slo_class=req.slo_class)
         self._set_gauges()
         return req.request_id
+
+    def submit_prefilled(self, prompt, k, v, first_token: int, *,
+                         max_new_tokens: int = 32,
+                         temperature: float = 0.0,
+                         eos_token_id: Optional[int] = None,
+                         slo_class: str = "default",
+                         prefill_ms: float = 0.0) -> int:
+        """Queue a request whose prefill already happened ELSEWHERE —
+        the decode half of prefill/decode disaggregation (ISSUE 9).
+
+        ``k``/``v`` are the prompt's per-token K/V ``[L, len(prompt),
+        kv_groups, dh]`` (a decoded cluster handoff —
+        ``serving/cluster/handoff.py``) and ``first_token`` the token
+        the prefill worker sampled from its prefill logits.  Admission
+        injects the K/V into this engine's cache (paged: freshly
+        allocated blocks, written through the same whole-page scatter
+        prefill uses; contiguous: the slot stripe) and the lane decodes
+        on — for a raw-wire handoff between same-dtype caches, greedy
+        continuation is token-identical to having prefilled here
+        (tests/test_serving_handoff.py pins it).  ``prefill_ms`` is
+        the remote measurement, carried onto the Response so per-request
+        accounting stays meaningful.
+
+        Injected blocks are never prefix-shared or published: their
+        content is wire-derived (possibly quantized), so the chained
+        content digests of locally computed pages must not alias them.
+        If the request is later preempted the handoff is dropped and
+        resume replays through the local prefill path."""
+        req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
+                      temperature=temperature, eos_token_id=eos_token_id,
+                      request_id=self._next_id, slo_class=str(slo_class))
+        if req.prompt.size + req.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({req.prompt.size}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds the engine max_len "
+                f"({self.max_len}); raise max_len or shorten the request")
+        pick_bucket(req.prompt.size, self._submit_buckets)
+        self._check_pool_budget(req)
+        k = np.asarray(k)
+        v = np.asarray(v)
+        want = (self.cfg.num_layers, req.prompt.size,
+                self.cfg.kv_groups, self.cfg.kv_channels)
+        if k.shape != want or v.shape != want:
+            raise ValueError(
+                f"handoff K/V shape {k.shape}/{v.shape} does not match "
+                f"this engine's cache geometry {want} — refusing to "
+                "reinterpret a foreign handoff")
+        req.handoff = (k, v, int(first_token), float(prefill_ms))
+        self._next_id += 1
+        req.submitted_t = time.perf_counter()
+        self._queue.append(req)
+        _telemetry.counter("serving.requests").inc()
+        _telemetry.event("serving.request.begin", id=req.request_id,
+                         prompt_tokens=int(req.prompt.size),
+                         max_new_tokens=req.max_new_tokens,
+                         slo_class=req.slo_class, injected=True)
+        self._set_gauges()
+        return req.request_id
+
+    def _check_pool_budget(self, req: Request) -> None:
+        """Reject a request that could never complete even alone
+        (paged layout: its worst-case block need exceeds the pool)."""
+        if self._mgr is None:
+            return
+        # spec adds a write horizon: a verify block touches up to
+        # spec.k cells past the materialized length before its
+        # rejected tail rolls back, so the solo worst case must
+        # cover those blocks too (clamped to the table reach)
+        horizon = min(
+            req.prompt.size + req.max_new_tokens
+            + (self._spec_ahead - 1),
+            blocks_for(self.max_len, self.block_size)
+            * self.block_size)
+        worst = (blocks_for(horizon, self.block_size)
+                 + self.reserve_blocks)
+        if worst > self.num_blocks:
+            raise ValueError(
+                f"request needs up to {worst} blocks (prompt "
+                f"{req.prompt.size} + max_new_tokens "
+                f"{req.max_new_tokens} at block_size "
+                f"{self.block_size}, + {self.reserve_blocks} "
+                f"reserve) but the pool holds {self.num_blocks}; "
+                "it could never run to completion even alone — "
+                "raise num_blocks or shorten the request")
 
     @property
     def idle(self) -> bool:
@@ -470,8 +541,19 @@ class ServingEngine:
         return sorted(out, key=lambda r: r.request_id)
 
     def stats(self) -> dict:
+        """Engine state snapshot.  Beyond the flat keys (kept stable
+        for existing consumers), ``queued_by_class`` and
+        ``free_block_headroom`` are the per-SLO-class admission signals
+        a cluster router reads (ISSUE 9): how much of each class is
+        waiting here, and how many blocks the engine could commit to a
+        NEW request without eating its decode reserve (contiguous
+        layout: free lanes, each worth one request)."""
+        by_class: dict = {}
+        for req in self._queue:
+            by_class[req.slo_class] = by_class.get(req.slo_class, 0) + 1
         out = {
             "queued": len(self._queue),
+            "queued_by_class": by_class,
             "active": self._pool.n_active,
             "free_slots": self._pool.n_free,
             "max_slots": self.max_slots,
@@ -489,7 +571,11 @@ class ServingEngine:
                 "blocks_in_use": self._mgr.n_in_use,
                 "prefix_shared_blocks": self._mgr.n_shared,
                 "preemptions": self._preempt_count,
+                "free_block_headroom": max(
+                    0, self._mgr.n_free - self.reserve_blocks),
             })
+        else:
+            out["free_block_headroom"] = self._pool.n_free
         return out
 
     # -- internals ---------------------------------------------------------
@@ -534,7 +620,10 @@ class ServingEngine:
     def _blocks_needed(self, req: Request) -> int:
         """NEW blocks the request must allocate at admission (prefix
         hits against the published block table are free — they map, not
-        allocate)."""
+        allocate).  A KV-handoff request allocates everything fresh:
+        its pages are wire-derived, never shared."""
+        if req.handoff is not None:
+            return blocks_for(req.prompt.size, self.block_size)
         tokens, hashes = self._admission_state(req)
         need = blocks_for(tokens.size, self.block_size)
         for h in hashes:
@@ -629,13 +718,74 @@ class ServingEngine:
             raise
         return blocks, write_ids, shared
 
+    def _claim_blocks_fresh(self, n_tokens: int):
+        """Allocate ``blocks_for(n_tokens)`` fresh blocks (no prefix
+        mapping, no publishing) — the KV-handoff admission form: every
+        page is written from the wire.  Same unwind contract as
+        :meth:`_claim_blocks`."""
+        blocks: List[int] = []
+        try:
+            for _ in range(blocks_for(n_tokens, self.block_size)):
+                blk = self._mgr.alloc()
+                if blk is None:
+                    raise RuntimeError("block pool exhausted mid-admit")
+                blocks.append(blk)
+        except Exception:
+            self._mgr.free_all(blocks)
+            raise
+        return blocks, list(blocks), 0
+
+    def _insert_prefill_kv(self, slot: int, bucket: int,
+                           write_ids: List[int], ks, vs, n: int) -> None:
+        """THE one insert edge for a freshly admitted request's K/V
+        ``[L, 1, bucket, g, dh]`` — used by both the prefill path and
+        the handoff-injection path, so the two can never drift apart
+        (the cross-process token-identity pin depends on injection
+        writing exactly what prefill would have)."""
+        if self._mgr is not None:
+            wid = np.full((blocks_for(bucket, self.block_size),),
+                          self.num_blocks, np.int32)
+            wid[: len(write_ids)] = write_ids
+            k, v = paged_insert_prefill(
+                self.cache["k"], self.cache["v"], ks, vs,
+                jnp.asarray(wid), jnp.int32(n),
+                block_size=self.block_size)
+            self.cache = {
+                "k": k, "v": v,
+                "pos": self.cache["pos"].at[slot].set(n),
+            }
+        else:
+            self.cache = _insert_slot(self.cache, ks, vs,
+                                      jnp.int32(slot), jnp.int32(n))
+
+    def _inject_handoff(self, req: Request, slot: int, bucket: int,
+                        write_ids: List[int], n: int) -> int:
+        """Write a decoded KV handoff into this lane's cache through
+        the SAME jitted inserts prefill uses (bucket-shaped, so the
+        compile cache is shared with the prefill path) and return the
+        remotely sampled first token."""
+        k, v, tok, _ms = req.handoff
+        shape = (self.cfg.num_layers, 1, bucket,
+                 self.cfg.kv_groups, self.cfg.kv_channels)
+        k_pad = np.zeros(shape, dtype=self._cache_dtype)
+        v_pad = np.zeros(shape, dtype=self._cache_dtype)
+        k_pad[:, 0, :n] = np.asarray(k, dtype=self._cache_dtype)
+        v_pad[:, 0, :n] = np.asarray(v, dtype=self._cache_dtype)
+        self._insert_prefill_kv(slot, bucket, write_ids,
+                                jnp.asarray(k_pad), jnp.asarray(v_pad),
+                                n)
+        return int(tok)
+
     def _admit_one(self, req: Request, slot: int) -> List[Response]:
         """Prefill one claimed request into its lane (split out so
         :meth:`_admit` can unwind slot + queue state on failure; block
-        allocations unwind HERE, closest to where they happen)."""
+        allocations unwind HERE, closest to where they happen).  A
+        request carrying a KV handoff (``submit_prefilled``) skips the
+        prefill forward entirely: its cache pages come off the wire,
+        its first token from the remote sampler."""
         completed: List[Response] = []
         hashes: List[bytes] = []
-        if self._mgr is not None:
+        if self._mgr is not None and req.handoff is None:
             tokens, hashes = self._admission_state(req)
         else:
             tokens = self._full_tokens(req)
@@ -645,7 +795,11 @@ class ServingEngine:
         write_ids: List[int] = []
         shared = 0
         if self._mgr is not None:
-            blocks, write_ids, shared = self._claim_blocks(tokens, hashes)
+            if req.handoff is not None:
+                blocks, write_ids, shared = self._claim_blocks_fresh(n)
+            else:
+                blocks, write_ids, shared = self._claim_blocks(
+                    tokens, hashes)
         t0 = time.perf_counter()
         if req.admitted_t == 0.0:
             # first admission only: queue wait ends the moment the
@@ -656,34 +810,30 @@ class ServingEngine:
             req.admitted_t = t0
             req.queue_wait_s = t0 - req.submitted_t
         try:
-            with span("serving.prefill"), \
-                    compile_label("serving.prefill"):
-                padded = jnp.asarray(pad_prompt(tokens, bucket)[None])
-                lens = jnp.asarray([n], jnp.int32)
-                logits, small = prefill(
-                    self.params, padded, self.cfg, prompt_lens=lens,
-                    max_len=bucket, cache_dtype=self._cache_dtype)
-                if self._mgr is not None:
-                    wid = np.full((blocks_for(bucket, self.block_size),),
-                                  self.num_blocks, np.int32)
-                    wid[: len(write_ids)] = write_ids
-                    k, v = paged_insert_prefill(
-                        self.cache["k"], self.cache["v"],
-                        small["k"], small["v"], jnp.asarray(wid),
-                        jnp.int32(n), block_size=self.block_size)
-                    self.cache = {
-                        "k": k, "v": v,
-                        "pos": self.cache["pos"].at[slot].set(n),
-                    }
-                else:
-                    self.cache = _insert_slot(
-                        self.cache, small["k"], small["v"],
-                        jnp.int32(slot), jnp.int32(n))
-                self._key, sub = jax.random.split(self._key)
-                first = self._sample_fn(
-                    logits, jnp.asarray([req.temperature], jnp.float32),
-                    sub)
-                tok = int(np.asarray(first)[0])      # host sync
+            if req.handoff is not None:
+                with span("serving.kv_inject"), \
+                        compile_label("serving.prefill"):
+                    # same label: the bucket-shaped insert compile is
+                    # shared with (and indistinguishable from) the
+                    # prefill path's
+                    tok = self._inject_handoff(req, slot, bucket,
+                                               write_ids, n)
+            else:
+                with span("serving.prefill"), \
+                        compile_label("serving.prefill"):
+                    padded = jnp.asarray(pad_prompt(tokens, bucket)[None])
+                    lens = jnp.asarray([n], jnp.int32)
+                    logits, small = prefill(
+                        self.params, padded, self.cfg, prompt_lens=lens,
+                        max_len=bucket, cache_dtype=self._cache_dtype)
+                    self._insert_prefill_kv(slot, bucket, write_ids,
+                                            small["k"], small["v"], n)
+                    self._key, sub = jax.random.split(self._key)
+                    first = self._sample_fn(
+                        logits,
+                        jnp.asarray([req.temperature], jnp.float32),
+                        sub)
+                    tok = int(np.asarray(first)[0])      # host sync
             if self._mgr is not None:
                 self._tables[slot, :] = self.num_blocks
                 self._tables[slot, : len(blocks)] = blocks
@@ -704,8 +854,17 @@ class ServingEngine:
                 # wait + this replay prefill) is now fully realized
                 req.preempt_overhead_s += now - req.preempted_t
                 req.preempted_t = 0.0
-            _telemetry.counter("serving.prefill_calls").inc()
-            _telemetry.histogram("serving.prefill_ms").observe(ms)
+            if req.handoff is not None:
+                # the prefill happened remotely: count the injection,
+                # keep serving.prefill_{calls,ms} honest (no forward
+                # ran here), and carry the REMOTE prefill cost onto
+                # the Response so per-request accounting holds up
+                _telemetry.counter("serving.kv_injected").inc()
+                _telemetry.histogram("serving.kv_inject_ms").observe(ms)
+                ms = req.handoff[3]
+            else:
+                _telemetry.counter("serving.prefill_calls").inc()
+                _telemetry.histogram("serving.prefill_ms").observe(ms)
             _telemetry.counter("serving.tokens_generated").inc()
             if _telemetry.enabled():
                 sample_device_memory()   # admission = cache growth edge
@@ -764,6 +923,10 @@ class ServingEngine:
         self._pool.release(slot)
         req = st.request
         req.resume_tokens = list(st.tokens)
+        # an injected handoff dies with its blocks: resume replays
+        # prompt+generated through the LOCAL prefill path (bit-identical
+        # K/V for a raw-wire handoff, so greedy parity survives)
+        req.handoff = None
         req.preemptions += 1
         req.resume_polls = st.decode_polls
         # the overhead clock: runs from here until the resume prefill

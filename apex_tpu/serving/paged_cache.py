@@ -55,8 +55,9 @@ import numpy as np
 
 from apex_tpu.models.config import TransformerConfig
 
-__all__ = ["BlockManager", "blocks_for", "init_paged_pool",
-           "paged_insert_prefill", "prefix_block_hashes"]
+__all__ = ["BlockManager", "blocks_for", "gather_block_kv",
+           "init_paged_pool", "paged_insert_prefill",
+           "prefix_block_hashes"]
 
 
 def blocks_for(n_tokens: int, block_size: int) -> int:
@@ -225,6 +226,26 @@ class BlockManager:
 
     def refcount(self, blk: int) -> int:
         return self._ref.get(blk, 0)
+
+
+def gather_block_kv(pool_k, pool_v, block_ids):
+    """Dereference an ordered block list into token-major K/V views
+    ``[L, len(block_ids)·block_size, kv_groups, dh]`` — the paged
+    extraction half of the cluster KV handoff (ISSUE 9): a prefill
+    worker pulls exactly the blocks its block table names (contiguous
+    in *token* order, arbitrary in *pool* order) so the wire never
+    carries another request's pages.  The caller trims the tail block's
+    padding with its known token count.  Plain XLA gathers, no jit —
+    handoff extraction is a per-request host edge, not a decode-loop
+    op."""
+    ids = jnp.asarray(block_ids, jnp.int32)
+    if ids.ndim != 1:
+        raise ValueError(
+            f"block_ids must be a 1-D block list, got shape {ids.shape}")
+    L, _, bs, g, dh = pool_k.shape
+    k = jnp.take(pool_k, ids, axis=1).reshape(L, ids.shape[0] * bs, g, dh)
+    v = jnp.take(pool_v, ids, axis=1).reshape(L, ids.shape[0] * bs, g, dh)
+    return k, v
 
 
 @functools.partial(jax.jit, donate_argnames=("pool_k", "pool_v"),

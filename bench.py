@@ -435,6 +435,8 @@ def _slo_fields(resps):
             "ttft_ms_p95": round(_pct_of([r.ttft_ms for r in rs], .95), 3),
             "tpot_ms_p50": round(_pct_of(tpots, .5), 4),
             "tpot_ms_p95": round(_pct_of(tpots, .95), 4),
+            "e2e_ms_p50": round(_pct_of([r.e2e_ms for r in rs], .5), 3),
+            "e2e_ms_p95": round(_pct_of([r.e2e_ms for r in rs], .95), 3),
             "queue_wait_ms_p95": round(
                 _pct_of([r.queue_wait_ms for r in rs], .95), 3),
             "goodput_rate": round(met / len(rs), 4),
@@ -703,6 +705,196 @@ def _print_spec_table(details, out=None):
                 print(f"{layout:<12} {sweep:<12} {'x':<7} "
                       f"{srow['ngram_over_off']:>9} (ngram/off)",
                       file=out)
+
+
+# -- serve-trace: single-engine vs disaggregated topology (ISSUE 9) ---------
+
+# the tiny trace model, expressed as worker CLI flags so the spawned
+# pool members materialize IDENTICAL parameters from the same seed
+_TRACE_MODEL = dict(layers=2, hidden=64, heads=4, vocab=256,
+                    max_pos=128, seed=0)
+_TRACE_ENGINE = dict(max_slots=3, max_len=64, block_size=8)
+
+
+def _trace_cfg():
+    from apex_tpu.models.config import TransformerConfig
+
+    m = _TRACE_MODEL
+    return TransformerConfig(
+        num_layers=m["layers"], hidden_size=m["hidden"],
+        num_attention_heads=m["heads"], vocab_size=m["vocab"],
+        max_position_embeddings=m["max_pos"],
+        compute_dtype=jnp.float32, remat=False)
+
+
+def _bursty_trace(rng, vocab, n_requests=18, calm_gap_s=0.15,
+                  burst_every=6, burst_len=3):
+    """Open-loop arrival trace: a calm exponential stream punctuated by
+    near-simultaneous bursts (every ``burst_every``-th arrival opens a
+    ``burst_len`` back-to-back volley) — the tail-forming load shape a
+    router exists for.  Classes cycle interactive (short, tight
+    deadlines) / standard / batch (long, deadline-free); all greedy so
+    the two topologies must agree token-for-token."""
+    shapes = (("interactive", 8, 6), ("standard", 16, 8),
+              ("batch", 28, 12))
+    trace = []
+    t = 0.0
+    i = 0
+    while len(trace) < n_requests:
+        in_burst = (i % burst_every) == 0
+        volley = burst_len if in_burst else 1
+        for _ in range(volley):
+            if len(trace) >= n_requests:
+                break
+            cls, plen, new = shapes[len(trace) % len(shapes)]
+            trace.append((round(t, 4), dict(
+                prompt=rng.randint(0, vocab, (plen,)).tolist(),
+                max_new_tokens=new, temperature=0.0, slo_class=cls)))
+            t += 0.002                      # burst spacing: ~zero
+        t += float(rng.exponential(calm_gap_s))
+        i += 1
+    return trace
+
+
+def _replay_single(engine, trace, max_wall_s=300.0):
+    """Open-loop replay against one ServingEngine: arrivals submit at
+    their trace offsets regardless of completions (same discipline as
+    Router.run_trace), steps run continuously."""
+    import time as _time
+
+    order = sorted(trace, key=lambda item: item[0])
+    t0 = _time.perf_counter()
+    i = 0
+    resps = []
+    while i < len(order) or not engine.idle:
+        now = _time.perf_counter() - t0
+        while i < len(order) and order[i][0] <= now:
+            engine.submit(**order[i][1])
+            i += 1
+        resps.extend(engine.step())
+        if engine.idle and i < len(order):
+            wait = order[i][0] - (_time.perf_counter() - t0)
+            if wait > 0:
+                _time.sleep(min(wait, 0.002))
+        if _time.perf_counter() - t0 > max_wall_s:
+            break
+    return resps, _time.perf_counter() - t0
+
+
+def bench_serve_trace(cache_layout="paged", wire_dtype="raw",
+                      n_requests=18):
+    """The disaggregation anchor (ISSUE 9 / ROADMAP item 4): ONE bursty
+    open-loop arrival trace replayed against (a) the single-process
+    ServingEngine and (b) the two-process prefill/decode topology —
+    real OS processes, real sockets, the KV cache crossing the wire —
+    on one host, reporting measured per-class TTFT/e2e p50/p95 +
+    goodput for both, the realized handoff bytes, and whether greedy
+    outputs stayed token-identical across the handoff (``wire_dtype=
+    "raw"`` must; the compressed wire forms trade that for bytes).
+
+    CPU-pinned by design (main() forces the platform): this row
+    measures TOPOLOGY cost — routing, framing, wire, injection — under
+    identical numerics, not chip throughput."""
+    import time as _time
+
+    from apex_tpu.models.transformer_lm import init_gpt_params
+    from apex_tpu.serving import ServingEngine
+    from apex_tpu.serving.cluster import Router
+    from apex_tpu.serving.cluster.worker import spawn_worker
+
+    cfg = _trace_cfg()
+    params = init_gpt_params(jax.random.PRNGKey(_TRACE_MODEL["seed"]),
+                             cfg)
+    rng = np.random.RandomState(7)
+    trace = _bursty_trace(rng, cfg.vocab_size, n_requests=n_requests)
+    engine_kw = dict(max_slots=_TRACE_ENGINE["max_slots"],
+                     max_len=_TRACE_ENGINE["max_len"],
+                     cache_layout=cache_layout)
+    if cache_layout == "paged":
+        engine_kw["block_size"] = _TRACE_ENGINE["block_size"]
+
+    row = {"cache_layout": cache_layout, "wire_dtype": wire_dtype,
+           "requests": len(trace),
+           "trace_span_s": round(trace[-1][0], 3)}
+
+    # -- topology A: one process, one engine ---------------------------
+    ServingEngine(params, cfg, **engine_kw).run(
+        [dict(prompt=t[1]["prompt"], max_new_tokens=2)
+         for t in trace[:2]])                       # compile warmup
+    engine = ServingEngine(params, cfg, **engine_kw)
+    single, wall_a = _replay_single(engine, trace)
+    row["single_engine"] = {
+        "wall_s": round(wall_a, 3),
+        "completed": len(single),
+        "gen_tokens_per_sec": round(
+            sum(r.tokens.size for r in single) / wall_a, 1),
+        "slo": _slo_fields(single),
+    }
+
+    # -- topology B: router + prefill process + decode process ---------
+    model_flags = []
+    for flag, key in (("--layers", "layers"), ("--hidden", "hidden"),
+                      ("--heads", "heads"), ("--vocab", "vocab"),
+                      ("--max-pos", "max_pos"), ("--seed", "seed")):
+        model_flags += [flag, str(_TRACE_MODEL[key])]
+    decode_flags = model_flags + [
+        "--max-slots", str(_TRACE_ENGINE["max_slots"]),
+        "--max-len", str(_TRACE_ENGINE["max_len"]),
+        "--cache-layout", cache_layout,
+        "--block-size", str(_TRACE_ENGINE["block_size"])]
+    prefill_flags = model_flags + [
+        "--max-len", str(_TRACE_ENGINE["max_len"]),
+        "--wire-dtype", wire_dtype]
+    procs = []
+    try:
+        pf_proc, pf_addr, _ = spawn_worker("prefill",
+                                           extra_args=prefill_flags)
+        procs.append(pf_proc)
+        dc_proc, dc_addr, _ = spawn_worker("decode",
+                                           extra_args=decode_flags)
+        procs.append(dc_proc)
+        router = Router([pf_addr], [dc_addr], wire_dtype=wire_dtype)
+        # warmup: compile both workers' buckets before the clock runs
+        for t in trace[:2]:
+            router.submit(t[1]["prompt"], max_new_tokens=2)
+        router.run(max_wall_s=180)
+        t0 = _time.perf_counter()
+        disagg = router.run_trace(trace, max_wall_s=300)
+        wall_b = _time.perf_counter() - t0
+        row["disaggregated"] = {
+            "wall_s": round(wall_b, 3),
+            "completed": len(disagg),
+            "gen_tokens_per_sec": round(
+                sum(r.tokens.size for r in disagg) / wall_b, 1),
+            "handoff_bytes_total": sum(r.handoff_bytes
+                                       for r in disagg),
+            "requeued": router.stats()["requeued"],
+            "slo": _slo_fields(disagg),
+        }
+        # the acceptance pin, measured in the bench itself: same trace,
+        # same greedy sampling — the handoff must not change one token.
+        # Compared in SUBMISSION order (request ids sort identically
+        # within each topology but the router's warmup offsets its id
+        # space, so ids themselves are not comparable across them).
+        seq_a = [r.tokens.tolist()
+                 for r in sorted(single, key=lambda r: r.request_id)]
+        seq_b = [r.tokens.tolist()
+                 for r in sorted(disagg, key=lambda r: r.request_id)]
+        row["token_identical"] = seq_a == seq_b
+        if not row["token_identical"]:
+            row["token_mismatch_indices"] = [
+                i for i in range(max(len(seq_a), len(seq_b)))
+                if (seq_a[i: i + 1] or [None])
+                != (seq_b[i: i + 1] or [None])][:8]
+        router.close(shutdown_workers=True)
+    finally:
+        for proc in procs:
+            try:
+                proc.terminate()
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+    return row
 
 
 def bench_resnet50(on_tpu):
@@ -1122,6 +1314,22 @@ def main():
              "the --decode rows; more than one also emits the "
              "matched-HBM cache_layout_ablation row (ISSUE 6)")
     parser.add_argument(
+        "--serve-trace", action="store_true",
+        help="run ONLY the cluster serve-trace rows (ISSUE 9): one "
+             "bursty open-loop arrival trace replayed against the "
+             "single-process engine AND the two-process "
+             "prefill/decode disaggregated topology (real sockets, "
+             "KV handoff over the wire) on this host, with per-class "
+             "TTFT/e2e percentiles + goodput per topology.  "
+             "CPU-pinned: this measures topology cost under "
+             "identical numerics, not chip rates.  --cache-layout "
+             "picks the decode pool layout(s)")
+    parser.add_argument(
+        "--wire-dtype", default="raw", metavar="DTYPES",
+        help="comma list of KV handoff wire formats (raw, bf16, int8) "
+             "for the --serve-trace rows; raw is the token-identity "
+             "form, bf16/int8 trade parity for wire bytes")
+    parser.add_argument(
         "--spec", default=None, metavar="SPECS",
         help="comma list of speculative-decoding modes (off, ngram): "
              "with --decode, run ONLY the spec ablation rows "
@@ -1145,6 +1353,22 @@ def main():
     if bad or not layouts:
         parser.error(f"--cache-layout {args.cache_layout!r}: expected a "
                      "comma list of contiguous, paged")
+    wire_dtypes = tuple(
+        w.strip() for w in args.wire_dtype.split(",") if w.strip())
+    bad = [w for w in wire_dtypes if w not in ("raw", "bf16", "int8")]
+    if bad or not wire_dtypes:
+        parser.error(f"--wire-dtype {args.wire_dtype!r}: expected a "
+                     "comma list of raw, bf16, int8")
+    if args.serve_trace:
+        # the topology demo is CPU-pinned BEFORE backend init: both
+        # topologies (and the spawned worker processes) must share one
+        # platform or neither the latency comparison nor the greedy
+        # token-identity pin means anything — and a second process
+        # cannot attach to an already-claimed TPU anyway
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
     # APEX_TPU_TELEMETRY=<path> streams every row's StepTimer span into
     # the shared JSONL schema alongside the headline JSON line
     # (APEX_TPU_TELEMETRY_TRACE=<path> adds the Perfetto timeline).
@@ -1184,6 +1408,29 @@ def main():
             "value": rows.get("off", {}).get("tokens_per_sec", 0.0),
             "unit": "tokens/s",
             "details": rows,
+            "runtime": runtime_summary(),
+        }))
+        return
+    if args.serve_trace:
+        details = {}
+        for layout in layouts:
+            for wire in wire_dtypes:
+                sfx = f"_{layout}_{wire}"
+                try:
+                    details["serve_trace" + sfx] = bench_serve_trace(
+                        cache_layout=layout, wire_dtype=wire)
+                except Exception as e:
+                    details["serve_trace" + sfx] = {
+                        "error": f"{type(e).__name__}: {e}"[:200]}
+        head = details.get(
+            f"serve_trace_{layouts[0]}_{wire_dtypes[0]}", {})
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "metric": "serve_trace_disaggregation",
+            "value": head.get("disaggregated", {}).get(
+                "gen_tokens_per_sec", 0.0),
+            "unit": "tokens/s",
+            "details": details,
             "runtime": runtime_summary(),
         }))
         return

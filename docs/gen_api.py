@@ -124,6 +124,16 @@ MODULES = [
      "serving.paged_cache — block pool, block tables, prefix sharing"),
     ("apex_tpu.serving.slo", "serving",
      "serving.slo — SLO classes, TTFT/TPOT deadlines, goodput judge"),
+    ("apex_tpu.serving.cluster", "serving",
+     "serving.cluster — disaggregated prefill/decode tier"),
+    ("apex_tpu.serving.cluster.protocol", "serving",
+     "serving.cluster.protocol — length-prefixed socket frames"),
+    ("apex_tpu.serving.cluster.handoff", "serving",
+     "serving.cluster.handoff — KV wire format (raw/bf16/int8)"),
+    ("apex_tpu.serving.cluster.worker", "serving",
+     "serving.cluster.worker — prefill/decode pool members"),
+    ("apex_tpu.serving.cluster.router", "serving",
+     "serving.cluster.router — SLO-aware dispatch + requeue"),
     # data
     ("apex_tpu.data.image_folder", "data",
      "data.image_folder — file-backed input pipeline"),

@@ -1,0 +1,464 @@
+"""Cluster serving tier: router, workers, requeue, degradation
+(ISSUE 9).
+
+In-process integration over REAL sockets (each WorkerServer runs its
+select loop in a thread; the router talks to it exactly as it would
+across hosts), so the wire protocol, dispatch policy, and failure
+paths are the ones production would run — minus process isolation,
+which ``bench.py --serve-trace`` and the slow two-process test cover.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import observability as obs
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.transformer_lm import init_gpt_params
+from apex_tpu.observability.detectors import PoolStallDetector
+from apex_tpu.serving import ServingEngine
+from apex_tpu.serving.cluster import Router, RouterBusy, WorkerServer
+
+
+def _cfg(**kw):
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("remat", False)
+    return TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _start(server):
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return t
+
+
+def _pools(params, cfg, n_decode=1, **decode_kw):
+    """One prefill worker + n decode workers, each serving in a
+    thread; returns (servers, threads)."""
+    decode_kw.setdefault("max_len", 32)
+    decode_kw.setdefault("cache_layout", "paged")
+    decode_kw.setdefault("block_size", 4)
+    decode_kw.setdefault("max_slots", 2)
+    servers = [WorkerServer("prefill", params, cfg, max_len=32)]
+    servers += [WorkerServer("decode", params, cfg, **decode_kw)
+                for _ in range(n_decode)]
+    threads = [_start(s) for s in servers]
+    return servers, threads
+
+
+# ---------------------------------------------------------------------------
+# worker RPC surface (no sockets: handle() directly)
+# ---------------------------------------------------------------------------
+
+
+class TestWorkerRPC:
+    def test_hello_stats_and_bad_ops(self, model):
+        cfg, params = model
+        w = WorkerServer("prefill", params, cfg, max_len=32)
+        try:
+            reply, _ = w.handle({"op": "hello"}, [])
+            assert reply["ok"] and reply["role"] == "prefill"
+            reply, _ = w.handle({"op": "stats"}, [])
+            assert reply["stats"]["scratch_layout"] == "paged"
+            reply, _ = w.handle({"op": "poll"}, [])
+            assert not reply["ok"]               # poll needs an engine
+            reply, _ = w.handle({"op": "nope"}, [])
+            assert not reply["ok"] and "unknown op" in reply["error"]
+            reply, _ = w.handle({"op": "prefill", "prompt": []}, [])
+            assert not reply["ok"]
+        finally:
+            w.close()
+
+    def test_prefill_decode_rpc_pair(self, model):
+        """The RPC pair end to end without a router: prefill returns a
+        KV handoff the decode worker accepts and serves."""
+        cfg, params = model
+        pf = WorkerServer("prefill", params, cfg, max_len=32)
+        dc = WorkerServer("decode", params, cfg, max_len=32,
+                          max_slots=1)
+        try:
+            prompt = list(range(1, 8))
+            reply, blobs = pf.handle(
+                {"op": "prefill", "prompt": prompt,
+                 "temperature": 0.0}, [])
+            assert reply["ok"] and reply["n"] == 7
+            assert reply["handoff_bytes"] == sum(len(b) for b in blobs)
+            ack, _ = dc.handle(
+                {"op": "decode", "rid": 42, "prompt": prompt,
+                 "first_token": reply["first_token"],
+                 "kv": reply["kv"], "max_new_tokens": 4}, blobs)
+            assert ack["ok"] and ack["accepted"]
+            for _ in range(30):
+                if dc.engine.idle:
+                    break
+                dc._pump()
+            poll, _ = dc.handle({"op": "poll"}, [])
+            (resp,) = poll["responses"]
+            assert resp["rid"] == 42
+            assert len(resp["tokens"]) == 4
+            assert poll["stats"]["queued"] == 0
+        finally:
+            pf.close()
+            dc.close()
+
+
+# ---------------------------------------------------------------------------
+# routing policy units
+# ---------------------------------------------------------------------------
+
+
+def _bare_router(**kw):
+    """A Router with no sockets — just the policy state, for admission
+    and priority units."""
+    from collections import deque  # noqa: F401
+
+    r = object.__new__(Router)
+    r._prefill, r._decode = [], []
+    r._slo_targets = __import__(
+        "apex_tpu.serving.slo", fromlist=["resolve_slo_targets"]
+    ).resolve_slo_targets(None)
+    r._caps = kw.get("queue_caps", {})
+    r._priority = kw.get("class_priority",
+                         ("interactive", "standard", "default",
+                          "batch"))
+    r.wire_dtype = "raw"
+    r._max_worker_queue = 4
+    r._queues = {}
+    r._next_rid = 0
+    r._pf_rr = 0
+    r._last_decode_pick = None
+    r._requeued_total = 0
+    r._completed_total = 0
+    return r
+
+
+class TestRoutingPolicy:
+    def test_class_priority_order(self):
+        r = _bare_router()
+        for cls in ("batch", "bulk-custom", "standard", "interactive"):
+            r.submit([1, 2], slo_class=cls)
+        order = []
+        while True:
+            cls = r._next_class()
+            if cls is None:
+                break
+            order.append(cls)
+            r._queues[cls].popleft()
+        # interactive first, explicit batch last, unknown classes just
+        # above batch
+        assert order == ["interactive", "standard", "bulk-custom",
+                         "batch"]
+
+    def test_queue_caps_shed_load(self):
+        r = _bare_router(queue_caps={"batch": 2})
+        r.submit([1], slo_class="batch")
+        r.submit([1], slo_class="batch")
+        with pytest.raises(RouterBusy, match="cap"):
+            r.submit([1], slo_class="batch")
+        r.submit([1], slo_class="interactive")   # other classes unhurt
+
+    def test_pool_stall_detector_latch(self):
+        det = PoolStallDetector(threshold=3)
+        assert det.feed("decode", False) is None
+        assert det.feed("decode", False) is None
+        a = det.feed("decode", False)
+        assert a is not None and a.kind == "pool_stall"
+        assert det.stalled("decode")
+        # latched: more failures do not re-fire
+        assert det.feed("decode", False) is None
+        # recovery needs threshold consecutive successes
+        det.feed("decode", True)
+        det.feed("decode", True)
+        assert det.stalled("decode")
+        det.feed("decode", True)
+        assert not det.stalled("decode")
+        # pools are independent
+        assert det.feed("prefill", False) is None
+
+    def test_autoscale_hints_from_fleet_summary(self):
+        r = _bare_router()
+
+        class _W:
+            alive = True
+            stats = {"free_block_headroom": 5, "max_slots": 4,
+                     "active": 1}
+            in_flight = {}
+
+        r._decode = [_W()]
+        r._prefill = [_W()]
+        sig = r.autoscale_signal()
+        assert sig["decode"]["hint"] == 0
+        # a windowed fleet summary showing interactive TTFT p95 over
+        # its 500ms deadline asks for prefill scale-up; TPOT over
+        # deadline asks for decode scale-up
+        fleet = {"sketches": {
+            "serving.ttft_ms{slo_class=interactive}": {"p95": 800.0},
+            "serving.tpot_ms{slo_class=interactive}": {"p95": 90.0},
+        }}
+        sig = r.autoscale_signal(fleet)
+        assert sig["prefill"]["hint"] == 1
+        assert sig["decode"]["hint"] == 1
+        assert set(sig["slo_violations"]) == {"interactive:ttft",
+                                              "interactive:tpot"}
+
+
+# ---------------------------------------------------------------------------
+# integration over real sockets
+# ---------------------------------------------------------------------------
+
+
+class TestClusterIntegration:
+    def test_token_identity_and_telemetry(self, model):
+        """Routed greedy outputs == single-engine outputs, and the
+        cluster telemetry counters carry the routing evidence."""
+        cfg, params = model
+        reg = obs.configure()
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(0, 128, (3 + 2 * i,)) for i in range(5)]
+        classes = ["interactive", "standard", "batch", "default",
+                   "interactive"]
+
+        single = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               cache_layout="paged", block_size=4)
+        for p, c in zip(prompts, classes):
+            single.submit(p, max_new_tokens=4, slo_class=c)
+        ref = {}
+        while not single.idle:
+            for r in single.step():
+                ref[tuple(r.prompt.tolist())] = r.tokens.tolist()
+
+        servers, _ = _pools(params, cfg)
+        router = Router([servers[0].addr], [servers[1].addr])
+        try:
+            for p, c in zip(prompts, classes):
+                router.submit(p, max_new_tokens=4, slo_class=c)
+            out = router.run(max_wall_s=120)
+            assert len(out) == 5
+            for r in out:
+                assert r.tokens.tolist() == ref[tuple(
+                    r.prompt.tolist())]
+                assert r.handoff_bytes > 0
+                assert r.pool == servers[1].addr
+                assert 0 <= r.queue_wait_ms <= r.ttft_ms <= r.e2e_ms
+            counters = [r for r in reg.snapshot()
+                        if r["kind"] == "counter"]
+            route_total = sum(r["value"] for r in counters
+                              if r["name"] == "cluster.route")
+            assert route_total == 5
+            handoff = sum(r["value"] for r in counters
+                          if r["name"] == "cluster.handoff_bytes")
+            assert handoff == sum(r.handoff_bytes for r in out)
+        finally:
+            router.close(shutdown_workers=True)
+            obs.shutdown()
+
+    def test_killed_decode_worker_requeues_not_loses(self, model):
+        """THE SOAK PIN: kill one of two decode workers mid-flight —
+        every request still completes (on the survivor), requeues are
+        counted, outputs stay greedy-correct."""
+        cfg, params = model
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(0, 128, (4 + i,)) for i in range(6)]
+
+        single = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               cache_layout="paged", block_size=4)
+        for p in prompts:
+            single.submit(p, max_new_tokens=6)
+        ref = {}
+        while not single.idle:
+            for r in single.step():
+                ref[tuple(r.prompt.tolist())] = r.tokens.tolist()
+
+        servers, _ = _pools(params, cfg, n_decode=2, max_slots=1)
+        victim = servers[2]
+        router = Router([servers[0].addr],
+                        [servers[1].addr, servers[2].addr],
+                        max_worker_queue=2)
+        try:
+            for p in prompts:
+                router.submit(p, max_new_tokens=6)
+            out = []
+            # step until the victim worker owns in-flight work, then
+            # kill it the hard way (loop stops, sockets close)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                out.extend(router.step())
+                victim_w = next(w for w in router._decode
+                                if w.addr == victim.addr)
+                if victim_w.in_flight:
+                    break
+            assert victim_w.in_flight, "victim never got work"
+            victim.stop()
+            time.sleep(0.1)
+            out.extend(router.run(max_wall_s=120))
+            got = {tuple(r.prompt.tolist()): r.tokens.tolist()
+                   for r in out}
+            assert got == ref                  # nothing lost, all exact
+            assert router.stats()["requeued"] >= 1
+            assert any(r.requeues > 0 for r in out)
+            assert all(r.pool == servers[1].addr
+                       for r in out if r.requeues)
+        finally:
+            router.close(shutdown_workers=True)
+
+    def test_pool_stall_latches_healthz(self, model):
+        """All decode workers dead + queued work = a pool stall: the
+        detector latches and the router process's /healthz answers
+        503 — the degradation signal a balancer acts on."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        cfg, params = model
+        reg = obs.configure(export_port=0)
+        servers, _ = _pools(params, cfg)
+        router = Router([servers[0].addr], [servers[1].addr])
+        try:
+            url = reg.exporter.url
+            assert json.loads(urllib.request.urlopen(
+                url + "/healthz", timeout=5).read())["status"] == "ok"
+            servers[1].stop()
+            time.sleep(0.1)
+            router.submit([1, 2, 3], max_new_tokens=2)
+            for _ in range(5):
+                router.step()
+            assert reg.detectors.pool.stalled("decode")
+            kinds = {a.kind for a in reg.detectors.anomalies}
+            assert "pool_stall" in kinds
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url + "/healthz", timeout=5)
+            assert ei.value.code == 503
+            doc = json.loads(ei.value.read().decode())
+            assert "pool_stall" in doc["kinds"]
+            # the request is requeued, not lost
+            assert router.stats()["queued"] == 1
+        finally:
+            router.close(shutdown_workers=True)
+            servers[0].stop()
+            obs.shutdown()
+
+    def test_scrape_stats_covers_prefill_pool(self, model):
+        cfg, params = model
+        servers, _ = _pools(params, cfg)
+        router = Router([servers[0].addr], [servers[1].addr])
+        try:
+            router.scrape_stats()
+            st = router.stats()
+            assert st["pools"]["decode"][0]["stats"]["max_slots"] == 2
+            assert router._prefill[0].stats["prefill_calls"] == 0
+        finally:
+            router.close(shutdown_workers=True)
+
+
+class TestServeDashMultiPool:
+    def test_warming_pool_renders_instead_of_crashing(self, model):
+        """tools/serve_dash.py multi-pool mode: one live exporter +
+        one refused port render one healthy block and one 'warming
+        up / unreachable' block — the loop never dies on a pool that
+        is still starting."""
+        import importlib.util
+        import io
+        import os
+        import socket as socket_mod
+
+        cfg, params = model
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "serve_dash", os.path.join(repo, "tools", "serve_dash.py"))
+        dash = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(dash)
+        om = dash.load_openmetrics_module()
+
+        reg = obs.configure(export_port=0)
+        try:
+            engine = ServingEngine(params, cfg, max_slots=1,
+                                   max_len=32)
+            engine.submit([1, 2, 3], max_new_tokens=2)
+            while not engine.idle:
+                engine.step()
+            # a port nothing listens on = a pool mid-startup
+            probe = socket_mod.socket()
+            probe.bind(("127.0.0.1", 0))
+            dead_port = probe.getsockname()[1]
+            probe.close()
+
+            out = io.StringIO()
+            live = dash.pool_frame(om, reg.exporter.url, "pool 0",
+                                   out=out)
+            dead = dash.pool_frame(
+                om, f"http://127.0.0.1:{dead_port}", "pool 1", out=out)
+            text = out.getvalue()
+            assert live is not None and dead is None
+            assert "pool 0" in text and "pool 1" in text
+            assert "warming up / unreachable" in text
+            # and the CLI multi-URL form takes the same path
+            rc = dash.main(["--once", reg.exporter.url,
+                            f"127.0.0.1:{dead_port}"])
+            assert rc == 0
+        finally:
+            obs.shutdown()
+
+
+@pytest.mark.slow
+class TestTwoProcess:
+    def test_two_process_token_identity(self, model):
+        """The full two-OS-process demo (also exercised by bench.py
+        --serve-trace): spawned workers, router here, greedy outputs
+        pinned against the single engine."""
+        from apex_tpu.serving.cluster.worker import spawn_worker
+
+        cfg, params = model
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, 128, (5 + i,)) for i in range(4)]
+        single = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               cache_layout="paged", block_size=4)
+        for p in prompts:
+            single.submit(p, max_new_tokens=5)
+        ref = {}
+        while not single.idle:
+            for r in single.step():
+                ref[tuple(r.prompt.tolist())] = r.tokens.tolist()
+
+        flags = ["--hidden", "64", "--heads", "4", "--vocab", "128",
+                 "--max-pos", "64", "--max-len", "32"]
+        procs = []
+        try:
+            pf_proc, pf_addr, _ = spawn_worker("prefill",
+                                               extra_args=flags)
+            procs.append(pf_proc)
+            dc_proc, dc_addr, _ = spawn_worker(
+                "decode", extra_args=flags + [
+                    "--max-slots", "2", "--cache-layout", "paged",
+                    "--block-size", "4"])
+            procs.append(dc_proc)
+            router = Router([pf_addr], [dc_addr])
+            for p in prompts:
+                router.submit(p, max_new_tokens=5)
+            out = router.run(max_wall_s=240)
+            assert {tuple(r.prompt.tolist()): r.tokens.tolist()
+                    for r in out} == ref
+            router.close(shutdown_workers=True)
+        finally:
+            for proc in procs:
+                try:
+                    proc.terminate()
+                    proc.wait(timeout=10)
+                except Exception:
+                    proc.kill()

@@ -1,0 +1,446 @@
+"""Cluster KV handoff: wire protocol, serialization, inject parity
+(ISSUE 9).
+
+The disaggregation contract everything else stands on: a prompt's KV
+extracted from one cache, framed over the wire, and injected into
+another MUST leave greedy decode token-identical (raw wire, both
+layouts, fp32 and bf16 caches) — including across a ragged
+mid-generation seam, where per-row lengths are not block-aligned.
+"""
+
+import socket
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.generate import (
+    decode_step, extract_kv, init_kv_cache, inject_kv, prefill)
+from apex_tpu.models.transformer_lm import init_gpt_params
+from apex_tpu.serving import ServingEngine
+from apex_tpu.serving.batching import (
+    default_buckets, pad_prompt, pick_bucket)
+from apex_tpu.serving.cluster import protocol
+from apex_tpu.serving.cluster.handoff import (
+    decode_kv, encode_kv, wire_bytes)
+
+
+def _cfg(**kw):
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("remat", False)
+    return TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# the socket protocol
+# ---------------------------------------------------------------------------
+
+
+class TestProtocol:
+    def test_roundtrip_header_and_blobs(self):
+        a, b = socket.socketpair()
+        try:
+            blobs = [b"\x00" * 1000, b"xyz", b""]
+            n = protocol.send_msg(a, {"op": "x", "v": [1, 2]}, blobs)
+            header, got = protocol.recv_msg(b)
+            assert header == {"op": "x", "v": [1, 2]}
+            assert got == blobs
+            assert n > 1003
+        finally:
+            a.close()
+            b.close()
+
+    def test_clean_close_is_none_midframe_raises(self):
+        a, b = socket.socketpair()
+        a.close()
+        assert protocol.recv_msg(b) is None       # boundary EOF
+        b.close()
+        a, b = socket.socketpair()
+        try:
+            a.sendall(b"\x00\x00\x00\xff")        # declares 255 bytes
+            a.sendall(b"{")                       # ...sends one
+            a.close()
+            with pytest.raises(protocol.ProtocolError,
+                               match="mid-frame"):
+                protocol.recv_msg(b)
+        finally:
+            b.close()
+
+    def test_malformed_header_raises(self):
+        for payload in (b"not json", b"[1, 2]"):
+            a, b = socket.socketpair()
+            try:
+                import struct
+
+                a.sendall(struct.pack("!I", len(payload)) + payload)
+                with pytest.raises(protocol.ProtocolError):
+                    protocol.recv_msg(b)
+            finally:
+                a.close()
+                b.close()
+
+    def test_stdlib_only_by_path(self):
+        """protocol.py's dependency-free contract: it must load by
+        file path in a process where jax and numpy are unimportable
+        (the tools/ path-loading discipline)."""
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        path = os.path.join(repo, "apex_tpu", "serving", "cluster",
+                            "protocol.py")
+        code = (
+            "import sys, importlib.util\n"
+            "sys.modules['jax'] = None\n"
+            "sys.modules['numpy'] = None\n"
+            f"spec = importlib.util.spec_from_file_location("
+            f"'_proto', {path!r})\n"
+            "m = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(m)\n"
+            "print('loaded', m.MAX_HEADER > 0)\n")
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert "loaded True" in out.stdout
+
+    def test_oversized_declaration_refused(self):
+        a, b = socket.socketpair()
+        try:
+            import json
+            import struct
+
+            hdr = json.dumps(
+                {"op": "kv",
+                 "_blobs": [protocol.MAX_MESSAGE]}).encode()
+            a.sendall(struct.pack("!I", len(hdr)) + hdr)
+            with pytest.raises(protocol.ProtocolError,
+                               match="MAX_MESSAGE"):
+                protocol.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# wire serialization
+# ---------------------------------------------------------------------------
+
+
+class TestWireFormat:
+    @pytest.mark.parametrize("cache_dtype", ["float32", "bfloat16"])
+    @pytest.mark.parametrize("wire", ["raw", "bf16", "int8"])
+    def test_encode_decode_roundtrip(self, cache_dtype, wire):
+        rng = np.random.RandomState(0)
+        shape = (2, 7, 4, 16)
+        k = jnp.asarray(rng.randn(*shape), jnp.dtype(cache_dtype))
+        v = jnp.asarray(rng.randn(*shape), jnp.dtype(cache_dtype))
+        header, blobs = encode_kv(k, v, wire_dtype=wire)
+        k2, v2 = decode_kv(header, blobs)
+        assert k2.shape == shape and jnp.dtype(k2.dtype) == k.dtype
+        if wire == "raw" or (wire == "bf16"
+                             and cache_dtype == "bfloat16"):
+            # bit-exact forms: raw always; bf16 wire on a bf16 cache
+            # is a no-op cast
+            assert bytes(np.asarray(k).tobytes()) == bytes(k2.tobytes())
+            assert bytes(np.asarray(v).tobytes()) == bytes(v2.tobytes())
+        else:
+            np.testing.assert_allclose(
+                np.asarray(k, np.float32), np.asarray(k2, np.float32),
+                rtol=0, atol=0.05)
+
+    def test_wire_bytes_ordering(self):
+        """The compression the wire formats exist for: int8 < bf16 <
+        raw on an fp32 cache."""
+        k = jnp.asarray(np.random.RandomState(1).randn(2, 8, 4, 16),
+                        jnp.float32)
+        sizes = {w: wire_bytes(encode_kv(k, k, wire_dtype=w)[1])
+                 for w in ("raw", "bf16", "int8")}
+        assert sizes["int8"] < sizes["bf16"] < sizes["raw"]
+        assert sizes["bf16"] == sizes["raw"] // 2
+
+    def test_torn_handoff_rejected(self):
+        k = jnp.ones((2, 4, 4, 16), jnp.float32)
+        header, blobs = encode_kv(k, k)
+        with pytest.raises(ValueError, match="declares"):
+            decode_kv(header, [blobs[0][:-8], blobs[1]])
+        with pytest.raises(ValueError):
+            decode_kv(dict(header, cache_dtype="int64"), blobs)
+        with pytest.raises(ValueError):
+            decode_kv(dict(header, shape=[2, 4]), blobs)
+        with pytest.raises(ValueError):
+            encode_kv(k, k, wire_dtype="fp8")
+
+
+# ---------------------------------------------------------------------------
+# extract / inject across layouts
+# ---------------------------------------------------------------------------
+
+
+class TestExtractInject:
+    @pytest.mark.parametrize("layout", ["contiguous", "paged"])
+    def test_roundtrip_identity(self, model, layout):
+        cfg, params = model
+        prompt = np.random.RandomState(2).randint(0, 128, (1, 9))
+        cache = init_kv_cache(cfg, 1, 32, cache_layout=layout,
+                              block_size=4)
+        _, cache = prefill(params, jnp.asarray(prompt), cfg,
+                           cache=cache)
+        k, v = extract_kv(cache, 9)
+        assert k.shape == (2, 9, 4, 16)
+        dst = init_kv_cache(cfg, 1, 32, cache_layout=layout,
+                            block_size=4)
+        dst = inject_kv(dst, k, v)
+        assert int(dst["pos"][0]) == 9
+        k2, v2 = extract_kv(dst, 9)
+        assert bool(jnp.all(k == k2)) and bool(jnp.all(v == v2))
+
+    def test_errors(self, model):
+        cfg, _ = model
+        cache = init_kv_cache(cfg, 1, 16, cache_layout="paged",
+                              block_size=4)
+        with pytest.raises(ValueError, match="blocks"):
+            extract_kv(cache, 99)
+        with pytest.raises(ValueError, match="length"):
+            extract_kv(cache, 0)
+        with pytest.raises(ValueError, match="max_len"):
+            inject_kv(init_kv_cache(cfg, 1, 8),
+                      jnp.zeros((2, 9, 4, 16)), jnp.zeros((2, 9, 4, 16)))
+
+    def test_unmapped_table_entries_refused(self, model):
+        """A length that reaches UNMAPPED (sentinel) table entries must
+        refuse, never clamp-gather another request's pool pages
+        (extract) or silently drop writes while pos claims them
+        (inject)."""
+        cfg, _ = model
+        cache = init_kv_cache(cfg, 1, 16, cache_layout="paged",
+                              block_size=4)
+        nb = cache["k"].shape[1]
+        # engine-style table: only the first 2 blocks mapped
+        tables = np.full((1, 4), nb, np.int32)
+        tables[0, :2] = [0, 1]
+        cache = dict(cache, block_tables=jnp.asarray(tables))
+        k, v = extract_kv(cache, 8)              # mapped range: fine
+        with pytest.raises(ValueError, match="unmapped"):
+            extract_kv(cache, 9)                 # third block: sentinel
+        with pytest.raises(ValueError, match="unmapped"):
+            inject_kv(cache, jnp.zeros((2, 9, 4, 16)),
+                      jnp.zeros((2, 9, 4, 16)))
+        assert k.shape == (2, 8, 4, 16) and v.shape == (2, 8, 4, 16)
+
+
+# ---------------------------------------------------------------------------
+# engine injection parity: the acceptance pin
+# ---------------------------------------------------------------------------
+
+
+def _remote_prefill(params, cfg, prompt, max_len, cache_dtype,
+                    scratch_layout="paged"):
+    """What a prefill worker does, engine-bucket-identically: one
+    bucket-shaped flash prefill + greedy first token + extraction."""
+    buckets = tuple(sorted(default_buckets(max_len)))
+    n = int(prompt.size)
+    bucket = pick_bucket(n, buckets)
+    padded = jnp.asarray(pad_prompt(prompt, bucket)[None])
+    lens = jnp.asarray([n], jnp.int32)
+    if scratch_layout == "paged":
+        scratch = init_kv_cache(cfg, 1, bucket, cache_dtype=cache_dtype,
+                                cache_layout="paged", block_size=4)
+        logits, cache = prefill(params, padded, cfg, prompt_lens=lens,
+                                cache=scratch)
+    else:
+        logits, cache = prefill(params, padded, cfg, prompt_lens=lens,
+                                max_len=bucket, cache_dtype=cache_dtype)
+    first = int(jnp.argmax(logits[0]))
+    k, v = extract_kv(cache, n)
+    return np.asarray(k), np.asarray(v), first
+
+
+class TestEngineInjectionParity:
+    @pytest.mark.parametrize("layout", ["contiguous", "paged"])
+    @pytest.mark.parametrize("cache_dtype",
+                             [jnp.float32, jnp.bfloat16])
+    def test_raw_wire_token_identical(self, model, layout, cache_dtype):
+        """extract → wire (raw) → inject, then decode: greedy outputs
+        must equal a single engine that prefilled locally — on both
+        layouts, fp32 AND bf16 caches."""
+        cfg, params = model
+        rng = np.random.RandomState(4)
+        prompts = [rng.randint(0, 128, (n,)) for n in (5, 9)]
+        kw = dict(max_slots=2, max_len=32, cache_layout=layout,
+                  block_size=4, cache_dtype=cache_dtype)
+
+        ref_eng = ServingEngine(params, cfg, **kw)
+        for p in prompts:
+            ref_eng.submit(p, max_new_tokens=5)
+        ref = {}
+        while not ref_eng.idle:
+            for r in ref_eng.step():
+                ref[tuple(r.prompt.tolist())] = r.tokens.tolist()
+
+        eng = ServingEngine(params, cfg, **kw)
+        for p in prompts:
+            k, v, first = _remote_prefill(
+                params, cfg, p, 32, jnp.dtype(cache_dtype),
+                scratch_layout=("paged" if layout == "contiguous"
+                                else "contiguous"))  # CROSS-layout
+            hdr, blobs = encode_kv(k, v, wire_dtype="raw")
+            k2, v2 = decode_kv(hdr, blobs)
+            eng.submit_prefilled(p, k2, v2, first, max_new_tokens=5)
+        out = {}
+        while not eng.idle:
+            for r in eng.step():
+                out[tuple(r.prompt.tolist())] = r.tokens.tolist()
+        assert out == ref
+
+    def test_quantized_wire_decodes_but_may_diverge(self, model):
+        """int8 wire: the engine accepts and decodes it (shapes,
+        lifecycle); token parity is NOT claimed — that's the parity
+        knob's documented trade."""
+        cfg, params = model
+        p = np.random.RandomState(5).randint(0, 128, (7,))
+        k, v, first = _remote_prefill(params, cfg, p, 32, jnp.float32)
+        hdr, blobs = encode_kv(k, v, wire_dtype="int8")
+        k2, v2 = decode_kv(hdr, blobs)
+        eng = ServingEngine(params, cfg, max_slots=1, max_len=32)
+        eng.submit_prefilled(p, k2, v2, first, max_new_tokens=4)
+        out = []
+        while not eng.idle:
+            out.extend(eng.step())
+        assert len(out) == 1 and out[0].tokens.size == 4
+
+    def test_shape_mismatch_refused(self, model):
+        cfg, params = model
+        eng = ServingEngine(params, cfg, max_slots=1, max_len=32)
+        bad = np.zeros((2, 5, 4, 8), np.float32)     # wrong dh
+        with pytest.raises(ValueError, match="geometry"):
+            eng.submit_prefilled(np.arange(5) + 1, bad, bad, 0,
+                                 max_new_tokens=4)
+
+    def test_preempted_injection_resumes_locally(self, model):
+        """A preempted injected request drops its handoff and resumes
+        through LOCAL prefill — still token-identical (raw wire), and
+        the blocks ledger stays clean."""
+        cfg, params = model
+        rng = np.random.RandomState(6)
+        # a pool sized to force preemption: 2 lanes want more blocks
+        # than exist once decode grows
+        kw = dict(max_slots=2, max_len=32, cache_layout="paged",
+                  block_size=4, num_blocks=7, reserve_blocks=0)
+        prompts = [rng.randint(0, 128, (8,)), rng.randint(0, 128, (8,))]
+
+        ref_eng = ServingEngine(params, cfg, **kw)
+        for p in prompts:
+            ref_eng.submit(p, max_new_tokens=6)
+        ref = {}
+        while not ref_eng.idle:
+            for r in ref_eng.step():
+                ref[tuple(r.prompt.tolist())] = r.tokens.tolist()
+
+        eng = ServingEngine(params, cfg, **kw)
+        for p in prompts:
+            k, v, first = _remote_prefill(params, cfg, p, 32,
+                                          jnp.float32)
+            eng.submit_prefilled(p, k, v, first, max_new_tokens=6)
+        out = {}
+        while not eng.idle:
+            for r in eng.step():
+                out[tuple(r.prompt.tolist())] = r.tokens.tolist()
+        assert out == ref
+        assert eng.stats()["blocks_in_use"] == 0
+        assert eng.stats()["preemptions"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# the ragged mid-generation seam
+# ---------------------------------------------------------------------------
+
+
+class TestMidGenerationSeam:
+    @pytest.mark.parametrize("src,dst", [("paged", "contiguous"),
+                                         ("contiguous", "paged")])
+    def test_ragged_seam_cross_layout(self, model, src, dst):
+        """Hand off MID-GENERATION, ragged, across layouts: rows at
+        non-block-aligned lengths extract, cross the wire, inject into
+        the OTHER layout, and continue bitwise-identically to never
+        having moved."""
+        cfg, params = model
+        rng = np.random.RandomState(7)
+        lens = jnp.asarray([5, 8], jnp.int32)
+        prompt = jnp.asarray(rng.randint(0, 128, (2, 8)), jnp.int32)
+
+        def greedy_steps(cache, tok, steps):
+            toks = []
+            for _ in range(steps):
+                logits, cache = decode_step(params, tok, cache, cfg)
+                tok = jnp.argmax(logits, -1).astype(jnp.int32)
+                toks.append(np.asarray(tok).tolist())
+            return cache, tok, toks
+
+        cache = init_kv_cache(cfg, 2, 32, cache_layout=src,
+                              block_size=4)
+        logits, cache = prefill(params, prompt, cfg, prompt_lens=lens,
+                                cache=cache)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        cache, tok, _ = greedy_steps(cache, tok, 3)
+        # rows now at pos 8 and 11 — 11 % 4 != 0: the seam splits a
+        # block mid-page
+        assert [int(p) for p in cache["pos"]] == [8, 11]
+
+        moved = init_kv_cache(cfg, 2, 32, cache_layout=dst,
+                              block_size=4)
+        for row in range(2):
+            n = int(cache["pos"][row])
+            k, v = extract_kv(cache, n, row=row)
+            hdr, blobs = encode_kv(np.asarray(k), np.asarray(v))
+            k2, v2 = decode_kv(hdr, blobs)
+            moved = inject_kv(moved, k2, v2, row=row)
+
+        _, _, cont = greedy_steps(moved, tok, 4)
+        _, _, ref = greedy_steps(cache, tok, 4)
+        assert cont == ref
+
+
+# ---------------------------------------------------------------------------
+# the stats admission signals (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestStatsSignals:
+    def test_queued_by_class_and_headroom(self, model):
+        cfg, params = model
+        eng = ServingEngine(params, cfg, max_slots=1, max_len=32,
+                            cache_layout="paged", block_size=4,
+                            reserve_blocks=2)
+        st = eng.stats()
+        assert st["queued_by_class"] == {}
+        assert st["free_block_headroom"] == st["blocks_free"] - 2
+        for cls in ("interactive", "interactive", "batch"):
+            eng.submit([1, 2, 3], max_new_tokens=2, slo_class=cls)
+        st = eng.stats()
+        # flat keys unchanged for existing consumers
+        assert st["queued"] == 3
+        assert st["queued_by_class"] == {"interactive": 2, "batch": 1}
+        while not eng.idle:
+            eng.step()
+
+    def test_contiguous_headroom_is_free_lanes(self, model):
+        cfg, params = model
+        eng = ServingEngine(params, cfg, max_slots=3, max_len=32)
+        assert eng.stats()["free_block_headroom"] == 3

@@ -250,3 +250,106 @@ def test_spec_summary_fixture(report, tmp_path):
         "generate.spec.accepted_tokens": 4.0})
     assert partial["accept_rate"] == 0.5
     assert partial["tokens_per_verify"] is None
+
+
+# -- aggregate_telemetry --window (ISSUE 9 satellite) ------------------------
+
+
+@pytest.fixture(scope="module")
+def aggregate():
+    spec = importlib.util.spec_from_file_location(
+        "aggregate_telemetry", os.path.join(REPO, "tools",
+                                            "aggregate_telemetry.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _segment(sketch_mod, name, values, counter):
+    """One appended run segment: meta + a cumulative sketch flush + a
+    cumulative counter flush."""
+    import json
+
+    sk = sketch_mod.LogBucketSketch()
+    for v in values:
+        sk.observe(v)
+    return (
+        json.dumps({"type": "meta", "schema_version": 3}) + "\n"
+        + json.dumps({"type": "sketch", "name": name,
+                      "tags": {"slo_class": "interactive"},
+                      "value": sk.to_dict()}) + "\n"
+        + json.dumps({"type": "counter",
+                      "name": "serving.goodput.met",
+                      "tags": {"slo_class": "interactive"},
+                      "value": counter}) + "\n")
+
+
+def test_window_merges_only_last_n_segments(aggregate, tmp_path):
+    """--window N: an autoscaler polling recent fleet percentiles must
+    not see lifetime history — only each file's last N run segments
+    merge.  Lifetime (no window) still merges everything."""
+    sketch_mod = aggregate.load_sketch_module()
+    f = tmp_path / "host0.jsonl"
+    f.write_text(
+        _segment(sketch_mod, "serving.ttft_ms", [1.0] * 8, 8.0)
+        + _segment(sketch_mod, "serving.ttft_ms", [100.0] * 4, 4.0)
+        + _segment(sketch_mod, "serving.ttft_ms", [1000.0] * 2, 2.0))
+    key = "serving.ttft_ms{slo_class=interactive}"
+    records = aggregate.load_records([str(f)])
+
+    lifetime = aggregate.aggregate(records)
+    assert lifetime["sketches"][key]["count"] == 14
+    assert lifetime["counters"][
+        "serving.goodput.met{slo_class=interactive}"] == 14.0
+
+    last1 = aggregate.aggregate(aggregate.windowed(records, 1))
+    assert last1["sketches"][key]["count"] == 2
+    assert last1["sketches"][key]["p50"] >= 1000.0 * 0.96
+    assert last1["goodput"]["interactive"]["met"] == 2.0
+
+    last2 = aggregate.aggregate(aggregate.windowed(records, 2))
+    assert last2["sketches"][key]["count"] == 6
+    # window wider than history = lifetime
+    assert aggregate.aggregate(aggregate.windowed(records, 99))[
+        "sketches"][key]["count"] == 14
+
+    with pytest.raises(ValueError, match="window"):
+        aggregate.windowed(records, 0)
+
+
+def test_window_is_per_file(aggregate, tmp_path):
+    """Each FILE keeps its own last-N segments (hosts flush on their
+    own cadence; one busy host must not evict another's only
+    segment)."""
+    sketch_mod = aggregate.load_sketch_module()
+    a = tmp_path / "a.jsonl"
+    a.write_text(
+        _segment(sketch_mod, "serving.ttft_ms", [1.0] * 4, 4.0)
+        + _segment(sketch_mod, "serving.ttft_ms", [10.0] * 3, 3.0))
+    b = tmp_path / "b.jsonl"
+    b.write_text(_segment(sketch_mod, "serving.ttft_ms", [10.0] * 5,
+                          5.0))
+    agg = aggregate.aggregate(aggregate.windowed(
+        aggregate.load_records([str(a), str(b)]), 1))
+    key = "serving.ttft_ms{slo_class=interactive}"
+    # a's last segment (3) + b's only segment (5)
+    assert agg["sketches"][key]["count"] == 8
+    assert agg["goodput"]["interactive"]["met"] == 8.0
+
+
+def test_window_cli_flag(aggregate, tmp_path, capsys):
+    sketch_mod = aggregate.load_sketch_module()
+    f = tmp_path / "h.jsonl"
+    f.write_text(
+        _segment(sketch_mod, "serving.ttft_ms", [1.0] * 8, 8.0)
+        + _segment(sketch_mod, "serving.ttft_ms", [5.0] * 2, 2.0))
+    out_json = tmp_path / "agg.json"
+    rc = aggregate.main(["--window", "1", "--json", str(out_json),
+                         str(f)])
+    assert rc == 0
+    import json
+
+    agg = json.loads(out_json.read_text())
+    assert agg["window"] == 1
+    assert agg["sketches"][
+        "serving.ttft_ms{slo_class=interactive}"]["count"] == 2

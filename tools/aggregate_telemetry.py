@@ -32,6 +32,14 @@ Run segments follow the ``meta``-record discipline of
 ``telemetry_report.py`` (one file can hold several appended runs).
 Garbage lines warn and skip — a fleet merge must read wounded hosts.
 
+``--window N`` merges only each file's **last N run segments** (ISSUE
+9): sketches and counters are cumulative *within* a segment, so the
+lifetime merge answers "what has this fleet ever done" — useless to an
+autoscaler, which needs "what are the RECENT percentiles".  A router
+polling ``--window 1 --json`` on streams that flush per run/interval
+gets exactly the recent-window fleet p95s its scale-up decision keys
+on (``Router.autoscale_signal`` consumes this artifact).
+
 Deliberately dependency-free: runs on any box with the repo checkout
 (the sketch module is loaded by file path and is itself stdlib-only —
 no jax required).
@@ -96,6 +104,29 @@ def load_records(paths: Iterable[str], out=None) -> List[dict]:
                 rec["_epoch"] = epoch
                 records.append(rec)
     return records
+
+
+def windowed(records: List[dict], window: Optional[int]
+             ) -> List[dict]:
+    """Keep only each source file's last ``window`` run segments
+    (None = everything).  Segment identity is the ``_epoch`` stamp
+    ``load_records`` derives from meta-record boundaries, so "last N"
+    means the N most recent appended runs per host — the recency
+    filter behind ``--window``."""
+    if window is None:
+        return records
+    if window < 1:
+        raise ValueError(f"window={window} must be >= 1")
+    last_epochs: Dict[int, List[int]] = {}
+    for rec in records:
+        epochs = last_epochs.setdefault(rec["_src"], [])
+        if rec["_epoch"] not in epochs:
+            epochs.append(rec["_epoch"])
+    keep = {(src, e)
+            for src, epochs in last_epochs.items()
+            for e in sorted(epochs)[-window:]}
+    return [rec for rec in records
+            if (rec["_src"], rec["_epoch"]) in keep]
 
 
 def aggregate(records: List[dict], out=None) -> dict:
@@ -211,8 +242,16 @@ def main(argv=None) -> int:
     ap.add_argument("--json", metavar="PATH", default=None,
                     help="also write the aggregate as JSON (the "
                          "machine-readable autoscaling substrate)")
+    ap.add_argument("--window", metavar="N", type=int, default=None,
+                    help="merge only each file's last N run segments "
+                         "(recent percentiles for the router's "
+                         "autoscaler, not lifetime totals)")
     args = ap.parse_args(argv)
-    agg = aggregate(load_records(args.files))
+    if args.window is not None and args.window < 1:
+        ap.error(f"--window {args.window}: must be >= 1")
+    agg = aggregate(windowed(load_records(args.files), args.window))
+    if args.window is not None:
+        agg["window"] = args.window
     print_report(agg)
     if args.json:
         with open(args.json, "w") as f:

@@ -1,11 +1,12 @@
 """Exporter smoke: engine up with live export, one scrape, validate,
-tear down.
+tear down — then the same for the disaggregated cluster.
 
     python tools/exporter_smoke.py
+    python tools/exporter_smoke.py --skip-cluster   # single-engine only
 
-The ``tools/measure_all.py`` campaign stage for ISSUE 7: boots a tiny
-serving engine with ``observability.configure(export_port=0)`` (an
-ephemeral localhost port — the stage can never collide with a real
+The ``tools/measure_all.py`` campaign stage for ISSUE 7 (+9): boots a
+tiny serving engine with ``observability.configure(export_port=0)``
+(an ephemeral localhost port — the stage can never collide with a real
 exporter), drives a handful of requests across two SLO classes, then
 
 1. scrapes ``/metrics`` once and validates it with the strict
@@ -19,6 +20,14 @@ exporter), drives a handful of requests across two SLO classes, then
 4. shuts down and verifies the exporter thread actually exited (a
    leaked daemon thread would outlive every later stage).
 
+Cluster half (ISSUE 9): spawns one prefill + one decode worker as
+their own processes (each exporting on an ephemeral port), routes a
+few requests across them, and scrapes ALL THREE surfaces — the
+router's (``cluster_route_total``, queue gauges), the decode pool's
+(``serving_kv_injected_total`` proves the handoff landed), and the
+prefill pool's — each through the strict parser, plus each
+``/healthz``.
+
 Exit 0 = the live export surface works end to end on this box.
 """
 
@@ -31,8 +40,105 @@ import urllib.error
 import urllib.request
 
 
+def _scrape_valid(openmetrics, url: str, want_names=(), label=""):
+    """One strict scrape; returns the parsed doc or raises/returns
+    None on failure (caller turns that into a stage failure)."""
+    text = urllib.request.urlopen(url + "/metrics", timeout=10).read()
+    parsed = openmetrics.parse(text.decode("utf-8"))
+    if not parsed["eof"]:
+        print(f"[exporter_smoke] FAIL: {label} exposition missing "
+              "# EOF")
+        return None
+    names = {n for n, _l, _v in parsed["samples"]}
+    for want in want_names:
+        if want not in names:
+            print(f"[exporter_smoke] FAIL: {want} missing from "
+                  f"{label} scrape ({len(names)} sample names)")
+            return None
+    return parsed
+
+
+def smoke_cluster() -> int:
+    """Router + two worker processes, all three /metrics scraped."""
+    import numpy as np
+
+    from apex_tpu import observability as obs
+    from apex_tpu.observability import openmetrics
+    from apex_tpu.observability.exporter import THREAD_NAME
+    from apex_tpu.serving.cluster import Router
+    from apex_tpu.serving.cluster.worker import spawn_worker
+
+    reg = obs.configure(export_port=0, tags={"pool": "router"})
+    router_url = reg.exporter.url
+    flags = ["--vocab", "256", "--max-len", "64", "--export-port", "0"]
+    procs = []
+    try:
+        pf_proc, pf_addr, pf_url = spawn_worker(
+            "prefill", extra_args=flags)
+        procs.append(pf_proc)
+        dc_proc, dc_addr, dc_url = spawn_worker(
+            "decode", extra_args=flags + ["--max-slots", "2"])
+        procs.append(dc_proc)
+        router = Router([pf_addr], [dc_addr])
+        rng = np.random.RandomState(0)
+        for i in range(4):
+            router.submit(rng.randint(0, 256, (6,)),
+                          max_new_tokens=4,
+                          slo_class="interactive" if i % 2
+                          else "standard")
+        done = router.run(max_wall_s=120)
+        if len(done) != 4:
+            print(f"[exporter_smoke] FAIL: cluster completed "
+                  f"{len(done)}/4 requests")
+            return 1
+        scrapes = (
+            (router_url, "router", ("cluster_route_total",
+                                    "cluster_handoff_bytes_total")),
+            (pf_url, "prefill pool", ()),
+            (dc_url, "decode pool", ("serving_kv_injected_total",
+                                     "serving_requests_total")),
+        )
+        for url, label, want in scrapes:
+            if url is None:
+                print(f"[exporter_smoke] FAIL: {label} exported no "
+                      "metrics url")
+                return 1
+            parsed = _scrape_valid(openmetrics, url, want, label)
+            if parsed is None:
+                return 1
+            try:
+                urllib.request.urlopen(url + "/healthz", timeout=10)
+            except urllib.error.HTTPError:
+                pass                      # 503 still answers
+            print(f"[exporter_smoke] {label}: "
+                  f"{len(parsed['samples'])} samples, healthz up")
+        router.close(shutdown_workers=True)
+    finally:
+        for proc in procs:
+            try:
+                proc.terminate()
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+        obs.shutdown()
+    leaked = [t.name for t in threading.enumerate()
+              if t.name == THREAD_NAME]
+    if leaked:
+        print("[exporter_smoke] FAIL: exporter thread survived "
+              "cluster shutdown")
+        return 1
+    print("[exporter_smoke] OK: router + both pools scraped clean")
+    return 0
+
+
 def main() -> int:
     import jax
+
+    # jax<0.9 compatibility shim (a no-op on the target toolchain,
+    # same as bench.py): pinned containers lack jax.typeof, which the
+    # flash-attention gate consults on every prefill
+    if not hasattr(jax, "typeof"):
+        jax.typeof = lambda x: jax.core.get_aval(x)
     import numpy as np
 
     from apex_tpu import observability as obs
@@ -87,7 +193,9 @@ def main() -> int:
         return 1
     print("[exporter_smoke] OK: scrape valid, SLO families present, "
           "clean teardown")
-    return 0
+    if "--skip-cluster" in sys.argv[1:]:
+        return 0
+    return smoke_cluster()
 
 
 if __name__ == "__main__":

@@ -37,10 +37,14 @@ ended — costs the least-valuable stages:
 
 Plus (ISSUE 7): an ``exporter_smoke`` stage early in the campaign
 (serving engine up with live ``/metrics`` export, one scrape validated
-by the strict OpenMetrics parser, clean teardown) and a final
+by the strict OpenMetrics parser, clean teardown — and, ISSUE 9, the
+two-process cluster with router + both pool scrapes) and a final
 ``aggregate_telemetry`` stage that merges the run's JSONL stream(s)
 into ``measure_logs/fleet_aggregate.json`` — exact sketch-merged
 percentiles, the autoscaling-signal substrate of ROADMAP item 4.
+Plus (ISSUE 9): a ``serve_trace`` stage replaying the bursty arrival
+trace against single-engine vs the two-process disaggregated topology
+(CPU-pinned by bench itself — topology cost, not chip rates).
 
 The flat-Adam / LN / flash-s512 win-or-delete decisions fired on the
 2026-07-31 03:46 first contact (BASELINE.md round-5 note); the one
@@ -177,9 +181,21 @@ def main():
     # /metrics scrape validated by the strict OpenMetrics parser, clean
     # teardown.  Cheap, and it gates the serving SLO telemetry the
     # decode stage's BENCH rows now carry.
+    # ISSUE 9: the smoke now also spawns the two-process cluster and
+    # scrapes router + both pools
     results["exporter_smoke"] = _run(
         "exporter_smoke", [sys.executable, "tools/exporter_smoke.py"],
         timeout=900)
+    # cluster serve-trace (ISSUE 9): the bursty open-loop trace
+    # against single-engine vs the two-process prefill/decode
+    # topology.  bench pins the whole run (and the spawned workers)
+    # to CPU — it measures topology cost under identical numerics,
+    # and a second process could not attach to the claimed chip
+    # anyway — so this stage is chip-free by construction.
+    results["serve_trace"] = _run(
+        "serve_trace", [sys.executable, "bench.py", "--serve-trace",
+                        "--cache-layout", "paged"],
+        timeout=1800)
     results["bench_tp_overlap"] = _run(
         "bench_tp_overlap",
         [sys.executable, "bench.py", "--tp-overlap"], timeout=1800)

@@ -1,8 +1,9 @@
-"""Live terminal dashboard over a serving engine's ``/metrics``.
+"""Live terminal dashboard over serving-engine ``/metrics`` endpoints.
 
     python tools/serve_dash.py http://127.0.0.1:9100
     python tools/serve_dash.py --interval 2 127.0.0.1:9100
     python tools/serve_dash.py --once $URL        # one frame, no clear
+    python tools/serve_dash.py $ROUTER $PREFILL $DECODE   # multi-pool
 
 Polls the OpenMetrics endpoint the exporter serves
 (``observability.configure(export_port=...)`` /
@@ -20,7 +21,17 @@ is actually operated on:
   quantile queries identically);
 - per-class goodput rate (``serving.goodput.{met,missed}``) and
   ``/healthz`` (which latches unhealthy on any anomaly-detector
-  firing, SLO violations included).
+  firing, SLO violations — and, on a router, pool stalls — included).
+
+Cluster mode (ISSUE 9): pass SEVERAL urls — one column block per pool
+(a router + its prefill/decode workers each export their own port) —
+and the dashboard renders them all per frame.  A pool whose scrape is
+refused or malformed MID-STARTUP renders as a ``warming up /
+unreachable`` block instead of crashing the loop (workers take seconds
+to come up; a dashboard that dies on the first refused connection is
+useless exactly when you need it), and ``cluster.*`` rows (queue
+depths by class, requeues, handoff bytes) render when the scrape
+carries them.
 
 Deliberately dependency-free: stdlib HTTP + the repo's
 ``openmetrics.py`` parser loaded by file path (itself stdlib-only), so
@@ -113,6 +124,12 @@ def snapshot(om, parsed) -> dict:
     accepted = val("generate_spec_accepted_tokens_total")
     if accepted is None:
         draft = None
+    # router-side cluster gauges/counters (present only on a router
+    # process — absent families simply hide the rows)
+    cluster_q = {}
+    for name, labels, v in parsed["samples"]:
+        if name == "cluster_queue_depth" and "slo_class" in labels:
+            cluster_q[labels["slo_class"]] = v
     return {
         "occupancy": val("serving_slot_occupancy"),
         "queue_depth": val("serving_queue_depth"),
@@ -123,6 +140,10 @@ def snapshot(om, parsed) -> dict:
         "requests": val("serving_requests_total"),
         "spec_accept_rate": (accepted / draft) if draft else None,
         "spec_verify_calls": val("generate_spec_verify_calls_total"),
+        "cluster_queue_depth": cluster_q or None,
+        "cluster_requeued": val("cluster_requeued_total"),
+        "cluster_handoff_bytes": val("cluster_handoff_bytes_total"),
+        "cluster_inflight": val("cluster_inflight"),
         "classes": rows,
     }
 
@@ -152,6 +173,14 @@ def render(snap: dict, health: str, url: str, out=None) -> None:
         p(f"  spec accept-rate {snap['spec_accept_rate']:.1%}   "
           f"verify passes "
           f"{_fmt(snap.get('spec_verify_calls'), '{:.0f}')}")
+    if snap.get("cluster_queue_depth") is not None:
+        depths = "  ".join(
+            f"{cls}:{int(v)}" for cls, v in
+            sorted(snap["cluster_queue_depth"].items()))
+        p(f"  router queues {depths}   inflight "
+          f"{_fmt(snap.get('cluster_inflight'), '{:.0f}')}   "
+          f"requeued {_fmt(snap.get('cluster_requeued'), '{:.0f}')}   "
+          f"handoff {_fmt(snap.get('cluster_handoff_bytes'), '{:.0f}')}B")
     if snap["classes"]:
         p(f"  {'slo_class':<14} {'reqs':>6} {'goodput':>8} "
           f"{'ttft p50':>10} {'ttft p95':>10} {'tpot p50':>10} "
@@ -170,19 +199,44 @@ def render(snap: dict, health: str, url: str, out=None) -> None:
 
 def one_frame(om, base: str, out=None) -> dict:
     """Scrape + validate + render one frame; returns the snapshot
-    (the --once/test entry point)."""
+    (the --once/test entry point).  Raises on a failed/malformed
+    scrape — :func:`pool_frame` is the never-crash wrapper the
+    dashboard loop uses."""
     parsed = om.parse(_fetch(base + "/metrics"))   # raises on malformed
     snap = snapshot(om, parsed)
     render(snap, _healthz(base), base, out=out)
     return snap
 
 
+def pool_frame(om, base: str, label: str = "",
+               out=None) -> Optional[dict]:
+    """One pool's frame block, degradation-tolerant: a refused,
+    timed-out, EMPTY, or malformed ``/metrics`` renders as a
+    ``warming up / unreachable`` line (with the reason) instead of
+    raising — a dashboard over a starting or dying fleet must keep
+    drawing the pools that DO answer.  Returns the snapshot, or None
+    for the degraded frame."""
+    o = sys.stdout if out is None else out
+    if label:
+        print(f"== {label}: {base} ==", file=o)
+    try:
+        return one_frame(om, base, out=out)
+    except Exception as e:
+        print(f"apex_tpu serve dash — {base}   "
+              f"[{time.strftime('%H:%M:%S')}]", file=o)
+        print(f"  (pool warming up / unreachable: "
+              f"{e.__class__.__name__}: {e})", file=o)
+        return None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="Terminal dashboard polling a serving engine's "
-                    "/metrics endpoint.")
-    ap.add_argument("url", help="exporter base URL (host:port or "
-                                "http://host:port)")
+        description="Terminal dashboard polling serving-engine "
+                    "/metrics endpoints (one or many pools).")
+    ap.add_argument("urls", nargs="+", metavar="URL",
+                    help="exporter base URL(s) (host:port or "
+                         "http://host:port); several = one column "
+                         "block per pool (router + workers)")
     ap.add_argument("--interval", type=float, default=2.0, metavar="S",
                     help="poll interval in seconds (default 2)")
     ap.add_argument("--iterations", type=int, default=None, metavar="N",
@@ -190,22 +244,27 @@ def main(argv=None) -> int:
     ap.add_argument("--once", action="store_true",
                     help="print one frame and exit (no screen clear)")
     args = ap.parse_args(argv)
-    base = args.url if "://" in args.url else "http://" + args.url
-    base = base.rstrip("/")
+    bases = [(u if "://" in u else "http://" + u).rstrip("/")
+             for u in args.urls]
+    labels = ([""] if len(bases) == 1
+              else [f"pool {i}" for i in range(len(bases))])
     om = load_openmetrics_module()
+
+    def frame():
+        for base, label in zip(bases, labels):
+            pool_frame(om, base, label)
+            if label:
+                print()
+
     if args.once:
-        one_frame(om, base)
+        frame()
         return 0
     n = 0
     try:
         while args.iterations is None or n < args.iterations:
             frame_t = time.time()
             sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
-            try:
-                one_frame(om, base)
-            except Exception as e:
-                print(f"scrape failed: {e!r} — retrying in "
-                      f"{args.interval:g}s")
+            frame()
             n += 1
             delay = args.interval - (time.time() - frame_t)
             if delay > 0 and (args.iterations is None
