@@ -60,6 +60,13 @@ class TransformerConfig:
     moe_top_k: int = 1
     moe_aux_loss_coeff: float = 1e-2
     moe_ep_axis: str = "ep"                       # expert mesh axis name
+    # 'capacity' = Switch drop-token einsums (GSPMD-inferred EP);
+    # 'ragged' = capacity-free sort-by-expert routing through the
+    # grouped matmul with explicit compressed/overlapped EP dispatch
+    moe_routing: str = "capacity"
+    # EP dispatch/combine wire dtype on the ragged path ('fp32' | 'bf16'
+    # | 'int8' — the grad_comm= surface applied to expert all-to-alls)
+    moe_comm: str = "fp32"
 
     # regularization
     hidden_dropout: float = 0.0
@@ -100,6 +107,14 @@ class TransformerConfig:
                 self, "kv_channels",
                 self.hidden_size // self.num_attention_heads,
             )
+        if self.moe_routing not in ("capacity", "ragged"):
+            raise ValueError(
+                f"moe_routing ({self.moe_routing!r}) must be 'capacity' "
+                "or 'ragged'")
+        if self.moe_comm not in ("fp32", "bf16", "int8"):
+            raise ValueError(
+                f"moe_comm ({self.moe_comm!r}) must be 'fp32', 'bf16' "
+                "or 'int8'")
         if self.num_query_groups is not None:
             if (self.num_query_groups < 1
                     or self.num_attention_heads % self.num_query_groups):

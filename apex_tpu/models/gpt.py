@@ -99,6 +99,14 @@ def make_gpt_train_step(
     serialized all-reduce after the matmul.  Default ``None`` keeps the
     monolithic collectives unless an enclosing
     ``collective_matmul.overlap_scope`` turns the ring on.
+
+    MoE configs (``cfg.num_experts``) additionally honor
+    ``cfg.moe_routing``/``cfg.moe_comm``: ``moe_routing='ragged'`` makes
+    every expert layer capacity-free (no dropped tokens, no pad slots)
+    with its EP dispatch/combine running explicitly through the counted
+    ``all_to_all`` wrappers at ``moe_comm`` wire precision — and the
+    same ``overlap_comm`` scope that rings the TP exits also rings the
+    expert dispatch/combine (per-hop expert compute inside the ring).
     """
     if context_parallel:
         if cfg.attn_mask_type == "padding":

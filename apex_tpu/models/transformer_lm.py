@@ -688,10 +688,15 @@ def _row_parallel_out(ctx: TPContext, x, w):
 
 
 def _moe_mlp(cfg: TransformerConfig, lp: dict, x):
-    """Switch MoE FFN (transformer/moe.py) in place of the dense MLP when
+    """MoE FFN (transformer/moe.py) in place of the dense MLP when
     ``cfg.num_experts`` is set; returns (out, aux_loss).  Experts shard
-    over the 'ep' mesh axis under GSPMD; tp inside experts is not
-    combined (experts ARE the parallelism for the FFN block)."""
+    over the 'ep' mesh axis — via GSPMD annotations on the capacity
+    path, or the explicit compressed/ring-overlapped shard_map island on
+    the ragged path (``cfg.moe_routing='ragged'``, wire dtype
+    ``cfg.moe_comm``; overlap follows the ambient
+    ``collective_matmul.overlap_scope`` the train step sets).  tp inside
+    experts is not combined (experts ARE the parallelism for the FFN
+    block)."""
     from apex_tpu.transformer.moe import switch_moe_mlp
 
     moe_params = {
@@ -706,7 +711,9 @@ def _moe_mlp(cfg: TransformerConfig, lp: dict, x):
         capacity_factor=cfg.moe_capacity_factor,
         top_k=cfg.moe_top_k,
         ep_axis=cfg.moe_ep_axis,
-        activation=cfg.activation)
+        activation=cfg.activation,
+        routing=cfg.moe_routing,
+        moe_comm=cfg.moe_comm)
     return o.out, o.aux_loss
 
 

@@ -49,6 +49,12 @@ import numpy as np
 # of a measurement
 if not hasattr(jax, "typeof"):
     jax.typeof = lambda x: jax.core.get_aval(x)
+if not hasattr(jax.sharding, "get_abstract_mesh"):
+    # same family: the sharding-constraint helpers ask for the ambient
+    # abstract mesh; on 0.4.x "no mesh context" (None) is the correct
+    # answer, and without it every capacity-MoE row (gpt_moe_8e, the
+    # --moe capacity ablation row) errors instead of measuring
+    jax.sharding.get_abstract_mesh = lambda: None
 
 from apex_tpu.models.config import bert_large, gpt_125m
 from apex_tpu.models.bert import make_bert_train_step
@@ -1247,6 +1253,139 @@ def bench_tp_overlap(on_tpu):
     return rows
 
 
+def bench_moe_ablation(on_tpu):
+    """Routing x wire-dtype x overlap ablation for the expert-parallel
+    MoE fast path (``--moe``, ROADMAP item 5): the GPT-MoE geometry
+    trained through ``make_gpt_train_step`` over an (ep, dp) mesh of
+    every visible device — one row per (routing, moe_comm, overlap_comm)
+    combination with the trace-time ``moe.*`` dispatch/ring counters
+    alongside tokens/s — plus the *dense twin at matched active params
+    per token* (same hidden/ffn/layers, no experts), the headline
+    comparison: a top-1 MoE moves the same per-token FLOPs as its dense
+    twin, so ragged tokens/s over dense tokens/s is the routing +
+    dispatch overhead the fast path exists to shrink.  On a 1-chip
+    window ep=1 keeps the island inapplicable (dispatch bytes stay 0) —
+    the rows exist so the next multi-chip window can run
+    ``python bench.py --moe`` and read the crossover directly.
+
+    Also sets the ``moe.expert_load_max``/``moe.expert_load_mean``
+    gauges host-side from a routing probe (``MoEOutput.expert_load``),
+    the load-imbalance signal ``tools/telemetry_report.py``'s MoE
+    summary reads."""
+    import math
+
+    from apex_tpu.observability import metrics as _telemetry
+    from apex_tpu.parallel.mesh import create_mesh
+
+    ndev = len(jax.devices())
+    if on_tpu:
+        batch, seq, iters, E = 8, 512, 10, 8
+        dims = dict(num_layers=12, hidden_size=768,
+                    num_attention_heads=12, vocab_size=50304,
+                    max_position_embeddings=seq, remat=False,
+                    scan_layers=False)
+    else:
+        batch, seq, iters, E = 2, 64, 2, 4
+        dims = dict(num_layers=2, hidden_size=128,
+                    num_attention_heads=4, vocab_size=1024,
+                    max_position_embeddings=seq, remat=False)
+    ep = math.gcd(ndev, E)
+    dp = ndev // ep
+    # a 1-device window gets the meshless step (the island then falls
+    # back to the local ragged math — rows still carry their counters)
+    mesh = create_mesh(dp=dp, ep=ep) if ndev > 1 else None
+    batch = batch * dp
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, dims["vocab_size"], (batch, seq)),
+                         jnp.int32)
+    labels = jnp.asarray(rng.randint(0, dims["vocab_size"], (batch, seq)),
+                         jnp.int32)
+
+    def run_row(name, cfg, overlap=None):
+        init, step = make_gpt_train_step(
+            cfg, fused_adam(lr=1e-4), "O2", mesh, overlap_comm=overlap)
+        state = init(jax.random.PRNGKey(0))
+        reg = _telemetry.registry()
+        base = (tuple(reg.counter(f"moe.{c}").value for c in
+                      ("dispatch_bytes", "dispatch_raw_bytes",
+                       "ring_calls", "ring_hops"))
+                if reg is not None else (0, 0, 0, 0))
+
+        def one(carry, step=step, state=state):
+            s = carry[0] if carry else state
+            s, m = step(s, tokens, labels)
+            return s, m["loss"]
+
+        sec = _time_fn(one, iters=iters, name=f"gpt_moe_{name}")
+        row = {
+            "tokens_per_sec": round(batch * seq / sec, 1),
+            "step_ms": round(sec * 1e3, 2),
+            "ep": ep, "dp": dp,
+        }
+        if reg is not None:
+            now = tuple(reg.counter(f"moe.{c}").value for c in
+                        ("dispatch_bytes", "dispatch_raw_bytes",
+                         "ring_calls", "ring_hops"))
+            row.update(
+                dispatch_bytes_per_trace=int(now[0] - base[0]),
+                dispatch_raw_bytes_per_trace=int(now[1] - base[1]),
+                ring_calls_per_trace=int(now[2] - base[2]),
+                ring_hops_per_trace=int(now[3] - base[3]),
+            )
+        del state
+        return row
+
+    from apex_tpu.models.config import TransformerConfig
+
+    def safe_row(rows, key, *args, **kw):
+        try:
+            rows[key] = run_row(*args, **kw)
+        except Exception as e:        # keep the other ablation rows alive
+            rows[key] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+    rows = {}
+    safe_row(rows, "dense", "dense", TransformerConfig(**dims))
+    safe_row(rows, "capacity", "capacity",
+             TransformerConfig(num_experts=E, **dims))
+    for wire in ("fp32", "bf16", "int8"):
+        for ov_name, ov in (("off", False), ("on", True)):
+            safe_row(
+                rows, f"ragged_{wire}_overlap_{ov_name}",
+                f"ragged_{wire}_{ov_name}",
+                TransformerConfig(num_experts=E, moe_routing="ragged",
+                                  moe_comm=wire, **dims),
+                overlap=ov)
+
+    # expert-load imbalance gauges from a routing probe: the data-
+    # dependent load cannot ride trace-time counters, so bench samples
+    # it host-side from MoEOutput.expert_load (no-op when telemetry is
+    # unconfigured — module-level gauge helpers fast-path)
+    from apex_tpu.transformer.moe import init_moe_params, switch_moe_mlp
+
+    h = dims["hidden_size"]
+    probe = switch_moe_mlp(
+        init_moe_params(jax.random.PRNGKey(1), h, 4 * h, E),
+        jnp.asarray(rng.randn(2, seq, h) * 0.5, jnp.float32),
+        ep_axis=None, routing="ragged")
+    load = np.asarray(probe.expert_load, np.float64)
+    _telemetry.gauge("moe.expert_load_max").set(float(load.max()))
+    _telemetry.gauge("moe.expert_load_mean").set(float(load.mean()))
+    rows["expert_load"] = {
+        "max": float(load.max()), "mean": float(load.mean()),
+        "imbalance": round(float(load.max() / max(load.mean(), 1e-9)),
+                           3),
+    }
+
+    # the headline: MoE tokens/s vs dense at matched active params
+    dense_tps = rows["dense"].get("tokens_per_sec", 0.0)
+    for key in ("capacity", "ragged_fp32_overlap_off"):
+        tps = rows.get(key, {}).get("tokens_per_sec", 0.0)
+        if dense_tps and tps:
+            rows[f"{key}_over_dense_matched_active"] = round(
+                tps / dense_tps, 3)
+    return rows
+
+
 # the inference rows, shared by the full matrix and --decode so the two
 # run modes can never report differently-configured rows under one name
 _DECODE_ROWS = (
@@ -1303,6 +1442,12 @@ def main():
         help="run ONLY the ring collective-matmul TP-overlap ablation "
              "rows (bench_tp_overlap, overlap_comm off vs on) instead "
              "of the full matrix")
+    parser.add_argument(
+        "--moe", action="store_true",
+        help="run ONLY the expert-parallel MoE ablation rows "
+             "(bench_moe_ablation: routing x wire dtype x overlap, "
+             "plus the dense twin at matched active params — the "
+             "headline MoE-vs-dense row) instead of the full matrix")
     parser.add_argument(
         "--decode", action="store_true",
         help="run ONLY the inference rows (prefill/decode split + GQA "
@@ -1395,6 +1540,20 @@ def main():
             "schema_version": SCHEMA_VERSION,
             "metric": "gpt_ddp_grad_comm_ablation",
             "value": rows.get(wires[0], {}).get("tokens_per_sec", 0.0),
+            "unit": "tokens/s",
+            "details": rows,
+            "runtime": runtime_summary(),
+        }))
+        return
+    if args.moe:
+        rows = bench_moe_ablation(on_tpu)
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "metric": "gpt_moe_ep_ablation",
+            # headline: ragged MoE tokens/s (dense twin + the
+            # matched-active-params ratio ride in the details)
+            "value": rows.get("ragged_fp32_overlap_off", {}).get(
+                "tokens_per_sec", 0.0),
             "unit": "tokens/s",
             "details": rows,
             "runtime": runtime_summary(),

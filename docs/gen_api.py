@@ -51,6 +51,8 @@ MODULES = [
     ("apex_tpu.ops.flat_adam", "ops", "ops.flat_adam — flat Adam"),
     ("apex_tpu.ops.collective_matmul", "ops",
      "ops.collective_matmul — overlapped ring TP collectives"),
+    ("apex_tpu.ops.grouped_matmul", "ops",
+     "ops.grouped_matmul — ragged expert segment matmul"),
     ("apex_tpu.ops.paged_attention", "ops",
      "ops.paged_attention — ragged paged-attention decode kernel"),
     ("apex_tpu.ops.fused_sampling", "ops",

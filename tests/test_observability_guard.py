@@ -56,6 +56,13 @@ _MEM_GATE = re.compile(r"enabled\(\)|is not None|is None|emit=False")
 # fork the accept-rate accounting telemetry_report/serve_dash read
 _SPEC_COUNTER = re.compile(r"[\"']generate\.spec\.")
 _SPEC_HELPER = re.compile(r"_telemetry\s*\.\s*counter\s*\(")
+# the expert-parallel MoE telemetry (ISSUE 10): every moe.* metric
+# touch must ride a module-level helper on the same statement — the
+# dispatch-byte/ring-hop counters feed telemetry_report's MoE summary
+# and the moe_ep dryrun gate's wire-ratio assertion, so a second
+# (unguarded) access idiom would fork that accounting
+_MOE_METRIC = re.compile(r"[\"']moe\.")
+_MOE_HELPER = re.compile(r"_telemetry\s*\.\s*(counter|gauge)\s*\(")
 
 
 def _py_files():
@@ -202,6 +209,29 @@ def test_spec_counters_use_the_helper_only():
         + "\n".join(offenders))
 
 
+def test_moe_metrics_use_the_helpers_only():
+    """Every ``moe.*`` metric touch in ``apex_tpu/`` must go through
+    ``_telemetry.counter(...)`` / ``_telemetry.gauge(...)`` on the same
+    statement (the no-op-fast-path helpers): the dispatch-byte and
+    ring-hop counters are asserted against by the ``moe_ep`` dryrun
+    phase and summarized by telemetry_report's MoE view."""
+    offenders = []
+    for path in _py_files():
+        if _in_obs(path):
+            continue
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                if not _MOE_METRIC.search(line):
+                    continue
+                if _MOE_HELPER.search(line):
+                    continue
+                offenders.append(f"{path}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "moe.* metrics must be accessed via _telemetry.counter(...)/"
+        "_telemetry.gauge(...) on the same statement:\n"
+        + "\n".join(offenders))
+
+
 def test_guard_patterns_actually_match():
     """The guard is only as good as its regexes: each must match its
     own anti-pattern (a regression here silently disables the guard)."""
@@ -216,6 +246,14 @@ def test_guard_patterns_actually_match():
         '_telemetry.counter("generate.spec.draft_tokens").inc(2)')
     assert not _SPEC_COUNTER.search(
         "the generate.spec.draft_tokens counter (docs)")
+    assert _MOE_METRIC.search(
+        'reg.counter("moe.dispatch_bytes").inc(8)')
+    assert _MOE_HELPER.search(
+        '_telemetry.gauge("moe.dropped_fraction").set(0.0)')
+    assert _MOE_HELPER.search(
+        '_telemetry.counter("moe.ring_hops").inc(7)')
+    assert not _MOE_METRIC.search(
+        "the moe.ring_hops invariant (docs)")
     assert _PRIVATE_GLOBAL.search("from x import _REGISTRY")
     assert _MEM_SAMPLE.search("sample_device_memory()")
     assert _EXPORTER_IMPORT.search(

@@ -353,3 +353,45 @@ def test_window_cli_flag(aggregate, tmp_path, capsys):
     assert agg["window"] == 1
     assert agg["sketches"][
         "serving.ttft_ms{slo_class=interactive}"]["count"] == 2
+
+
+def test_moe_summary_from_stream(report, tmp_path):
+    """The ISSUE-10 MoE view: wire-vs-raw dispatch ratio, the
+    hops == (ep-1) x calls ring check with the implied ep, and the
+    expert-load imbalance from the bench-probe gauges."""
+    f = tmp_path / "moe.jsonl"
+    f.write_text(
+        '{"schema_version":3,"t":1,"type":"counter",'
+        '"name":"moe.dispatch_bytes","value":72000}\n'
+        '{"schema_version":3,"t":1,"type":"counter",'
+        '"name":"moe.dispatch_raw_bytes","value":256000}\n'
+        '{"schema_version":3,"t":1,"type":"counter",'
+        '"name":"moe.ring_calls","value":6}\n'
+        '{"schema_version":3,"t":1,"type":"counter",'
+        '"name":"moe.ring_hops","value":42}\n'
+        '{"schema_version":3,"t":2,"type":"gauge",'
+        '"name":"moe.expert_load_max","value":24}\n'
+        '{"schema_version":3,"t":2,"type":"gauge",'
+        '"name":"moe.expert_load_mean","value":16}\n')
+    summ = report.summarize(report.load_records([str(f)]))
+    moe = report.moe_summary(summ)
+    assert moe is not None
+    assert moe["wire_over_raw"] == pytest.approx(72000 / 256000)
+    assert moe["hops_per_call"] == pytest.approx(7.0)
+    assert moe["ep"] == 8                    # hops/call + 1
+    assert moe["load_imbalance"] == pytest.approx(1.5)
+    import io
+    out = io.StringIO()
+    report.print_report(summ, out=out)
+    text = out.getvalue()
+    assert "expert-parallel MoE" in text
+    assert "ep 8" in text
+    assert "imbalance 1.5" in text
+
+
+def test_moe_summary_absent_for_dense_streams(report, tmp_path):
+    f = tmp_path / "dense.jsonl"
+    f.write_text('{"schema_version":3,"t":1,"type":"counter",'
+                 '"name":"collectives.ring.calls","value":2}\n')
+    summ = report.summarize(report.load_records([str(f)]))
+    assert report.moe_summary(summ) is None

@@ -204,6 +204,19 @@ def main():
         [sys.executable, "-c",
          "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
         env_extra={"APEX_TPU_DRYRUN_PHASE": "tp_overlap"}, timeout=1800)
+    # MoE expert-parallel fast path (ISSUE 10): the routing x wire x
+    # overlap ablation rows (ragged vs capacity vs the dense twin at
+    # matched active params), then the moe_ep dryrun parity phase on
+    # the 8-virtual-device ep mesh (ragged == capacity fwd+bwd, int8
+    # dispatch wire < 0.3x raw, moe.ring hop invariant)
+    results["bench_moe"] = _run(
+        "bench_moe", [sys.executable, "bench.py", "--moe"],
+        timeout=1800)
+    results["dryrun_moe_ep"] = _run(
+        "dryrun_moe_ep",
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        env_extra={"APEX_TPU_DRYRUN_PHASE": "moe_ep"}, timeout=1800)
     results["tpu_tier"] = _run(
         "tpu_tier", [sys.executable, "-m", "pytest",
                      "tests/test_on_tpu_kernels.py", "-m", "tpu", "-q"],

@@ -261,6 +261,46 @@ def spec_summary(counters: Dict[str, float]) -> Optional[dict]:
     }
 
 
+def moe_summary(summary: dict) -> Optional[dict]:
+    """Derived view of the expert-parallel MoE telemetry (``moe.*``,
+    ISSUE 10): dispatch wire bytes vs the raw fp32 payload (the
+    compression the EP fast path actually achieved on the wire), the
+    ring hop check — each MoE ring books exactly ep−1 hops, so
+    ``hops == (ep−1) × calls`` and the implied ep falls out — and the
+    expert-load imbalance max/mean ratio from the bench-probe gauges
+    (1.0 = perfectly balanced routing).  None when the stream carries
+    no MoE series (dense models, pre-ISSUE-10 writers)."""
+    counters = summary["counters"]
+    gauges = summary["gauges"]
+    wire = counters.get("moe.dispatch_bytes", 0.0)
+    raw = counters.get("moe.dispatch_raw_bytes", 0.0)
+    calls = counters.get("moe.ring_calls", 0.0)
+    load_max = gauges.get("moe.expert_load_max")
+    if not (wire or raw or calls or load_max):
+        return None
+    out = {
+        "dispatch_bytes": wire,
+        "dispatch_raw_bytes": raw,
+        "wire_over_raw": (wire / raw) if raw else None,
+        "ring_calls": calls,
+        "ring_hops": counters.get("moe.ring_hops", 0.0),
+        "hops_per_call": None,
+        "ep": None,
+    }
+    if calls:
+        per = out["ring_hops"] / calls
+        out["hops_per_call"] = per
+        if abs(per - round(per)) < 1e-9:
+            out["ep"] = int(round(per)) + 1
+    if load_max:
+        lmax = load_max[-1]
+        lmean = (gauges.get("moe.expert_load_mean") or [0.0])[-1]
+        out["expert_load_max"] = lmax
+        out["expert_load_mean"] = lmean
+        out["load_imbalance"] = (lmax / lmean) if lmean else None
+    return out
+
+
 def serving_summary(summary: dict) -> Optional[dict]:
     """Derived view of the paged serving engine's telemetry (ISSUE 6):
     block-pool high-water mark, preemption rate per admitted request,
@@ -368,6 +408,29 @@ def print_report(summary: dict, out=None) -> None:
             print(f"  verify calls {spec['verify_calls']:g} -> "
                   f"tokens/verify {spec['tokens_per_verify']:.3g} "
                   "(amortization; ceiling is k+1)", file=out)
+    moe = moe_summary(summary)
+    if moe:
+        print("== expert-parallel MoE (moe.*) ==", file=out)
+        if moe["dispatch_raw_bytes"]:
+            print(f"  dispatch wire {moe['dispatch_bytes']:g} / raw "
+                  f"{moe['dispatch_raw_bytes']:g} -> "
+                  f"{moe['wire_over_raw']:.3g}x on the wire", file=out)
+        if moe["ring_calls"]:
+            if moe["ep"] is not None:
+                print(f"  ring calls {moe['ring_calls']:g}  hops "
+                      f"{moe['ring_hops']:g} -> hops/call "
+                      f"{moe['hops_per_call']:g} -> ep "
+                      f"{moe['ep']}", file=out)
+            else:
+                print(f"  ring hops/call {moe['hops_per_call']:.3g} — "
+                      "NOT an integer: the stream mixes ep sizes; the "
+                      "invariant hops == (ep-1) x calls still holds "
+                      "within each", file=out)
+        if moe.get("load_imbalance") is not None:
+            print(f"  expert load max {moe['expert_load_max']:g} / "
+                  f"mean {moe['expert_load_mean']:g} -> imbalance "
+                  f"{moe['load_imbalance']:.3g} (1.0 = balanced)",
+                  file=out)
     serving = serving_summary(summary)
     if serving:
         print("== paged serving (serving.blocks_*) ==", file=out)
