@@ -39,6 +39,7 @@ from apex_tpu.utils.logging import get_logger  # noqa: F401
 # compatibility/amp_C.py:4-37, without the JIT-build machinery TPUs don't need).
 _LAZY_SUBMODULES = (
     "amp",
+    "checkpoint",
     "comm",
     "optimizers",
     "ops",

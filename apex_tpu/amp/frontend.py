@@ -34,6 +34,8 @@ __all__ = [
     "make_train_step",
     "state_dict",
     "load_state_dict",
+    "save_train_state",
+    "restore_train_state",
 ]
 
 
@@ -435,3 +437,43 @@ def load_state_dict(d: dict) -> scaler_lib.LossScaleState:
         loss_scale=jnp.asarray(entry["loss_scale"], jnp.float32),
         unskipped=jnp.asarray(entry["unskipped"], jnp.int32),
     )
+
+
+# ---- full-state sharded checkpointing (ISSUE 11) -----------------------------
+#
+# state_dict/load_state_dict above serialize ONLY the scaler (the
+# reference surface); a fault-tolerant run must persist the complete
+# TrainState — params, fp32 masters, optimizer moments, the comm_state
+# error-feedback residuals, the scaler's mid-doubling window, and the
+# step counter — bitwise, or the resumed loss trajectory diverges from
+# the unkilled run.  These hooks delegate to apex_tpu.checkpoint (per-
+# process shard files + an atomically committed manifest; async save
+# via checkpoint.AsyncCheckpointer; detector-driven rollback via
+# checkpoint.RecoveryManager — see docs/training.md).
+
+
+def save_train_state(directory: str, step: int, state: TrainState, *,
+                     keep=None, extra=None) -> str:
+    """Synchronously snapshot a full :class:`TrainState` (every leaf,
+    including ``comm_state`` residuals and the loss-scaler window) as
+    a committed sharded checkpoint.  Training loops should prefer
+    ``apex_tpu.checkpoint.AsyncCheckpointer`` — this is the blocking
+    one-shot form (final save, tooling)."""
+    from apex_tpu.checkpoint import save_sharded
+
+    return save_sharded(directory, step, state, keep=keep, extra=extra)
+
+
+def restore_train_state(directory: str, state_like: TrainState, *,
+                        step=None, reshard: bool = False) -> TrainState:
+    """Restore a :class:`TrainState` snapshot into the structure and
+    shardings of ``state_like`` (pass the freshly ``init_fn``-built
+    state).  Validates tree structure, shapes, dtypes and mesh
+    geometry, checks content digests, and replays bitwise — the
+    resumed trajectory is identical to an unkilled run's.
+    ``reshard=True`` permits a different mesh geometry (elastic world
+    size; shards reassemble through the manifest's layout metadata)."""
+    from apex_tpu.checkpoint import restore_sharded
+
+    return restore_sharded(directory, state_like, step=step,
+                           reshard=reshard)

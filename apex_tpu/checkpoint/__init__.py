@@ -1,0 +1,75 @@
+"""apex_tpu.checkpoint — elastic fault-tolerant training state (ISSUE 11).
+
+The serving tier survives worker death (the cluster router requeues
+in-flight requests); this package makes *training* survive: one
+preemption, NaN cascade, or host loss must cost at most the steps since
+the last snapshot, never the run.  Three layers:
+
+- :mod:`apex_tpu.checkpoint.sharded` — the on-disk format: each process
+  persists only the array shards it owns (per-process ``.bin`` files,
+  one contiguous buffer per shard, content-digested) plus ONE
+  atomically committed ``MANIFEST.json`` (write-temp-then-rename) that
+  records every leaf's tree path, shape, dtype, mesh geometry and
+  per-shard layout.  A checkpoint either has a valid manifest or it
+  does not exist; readers never see a torn snapshot.  Restore validates
+  structure/shape/dtype/mesh against the live state and replays
+  **bitwise** — including the ``comm_state`` error-feedback residuals
+  and the loss scaler's mid-doubling window — so a resumed run's loss
+  trajectory is identical to an unkilled one.  The manifest's per-leaf
+  layout metadata also supports restoring onto a *different* mesh
+  (``reshard=True``): shards are reassembled into the global array and
+  re-placed under the new sharding (elastic world size).
+- :mod:`apex_tpu.checkpoint.async_saver` — the zero-stall save path:
+  ``save()`` starts the device→host copies asynchronously and hands the
+  file writing to a background thread, so the train loop dispatches the
+  next step's forward while the previous state persists.  Telemetry
+  (``checkpoint.{save_ms,bytes,overlap_ratio}``) quantifies the overlap
+  through the existing registry/span machinery; ``bench.py --ckpt``
+  pins the steady-state overhead.
+- :mod:`apex_tpu.checkpoint.recovery` — detector-driven in-job
+  recovery: a NaN / loss-spike / grad-norm firing from
+  :mod:`apex_tpu.observability.detectors` triggers automatic
+  rollback-to-last-good plus an LR re-warm window instead of a dead
+  job, with the flight recorder documenting the incident
+  (``anomaly.rollback`` event + post-mortem dump).
+
+See docs/training.md for the layout, retention and recovery runbook.
+"""
+
+from apex_tpu.checkpoint.sharded import (  # noqa: F401
+    MANIFEST_NAME,
+    MANIFEST_SCHEMA_VERSION,
+    CheckpointError,
+    all_steps,
+    latest_step,
+    load_manifest,
+    prune_checkpoints,
+    restore_sharded,
+    save_sharded,
+)
+from apex_tpu.checkpoint.async_saver import (  # noqa: F401
+    AsyncCheckpointer,
+    SaveResult,
+)
+from apex_tpu.checkpoint.recovery import (  # noqa: F401
+    RecoveryGivingUp,
+    RecoveryManager,
+    RollbackConfig,
+)
+
+__all__ = [
+    "MANIFEST_NAME",
+    "MANIFEST_SCHEMA_VERSION",
+    "CheckpointError",
+    "AsyncCheckpointer",
+    "SaveResult",
+    "RecoveryGivingUp",
+    "RecoveryManager",
+    "RollbackConfig",
+    "all_steps",
+    "latest_step",
+    "load_manifest",
+    "prune_checkpoints",
+    "restore_sharded",
+    "save_sharded",
+]

@@ -1,0 +1,221 @@
+"""Async checkpointing: device→host copy + file write off the step path.
+
+JAX dispatch is asynchronous, and so is the device→host DMA once
+``copy_to_host_async`` has been issued — the only part of a snapshot
+that *must* run on the train-loop thread is issuing those copies (a
+microseconds-per-leaf host call).  :class:`AsyncCheckpointer.save`
+does exactly that and returns; a background thread then materializes
+the host buffers (blocking only itself on the in-flight DMA), digests
+them, writes the shard file and commits the manifest — all overlapped
+with the forward of the next step the loop already dispatched.  At
+most one save is in flight: a new ``save`` first waits out the
+previous write, so host memory for snapshots is bounded at one state.
+
+Telemetry (no-op fast path when unconfigured, like every subsystem):
+
+- span ``checkpoint.save`` — background wall time per snapshot (the
+  number ``tools/telemetry_report.py`` summarizes as save p50/p95);
+- span ``checkpoint.blocking`` — the train-loop-thread time ``save()``
+  actually stole (issue-copies + bookkeeping);
+- gauge ``checkpoint.overlap_ratio`` — ``1 − blocking/total``: 1.0
+  means the write was entirely hidden behind the next step;
+- counters ``checkpoint.bytes`` / ``checkpoint.saves``;
+- event ``checkpoint.committed`` per durable manifest.
+
+``bench.py --ckpt`` pins the acceptance number: steady-state step time
+with async saves inside the timed window vs without.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, NamedTuple, Optional
+
+import jax
+
+from apex_tpu.checkpoint import sharded as _sharded
+from apex_tpu.observability import metrics as _telemetry
+
+__all__ = ["AsyncCheckpointer", "SaveResult"]
+
+
+class SaveResult(NamedTuple):
+    """What one completed async save measured."""
+
+    step: int
+    path: str
+    bytes: int
+    save_ms: float        # background thread wall (copy-wait + write)
+    blocking_ms: float    # train-loop thread time save() consumed
+    overlap_ratio: float  # 1 - blocking / (blocking + background)
+
+
+# ONE jitted identity for the whole array set: without donation XLA
+# must produce fresh output buffers, so this IS a device-side copy —
+# and one async jit dispatch instead of a per-leaf eager op chain
+# keeps the train-loop thread's cost at microseconds.  Cached per
+# pytree structure/shapes by jit itself.
+_jit_copy = None
+
+
+def _device_copy(arrs):
+    global _jit_copy
+    if _jit_copy is None:
+        import jax.numpy as jnp
+
+        _jit_copy = jax.jit(
+            lambda xs: tuple(jnp.copy(x) for x in xs))
+    return _jit_copy(tuple(arrs))
+
+
+def _snapshot(state: Any) -> Any:
+    """Donation-safe device-side snapshot, dispatched asynchronously.
+
+    Training steps donate their state (``donate_argnums`` halves peak
+    memory), which DELETES the old buffers once the next step runs —
+    so the background writer must never read the caller's arrays.
+    One jitted copy over every ``jax.Array`` leaf dispatches an
+    on-device identity into the same execution stream (it completes
+    before the next step's donated reuse, by data dependency) and
+    hands back fresh buffers only this saver references.  Then the D2H
+    DMA is issued per shard without blocking, so the background
+    thread's ``np.asarray`` overlaps the transfer with the next step's
+    compute instead of serializing behind it.  Cost: one transient
+    state-sized device allocation per in-flight save (bounded at one
+    by :meth:`AsyncCheckpointer.save`).  Non-array leaves pass through
+    untouched (never traced — a python float must not come back as a
+    weakly-typed device array in the manifest).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    idx = [i for i, leaf in enumerate(leaves)
+           if isinstance(leaf, jax.Array)]
+    if idx:
+        copies = _device_copy([leaves[i] for i in idx])
+        for i, c in zip(idx, copies):
+            leaves[i] = c
+    snap = jax.tree_util.tree_unflatten(treedef, leaves)
+    for i in idx:
+        try:
+            for sh in leaves[i].addressable_shards:
+                sh.data.copy_to_host_async()
+        except Exception:
+            # a backend without async copies just pays the wait on the
+            # background thread — correctness is unaffected
+            pass
+    return snap
+
+
+class AsyncCheckpointer:
+    """Overlapped sharded checkpointing for a training loop::
+
+        with AsyncCheckpointer(ckpt_dir, keep=3) as ckpt:
+            for step in loop:
+                state, metrics = train_step(state, batch)
+                if step % every == 0:
+                    ckpt.save(step, state)   # returns immediately
+        # exit waits until the last manifest is committed
+
+    ``keep`` is the retention policy applied after each commit.  A
+    failed background write re-raises from the NEXT ``save``/``wait``
+    call (a checkpointing loop must not die silently — but also must
+    not die on the step that happened to poll).
+    """
+
+    def __init__(self, directory: str, *, keep: Optional[int] = 3,
+                 process_index: Optional[int] = None):
+        self.directory = directory
+        self.keep = keep
+        self.process_index = process_index
+        self.last_result: Optional[SaveResult] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, step: int, state: Any,
+             extra: Optional[dict] = None) -> None:
+        """Snapshot ``state`` asynchronously (see module docstring)."""
+        self.wait()   # bound in-flight saves (and surface prior errors)
+        t0 = time.perf_counter()
+        # donation-safe: the background thread reads the SNAPSHOT's
+        # buffers, never the caller's — the loop is free to donate its
+        # state to the next step immediately
+        snap = _snapshot(state)
+        blocking_s = time.perf_counter() - t0
+        self._thread = threading.Thread(
+            target=self._write, args=(int(step), snap, extra, blocking_s),
+            name="apex-tpu-ckpt-writer", daemon=True)
+        self._thread.start()
+
+    def _write(self, step: int, state: Any, extra: Optional[dict],
+               blocking_s: float) -> None:
+        t0 = time.perf_counter()
+        try:
+            # return_stats: this process's payload bytes come back
+            # directly — only process 0 ever owns the merged manifest,
+            # so re-reading it here would fail on every other rank
+            path, nbytes = _sharded.save_sharded(
+                self.directory, step, state,
+                process_index=self.process_index, keep=self.keep,
+                extra=extra, return_stats=True)
+        except BaseException as e:   # surfaced from the next save/wait
+            self._error = e
+            return
+        bg_s = time.perf_counter() - t0
+        total = blocking_s + bg_s
+        result = SaveResult(
+            step=step, path=path, bytes=nbytes,
+            save_ms=bg_s * 1e3, blocking_ms=blocking_s * 1e3,
+            overlap_ratio=(1.0 - blocking_s / total) if total > 0 else 1.0)
+        self.last_result = result
+        reg = _telemetry.registry()
+        if reg is not None:
+            reg.observe_span("checkpoint.save", bg_s, step=step)
+            reg.observe_span("checkpoint.blocking", blocking_s, step=step)
+            _telemetry.gauge("checkpoint.overlap_ratio").set(
+                result.overlap_ratio)
+            _telemetry.counter("checkpoint.bytes").inc(nbytes)
+            _telemetry.counter("checkpoint.saves").inc()
+            _telemetry.event("checkpoint.committed", step=step, path=path,
+                             bytes=nbytes,
+                             save_ms=round(result.save_ms, 3),
+                             blocking_ms=round(result.blocking_ms, 3))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def wait(self) -> Optional[SaveResult]:
+        """Block until the in-flight save (if any) is durable; re-raise
+        a background failure; return the last completed result."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise CheckpointWriteError(
+                f"background checkpoint write failed: {err}") from err
+        return self.last_result
+
+    def close(self) -> None:
+        self.wait()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        # let an in-flight exception propagate un-shadowed: only wait
+        # cleanly on the no-exception path
+        if exc and exc[0] is not None:
+            try:
+                self.wait()
+            except Exception:
+                pass
+            return False
+        self.close()
+        return False
+
+
+class CheckpointWriteError(_sharded.CheckpointError):
+    """An async background write failed (re-raised on the next
+    ``save``/``wait`` so the loop learns about it deterministically)."""

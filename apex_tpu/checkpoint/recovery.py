@@ -1,0 +1,287 @@
+"""Detector-driven in-job recovery: rollback-to-last-good + LR re-warm.
+
+A NaN cascade or loss spike used to mean a dead job: the flight
+recorder dumps a post-mortem, the process exits, a human restarts it.
+This module closes the loop instead.  :class:`RecoveryManager` sits at
+the step boundary of a training loop::
+
+    mgr = RecoveryManager(ckpt_dir, save_every=100, keep=3)
+    for batch in data:
+        state, metrics = step_fn(state, *batch)
+        record_step_metrics(metrics)          # feeds the detectors
+        state, rolled_back = mgr.after_step(state, metrics)
+        if rolled_back:
+            step_fn = rebuild_step(lr=mgr.rewarm_schedule(base_lr))
+
+``after_step`` watches the anomaly stream the detectors
+(:mod:`apex_tpu.observability.detectors`) already produce from the
+metrics dict — NaN/Inf first-seen, loss spike, grad-norm explosion by
+default.  On a firing it:
+
+1. waits out any in-flight async save (a snapshot initiated from a
+   *pre*-anomaly state is still good — poisoned states are never saved
+   because the anomaly check runs before the save decision);
+2. restores the newest committed checkpoint **bitwise** into the live
+   state's structure/shardings;
+3. opens an LR re-warm window (``lr_scale()`` ramps from
+   ``lr_scale_floor`` back to 1.0 over ``rewarm_steps`` steps measured
+   from the restored step index);
+4. documents the incident: ``anomaly.rollback`` event +
+   ``checkpoint.rollbacks`` counter + flight-recorder notification
+   (post-mortem dump on first blood), all via
+   ``DetectorBank.record_rollback`` — and re-arms the NaN latch so a
+   *second* divergence after recovery is detected, not ignored.
+
+Telemetry-free loops still recover: without a configured registry the
+manager falls back to its own non-finite-loss check.
+
+``max_rollbacks`` bounds the loop: a run that keeps diverging after N
+recoveries has a real bug, and the manager re-raises as
+:class:`RecoveryGivingUp` so the job fails loudly with N incidents on
+record instead of cycling forever.
+
+Scope note: rollback is coordinated per *controller*.  In a
+multi-controller (multi-host jax.distributed) job, every rank must
+agree on the rollback target before restoring — put a barrier (or an
+agreed step exchange) between the anomaly and the restore, and make
+rank 0's ``saver.wait()`` cover the manifest merge; otherwise ranks
+whose shared-filesystem view lags can restore different steps.  The
+in-tree topologies (single controller, many devices) need nothing.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.checkpoint import sharded as _sharded
+from apex_tpu.checkpoint.async_saver import AsyncCheckpointer
+from apex_tpu.observability import metrics as _telemetry
+
+__all__ = ["RecoveryManager", "RecoveryGivingUp", "RollbackConfig"]
+
+
+class RollbackConfig(NamedTuple):
+    """Rollback/re-warm policy.
+
+    ``trigger_kinds`` names the detector anomaly kinds that trigger a
+    rollback (others — scaler thrash, throughput regressions, serving
+    anomalies — are diagnostics, not state corruption).  After a
+    rollback the learning rate restarts at ``lr_scale_floor`` × its
+    scheduled value and ramps linearly back to 1× over
+    ``rewarm_steps`` optimizer steps (the standard post-restore
+    stabilization: the restored Adam moments are slightly stale
+    relative to the replayed data order, and the full LR can re-spike
+    the loss that killed the run)."""
+
+    rewarm_steps: int = 100
+    lr_scale_floor: float = 0.1
+    max_rollbacks: int = 3
+    trigger_kinds: Tuple[str, ...] = (
+        "nan_inf", "loss_spike", "grad_norm_explosion")
+
+
+class RecoveryGivingUp(RuntimeError):
+    """More than ``max_rollbacks`` rollbacks: the divergence is
+    systematic, recovery would cycle forever."""
+
+
+class RecoveryManager:
+    """Periodic async snapshots + automatic rollback (module docstring).
+
+    ``save_every`` snapshots every N *clean* steps through an owned
+    :class:`AsyncCheckpointer` (pass ``saver=`` to share one);
+    ``keep`` is its retention.  ``after_step`` is the only call a loop
+    needs; ``lr_scale()`` / ``rewarm_schedule(base_lr)`` expose the
+    re-warm window (the schedule form bakes the current rollback anchor
+    — rebuild the step function with it after a rollback, one
+    recompile per incident)."""
+
+    def __init__(self, directory: str, *, save_every: int = 100,
+                 keep: int = 3, saver: Optional[AsyncCheckpointer] = None,
+                 config: RollbackConfig = RollbackConfig()):
+        if save_every < 1:
+            raise ValueError(f"save_every={save_every} must be >= 1")
+        self.directory = directory
+        self.save_every = int(save_every)
+        self.config = config
+        self.saver = saver or AsyncCheckpointer(directory, keep=keep)
+        self.rollbacks = 0
+        self.last_rollback_step: Optional[int] = None
+        self._rewarm_anchor: Optional[int] = None
+        self._last_step: Optional[int] = None
+        self._last_saved_step: Optional[int] = None
+        # baseline of the bank's monotonic trigger-kind firing totals:
+        # anomalies that fired BEFORE this manager existed (a warmup
+        # phase's diagnostic loss spike) are history, not triggers.
+        # None = no bank observed yet; baselined at first sight.
+        self._seen_trigger_count: Optional[int] = (
+            self._trigger_count(self._bank()))
+
+    # -- the step-boundary hook --------------------------------------------
+
+    def after_step(self, state: Any, metrics: dict) -> Tuple[Any, bool]:
+        """Check the anomaly stream, roll back if it fired, else maybe
+        snapshot.  Returns ``(state, rolled_back)`` — the state is the
+        restored one when ``rolled_back``."""
+        step = self._state_step(state, metrics)
+        self._last_step = step
+        if self._anomaly_fired(metrics):
+            return self._rollback(state, metrics, step), True
+        # skip when the counter hasn't moved since the last snapshot:
+        # scaler-skipped steps stall the state's counter, and a stall
+        # ON a save_every multiple must not re-save (and de-commit/
+        # rewrite) the same step every iteration
+        if (step is not None and step > 0
+                and step % self.save_every == 0
+                and step != self._last_saved_step):
+            self._last_saved_step = step
+            self.saver.save(step, state,
+                            extra={"rollbacks": self.rollbacks})
+        return state, False
+
+    # -- re-warm window ----------------------------------------------------
+
+    def lr_scale(self, step: Optional[int] = None) -> float:
+        """The current LR multiplier: 1.0 normally; after a rollback,
+        a linear ramp ``floor → 1.0`` over ``rewarm_steps`` steps from
+        the restored step index."""
+        if self._rewarm_anchor is None:
+            return 1.0
+        step = self._last_step if step is None else step
+        if step is None:
+            return self.config.lr_scale_floor
+        frac = min(1.0, max(0.0, (step - self._rewarm_anchor)
+                            / max(1, self.config.rewarm_steps)))
+        return (self.config.lr_scale_floor
+                + (1.0 - self.config.lr_scale_floor) * frac)
+
+    def rewarm_schedule(self, base_lr):
+        """An optax-style schedule ``lr(step)`` = ``base_lr`` (itself a
+        scalar or schedule) × the re-warm ramp anchored at the LAST
+        rollback.  Baked at trace time: rebuild the step function with
+        this after each rollback."""
+        anchor = self._rewarm_anchor
+        floor = self.config.lr_scale_floor
+        window = max(1, self.config.rewarm_steps)
+
+        def schedule(step):
+            import jax.numpy as jnp
+
+            base = base_lr(step) if callable(base_lr) else base_lr
+            if anchor is None:
+                return jnp.asarray(base, jnp.float32)
+            frac = jnp.clip((step - anchor) / window, 0.0, 1.0)
+            return jnp.asarray(base, jnp.float32) * (
+                floor + (1.0 - floor) * frac)
+
+        return schedule
+
+    # -- internals ---------------------------------------------------------
+
+    @staticmethod
+    def _state_step(state: Any, metrics: dict) -> Optional[int]:
+        """The step index a snapshot of ``state`` should be labeled
+        with: the state's OWN counter when it has one (``TrainState`` /
+        ``ZeroTrainState.step`` — post-increment, and it does not
+        advance on scaler-skipped steps, so the label always names the
+        state's true position), else the metrics dict's ``step``
+        (pre-increment; loops without a counter get best-effort
+        labels)."""
+        v = getattr(state, "step", None)
+        if v is None:
+            v = metrics.get("step")
+        if v is None:
+            return None
+        try:
+            return int(np.asarray(v).reshape(())[()])
+        except (TypeError, ValueError):
+            return None
+
+    def _bank(self):
+        reg = _telemetry.registry()
+        return reg.detectors if reg is not None else None
+
+    def _trigger_count(self, bank) -> Optional[int]:
+        """Monotonic total of trigger-kind firings — read from the
+        bank's unbounded ``fired_counts``, never from the
+        MAX_KEPT-bounded anomaly list (a long run's full diagnostic
+        log must not disarm recovery)."""
+        if bank is None:
+            return None
+        return sum(bank.fired_counts.get(k, 0)
+                   for k in self.config.trigger_kinds)
+
+    def _anomaly_fired(self, metrics: dict) -> bool:
+        bank = self._bank()
+        if bank is not None:
+            cur = self._trigger_count(bank)
+            if self._seen_trigger_count is not None:
+                fired = cur > self._seen_trigger_count
+                self._seen_trigger_count = cur
+                return fired
+            # telemetry was configured after construction: baseline now
+            # — PRE-EXISTING incidents are not our triggers — but fall
+            # through to the loss check so a NaN on this very step
+            # (whose firing is inside the baseline) still recovers
+            self._seen_trigger_count = cur
+        # telemetry off (or first bank sighting): the manager still
+        # owes NaN recovery from the metrics themselves
+        try:
+            loss = float(np.asarray(metrics.get("loss")).reshape(())[()])
+        except (TypeError, ValueError):
+            return False
+        return not math.isfinite(loss)
+
+    def _rollback(self, state: Any, metrics: dict,
+                  step: Optional[int]) -> Any:
+        self.saver.wait()   # the last pre-anomaly snapshot must be durable
+        to_step = _sharded.latest_step(self.directory)
+        if to_step is None:
+            raise _sharded.CheckpointError(
+                "anomaly fired but no committed checkpoint exists to "
+                f"roll back to under {self.directory} (save_every="
+                f"{self.save_every} never landed a snapshot)")
+        self.rollbacks += 1
+        if self.rollbacks > self.config.max_rollbacks:
+            raise RecoveryGivingUp(
+                f"rolled back {self.rollbacks - 1} times already "
+                f"(max_rollbacks={self.config.max_rollbacks}); the "
+                "divergence is systematic — fix the run, don't replay it")
+        restored = _sharded.restore_sharded(self.directory, state,
+                                            step=to_step)
+        self.last_rollback_step = to_step
+        self._rewarm_anchor = to_step
+        self._last_step = to_step
+        # the to_step snapshot is what we just restored from — don't
+        # immediately rewrite it when the counter re-crosses its label
+        self._last_saved_step = to_step
+        detail = {
+            "from_step": step,
+            "to_step": to_step,
+            "rollback_count": self.rollbacks,
+            "rewarm_steps": self.config.rewarm_steps,
+            "lr_scale_floor": self.config.lr_scale_floor,
+        }
+        reg = _telemetry.registry()
+        if reg is not None:
+            _telemetry.counter("checkpoint.rollbacks").inc()
+            bank = reg.detectors
+            if bank is not None:
+                # fires anomaly.rollback (kind "rollback" is not a
+                # trigger kind, so it cannot re-trigger us) and re-arms
+                # the NaN first-seen latch for the next incident
+                bank.record_rollback(
+                    from_step=step, to_step=to_step, detail=detail)
+            else:
+                _telemetry.event("anomaly.rollback", **detail)
+        from apex_tpu.utils.logging import get_logger
+
+        get_logger("checkpoint").warning(
+            "rollback %d/%d: anomaly at step %s -> restored step %s; "
+            "LR re-warm %.2gx -> 1.0x over %d steps",
+            self.rollbacks, self.config.max_rollbacks, step, to_step,
+            self.config.lr_scale_floor, self.config.rewarm_steps)
+        return restored

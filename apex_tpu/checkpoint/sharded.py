@@ -1,0 +1,660 @@
+"""Per-process sharded snapshots with an atomically committed manifest.
+
+On-disk layout (one directory per step)::
+
+    <directory>/
+      step_00000400/
+        shard_p0.bin        # this process's shard payloads, concatenated
+        shard_p1.bin        # (multi-host: one file per process)
+        MANIFEST.json       # committed LAST, via write-temp-then-rename
+      step_00000500/ ...
+
+The manifest is the commit point: a checkpoint without a valid
+``MANIFEST.json`` does not exist (``latest_step`` skips it, retention
+deletes it).  It records, per pytree leaf: the tree path
+(``jax.tree_util.keystr``), global shape, dtype, PRNG-key impl for
+typed keys, the mesh geometry + partition spec the leaf was saved
+under, and one entry per shard — owning file, byte offset/length, the
+global index slices the shard covers, and a SHA-256 content digest.
+
+Save writes each process's **own** addressable shards only ("Automatic
+Cross-Replica Sharding of Weight Update": each rank persists its
+slice); replicated leaves are written once per process (replica 0).
+Each process also writes a manifest *fragment*
+(``MANIFEST.p<proc>.json``); process 0 gathers every fragment from
+the shared filesystem, merges them, and commits the single
+authoritative manifest — a peer dying mid-save leaves the checkpoint
+uncommitted, never half-described.
+Restore is template-driven (pass the live, freshly-initialized state):
+tree structure, shapes, dtypes and mesh geometry are validated against
+the template, shards are digest-checked and reassembled, and every
+leaf is placed back under the template's sharding — **bitwise**, so a
+resumed run's loss trajectory is identical to an unkilled one (the
+error-feedback residuals and the loss scaler's mid-doubling window
+round-trip exactly).  ``reshard=True`` relaxes only the mesh-geometry
+check: the manifest's per-leaf layout metadata lets the same snapshot
+reassemble onto a different dp degree (elastic world size).
+
+Everything here is synchronous host-side I/O;
+:mod:`apex_tpu.checkpoint.async_saver` is the overlapped wrapper the
+train loop uses.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+__all__ = [
+    "MANIFEST_NAME",
+    "MANIFEST_SCHEMA_VERSION",
+    "CheckpointError",
+    "all_steps",
+    "latest_step",
+    "load_manifest",
+    "prune_checkpoints",
+    "restore_sharded",
+    "save_sharded",
+]
+
+MANIFEST_NAME = "MANIFEST.json"
+MANIFEST_SCHEMA_VERSION = 1
+
+_STEP_DIR = re.compile(r"^step_(\d{8})$")
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be saved/validated/restored."""
+
+
+def _step_dir(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), f"step_{int(step):08d}")
+
+
+def _process_index() -> int:
+    try:
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def _is_typed_key(x) -> bool:
+    dt = getattr(x, "dtype", None)
+    try:
+        return dt is not None and jax.dtypes.issubdtype(
+            dt, jax.dtypes.prng_key)
+    except (TypeError, AttributeError):
+        return False
+
+
+def _key_impl_name(x) -> Optional[str]:
+    try:
+        return str(jax.random.key_impl(x))
+    except Exception:
+        return None
+
+
+def _sharding_desc(x) -> Optional[dict]:
+    """Mesh geometry + partition spec of a jax.Array leaf, or None when
+    the leaf has no named sharding (single-device / numpy)."""
+    sharding = getattr(x, "sharding", None)
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is None:
+        return None
+    spec = getattr(sharding, "spec", None)
+    return {
+        "mesh_axes": [str(a) for a in mesh.axis_names],
+        "mesh_shape": [int(s) for s in np.shape(mesh.devices)],
+        "spec": [None if e is None
+                 else (list(e) if isinstance(e, tuple) else str(e))
+                 for e in tuple(spec)] if spec is not None else None,
+    }
+
+
+def _norm_index(index, shape) -> List[List[int]]:
+    """A shard's global index slices as [[start, stop], ...] per dim."""
+    out = []
+    for sl, dim in zip(tuple(index), shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _leaf_shards(x) -> List[Tuple[List[List[int]], np.ndarray]]:
+    """(global index, host buffer) for every shard THIS process owns,
+    deduplicated: a replicated leaf (every device holds the full value)
+    contributes one entry, a sharded leaf one entry per distinct slice
+    (replica 0 writes; other replicas hold identical bytes)."""
+    shape = tuple(np.shape(x))
+    if isinstance(x, jax.Array):
+        try:
+            shards = x.addressable_shards
+        except Exception:
+            shards = None
+        if shards:
+            seen: Dict[tuple, np.ndarray] = {}
+            for sh in shards:
+                if getattr(sh, "replica_id", 0) != 0:
+                    continue
+                idx = _norm_index(sh.index, shape)
+                key = tuple(map(tuple, idx))
+                if key not in seen:
+                    seen[key] = np.asarray(sh.data)
+            if not seen:   # every addressable shard was a replica copy
+                sh = shards[0]
+                seen[tuple(map(tuple, _norm_index(sh.index, shape)))] = (
+                    np.asarray(sh.data))
+            return [(list(map(list, k)), v) for k, v in seen.items()]
+    arr = np.asarray(x)
+    return [([[0, d] for d in arr.shape], arr)]
+
+
+def _flatten_with_keys(tree):
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return ([(jax.tree_util.keystr(path), leaf) for path, leaf in leaves],
+            treedef)
+
+
+# ---------------------------------------------------------------------------
+# save
+# ---------------------------------------------------------------------------
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so renames/creations inside it are durable
+    (the file-content fsyncs alone leave the directory entries at the
+    filesystem's mercy — a post-crash state where retention's deletes
+    survived but the new manifest's rename did not would violate the
+    commit contract).  Best-effort: not every platform/fs supports
+    opening directories."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def save_sharded(directory: str, step: int, state: Any, *,
+                 process_index: Optional[int] = None,
+                 expected_processes: Optional[int] = None,
+                 merge_timeout_s: float = 600.0,
+                 keep: Optional[int] = None,
+                 extra: Optional[dict] = None,
+                 return_stats: bool = False):
+    """Snapshot ``state`` (any pytree of arrays) under
+    ``directory/step_<N>`` and commit the manifest atomically.
+
+    Every process writes its own addressable shards
+    (``shard_p<proc>.bin``) plus a manifest FRAGMENT
+    (``MANIFEST.p<proc>.json``, atomic).  Process 0 then waits (up to
+    ``merge_timeout_s``) for all ``expected_processes`` fragments on
+    the shared filesystem, merges them into the single committed
+    ``MANIFEST.json`` (duplicate shard indices deduplicated — every
+    process holds a copy of replicated leaves), fsyncs the directory
+    entries, and applies retention.  Non-zero processes return after
+    their fragment is durable; a checkpoint becomes visible only once
+    the merged manifest lands.  ``keep`` applies the retention policy
+    after commit (older *committed* checkpoints beyond the newest
+    ``keep`` are deleted; torn attempts are always swept).  ``extra``
+    is an optional JSON-safe dict stored verbatim in the manifest
+    (host-side loop state — data position, schedule anchors).
+
+    Returns the checkpoint directory path (or ``(path, bytes_written)``
+    with ``return_stats=True`` — this process's payload bytes, so
+    callers need not re-read the manifest that only process 0 owns).
+    """
+    proc = _process_index() if process_index is None else int(process_index)
+    nprocs = (_safe_process_count() if expected_processes is None
+              else int(expected_processes))
+    path = _step_dir(directory, step)
+    os.makedirs(path, exist_ok=True)
+    manifest_path = os.path.join(path, MANIFEST_NAME)
+    if os.path.exists(manifest_path):
+        # re-saving an already-committed step: de-commit first so a
+        # crash mid-rewrite can never leave a manifest describing a
+        # half-overwritten payload
+        os.remove(manifest_path)
+        _fsync_dir(path)
+    # sweep OUR OWN stale fragment from a crashed earlier attempt
+    # before rewriting the shard file: process 0's merge must never
+    # pair a stale fragment with an in-progress shard rewrite (the
+    # merge additionally validates each fragment's recorded byte
+    # extents against the shard file on disk)
+    own_frag = os.path.join(path, f"MANIFEST.p{proc}.json")
+    if os.path.exists(own_frag):
+        os.remove(own_frag)
+        _fsync_dir(path)
+
+    keyed, _ = _flatten_with_keys(state)
+    shard_file = f"shard_p{proc}.bin"
+    leaves_meta: List[dict] = []
+    offset = 0
+    total_bytes = 0
+    with open(os.path.join(path, shard_file), "wb") as f:
+        for key, leaf in keyed:
+            typed_key = _is_typed_key(leaf)
+            impl = _key_impl_name(leaf) if typed_key else None
+            data_leaf = jax.random.key_data(leaf) if typed_key else leaf
+            shards_meta = []
+            for index, buf in _leaf_shards(data_leaf):
+                raw = np.ascontiguousarray(buf).tobytes()
+                f.write(raw)
+                shards_meta.append({
+                    "file": shard_file,
+                    "offset": offset,
+                    "nbytes": len(raw),
+                    "index": index,
+                    "digest": "sha256:"
+                              + hashlib.sha256(raw).hexdigest(),
+                })
+                offset += len(raw)
+                total_bytes += len(raw)
+            leaves_meta.append({
+                "key": key,
+                "shape": [int(d) for d in np.shape(data_leaf)],
+                "dtype": _dtype_name(data_leaf),
+                "prng_impl": impl,
+                "typed_key": typed_key,
+                "sharding": _sharding_desc(leaf),
+                "shards": shards_meta,
+            })
+        f.flush()
+        os.fsync(f.fileno())
+
+    fragment = {
+        "process_index": proc,
+        "total_bytes": total_bytes,
+        "leaves": leaves_meta,
+    }
+    frag_path = os.path.join(path, f"MANIFEST.p{proc}.json")
+    tmp = frag_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(fragment, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, frag_path)
+    _fsync_dir(path)
+
+    if proc == 0:
+        _merge_and_commit(directory, path, step, nprocs,
+                          merge_timeout_s, extra)
+        if keep is not None:
+            prune_checkpoints(directory, keep)
+    return (path, total_bytes) if return_stats else path
+
+
+def _merge_and_commit(directory: str, path: str, step: int, nprocs: int,
+                      timeout_s: float, extra: Optional[dict]) -> None:
+    """Process 0: gather every process's manifest fragment, merge, and
+    commit the single authoritative manifest."""
+    deadline = time.time() + timeout_s
+    frag_paths = [os.path.join(path, f"MANIFEST.p{p}.json")
+                  for p in range(nprocs)]
+    while True:
+        missing = [p for p in frag_paths if not os.path.exists(p)]
+        if not missing:
+            break
+        if time.time() > deadline:
+            raise CheckpointError(
+                f"step {step}: timed out after {timeout_s:.0f}s waiting "
+                f"for manifest fragments {missing} — a peer process "
+                "died mid-save; the checkpoint stays uncommitted")
+        time.sleep(0.05)
+    merged: Dict[str, dict] = {}
+    order: List[str] = []
+    total_bytes = 0
+    for fp in frag_paths:
+        with open(fp) as f:
+            frag = json.load(f)
+        # a fragment must describe bytes that are actually on disk: a
+        # stale fragment paired with a peer's in-progress shard
+        # rewrite shows up as a too-short shard file here, and the
+        # commit refuses instead of describing a torn payload
+        extents: Dict[str, int] = {}
+        for leaf in frag["leaves"]:
+            for s in leaf["shards"]:
+                extents[s["file"]] = max(
+                    extents.get(s["file"], 0),
+                    int(s["offset"]) + int(s["nbytes"]))
+        for fname, end in extents.items():
+            fpath = os.path.join(path, fname)
+            if not os.path.exists(fpath) or os.path.getsize(fpath) < end:
+                raise CheckpointError(
+                    f"step {step}: fragment {os.path.basename(fp)} "
+                    f"describes {end} bytes in {fname} but the file "
+                    "is missing or shorter — a peer's shard write is "
+                    "incomplete (stale fragment?); the checkpoint "
+                    "stays uncommitted")
+        total_bytes += int(frag.get("total_bytes", 0))
+        for leaf in frag["leaves"]:
+            key = leaf["key"]
+            have = merged.get(key)
+            if have is None:
+                merged[key] = {**leaf,
+                               "shards": list(leaf["shards"])}
+                order.append(key)
+                continue
+            for field in ("shape", "dtype", "typed_key"):
+                if have[field] != leaf[field]:
+                    raise CheckpointError(
+                        f"step {step}: processes disagree on leaf "
+                        f"{key} {field}: {have[field]} vs "
+                        f"{leaf[field]}")
+            seen = {tuple(map(tuple, s["index"]))
+                    for s in have["shards"]}
+            for s in leaf["shards"]:
+                # replicated leaves appear in every fragment — keep
+                # one copy per distinct global slice
+                if tuple(map(tuple, s["index"])) not in seen:
+                    have["shards"].append(s)
+    manifest = {
+        "manifest_schema_version": MANIFEST_SCHEMA_VERSION,
+        "step": int(step),
+        "t": time.time(),
+        "process_count": nprocs,
+        "total_bytes": total_bytes,
+        "leaves": [merged[k] for k in order],
+    }
+    if extra is not None:
+        manifest["extra"] = extra
+    manifest_path = os.path.join(path, MANIFEST_NAME)
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, manifest_path)   # the commit point
+    for fp in frag_paths:
+        try:
+            os.remove(fp)
+        except OSError:
+            pass
+    _fsync_dir(path)
+    _fsync_dir(os.path.dirname(path))
+
+
+def _dtype_name(x) -> str:
+    dt = getattr(x, "dtype", None)
+    if dt is not None:
+        return str(dt)
+    return str(np.asarray(x).dtype)
+
+
+def _safe_process_count() -> int:
+    try:
+        return int(jax.process_count())
+    except Exception:
+        return 1
+
+
+# ---------------------------------------------------------------------------
+# discovery / retention
+# ---------------------------------------------------------------------------
+
+
+def _committed(path: str) -> bool:
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            doc = json.load(f)
+        return isinstance(doc, dict) and "manifest_schema_version" in doc
+    except (OSError, ValueError):
+        return False
+
+
+def all_steps(directory: str) -> List[int]:
+    """Sorted step indices of every COMMITTED checkpoint (a valid,
+    parseable manifest — torn snapshots are invisible)."""
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for name in os.listdir(directory):
+        m = _STEP_DIR.match(name)
+        if m and _committed(os.path.join(directory, name)):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Newest committed step, or None."""
+    steps = all_steps(directory)
+    return steps[-1] if steps else None
+
+
+def prune_checkpoints(directory: str, keep: int) -> List[int]:
+    """Delete committed checkpoints beyond the newest ``keep`` (and any
+    torn ``step_*`` attempt older than the newest committed one).
+    Returns the deleted step indices."""
+    if keep < 1:
+        raise ValueError(f"keep={keep} must be >= 1")
+    directory = os.path.abspath(directory)
+    committed = all_steps(directory)
+    doomed = committed[:-keep] if len(committed) > keep else []
+    for step in doomed:
+        shutil.rmtree(_step_dir(directory, step), ignore_errors=True)
+    if committed:
+        newest = committed[-1]
+        for name in os.listdir(directory):
+            m = _STEP_DIR.match(name)
+            if (m and int(m.group(1)) < newest
+                    and not _committed(os.path.join(directory, name))):
+                shutil.rmtree(os.path.join(directory, name),
+                              ignore_errors=True)
+    return doomed
+
+
+def load_manifest(directory: str, step: Optional[int] = None) -> dict:
+    """The committed manifest of ``step`` (default: newest)."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise CheckpointError(
+                f"no committed checkpoints under {directory}")
+    path = os.path.join(_step_dir(directory, step), MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointError(f"unreadable manifest {path}: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# restore
+# ---------------------------------------------------------------------------
+
+
+def _mesh_mismatch(saved: Optional[dict],
+                   live: Optional[dict]) -> Optional[str]:
+    """Mesh GEOMETRY must match (axis names + shape — the world
+    layout); the per-leaf partition ``spec`` is recorded as layout
+    metadata but not compared: XLA legitimately picks different specs
+    for the same logical value across jit boundaries, restore re-places
+    under the live template's sharding either way, and the bytes are
+    exact regardless of placement."""
+    if saved is None or live is None:
+        # no named mesh on one side = no geometry to disagree about: a
+        # freshly-initialized template (pre-first-jitted-step, default
+        # placement) restoring a mesh-saved snapshot is the normal
+        # resume path — assembly is global and placement follows the
+        # template either way
+        return None
+    for field in ("mesh_axes", "mesh_shape"):
+        if saved.get(field) != live.get(field):
+            return (f"{field}: saved {saved.get(field)} vs live "
+                    f"{live.get(field)}")
+    return None
+
+
+def restore_sharded(directory: str, state_like: Any, *,
+                    step: Optional[int] = None,
+                    verify_digests: bool = True,
+                    reshard: bool = False) -> Any:
+    """Restore a snapshot into the structure/shardings of ``state_like``.
+
+    Pass the live (freshly initialized) state: tree structure, per-leaf
+    shape and dtype MUST match the manifest — a drifted model or
+    optimizer config fails loudly instead of loading garbage.  Mesh
+    geometry must match too unless ``reshard=True``, in which case the
+    shards are reassembled into the global value and re-placed under
+    the template leaf's (different) sharding — the elastic-world-size
+    path.  Every shard's SHA-256 digest is checked when
+    ``verify_digests`` (flip off only for giant states where the read
+    is the budget).  Restoration is bitwise: the returned state's
+    buffers are exactly the saved bytes.
+    """
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise CheckpointError(
+                f"no committed checkpoints under {directory}")
+    t0 = time.perf_counter()
+    path = _step_dir(directory, step)
+    manifest = load_manifest(directory, step)
+
+    keyed, treedef = _flatten_with_keys(state_like)
+    saved = {leaf["key"]: leaf for leaf in manifest["leaves"]}
+    live_keys = [k for k, _ in keyed]
+    live_set = set(live_keys)
+    missing = [k for k in live_keys if k not in saved]
+    unexpected = [k for k in saved if k not in live_set]
+    if missing or unexpected:
+        raise CheckpointError(
+            f"tree structure mismatch restoring step {step}: "
+            f"missing from checkpoint {missing[:5]}, "
+            f"unexpected in checkpoint {unexpected[:5]} "
+            f"(template has {len(live_keys)} leaves, checkpoint "
+            f"{len(saved)})")
+
+    handles: Dict[str, Any] = {}
+
+    def _read(file: str, off: int, n: int) -> bytes:
+        f = handles.get(file)
+        if f is None:
+            fpath = os.path.join(path, file)
+            try:
+                f = handles[file] = open(fpath, "rb")
+            except OSError as e:
+                raise CheckpointError(
+                    f"missing shard file {fpath} (a process's shards "
+                    "were lost — restore needs every shard file the "
+                    "manifest names)") from e
+        f.seek(off)
+        raw = f.read(n)
+        if len(raw) != n:
+            raise CheckpointError(
+                f"short read from {file} at {off}: wanted {n} bytes, "
+                f"got {len(raw)}")
+        return raw
+
+    try:
+        out_leaves = []
+        for key, template in keyed:
+            meta = saved[key]
+            typed_key = _is_typed_key(template)
+            if bool(meta.get("typed_key")) != typed_key:
+                raise CheckpointError(
+                    f"leaf {key}: typed-PRNG-key mismatch (saved "
+                    f"{meta.get('typed_key')}, live {typed_key})")
+            t_data = (jax.random.key_data(template) if typed_key
+                      else template)
+            t_shape = tuple(int(d) for d in np.shape(t_data))
+            t_dtype = _dtype_name(t_data)
+            if tuple(meta["shape"]) != t_shape:
+                raise CheckpointError(
+                    f"leaf {key}: shape mismatch (saved "
+                    f"{tuple(meta['shape'])}, live {t_shape})")
+            if meta["dtype"] != t_dtype:
+                raise CheckpointError(
+                    f"leaf {key}: dtype mismatch (saved "
+                    f"{meta['dtype']}, live {t_dtype})")
+            mm = _mesh_mismatch(meta.get("sharding"),
+                                _sharding_desc(template))
+            if mm is not None and not reshard:
+                raise CheckpointError(
+                    f"leaf {key}: mesh geometry mismatch — {mm}; pass "
+                    "reshard=True to reassemble onto the live mesh "
+                    "(elastic world size)")
+            dtype = _np_dtype(meta["dtype"])
+            arr = np.empty(t_shape, dtype)
+            covered = 0
+            for sh in meta["shards"]:
+                raw = _read(sh["file"], sh["offset"], sh["nbytes"])
+                if verify_digests:
+                    digest = "sha256:" + hashlib.sha256(raw).hexdigest()
+                    if digest != sh["digest"]:
+                        raise CheckpointError(
+                            f"leaf {key}: shard {sh['index']} content "
+                            f"digest mismatch in {sh['file']} (expected "
+                            f"{sh['digest']}, got {digest}) — the "
+                            "checkpoint is corrupt")
+                idx = tuple(slice(a, b) for a, b in sh["index"])
+                piece = np.frombuffer(raw, dtype).reshape(
+                    [b - a for a, b in sh["index"]])
+                arr[idx] = piece
+                covered += piece.size
+            if covered < int(np.prod(t_shape, dtype=np.int64)):
+                raise CheckpointError(
+                    f"leaf {key}: shards cover only {covered} of "
+                    f"{int(np.prod(t_shape, dtype=np.int64))} elements "
+                    "— a process's shard file is missing from the "
+                    "manifest")
+            out_leaves.append(_place(arr, template, typed_key))
+    finally:
+        for f in handles.values():
+            f.close()
+    restored = jax.tree_util.tree_unflatten(treedef, out_leaves)
+    from apex_tpu.observability import metrics as _telemetry
+
+    reg = _telemetry.registry()
+    if reg is not None:
+        reg.observe_span("checkpoint.restore", time.perf_counter() - t0,
+                         step=int(step))
+        _telemetry.counter("checkpoint.restores").inc()
+    return restored
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # extension dtypes (bfloat16, float8_*) register with
+        # ml_dtypes; jnp.dtype resolves them by name
+        import jax.numpy as jnp
+
+        return np.dtype(jnp.dtype(name))
+
+
+def _place(arr: np.ndarray, template, typed_key: bool):
+    # device_put COMMITS an array to its devices; only do that when the
+    # template carries a named mesh (a sharded leaf must land on its
+    # shards).  Mesh-less leaves come back uncommitted (plain
+    # jnp.asarray) so jit remains free to co-place them with the rest
+    # of the state — a committed single-device leaf inside an
+    # otherwise mesh-sharded state is a device-mismatch error.
+    sharding = getattr(template, "sharding", None)
+    named = sharding is not None and getattr(
+        sharding, "mesh", None) is not None
+    if typed_key:
+        key = jax.random.wrap_key_data(jax.numpy.asarray(arr))
+        return jax.device_put(key, sharding) if named else key
+    if isinstance(template, jax.Array):
+        return (jax.device_put(arr, sharding) if named
+                else jax.numpy.asarray(arr))
+    if isinstance(template, np.ndarray):
+        return arr
+    # python scalar leaf: give back the same python type
+    return type(template)(arr.reshape(())[()])

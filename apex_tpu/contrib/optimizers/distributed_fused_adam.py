@@ -35,7 +35,8 @@ from apex_tpu.amp.policy import _effective, policy_for_opt_level
 from apex_tpu.utils.collectives import flag_and
 
 
-__all__ = ["ZeroTrainState", "make_distributed_adam_train_step"]
+__all__ = ["ZeroTrainState", "make_distributed_adam_train_step",
+           "zero_state_specs"]
 
 _LANES = 128
 
@@ -116,6 +117,32 @@ def _combine_bits(bf: jax.Array, rem: jax.Array) -> jax.Array:
     hi = jax.lax.bitcast_convert_type(bf, jnp.uint16).astype(jnp.uint32) << 16
     lo = jax.lax.bitcast_convert_type(rem, jnp.uint16).astype(jnp.uint32)
     return jax.lax.bitcast_convert_type(hi | lo, jnp.float32)
+
+
+def zero_state_specs(state: ZeroTrainState,
+                     axis_name: str = "dp") -> ZeroTrainState:
+    """Per-leaf :class:`PartitionSpec` tree of a :class:`ZeroTrainState`:
+    replicated params/step/scaler, ``P(axis_name)`` for the flat
+    master/m/v shards and (when present) the rank-local
+    ``comm_residual``.
+
+    This is the shard-extraction contract the checkpoint subsystem
+    relies on (ISSUE 11): ``apex_tpu.checkpoint.save_sharded`` walks
+    ``addressable_shards`` of exactly these placements, so each rank
+    persists only its own 1/dp slice of the optimizer state (and its
+    own error-feedback residual row), and restore re-places every
+    shard under the same specs — bitwise.  ``step_fn`` builds its
+    shard_map in/out specs from the same function, so the checkpoint
+    layout can never drift from the training layout."""
+    pspec = jax.tree_util.tree_map(lambda _: P(), state.params)
+    ls_spec = jax.tree_util.tree_map(
+        lambda _: P(), state.loss_scale_state)
+    return ZeroTrainState(
+        step=P(), params=pspec, master_shard=P(axis_name),
+        m_shard=P(axis_name), v_shard=P(axis_name),
+        loss_scale_state=ls_spec,
+        comm_residual=(P(axis_name) if state.comm_residual is not None
+                       else None))
 
 
 def make_distributed_adam_train_step(
@@ -348,14 +375,7 @@ def make_distributed_adam_train_step(
 
     def step_fn(state: ZeroTrainState, *batch):
         bf_flat, unravel_bf = _ravel_floats(state.params)
-        pspec = jax.tree_util.tree_map(lambda _: P(), state.params)
-        ls_spec = jax.tree_util.tree_map(
-            lambda _: P(), state.loss_scale_state)
-        in_state_spec = ZeroTrainState(
-            step=P(), params=pspec, master_shard=P(axis_name),
-            m_shard=P(axis_name), v_shard=P(axis_name),
-            loss_scale_state=ls_spec,
-            comm_residual=P(axis_name) if use_ef else None)
+        in_state_spec = zero_state_specs(state, axis_name)
         out_state_spec = in_state_spec._replace(params=None)
         fn = jax.shard_map(
             shard_step, mesh=mesh,

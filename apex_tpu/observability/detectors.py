@@ -451,6 +451,10 @@ class DetectorBank:
         cfg = dict(config or {})
         self._registry = registry
         self.anomalies: List[Anomaly] = []
+        # monotonic per-kind firing totals, NOT bounded by MAX_KEPT:
+        # consumers that react to firings (checkpoint.RecoveryManager)
+        # must keep seeing new incidents after the in-memory log fills
+        self.fired_counts: Dict[str, int] = {}
         self._dropped = 0
         self._last_compile_count = 0
         self.loss_spike = ZScoreDetector(
@@ -537,9 +541,40 @@ class DetectorBank:
             self._fire(a)
         return a
 
+    def record_rollback(self, from_step: Optional[int],
+                        to_step: Optional[int],
+                        detail: Optional[dict] = None) -> Anomaly:
+        """Document a checkpoint rollback (ISSUE 11): the recovery
+        manager restored the last good snapshot instead of letting the
+        job die.  Fires through the standard pipeline — an
+        ``anomaly.rollback`` event, the anomaly counter, a WARNING
+        line, and the flight-recorder notification (post-mortem dump
+        on first blood), so ``tools/health_report.py`` renders the
+        incident with its rollback-to-step and re-warm schedule.
+
+        Also re-arms the NaN first-seen latch: ``NanInfDetector``
+        fires once per run by design, but a rollback starts a fresh
+        incident window — a *second* divergence after recovery must be
+        detected (and trigger the next rollback), not ignored."""
+        d = dict(detail or {})
+        d.setdefault("from_step", from_step)
+        d.setdefault("to_step", to_step)
+        a = Anomaly(
+            "rollback", from_step,
+            f"anomaly at step {from_step} -> rolled back to the last "
+            f"good checkpoint (step {to_step}); LR re-warm over "
+            f"{d.get('rewarm_steps', '?')} steps from "
+            f"{d.get('lr_scale_floor', '?')}x",
+            d)
+        self._fire(a)
+        self.nan_inf.fired = False
+        return a
+
     # -- firing ------------------------------------------------------------
 
     def _fire(self, anomaly: Anomaly) -> None:
+        self.fired_counts[anomaly.kind] = (
+            self.fired_counts.get(anomaly.kind, 0) + 1)
         if len(self.anomalies) < self.MAX_KEPT:
             self.anomalies.append(anomaly)
         else:

@@ -15,6 +15,14 @@ requeue) as a plain polling stub so Megatron-style loops port unchanged.
 the train loop): orbax's async machinery snapshots device arrays to
 host, returns, and writes to disk on a background thread — the step
 loop keeps training while the previous checkpoint persists.
+
+NOTE (ISSUE 11): the production fault-tolerance path is
+:mod:`apex_tpu.checkpoint` — per-process shard files with an
+atomically committed manifest and content digests, donation-safe
+async saves with overlap telemetry, bitwise restore validation, and
+detector-driven rollback + LR re-warm (``RecoveryManager``).  This
+module remains the thin orbax-compatible surface for users who
+already run orbax everywhere.
 """
 
 from __future__ import annotations

@@ -68,6 +68,15 @@ MODULES = [
      "comm.bucketing — greedy dtype-segregated buckets"),
     ("apex_tpu.comm.reduce", "comm",
      "comm.reduce — compressed all-reduce / reduce-scatter + telemetry"),
+    # checkpoint
+    ("apex_tpu.checkpoint", "checkpoint",
+     "apex_tpu.checkpoint — elastic fault-tolerant training state"),
+    ("apex_tpu.checkpoint.sharded", "checkpoint",
+     "checkpoint.sharded — per-process shards + atomic manifest"),
+    ("apex_tpu.checkpoint.async_saver", "checkpoint",
+     "checkpoint.async_saver — overlapped zero-stall saves"),
+    ("apex_tpu.checkpoint.recovery", "checkpoint",
+     "checkpoint.recovery — detector-driven rollback + LR re-warm"),
     # parallel
     ("apex_tpu.parallel.mesh", "parallel", "parallel.mesh — device mesh"),
     ("apex_tpu.parallel.launch", "parallel",

@@ -11,7 +11,13 @@ it afterwards —
   no external tokenizer is needed;
 - background-thread prefetch of random crops from the memory-mapped
   corpus;
-- checkpoint save/resume (utils/checkpoint.py);
+- fault-tolerant checkpointing (``--ckpt-dir``): async sharded
+  snapshots every ``--ckpt-every`` steps through
+  ``apex_tpu.checkpoint`` (the write overlaps the next step), bitwise
+  resume from the newest committed manifest on restart, and — when
+  telemetry is on — detector-driven rollback-to-last-good + LR
+  re-warm instead of a dead job on a NaN/loss spike
+  (docs/training.md);
 - KV-cache generation (models/generate.py) prints a sample at the end;
 - optional telemetry (``--telemetry out.jsonl``): per-step spans plus
   loss-scale / loss / grad-norm gauges in the shared JSONL schema —
@@ -35,8 +41,8 @@ from apex_tpu.models.config import TransformerConfig
 from apex_tpu.models.generate import generate
 from apex_tpu.models.gpt import make_gpt_train_step
 from apex_tpu.optimizers import fused_adam
-from apex_tpu.utils.checkpoint import (
-    latest_step, restore_checkpoint, save_checkpoint)
+from apex_tpu.checkpoint import (
+    RecoveryManager, latest_step, restore_sharded, save_sharded)
 
 VOCAB = 384          # 256 byte values, padded for tp divisibility
 
@@ -63,6 +69,10 @@ def main():
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--opt-level", default="O2")
     ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--ckpt-every", type=int, default=100,
+                    help="async sharded snapshot cadence (steps); with "
+                         "--telemetry, a NaN/loss-spike detector firing "
+                         "rolls back to the last snapshot + LR re-warm")
     ap.add_argument("--sample-tokens", type=int, default=120)
     ap.add_argument("--top-k", type=int, default=40,
                     help="0 disables the top-k cutoff")
@@ -98,12 +108,16 @@ def main():
     state = init(jax.random.PRNGKey(0))
 
     start = 0
+    mgr = None
     if args.ckpt_dir:
         last = latest_step(args.ckpt_dir)
         if last is not None:
-            state = restore_checkpoint(args.ckpt_dir, state)
+            state = restore_sharded(args.ckpt_dir, state)
             start = last
-            print(f"resumed from step {start}")
+            print(f"resumed from step {start} (bitwise)")
+        # async sharded snapshots + (with telemetry) detector-driven
+        # rollback-to-last-good instead of a dead job on a NaN
+        mgr = RecoveryManager(args.ckpt_dir, save_every=args.ckpt_every)
 
     stream = device_prefetch(batches(data, args.batch, args.seq, seed=start))
     t0 = time.perf_counter()
@@ -124,6 +138,20 @@ def main():
             # overflow counters + train.* gauges (incl. grad_norm)
             record_scaler_step(m)
             obs.record_step_metrics(m)
+        if mgr is not None:
+            state, rolled = mgr.after_step(state, m)
+            if rolled:
+                # APPLY the re-warm, don't just announce it: rebuild
+                # the step with the schedule anchored at the restored
+                # step (one recompile per incident — which the restore
+                # already paid for in spirit); full LR resumes after
+                # rewarm_steps optimizer steps
+                _, step = make_gpt_train_step(
+                    cfg, fused_adam(lr=mgr.rewarm_schedule(args.lr)),
+                    args.opt_level, norm_telemetry=telemetry)
+                print(f"rollback: resumed from step "
+                      f"{mgr.last_rollback_step}; LR re-warm x"
+                      f"{mgr.lr_scale():.2f} -> 1.0")
         if (i + 1) % 50 == 0:
             print(f"step {i + 1}: loss {float(m['loss']):.4f}")
     loss = float(m["loss"]) if m is not None else float("nan")
@@ -134,7 +162,9 @@ def main():
     print(f"final loss {loss:.4f}  ({tps:,.0f} tokens/s)")
 
     if args.ckpt_dir:
-        save_checkpoint(args.ckpt_dir, args.steps, state)
+        if mgr is not None:
+            mgr.saver.close()   # drain any in-flight async snapshot
+        save_sharded(args.ckpt_dir, args.steps, state, keep=3)
 
     # sample from the trained model (bf16 params from the state)
     prompt_text = bytes(data[: min(32, args.seq)]).decode(

@@ -63,6 +63,15 @@ _SPEC_HELPER = re.compile(r"_telemetry\s*\.\s*counter\s*\(")
 # (unguarded) access idiom would fork that accounting
 _MOE_METRIC = re.compile(r"[\"']moe\.")
 _MOE_HELPER = re.compile(r"_telemetry\s*\.\s*(counter|gauge)\s*\(")
+# the checkpoint telemetry (ISSUE 11): every checkpoint counter/gauge
+# touch must ride a module-level helper on the same statement — the
+# save/byte/rollback accounting feeds telemetry_report's checkpoint
+# summary and the bench --ckpt overhead row (span names
+# checkpoint.save/restore/blocking go through observe_span under
+# bind-and-check and are not name-matched here)
+_CKPT_METRIC = re.compile(
+    r"[\"']checkpoint\.(saves|bytes|restores|rollbacks|overlap_ratio)")
+_CKPT_HELPER = re.compile(r"_telemetry\s*\.\s*(counter|gauge)\s*\(")
 
 
 def _py_files():
@@ -232,6 +241,29 @@ def test_moe_metrics_use_the_helpers_only():
         + "\n".join(offenders))
 
 
+def test_checkpoint_metrics_use_the_helpers_only():
+    """Every ``checkpoint.*`` counter/gauge touch in ``apex_tpu/`` must
+    go through ``_telemetry.counter(...)`` / ``_telemetry.gauge(...)``
+    on the same statement: the save/rollback accounting is what
+    telemetry_report's checkpoint summary and the ``bench --ckpt``
+    overhead row read, so a second access idiom would fork it."""
+    offenders = []
+    for path in _py_files():
+        if _in_obs(path):
+            continue
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                if not _CKPT_METRIC.search(line):
+                    continue
+                if _CKPT_HELPER.search(line):
+                    continue
+                offenders.append(f"{path}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "checkpoint.* metrics must be accessed via "
+        "_telemetry.counter(...)/_telemetry.gauge(...) on the same "
+        "statement:\n" + "\n".join(offenders))
+
+
 def test_guard_patterns_actually_match():
     """The guard is only as good as its regexes: each must match its
     own anti-pattern (a regression here silently disables the guard)."""
@@ -254,6 +286,12 @@ def test_guard_patterns_actually_match():
         '_telemetry.counter("moe.ring_hops").inc(7)')
     assert not _MOE_METRIC.search(
         "the moe.ring_hops invariant (docs)")
+    assert _CKPT_METRIC.search(
+        'reg.counter("checkpoint.rollbacks").inc()')
+    assert _CKPT_HELPER.search(
+        '_telemetry.gauge("checkpoint.overlap_ratio").set(r)')
+    assert not _CKPT_METRIC.search(
+        'reg.observe_span("checkpoint.save", bg_s)')
     assert _PRIVATE_GLOBAL.search("from x import _REGISTRY")
     assert _MEM_SAMPLE.search("sample_device_memory()")
     assert _EXPORTER_IMPORT.search(

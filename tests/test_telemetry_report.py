@@ -395,3 +395,52 @@ def test_moe_summary_absent_for_dense_streams(report, tmp_path):
                  '"name":"collectives.ring.calls","value":2}\n')
     summ = report.summarize(report.load_records([str(f)]))
     assert report.moe_summary(summ) is None
+
+
+def test_checkpoint_summary_from_stream(report, tmp_path):
+    """The ISSUE-11 checkpoint view: save/restore ms p50/p95 from the
+    span series, bytes + rollback counters, and the overlap-ratio
+    gauge — plus the printed section with the rollback callout."""
+    f = tmp_path / "ckpt.jsonl"
+    lines = []
+    for v in (0.10, 0.12, 0.14, 0.40):       # save seconds -> ms
+        lines.append('{"schema_version":3,"t":1,"type":"span",'
+                     f'"name":"checkpoint.save","value":{v}}}')
+    lines.append('{"schema_version":3,"t":1,"type":"span",'
+                 '"name":"checkpoint.blocking","value":0.002}')
+    lines.append('{"schema_version":3,"t":2,"type":"span",'
+                 '"name":"checkpoint.restore","value":0.25}')
+    for name, v in (("checkpoint.saves", 4), ("checkpoint.bytes", 8192),
+                    ("checkpoint.restores", 1),
+                    ("checkpoint.rollbacks", 1)):
+        lines.append('{"schema_version":3,"t":3,"type":"counter",'
+                     f'"name":"{name}","value":{v}}}')
+    lines.append('{"schema_version":3,"t":3,"type":"gauge",'
+                 '"name":"checkpoint.overlap_ratio","value":0.996}')
+    f.write_text("\n".join(lines) + "\n")
+    summ = report.summarize(report.load_records([str(f)]))
+    ck = report.checkpoint_summary(summ)
+    assert ck is not None
+    assert ck["saves"] == 4 and ck["bytes"] == 8192
+    assert ck["rollbacks"] == 1 and ck["restores"] == 1
+    # nearest-rank on 4 samples: p50 -> index round(1.5) = 2
+    assert ck["save_ms"]["p50"] == pytest.approx(140.0)
+    assert ck["save_ms"]["p95"] == pytest.approx(400.0)
+    assert ck["restore_ms"]["p50"] == pytest.approx(250.0)
+    assert ck["blocking_ms"]["p50"] == pytest.approx(2.0)
+    assert ck["overlap_ratio"] == pytest.approx(0.996)
+    out = io.StringIO()
+    report.print_report(summ, out=out)
+    text = out.getvalue()
+    assert "checkpointing (checkpoint.*)" in text
+    assert "overlap ratio 0.996" in text
+    assert "ROLLBACKS 1" in text
+    assert "health_report" in text
+
+
+def test_checkpoint_summary_absent_without_series(report, tmp_path):
+    f = tmp_path / "nock.jsonl"
+    f.write_text('{"schema_version":3,"t":1,"type":"counter",'
+                 '"name":"train.overflow_count","value":2}\n')
+    summ = report.summarize(report.load_records([str(f)]))
+    assert report.checkpoint_summary(summ) is None

@@ -64,7 +64,32 @@ _HINTS = {
                      "overhead (pool too small -> raise num_blocks), "
                      "and compile.serving.* (a retrace storm stalls "
                      "first tokens)",
+    "rollback": "the job recovered itself (rollback-to-last-good + LR "
+                "re-warm, see the line above); verify the post-rollback "
+                "loss rejoined the pre-incident trajectory, and fix the "
+                "root cause named by the triggering anomaly — repeated "
+                "rollbacks raise RecoveryGivingUp",
 }
+
+
+def _rollback_lines(details: List[dict]) -> List[str]:
+    """One human line per ``anomaly.rollback`` detail dict (dump
+    anomaly entries or trace instant args): the rollback-to step and
+    the LR re-warm schedule — the ISSUE 11 incident summary."""
+    out = []
+    for d in details:
+        to_step = d.get("to_step")
+        floor = d.get("lr_scale_floor")
+        steps = d.get("rewarm_steps")
+        line = (f"rollback #{d.get('rollback_count', '?')}: anomaly at "
+                f"step {d.get('from_step', '?')} -> resumed from "
+                f"checkpoint step {to_step if to_step is not None else '?'}")
+        if floor is not None and steps is not None:
+            line += (f"; LR re-warm {floor}x -> 1.0x over {steps} steps "
+                     f"(full LR from step "
+                     f"{'?' if to_step is None else to_step + steps})")
+        out.append(line)
+    return out
 
 
 def _parse_series_key(key: str):
@@ -286,6 +311,12 @@ def render_dump(doc: dict, out=None, last: int = 12) -> None:
             p(f"{str(s.get('step', '?')):>7}{mark} " + " ".join(row))
         if first_step is not None:
             p("(* = first anomalous step)")
+    rollbacks = [a.get("detail") or {} for a in anomalies
+                 if a.get("kind") == "rollback"]
+    if rollbacks:
+        p("\n== recovery (rollback-to-last-good, ISSUE 11) ==")
+        for line in _rollback_lines(rollbacks):
+            p(line)
     runtime = doc.get("runtime") or {}
     if runtime.get("compile"):
         c = runtime["compile"]
@@ -332,9 +363,13 @@ def render_trace(events: List[dict], out=None) -> None:
     asyncs: dict = {}
     instants: dict = {}
     end_args: List[dict] = []
+    rollback_args: List[dict] = []
     for ev in events:
         ph = ev.get("ph")
         name = ev.get("name", "?")
+        if (ph == "i" and name == "anomaly.rollback"
+                and isinstance(ev.get("args"), dict)):
+            rollback_args.append(ev["args"])
         if ph == "X":
             slices.setdefault(name, []).append(
                 float(ev.get("dur", 0.0)) / 1e6)
@@ -383,6 +418,12 @@ def render_trace(events: List[dict], out=None) -> None:
         p("\n== instant events ==")
         for name in sorted(instants):
             p(f"  {name:<44} {instants[name]}")
+    if rollback_args:
+        p("\n== recovery (rollback-to-last-good, ISSUE 11) ==")
+        for line in _rollback_lines(rollback_args):
+            p(line)
+        p("\n== next actions ==")
+        p(f"- [rollback] {_HINTS['rollback']}")
     if not (slices or asyncs or counters or instants):
         p("(no recognizable events — is this really a trace file?)")
 

@@ -33,10 +33,11 @@ prints:
   summary families) could see;
 - derived views when their series are present: ring collectives
   (``collectives.ring.*`` → implied tp), speculative decoding
-  (``generate.spec.*`` → accept rate + verify-call amortization), and
-  the paged serving engine (``serving.blocks_*`` +
+  (``generate.spec.*`` → accept rate + verify-call amortization), the
+  paged serving engine (``serving.blocks_*`` +
   ``serving.preemptions`` → block-pool high-water, preemption rate,
-  prefix-share ratio).
+  prefix-share ratio), and async checkpointing (``checkpoint.*`` →
+  save/restore ms p50/p95, bytes, overlap ratio, rollback count).
 
 ``--since-step N`` keeps only records stamped with ``step >= N``
 (schema v2 stamps every record emitted after the loop declared a step
@@ -301,6 +302,44 @@ def moe_summary(summary: dict) -> Optional[dict]:
     return out
 
 
+def checkpoint_summary(summary: dict) -> Optional[dict]:
+    """Derived view of the async-checkpoint telemetry (``checkpoint.*``,
+    ISSUE 11): save/restore wall p50/p95 (ms, from the span series —
+    exact, every save is in the stream), bytes written, the last
+    observed overlap ratio (1.0 = the write was entirely hidden behind
+    the next step), and the rollback count (each one is an
+    ``anomaly.rollback`` incident the flight recorder also holds).
+    None when the stream carries no checkpoint series (runs without a
+    saver, pre-ISSUE-11 writers)."""
+    spans = summary["spans"]
+    counters = summary["counters"]
+    saves = counters.get("checkpoint.saves", 0.0)
+    restores = counters.get("checkpoint.restores", 0.0)
+    rollbacks = counters.get("checkpoint.rollbacks", 0.0)
+    if not (saves or restores or rollbacks):
+        return None
+
+    def _ms(name):
+        vals = sorted(spans.get(name) or [])
+        if not vals:
+            return None
+        return {"p50": _pct(vals, 0.50) * 1e3,
+                "p95": _pct(vals, 0.95) * 1e3,
+                "count": len(vals)}
+
+    overlap = summary["gauges"].get("checkpoint.overlap_ratio")
+    return {
+        "saves": saves,
+        "restores": restores,
+        "rollbacks": rollbacks,
+        "bytes": counters.get("checkpoint.bytes", 0.0),
+        "save_ms": _ms("checkpoint.save"),
+        "blocking_ms": _ms("checkpoint.blocking"),
+        "restore_ms": _ms("checkpoint.restore"),
+        "overlap_ratio": overlap[-1] if overlap else None,
+    }
+
+
 def serving_summary(summary: dict) -> Optional[dict]:
     """Derived view of the paged serving engine's telemetry (ISSUE 6):
     block-pool high-water mark, preemption rate per admitted request,
@@ -430,6 +469,27 @@ def print_report(summary: dict, out=None) -> None:
             print(f"  expert load max {moe['expert_load_max']:g} / "
                   f"mean {moe['expert_load_mean']:g} -> imbalance "
                   f"{moe['load_imbalance']:.3g} (1.0 = balanced)",
+                  file=out)
+    ckpt = checkpoint_summary(summary)
+    if ckpt:
+        print("== checkpointing (checkpoint.*) ==", file=out)
+        line = (f"  saves {ckpt['saves']:g}  bytes {ckpt['bytes']:g}")
+        if ckpt["overlap_ratio"] is not None:
+            line += f"  overlap ratio {ckpt['overlap_ratio']:.3g}"
+        print(line, file=out)
+        for label, key in (("save", "save_ms"),
+                           ("loop-thread blocking", "blocking_ms"),
+                           ("restore", "restore_ms")):
+            ms = ckpt[key]
+            if ms:
+                print(f"  {label} ms p50 {ms['p50']:.4g}  p95 "
+                      f"{ms['p95']:.4g}  (n={ms['count']})", file=out)
+        if ckpt["restores"]:
+            print(f"  restores {ckpt['restores']:g}", file=out)
+        if ckpt["rollbacks"]:
+            print(f"  ROLLBACKS {ckpt['rollbacks']:g} — detector-driven "
+                  "recovery fired; see the flight-recorder dump "
+                  "(tools/health_report.py) for the incident(s)",
                   file=out)
     serving = serving_summary(summary)
     if serving:
