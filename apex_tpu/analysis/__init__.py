@@ -1,0 +1,49 @@
+"""apex_tpu.analysis — two-tier static analysis for the repo's invariants.
+
+Eleven PRs of accreted invariants — the telemetry zero-overhead fast
+path, ``APEX_TPU_*=kernel|reference|auto`` env routing with
+warn-by-name, ring-only collectives inside ``overlap_scope``,
+donation-safe jits, trace-time counter accounting — used to be enforced
+by one grep test and reviewer memory.  This package turns them into
+checked rules:
+
+- **Tier A** (:mod:`rules` + :mod:`linter`, stdlib ``ast`` only — no
+  jax import, runnable on any box): an AST rule framework over the repo
+  source.  ``tools/lint.py`` is the CLI;
+  ``tests/test_observability_guard.py`` is the tier-1 wrapper.
+- **Tier B** (:mod:`jaxpr_audit`): traces the canonical entry points
+  (AMP/DDP train step, ``decode_step`` both cache layouts, spec-decode
+  verify, MoE ragged, the TP overlap ring) and walks the ClosedJaxpr —
+  collective census vs the trace-time ``collectives.*``/``moe.*``
+  counters (accounting-drift detector), no monolithic collectives under
+  an active ``overlap_scope``, no unexplained bf16→f32 upcasts, donated
+  buffers actually donated, no dead equations.  The ``static_audit``
+  dryrun phase in ``__graft_entry__.py`` gates it.
+
+Import discipline: everything except :mod:`jaxpr_audit` must stay
+importable without jax (``tools/lint.py`` runs on router boxes and in
+pre-commit hooks); :mod:`jaxpr_audit` imports jax lazily inside its
+functions.
+
+The metric-prefix rule (APX105) exempts this package the way it exempts
+``apex_tpu/observability``: the auditor *reads* counter values by name
+to diff them against the jaxpr census — it never emits into the
+accounting streams the rule protects.
+
+See docs/static_analysis.md for the rule table, suppression syntax
+(``# apexlint: disable=APX301``) and the baseline workflow.
+"""
+
+from __future__ import annotations
+
+__all__ = ["linter", "rules", "env_registry", "callgraph", "jaxpr_audit"]
+
+
+def __getattr__(name):
+    # lazy: `import apex_tpu.analysis` must not drag jax in (jaxpr_audit
+    # imports it lazily itself, but keep even the module load deferred)
+    if name in __all__:
+        import importlib
+
+        return importlib.import_module(f"{__name__}.{name}")
+    raise AttributeError(name)

@@ -1,0 +1,198 @@
+"""Heuristic traced-code reachability over one module's AST.
+
+The host-sync rules (APX301/APX302) only make sense inside code that
+runs under a jax trace — ``time.time()`` in the serving engine's poll
+loop is correct host code; the same call inside a ``lax.while_loop``
+body is a silent per-step constant.  Whole-program points-to analysis
+is out of scope for a stdlib linter, so this module computes a
+*per-module over-approximation* that has proven adequate for the repo's
+idioms:
+
+1. **Trace roots.** A function is a root when it is
+
+   - decorated with ``jax.jit`` / ``jit`` / ``jax.pmap`` /
+     ``jax.shard_map`` — bare, called (``@jax.jit(...)``,
+     ``@functools.partial(jax.jit, ...)``), or nested in ``partial``;
+   - passed *by name* to a known tracing entry point anywhere in the
+     module: ``jax.jit(f)``, ``jax.lax.scan(f, ...)``,
+     ``lax.while_loop(cond, body, ...)``, ``lax.cond``/``switch``
+     branches, ``jax.shard_map(f, ...)``, ``jax.vmap``, ``jax.grad`` /
+     ``value_and_grad``, ``jax.checkpoint``/``remat``,
+     ``jax.custom_vjp``/``custom_jvp`` (+ ``.defvjp`` arguments),
+     ``jax.make_jaxpr``;
+   - defined *inside* a traced function (local helpers defined under a
+     trace are traced when called — the dominant repo pattern).
+
+2. **Propagation.** Tracedness flows through plain ``Name`` calls
+   resolved to functions defined in the same module (methods propagate
+   through ``self.<name>``/``cls.<name>`` too).
+
+Cross-module edges are NOT followed: a traced function calling an
+imported helper does not mark that helper in its home module.  The
+repo's traced helpers overwhelmingly live next to their entry points
+(generate/speculative/moe/engine), and the per-module approximation
+keeps the false-positive rate low enough to run as an error-severity
+rule.  Deliberate host paths inside traced regions carry an inline
+``# apexlint: disable=...`` with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set
+
+__all__ = ["TRACE_ENTRY_NAMES", "traced_functions"]
+
+# dotted-call suffixes that trace their function-valued arguments.
+# Matching is on the rightmost attribute path, so ``jax.lax.scan``,
+# ``lax.scan`` and a bare ``scan`` (from-imported) all hit "scan".
+TRACE_ENTRY_NAMES = {
+    "jit", "pmap", "shard_map", "scan", "while_loop", "cond", "switch",
+    "vmap", "grad", "value_and_grad", "custom_vjp", "custom_jvp",
+    "defvjp", "checkpoint", "remat", "make_jaxpr", "associative_scan",
+    "fori_loop",
+}
+
+_JIT_DECORATORS = {"jit", "pmap", "shard_map"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` → "a.b.c"; plain names → "a"; anything else → None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _decorator_traces(dec: ast.AST) -> bool:
+    """Does this decorator expression put the function under a trace?"""
+    d = _terminal(_dotted(dec))
+    if d in _JIT_DECORATORS:
+        return True
+    if isinstance(dec, ast.Call):
+        # @jax.jit(...)-style, @functools.partial(jax.jit, ...), and
+        # nested partials — anything mentioning a jit-family callable
+        if _terminal(_dotted(dec.func)) in _JIT_DECORATORS:
+            return True
+        for sub in ast.walk(dec):
+            if (isinstance(sub, (ast.Attribute, ast.Name))
+                    and _terminal(_dotted(sub)) in _JIT_DECORATORS):
+                return True
+    return False
+
+
+class _FunctionIndex(ast.NodeVisitor):
+    """Collect every function with its qualname, parent chain and the
+    set of local callee names it invokes."""
+
+    def __init__(self):
+        self.funcs: Dict[str, ast.AST] = {}        # qualname -> node
+        self.parents: Dict[str, Optional[str]] = {}
+        self.calls: Dict[str, Set[str]] = {}       # qualname -> callees
+        self._stack = []
+
+    def _visit_func(self, node):
+        qual = ".".join([*self._stack, node.name])
+        self.funcs[qual] = node
+        self.parents[qual] = ".".join(self._stack) or None
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_Call(self, node):
+        if self._stack:
+            qual = ".".join(self._stack)
+            callee = _dotted(node.func)
+            if callee is not None:
+                # self.f() / cls.f() resolve to the sibling method name
+                if callee.startswith(("self.", "cls.")):
+                    callee = callee.split(".", 1)[1]
+                self.calls.setdefault(qual, set()).add(callee)
+        self.generic_visit(node)
+
+
+def _name_args(call: ast.Call):
+    """Bare-Name positional/keyword arguments of a call (the function
+    references tracing entry points consume)."""
+    for arg in call.args:
+        if isinstance(arg, ast.Name):
+            yield arg.id
+    for kw in call.keywords:
+        if isinstance(kw.value, ast.Name):
+            yield kw.value.id
+
+
+def traced_functions(tree: ast.Module) -> Dict[str, str]:
+    """Map qualname → reason for every function the heuristic considers
+    reachable from a jax trace."""
+    index = _FunctionIndex()
+    index.visit(tree)
+
+    traced: Dict[str, str] = {}
+
+    def mark(qual: str, reason: str):
+        if qual not in traced:
+            traced[qual] = reason
+
+    # (a) decorator roots
+    for qual, node in index.funcs.items():
+        for dec in getattr(node, "decorator_list", ()):
+            if _decorator_traces(dec):
+                mark(qual, "jit-decorated")
+
+    # (b) by-name arguments of tracing entry points, anywhere
+    entry_args: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            if _terminal(_dotted(node.func)) in TRACE_ENTRY_NAMES:
+                entry_args.update(_name_args(node))
+    for qual, node in index.funcs.items():
+        if node.name in entry_args:
+            mark(qual, f"passed to a tracing entry point ({node.name})")
+
+    # (c) nesting: a def inside a traced function is traced
+    changed = True
+    while changed:
+        changed = False
+        for qual in index.funcs:
+            if qual in traced:
+                continue
+            parent = index.parents.get(qual)
+            while parent is not None:
+                if parent in traced and parent in index.funcs:
+                    mark(qual, f"defined inside traced {parent}")
+                    changed = True
+                    break
+                parent = index.parents.get(parent)
+        # (d) propagation through local Name calls
+        for qual in list(traced):
+            for callee in index.calls.get(qual, ()):
+                term = _terminal(callee)
+                for cq, cnode in index.funcs.items():
+                    if cnode.name == term and cq not in traced:
+                        # only same-scope or module-level resolution:
+                        # avoid marking an unrelated method of another
+                        # class that happens to share the name
+                        if ("." not in cq
+                                or index.parents.get(cq) ==
+                                index.parents.get(qual)
+                                or cq.startswith(qual + ".")):
+                            mark(cq, f"called from traced {qual}")
+                            changed = True
+    return traced

@@ -1,0 +1,250 @@
+"""Tier-A linter driver: walk targets, run rules, apply suppressions,
+diff against the committed baseline.
+
+The contract (mirrors the dryrun-gate philosophy — CI enforces, the
+author iterates locally):
+
+- ``lint(root)`` returns every live finding (suppressions already
+  applied) in a stable order.
+- ``LINT_BASELINE.json`` at the repo root grandfathers pre-existing
+  findings *with a one-line justification each*; ``tools/lint.py``
+  exits non-zero only on findings absent from the baseline, and warns
+  about stale baseline entries so the file shrinks as debt is paid.
+- Fingerprints are line-number-free (rule + path + offending source
+  text + ordinal), so unrelated edits above a grandfathered finding do
+  not churn the baseline.
+
+Suppression syntax, checked right here:
+
+- ``# apexlint: disable=APX301`` (comma list, or ``all``) on the
+  offending line;
+- ``# apexlint: skip-file`` within a file's first ten lines.
+
+Stdlib-only by contract (no jax): tools/lint.py must run on boxes
+without an accelerator stack, and in pre-commit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from apex_tpu.analysis.rules import (
+    ALL_RULES,
+    Finding,
+    ModuleInfo,
+    Rule,
+)
+
+__all__ = [
+    "DEFAULT_TARGETS",
+    "BASELINE_FILE",
+    "lint",
+    "load_baseline",
+    "write_baseline",
+    "diff_baseline",
+    "changed_files",
+    "fingerprints",
+]
+
+# Linted by default: the package plus everything that ships invariants
+# (tools, bench, the gate, examples).  tests/ are deliberately out —
+# fixtures plant anti-patterns on purpose.
+DEFAULT_TARGETS = (
+    "apex_tpu",
+    "tools",
+    "examples",
+    "bench.py",
+    "bench_kernels.py",
+    "__graft_entry__.py",
+)
+
+BASELINE_FILE = "LINT_BASELINE.json"
+
+_SUPPRESS = "# apexlint:"
+
+
+def _iter_files(root: str, targets: Sequence[str]) -> Iterable[str]:
+    for target in targets:
+        path = os.path.join(root, target)
+        if os.path.isfile(path) and path.endswith(".py"):
+            yield path
+        elif os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def _parse_modules(root: str,
+                   targets: Sequence[str]) -> List[ModuleInfo]:
+    modules: List[ModuleInfo] = []
+    for path in _iter_files(root, targets):
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        try:
+            modules.append(ModuleInfo(path, rel, source))
+        except SyntaxError:
+            # a file python itself cannot parse fails imports long
+            # before lint; not this tool's finding to make
+            continue
+    return modules
+
+
+def _skip_file(mod: ModuleInfo) -> bool:
+    return any(_SUPPRESS in line and "skip-file" in line
+               for line in mod.lines[:10])
+
+
+_SUPPRESS_IDS = re.compile(r"\b(?:APX\d+|all)\b")
+
+
+def _suppressed(mod: ModuleInfo, finding: Finding) -> bool:
+    line = mod.line_text(finding.line)
+    idx = line.find(_SUPPRESS)
+    if idx < 0:
+        return False
+    spec = line[idx + len(_SUPPRESS):]
+    if "disable=" not in spec:
+        return False
+    # tolerate any list spelling after disable= ("APX301,APX302",
+    # "APX301, APX302", trailing prose): every APX id / 'all' token
+    # counts — a spacing nuance must never un-suppress a rule
+    wanted = set(_SUPPRESS_IDS.findall(
+        spec.split("disable=", 1)[1]))
+    return "all" in wanted or finding.rule in wanted
+
+
+def lint(root: str, targets: Optional[Sequence[str]] = None,
+         rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Run the rule set over ``targets`` (repo-relative); returns live
+    findings sorted by (path, line, rule)."""
+    targets = tuple(targets or DEFAULT_TARGETS)
+    rules = tuple(rules if rules is not None else ALL_RULES)
+    modules = _parse_modules(root, targets)
+    by_rel = {m.relpath: m for m in modules}
+    findings: List[Finding] = []
+    for mod in modules:
+        if _skip_file(mod):
+            continue
+        for rule in rules:
+            if rule.repo_level:
+                continue
+            findings.extend(rule.check(mod))
+    for rule in rules:
+        if rule.repo_level:
+            findings.extend(rule.check_repo(modules, root))
+    live = []
+    for f in findings:
+        mod = by_rel.get(f.path)
+        if mod is not None and (_skip_file(mod)
+                                or _suppressed(mod, f)):
+            continue
+        live.append(f)
+    live.sort(key=lambda f: (f.path, f.line, f.rule, f.col))
+    return live
+
+
+def fingerprints(findings: Sequence[Finding]) -> List[Tuple[str,
+                                                            Finding]]:
+    """Stable (fingerprint, finding) pairs: identical (rule, path,
+    snippet) triples get ordinals in source order."""
+    seen: Dict[str, int] = {}
+    out = []
+    for f in findings:
+        base = f.fingerprint(0).rsplit(":", 1)[0]
+        ordinal = seen.get(base, 0)
+        seen[base] = ordinal + 1
+        out.append((f.fingerprint(ordinal), f))
+    return out
+
+
+def load_baseline(root: str,
+                  path: Optional[str] = None) -> Dict[str, dict]:
+    """fingerprint -> entry dict (rule/path/snippet/justification)."""
+    path = path or os.path.join(root, BASELINE_FILE)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    return {e["fingerprint"]: e for e in data.get("entries", [])}
+
+
+def write_baseline(root: str, findings: Sequence[Finding],
+                   justifications: Optional[Dict[str, str]] = None,
+                   path: Optional[str] = None) -> str:
+    """Serialize the current findings as the new baseline.  Existing
+    justifications are preserved by fingerprint; new entries get a
+    FILL-ME-IN marker the review is expected to replace."""
+    path = path or os.path.join(root, BASELINE_FILE)
+    old = load_baseline(root, path)
+    entries = []
+    for fp, f in fingerprints(findings):
+        just = (justifications or {}).get(fp) \
+            or old.get(fp, {}).get("justification") \
+            or "FILL-ME-IN: why is this finding deliberate?"
+        entries.append({
+            "fingerprint": fp,
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,
+            "snippet": f.snippet,
+            "message": f.message,
+            "justification": just,
+        })
+    doc = {
+        "comment": ("Grandfathered apexlint findings. Every entry "
+                    "needs a one-line justification; delete entries "
+                    "as the debt is paid (tools/lint.py warns on "
+                    "stale ones)."),
+        "entries": entries,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def diff_baseline(root: str, findings: Sequence[Finding],
+                  path: Optional[str] = None):
+    """(new_findings, stale_entries): findings not in the baseline, and
+    baseline entries whose finding no longer exists."""
+    baseline = load_baseline(root, path)
+    pairs = fingerprints(findings)
+    new = [(fp, f) for fp, f in pairs if fp not in baseline]
+    live = {fp for fp, _ in pairs}
+    stale = [e for fp, e in baseline.items() if fp not in live]
+    return new, stale
+
+
+def changed_files(root: str) -> List[str]:
+    """Repo-relative python files touched vs HEAD (staged, unstaged,
+    untracked) — the pre-commit scope for ``tools/lint.py --changed``."""
+    out: List[str] = []
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        for blob in (diff.stdout, untracked.stdout):
+            for line in blob.splitlines():
+                line = line.strip()
+                if line.endswith(".py") and os.path.exists(
+                        os.path.join(root, line)):
+                    out.append(line)
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return sorted(set(out))

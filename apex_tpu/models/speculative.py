@@ -177,10 +177,13 @@ def _spec_probs(logits, temperature, top_k, top_p, vocab_limit):
         soft = jax.nn.softmax(
             filter_logits(scaled, top_k=top_k, top_p=top_p), axis=-1)
         probs = jnp.where((temps > 0)[:, None], soft, onehot)
-    elif float(temperature) == 0.0:
+    # the ndim guard above already captured every traced form; what
+    # reaches these branches is a python scalar (the generate() path),
+    # so float() here is host arithmetic, not a concretization
+    elif float(temperature) == 0.0:   # apexlint: disable=APX301
         probs = onehot
     else:
-        scaled = flat / float(temperature)
+        scaled = flat / float(temperature)   # apexlint: disable=APX301
         probs = jax.nn.softmax(
             filter_logits(scaled, top_k=top_k, top_p=top_p), axis=-1)
     return probs.reshape(b, m, v)

@@ -49,6 +49,14 @@ Trace-time telemetry (PR-1 registry): every ring loop counts
 ``collectives.ring.bytes`` (+(n−1) × per-hop message bytes) — by
 construction ``hops == (tp−1) × calls`` on a fixed-tp program, the
 invariant the dryrun gate asserts.
+
+The ring-only contract is additionally enforced structurally: the
+``static_audit`` dryrun phase traces these paths under an active
+:func:`overlap_scope` and walks the jaxpr
+(``analysis/jaxpr_audit.py``) — any monolithic
+``all_gather``/``psum``/``all_to_all`` equation inside the overlap
+region fails CI, so a fallback path silently engaging under the scope
+cannot ship.
 """
 
 from __future__ import annotations

@@ -44,6 +44,11 @@ vs uncompressed fp32 payload), ``moe.ring_calls`` / ``moe.ring_hops``
 ``moe.dropped_fraction`` gauge (pinned 0.0 on the ragged path).  The
 data-dependent per-expert assignment counts come back in
 ``MoEOutput.expert_load`` for host-side gauges (bench ``--moe``).
+The dispatch accounting is structurally audited: the ``static_audit``
+dryrun phase traces the EP island and asserts its jaxpr's
+``all_to_all`` census equals the counted-wrapper deltas
+(``analysis/jaxpr_audit.py`` — an exchange emitted around the counted
+wrappers fails CI as accounting drift).
 
 Works on one device (constraints no-op), under ``jit`` over a mesh with
 an ``ep`` axis (``parallel.mesh.create_mesh(ep=...)``), and composes

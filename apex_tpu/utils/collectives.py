@@ -9,6 +9,16 @@ psum. A DDP layer that blindly psums again double-counts (verified on the
 These helpers consult ``jax.typeof(x).vma`` (the set of mesh axes a value
 varies over) to apply a collective only when the value is still
 shard-varying, and a plain division when SPMD-AD has pre-summed.
+
+The ``collectives.*`` counters these helpers book are load-bearing
+beyond dashboards: the Tier-B jaxpr auditor
+(``apex_tpu/analysis/jaxpr_audit.py``, gated by the ``static_audit``
+dryrun phase) diffs them against a census of the collective equations
+that actually landed in each entry point's jaxpr — a collective
+emitted around these wrappers shows up as accounting drift and fails
+CI.  New comm paths must route through this module (or the
+ring/compressed wrappers built on it), not bind ``jax.lax``
+collectives directly.
 """
 
 from __future__ import annotations

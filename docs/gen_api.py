@@ -77,6 +77,18 @@ MODULES = [
      "checkpoint.async_saver — overlapped zero-stall saves"),
     ("apex_tpu.checkpoint.recovery", "checkpoint",
      "checkpoint.recovery — detector-driven rollback + LR re-warm"),
+    # analysis (apexlint)
+    ("apex_tpu.analysis.rules", "analysis",
+     "analysis.rules — Tier-A AST rules (the invariant table)"),
+    ("apex_tpu.analysis.linter", "analysis",
+     "analysis.linter — rule driver, suppressions, baseline diff"),
+    ("apex_tpu.analysis.env_registry", "analysis",
+     "analysis.env_registry — the authoritative APEX_TPU_* table"),
+    ("apex_tpu.analysis.callgraph", "analysis",
+     "analysis.callgraph — traced-code reachability heuristic"),
+    ("apex_tpu.analysis.jaxpr_audit", "analysis",
+     "analysis.jaxpr_audit — Tier-B trace auditor (census, overlap, "
+     "upcasts, donation)"),
     # parallel
     ("apex_tpu.parallel.mesh", "parallel", "parallel.mesh — device mesh"),
     ("apex_tpu.parallel.launch", "parallel",

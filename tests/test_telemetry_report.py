@@ -444,3 +444,51 @@ def test_checkpoint_summary_absent_without_series(report, tmp_path):
                  '"name":"train.overflow_count","value":2}\n')
     summ = report.summarize(report.load_records([str(f)]))
     assert report.checkpoint_summary(summ) is None
+
+
+def test_audit_summary_from_stream(report, tmp_path):
+    """The ISSUE-12 jaxpr-audit view: per-entry census-vs-counter
+    deltas.  Agreement renders 'ok'; census > counted flags the entry
+    as accounting drift (the uncounted-collective direction the
+    static_audit gate fails on); counted > census annotates the benign
+    custom_vjp re-trace direction."""
+    import io
+
+    f = tmp_path / "audit.jsonl"
+    f.write_text(
+        '{"schema_version":3,"t":1,"type":"counter",'
+        '"name":"audit.census.all_to_all","value":3,'
+        '"tags":{"entry":"moe_ragged"}}\n'
+        '{"schema_version":3,"t":1,"type":"counter",'
+        '"name":"audit.counted.all_to_all","value":3,'
+        '"tags":{"entry":"moe_ragged"}}\n'
+        '{"schema_version":3,"t":1,"type":"counter",'
+        '"name":"audit.census.ppermute","value":14,'
+        '"tags":{"entry":"tp_ring_overlap"}}\n'
+        '{"schema_version":3,"t":1,"type":"counter",'
+        '"name":"audit.counted.ppermute","value":12,'
+        '"tags":{"entry":"tp_ring_overlap"}}\n')
+    summ = report.summarize(report.load_records([str(f)]))
+    audit = report.audit_summary(summ["counters"])
+    assert audit is not None
+    moe = audit["moe_ragged"]
+    assert moe["drift"] is False
+    assert moe["kinds"]["all_to_all"]["delta"] == 0
+    ring = audit["tp_ring_overlap"]
+    assert ring["drift"] is True
+    assert ring["kinds"]["ppermute"]["delta"] == pytest.approx(2.0)
+    out = io.StringIO()
+    report.print_report(summ, out=out)
+    text = out.getvalue()
+    assert "jaxpr audit (audit.*)" in text
+    assert "moe_ragged: ok" in text
+    assert "ACCOUNTING DRIFT" in text
+    assert "uncounted collective" in text
+
+
+def test_audit_summary_absent_without_series(report, tmp_path):
+    f = tmp_path / "noaudit.jsonl"
+    f.write_text('{"schema_version":3,"t":1,"type":"counter",'
+                 '"name":"collectives.psum.calls","value":2}\n')
+    summ = report.summarize(report.load_records([str(f)]))
+    assert report.audit_summary(summ["counters"]) is None

@@ -1,0 +1,165 @@
+"""apexlint CLI — the repo's invariants as enforced rules.
+
+    python tools/lint.py                  # whole repo, diff vs baseline
+    python tools/lint.py --changed        # pre-commit: touched files only
+    python tools/lint.py --json           # machine-readable findings
+    python tools/lint.py --write-baseline # grandfather current findings
+    python tools/lint.py --audit          # ALSO run the Tier-B jaxpr
+                                          # auditor (imports jax)
+
+Exit status: 0 when every live finding is baselined (each baseline
+entry carries a one-line justification — see LINT_BASELINE.json), 1 on
+any NEW finding, and (with ``--audit``) 1 on any Tier-B finding.
+
+Tier A is stdlib-only: no jax import, runnable on a router box or in a
+pre-commit hook.  ``--changed`` restricts per-file rules to files
+touched vs HEAD (staged + unstaged + untracked) — repo-level rules
+(docs-sync, env-table-sync, donation's cross-module pass) only see the
+changed set there, so CI runs the full form.
+
+The rule table, suppression syntax and baseline workflow are in
+docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from apex_tpu.analysis import linter  # noqa: E402  (path setup first)
+
+
+def _print_findings(pairs, out) -> None:
+    for fp, f in pairs:
+        print(f"{f.path}:{f.line}:{f.col}: {f.rule} [{f.severity}] "
+              f"{f.message}", file=out)
+        if f.snippet:
+            print(f"    {f.snippet}", file=out)
+        print(f"    fingerprint: {fp}", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="apexlint: AST repo linter + jaxpr trace auditor")
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative targets (default: the package, "
+                         "tools, bench, examples, the dryrun gate)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only python files touched vs HEAD "
+                         "(the pre-commit scope)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: LINT_BASELINE.json "
+                         "at the repo root)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="serialize the current findings as the new "
+                         "baseline (preserves existing justifications)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every live finding, baselined or not")
+    ap.add_argument("--audit", action="store_true",
+                    help="also run the Tier-B jaxpr auditor over the "
+                         "entry-point matrix (imports jax)")
+    ap.add_argument("--audit-entry", action="append", default=None,
+                    metavar="NAME",
+                    help="audit only this entry (repeatable; implies "
+                         "--audit)")
+    args = ap.parse_args(argv)
+
+    targets = args.paths or None
+    if args.changed:
+        changed = linter.changed_files(ROOT)
+        if not changed and not args.write_baseline:
+            print("apexlint: no changed python files")
+            return 0
+        targets = changed
+    if args.write_baseline and targets is not None:
+        # the baseline file is the WHOLE repo's grandfather list: a
+        # narrowed scan would silently delete every entry for a file
+        # outside the scope, and the next full CI lint re-reports them
+        # all as NEW
+        print("apexlint: --write-baseline always scans the full repo "
+              "(--changed/paths ignored for the write)")
+        targets = None
+    findings = linter.lint(ROOT, targets=targets)
+
+    rc = 0
+    if args.write_baseline:
+        path = linter.write_baseline(ROOT, findings,
+                                     path=args.baseline)
+        print(f"apexlint: baseline written to {path} "
+              f"({len(findings)} entr{'y' if len(findings) == 1 else 'ies'})")
+    elif args.no_baseline:
+        pairs = linter.fingerprints(findings)
+        if args.json:
+            print(json.dumps([dict(fingerprint=fp,
+                                   **f.__dict__) for fp, f in pairs],
+                             indent=1))
+        else:
+            _print_findings(pairs, sys.stdout)
+            print(f"apexlint: {len(pairs)} live finding(s)")
+        rc = 1 if pairs else 0
+    else:
+        new, stale = linter.diff_baseline(ROOT, findings,
+                                          path=args.baseline)
+        if targets is not None:
+            # narrowed scope (--changed / explicit paths): a baseline
+            # entry for an un-scanned file is absent from the findings
+            # by construction, not fixed — stale detection is only
+            # meaningful on a full-repo scan
+            stale = []
+        if args.json:
+            print(json.dumps({
+                "new": [dict(fingerprint=fp, **f.__dict__)
+                        for fp, f in new],
+                "stale_baseline": stale,
+                "total_live": len(findings),
+            }, indent=1))
+        else:
+            if new:
+                print("apexlint: NEW findings (not in the baseline):")
+                _print_findings(new, sys.stdout)
+            for e in stale:
+                print("apexlint: stale baseline entry (finding no "
+                      f"longer exists — delete it): {e['fingerprint']} "
+                      f"{e['path']}: {e['snippet']}")
+            print(f"apexlint: {len(findings)} live, {len(new)} new, "
+                  f"{len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'}")
+        rc = 1 if new else rc
+
+    if args.audit or args.audit_entry:
+        # Tier B needs jax; pick up env-configured telemetry first so
+        # the audit.census/audit.counted counters land in the stream
+        # telemetry_report's audit_summary reads
+        from apex_tpu.analysis import jaxpr_audit
+        from apex_tpu.observability import metrics as _telemetry
+
+        owned = False
+        if _telemetry.registry() is None:
+            owned = _telemetry.configure_from_env() is not None
+        reports = jaxpr_audit.run_audit(
+            tuple(args.audit_entry) if args.audit_entry else None)
+        for r in reports:
+            status = "ok" if r.ok else "FAIL"
+            print(f"audit {r.name}: {status} census={r.census} ")
+            for f in r.findings:
+                print(f"  FINDING: {f}")
+            for n in r.notes:
+                print(f"  note: {n}")
+        if owned:
+            from apex_tpu.observability import shutdown
+
+            shutdown()
+        if any(not r.ok for r in reports):
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -148,6 +148,21 @@ def main():
     # matrix and the Mosaic-validation tier run BEFORE the long kernel
     # ledgers, so a mid-campaign wedge costs the least-valuable stages.
     results = {}
+    # static analysis first (ISSUE 12): Tier A is seconds and chip-free,
+    # and the Tier-B jaxpr audit is tracing-only — a broken invariant
+    # should abort-signal before any chip time is spent.  The audit's
+    # census/counted counters land in their own JSONL so the campaign's
+    # telemetry_report shows the audit_summary section.
+    results["lint"] = _run(
+        "lint", [sys.executable, "tools/lint.py"], timeout=600)
+    results["dryrun_static_audit"] = _run(
+        "dryrun_static_audit",
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        env_extra={"APEX_TPU_DRYRUN_PHASE": "static_audit",
+                   "APEX_TPU_TELEMETRY": os.path.join(
+                       LOGS, "audit_telemetry.jsonl")},
+        timeout=1200)
     results["bench"] = _run("bench", [sys.executable, "bench.py"],
                             timeout=3600)
     # the inference fast path (prefill/decode split + serving engine):

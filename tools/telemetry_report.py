@@ -36,8 +36,11 @@ prints:
   (``generate.spec.*`` → accept rate + verify-call amortization), the
   paged serving engine (``serving.blocks_*`` +
   ``serving.preemptions`` → block-pool high-water, preemption rate,
-  prefix-share ratio), and async checkpointing (``checkpoint.*`` →
-  save/restore ms p50/p95, bytes, overlap ratio, rollback count).
+  prefix-share ratio), async checkpointing (``checkpoint.*`` →
+  save/restore ms p50/p95, bytes, overlap ratio, rollback count), and
+  the Tier-B jaxpr audit (``audit.*`` → per-entry-point
+  census-vs-counter deltas — accounting drift visible in reports, not
+  just in the static_audit CI gate).
 
 ``--since-step N`` keeps only records stamped with ``step >= N``
 (schema v2 stamps every record emitted after the loop declared a step
@@ -340,6 +343,49 @@ def checkpoint_summary(summary: dict) -> Optional[dict]:
     }
 
 
+def audit_summary(counters: Dict[str, float]) -> Optional[dict]:
+    """Derived view of the Tier-B jaxpr-audit telemetry (``audit.*``,
+    ISSUE 12): for every audited entry point, the per-collective-kind
+    jaxpr census vs the trace-time ``collectives.*`` counter delta the
+    auditor observed while tracing it.  ``census > counted`` is the
+    accounting hole the static_audit gate fails on (a collective
+    emitted around the counted wrappers); ``counted > census`` is the
+    benign custom_vjp re-trace direction.  None when the stream carries
+    no audit counters (runs without ``tools/lint.py --audit`` or the
+    ``dryrun_static_audit`` stage)."""
+    entries: Dict[str, dict] = {}
+    for key, val in counters.items():
+        if not key.startswith("audit."):
+            continue
+        base, _, tag = key.partition("{")
+        entry = "?"
+        if tag.startswith("entry="):
+            entry = tag[len("entry="):].rstrip("}")
+        parts = base.split(".")
+        if len(parts) != 3 or parts[1] not in ("census", "counted"):
+            continue
+        kind = parts[2]
+        slot = entries.setdefault(entry, {}).setdefault(
+            kind, {"census": 0.0, "counted": 0.0})
+        slot[parts[1]] += val
+    if not entries:
+        return None
+    out: Dict[str, dict] = {}
+    for entry, kinds in sorted(entries.items()):
+        rows = {}
+        for kind, v in sorted(kinds.items()):
+            rows[kind] = {
+                "census": v["census"],
+                "counted": v["counted"],
+                "delta": v["census"] - v["counted"],
+            }
+        out[entry] = {
+            "kinds": rows,
+            "drift": any(r["delta"] > 0 for r in rows.values()),
+        }
+    return out
+
+
 def serving_summary(summary: dict) -> Optional[dict]:
     """Derived view of the paged serving engine's telemetry (ISSUE 6):
     block-pool high-water mark, preemption rate per admitted request,
@@ -491,6 +537,21 @@ def print_report(summary: dict, out=None) -> None:
                   "recovery fired; see the flight-recorder dump "
                   "(tools/health_report.py) for the incident(s)",
                   file=out)
+    audit = audit_summary(counters) if counters else None
+    if audit:
+        print("== jaxpr audit (audit.*) ==", file=out)
+        for entry, info in audit.items():
+            flag = ("ACCOUNTING DRIFT — census exceeds counters; see "
+                    "the static_audit gate" if info["drift"] else "ok")
+            print(f"  {entry}: {flag}", file=out)
+            for kind, r in info["kinds"].items():
+                mark = ""
+                if r["delta"] > 0:
+                    mark = "  <-- uncounted collective(s)"
+                elif r["delta"] < 0:
+                    mark = "  (custom_vjp re-trace overcount)"
+                print(f"    {kind:<14} census {r['census']:g}  counted "
+                      f"{r['counted']:g}{mark}", file=out)
     serving = serving_summary(summary)
     if serving:
         print("== paged serving (serving.blocks_*) ==", file=out)
