@@ -45,10 +45,10 @@ import jax.numpy as jnp
 
 __all__ = ["amp_patch_scope", "PATCHED_COMPUTE", "PATCHED_FP32"]
 
-_tls = threading.local()          # .depth (int), .compute_dtype
+_tls = threading.local()          # .depth (int), .compute_dtype  # guarded-by: local
 _global_lock = threading.Lock()   # guards the module-attribute swap
-_scope_count = 0                  # process-wide count of live scopes
-_saved: list = []                 # originals while any scope is live
+_scope_count = 0                  # live scopes, process-wide  # guarded-by: _global_lock
+_saved: list = []                 # originals while any scope is live  # guarded-by: _global_lock
 
 
 def _is_array(x) -> bool:

@@ -19,11 +19,23 @@ checked rules:
   an active ``overlap_scope``, no unexplained bf16→f32 upcasts, donated
   buffers actually donated, no dead equations.  The ``static_audit``
   dryrun phase in ``__graft_entry__.py`` gates it.
+- **Tier C** (:mod:`concurrency` + :mod:`lifecycle`, stdlib ``ast``
+  like Tier A): the host control plane's thread discipline — a
+  thread-escape graph over every Thread/ThreadingHTTPServer spawn
+  site (APX501 unguarded cross-thread mutation), the ``# guarded-by:``
+  annotation convention (APX502), lock-order cycles (APX503),
+  thread/server lifecycle incl. the join-before-server_close ordering
+  (APX504), and paired acquire/release with unwind edges — the PR-6
+  ``_admit`` leak class — (APX505).  :mod:`stress` is the dynamic
+  half: a seeded scrape/flush/save/churn smoke asserting exact sketch
+  counts, zero refcount underflow and clean thread shutdown; the
+  ``concurrency_audit`` dryrun phase gates both.
 
 Import discipline: everything except :mod:`jaxpr_audit` must stay
 importable without jax (``tools/lint.py`` runs on router boxes and in
-pre-commit hooks); :mod:`jaxpr_audit` imports jax lazily inside its
-functions.
+pre-commit hooks); :mod:`jaxpr_audit` — and :mod:`stress`, which
+drives jax-touching subsystems — import their heavy dependencies
+lazily inside functions.
 
 The metric-prefix rule (APX105) exempts this package the way it exempts
 ``apex_tpu/observability``: the auditor *reads* counter values by name
@@ -36,7 +48,8 @@ See docs/static_analysis.md for the rule table, suppression syntax
 
 from __future__ import annotations
 
-__all__ = ["linter", "rules", "env_registry", "callgraph", "jaxpr_audit"]
+__all__ = ["linter", "rules", "env_registry", "callgraph", "jaxpr_audit",
+           "concurrency", "lifecycle", "stress"]
 
 
 def __getattr__(name):

@@ -1,0 +1,1116 @@
+"""Tier-C concurrency rules: the host control plane's thread discipline.
+
+Tier A audits what the repo *traces*; this module audits what it
+*threads*.  Nine PRs quietly grew a real host control plane — the async
+checkpoint writer (:mod:`~apex_tpu.checkpoint.async_saver`), the
+telemetry exporter's ``ThreadingHTTPServer``, cluster worker stdout
+drains, the data-prefetch producer — all sharing lock-guarded ledgers
+(the metrics registry, the :class:`~apex_tpu.serving.paged_cache.\
+BlockManager`).  Their synchronization contracts lived in docstrings;
+this module makes them mechanical (veScale's thesis: an eager control
+plane stays consistent at scale only when its disciplines are checkable
+by construction).
+
+Rules (stdlib ``ast`` only, same Rule/fingerprint/baseline machinery as
+Tier A):
+
+- ``APX501`` unguarded-cross-thread-mutation — build a *thread-escape
+  graph* from every ``threading.Thread(target=...)`` /
+  ``ThreadingHTTPServer`` spawn site, compute the functions reachable
+  from each thread target (same-module, transitively), and flag
+  attributes **written** on both the spawning side and the thread side
+  with no common ``with <lock>:`` scope.
+- ``APX502`` guarded-by-discipline — a ``# guarded-by: <spec>``
+  annotation on a shared attribute's defining assignment, enforced at
+  every access site.  Specs:
+
+  * ``self._lock`` (a lock expression): every access outside
+    ``__init__`` must sit inside ``with <that expr>:``;
+  * ``join(self._thread)``: ordering via join — spawning-side accesses
+    must be in a function that joins the writer thread first;
+  * ``confined(<owner>)``: single-thread confinement — the attribute
+    must be unreachable from any thread target in the module;
+  * ``queue`` / ``event`` / ``deque`` / ``lock`` / ``local``: the
+    object's own synchronization — the annotated initializer must
+    construct that thread-safe type.
+
+- ``APX503`` lock-order — a repo-level acquisition-order graph (lexical
+  ``with`` nesting plus one level of same-module call propagation);
+  any cycle is a potential deadlock.
+
+Honest limits (documented in docs/static_analysis.md): the escape graph
+is per-module (a thread target calling an *imported* helper is not
+followed), thread targets must be resolvable names (``self.method``, a
+local ``def``, a handler class, or an alias bound via ``x = self``),
+``__init__`` writes are treated as happens-before the spawn, and
+accesses through receivers other than ``self`` (``m.value`` from a
+registry loop) are out of scope.  APX501 checks *write/write* races;
+annotate read-heavy shared state with ``guarded-by`` so APX502 covers
+the reads.
+
+Stdlib-only by contract: no jax, no apex_tpu imports beyond the sibling
+analysis modules.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from apex_tpu.analysis.rules import Finding, ModuleInfo, Rule
+
+__all__ = [
+    "CONCURRENCY_RULES",
+    "GuardSpec",
+    "ThreadModel",
+    "parse_guard_spec",
+    "thread_model",
+]
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+# Constructors whose instances carry their own synchronization: an
+# attribute initialized to one of these is a handoff object, not shared
+# mutable state (queue.Queue puts are the sync; deque append/popleft
+# are atomic; Event set/is_set are the flag protocol).
+SAFE_TYPE_KEYWORDS: Dict[str, Tuple[str, ...]] = {
+    "queue": ("Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"),
+    "event": ("Event",),
+    "deque": ("deque",),
+    "lock": ("Lock", "RLock", "Condition", "Semaphore",
+             "BoundedSemaphore", "Barrier"),
+    "local": ("local",),
+}
+_SAFE_CONSTRUCTORS = frozenset(
+    t for ts in SAFE_TYPE_KEYWORDS.values() for t in ts)
+
+# method calls that mutate their receiver (so `self._outbox.append(x)`
+# counts as a WRITE of _outbox for the escape analysis)
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "remove", "clear", "update", "setdefault", "add", "discard",
+    "popitem", "sort", "reverse", "put", "put_nowait",
+})
+
+_SERVER_TYPES = frozenset({
+    "ThreadingHTTPServer", "HTTPServer", "ThreadingTCPServer",
+    "TCPServer", "ThreadingUDPServer", "UDPServer",
+})
+
+
+# ---------------------------------------------------------------------------
+# guarded-by annotations
+# ---------------------------------------------------------------------------
+
+_GUARD_RE = re.compile(r"guarded-by:\s*(.+?)\s*$")
+
+
+def _comments(mod: ModuleInfo) -> Dict[int, str]:
+    """lineno -> comment text, via the real tokenizer — a
+    ``guarded-by:`` inside a *string literal* (this module's own rule
+    descriptions, docstrings quoting the convention) must never parse
+    as an annotation."""
+    cached = getattr(mod, "_comment_lines_cache", None)
+    if cached is not None:
+        return cached
+    import io
+    import tokenize
+
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(
+                io.StringIO(mod.source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError,
+            SyntaxError):   # pragma: no cover — ast.parse ran already
+        pass
+    mod._comment_lines_cache = out
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardSpec:
+    """One parsed ``# guarded-by:`` annotation."""
+
+    form: str       # "lock" | "join" | "confined" | "safe-type" | "bad"
+    value: str      # lock expr / joined thread expr / owner label / kind
+    raw: str
+
+
+def parse_guard_spec(comment_tail: str) -> GuardSpec:
+    """Parse the text after ``guarded-by:`` — the first token decides
+    the form; trailing prose is allowed and ignored."""
+    raw = comment_tail.strip()
+    token = raw.split()[0] if raw.split() else ""
+    m = re.match(r"(join|confined)\(([^)]*)\)$", token)
+    if m:
+        return GuardSpec(form=m.group(1), value=m.group(2).strip(),
+                         raw=raw)
+    if token in SAFE_TYPE_KEYWORDS:
+        return GuardSpec(form="safe-type", value=token, raw=raw)
+    # a lock expression: a dotted python name like self._lock /
+    # _global_lock / self._reg._lock
+    if token and re.match(r"[A-Za-z_][\w.]*$", token):
+        return GuardSpec(form="lock", value=token, raw=raw)
+    return GuardSpec(form="bad", value=token, raw=raw)
+
+
+def _guard_annotation(mod: ModuleInfo, node: ast.AST) -> Optional[str]:
+    """The annotation comment on the statement's first or last line
+    (a wrapped assignment may carry it on either)."""
+    comments = _comments(mod)
+    for lineno in {node.lineno, getattr(node, "end_lineno", None)
+                   or node.lineno}:
+        comment = comments.get(lineno)
+        if comment:
+            m = _GUARD_RE.search(comment)
+            if m:
+                return m.group(1)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# module index: functions, classes, calls, self-aliases
+# ---------------------------------------------------------------------------
+
+
+class _Index(ast.NodeVisitor):
+    """Qualified-name index of one module: function nodes, their
+    enclosing class, the dotted callees each invokes, and ``x = self``
+    aliases (the exporter's handler-closure idiom)."""
+
+    def __init__(self):
+        self.funcs: Dict[str, ast.AST] = {}
+        self.parents: Dict[str, Optional[str]] = {}
+        self.class_of: Dict[str, Optional[str]] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.calls: Dict[str, Set[str]] = {}
+        self.self_aliases: Dict[str, Set[str]] = {}   # func -> names
+        self._stack: List[Tuple[str, str]] = []       # (name, kind)
+
+    def _qual(self, name: str) -> str:
+        return ".".join([n for n, _k in self._stack] + [name])
+
+    def _cur_class(self) -> Optional[str]:
+        for i in range(len(self._stack) - 1, -1, -1):
+            if self._stack[i][1] == "class":
+                return ".".join(n for n, _ in self._stack[: i + 1])
+        return None
+
+    def _visit_func(self, node):
+        qual = self._qual(node.name)
+        self.funcs[qual] = node
+        self.parents[qual] = ".".join(
+            n for n, _ in self._stack) or None
+        self.class_of[qual] = self._cur_class()
+        self._stack.append((node.name, "func"))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node):
+        qual = self._qual(node.name)
+        self.classes[qual] = node
+        self._stack.append((node.name, "class"))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_Call(self, node):
+        if self._stack and self._stack[-1][1] == "func":
+            qual = ".".join(n for n, _ in self._stack)
+            callee = _dotted(node.func)
+            if callee is not None:
+                self.calls.setdefault(qual, set()).add(callee)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        # `exporter = self` inside a method: calls through `exporter.`
+        # resolve like `self.` (the nested-handler-class idiom)
+        if (self._stack and self._stack[-1][1] == "func"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            qual = ".".join(n for n, _ in self._stack)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.self_aliases.setdefault(qual, set()).add(t.id)
+        self.generic_visit(node)
+
+
+def _index(mod: ModuleInfo) -> _Index:
+    cached = getattr(mod, "_concurrency_index", None)
+    if cached is None:
+        cached = _Index()
+        cached.visit(mod.tree)
+        mod._concurrency_index = cached
+    return cached
+
+
+# ---------------------------------------------------------------------------
+# thread-escape graph
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SpawnSite:
+    node: ast.Call
+    kind: str                 # "thread" | "server"
+    owner: Optional[str]      # qualname of the spawning function
+    target_quals: Tuple[str, ...]   # resolved same-module functions
+    target_text: str          # the target expr as written (diagnostics)
+    binding: Optional[str]    # source segment the object is bound to
+
+
+@dataclasses.dataclass
+class ThreadModel:
+    """Per-module thread-escape graph: where threads start, which
+    functions run on them, and which attributes each side touches."""
+
+    spawns: List[SpawnSite]
+    thread_funcs: Set[str]            # qualnames running on a spawned
+                                      # thread (targets + same-module
+                                      # transitive callees)
+    index: _Index
+
+    def is_thread_side(self, qual: Optional[str]) -> bool:
+        if qual is None:
+            return False
+        if qual in self.thread_funcs:
+            return True
+        # nested defs inherit their parent's side
+        return any(qual.startswith(t + ".") for t in self.thread_funcs)
+
+
+def _enclosing_scopes(owner: Optional[str]):
+    """The qualname and every enclosing prefix, innermost first
+    (walking string prefixes covers class frames, which the parents
+    map does not record)."""
+    scope = owner or ""
+    while scope:
+        yield scope
+        scope = scope.rsplit(".", 1)[0] if "." in scope else ""
+
+
+def _alias_classes(idx: _Index, owner: Optional[str]) -> Dict[str, str]:
+    """name -> class qualname whose instance the name denotes inside
+    ``owner``: ``self``/``cls`` resolve to the nearest enclosing
+    class, and ``x = self`` aliases resolve to the class of the
+    function that bound them — the nested-handler-class idiom reaches
+    its exporter through such an alias."""
+    out: Dict[str, str] = {}
+    for scope in _enclosing_scopes(owner):
+        cls = idx.class_of.get(scope)
+        if cls is not None:
+            out.setdefault("self", cls)
+            out.setdefault("cls", cls)
+            for name in idx.self_aliases.get(scope, ()):
+                out.setdefault(name, cls)
+    return out
+
+
+def _resolve_target(idx: _Index, owner: Optional[str],
+                    expr: ast.AST) -> Tuple[Tuple[str, ...], str]:
+    """Resolve a thread-target expression to same-module function
+    qualnames.  Unresolvable targets return () with the source text."""
+    text = _dotted(expr) or ast.dump(expr)[:40]
+    # self.method (or an alias of self)
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                      ast.Name):
+        aliases = _alias_classes(idx, owner)
+        cls = aliases.get(expr.value.id)
+        if cls is not None and f"{cls}.{expr.attr}" in idx.funcs:
+            return (f"{cls}.{expr.attr}",), text
+        # module-level function referenced through a module alias, or a
+        # resource method (self._server.serve_forever): unresolvable
+        return (), text
+    if isinstance(expr, ast.Name):
+        name = expr.id
+        # nearest enclosing scope first (nested def), then module level
+        for scope in _enclosing_scopes(owner):
+            q = f"{scope}.{name}"
+            if q in idx.funcs:
+                return (q,), text
+        if name in idx.funcs:
+            return (name,), text
+    return (), text
+
+
+def _callee_quals(idx: _Index, caller: str, callee: str) -> List[str]:
+    """Resolve a dotted callee string from ``caller`` to same-module
+    function qualnames (the callgraph.py resolution rules, plus
+    instance aliases)."""
+    parts = callee.split(".")
+    if len(parts) == 2:
+        cls = _alias_classes(idx, caller).get(parts[0])
+        if cls and f"{cls}.{parts[1]}" in idx.funcs:
+            return [f"{cls}.{parts[1]}"]
+        return []
+    if len(parts) == 1:
+        name = parts[0]
+        for scope in _enclosing_scopes(caller):
+            q = f"{scope}.{name}"
+            if q in idx.funcs:
+                return [q]
+        if name in idx.funcs:
+            return [name]
+    return []
+
+
+def thread_model(mod: ModuleInfo) -> ThreadModel:
+    """Build (and memoize) the module's thread-escape graph."""
+    cached = getattr(mod, "_thread_model_cache", None)
+    if cached is not None:
+        return cached
+    idx = _index(mod)
+    spawns: List[SpawnSite] = []
+    parents = mod.parents()
+
+    def _owner_of(node: ast.AST) -> Optional[str]:
+        # nearest enclosing function's qualname
+        chain: List[str] = []
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                chain.append(cur.name)
+            cur = parents.get(cur)
+        chain.reverse()
+        while chain:
+            qual = ".".join(chain)
+            if qual in idx.funcs:
+                return qual
+            chain.pop()
+        return None
+
+    def _binding_of(call: ast.Call) -> Optional[str]:
+        stmt = parents.get(call)
+        # threading.Thread(...).start(): the call's parent chain goes
+        # Attribute -> Call -> Expr — no binding.  A spawn anywhere
+        # under an Assign's VALUE (including list comprehensions:
+        # `threads = [Thread(...) for ...]`) binds through the target.
+        while stmt is not None and not isinstance(stmt, ast.stmt):
+            stmt = parents.get(stmt)
+        if isinstance(stmt, ast.Assign) and any(
+                sub is call for sub in ast.walk(stmt.value)):
+            for t in stmt.targets:
+                if isinstance(t, (ast.Name, ast.Attribute)):
+                    return mod.segment(t)
+        if isinstance(stmt, ast.AugAssign) and any(
+                sub is call for sub in ast.walk(stmt.value)):
+            if isinstance(stmt.target, (ast.Name, ast.Attribute)):
+                return mod.segment(stmt.target)
+        # threads.append(Thread(...)): the container is the binding
+        if isinstance(stmt, ast.Expr):
+            val = stmt.value
+            if (isinstance(val, ast.Call)
+                    and isinstance(val.func, ast.Attribute)
+                    and val.func.attr in ("append", "add", "extend")
+                    and any(sub is call
+                            for a in val.args
+                            for sub in ast.walk(a))):
+                return mod.segment(val.func.value)
+        return None
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        term = _terminal(_dotted(node.func))
+        if term == "Thread":
+            owner = _owner_of(node)
+            target = next((kw.value for kw in node.keywords
+                           if kw.arg == "target"), None)
+            if target is None and node.args:
+                target = node.args[0]
+            quals, text = ((), "<no target>")
+            if target is not None:
+                quals, text = _resolve_target(idx, owner, target)
+            spawns.append(SpawnSite(
+                node=node, kind="thread", owner=owner,
+                target_quals=quals, target_text=text,
+                binding=_binding_of(node)))
+        elif term in _SERVER_TYPES:
+            owner = _owner_of(node)
+            handler_quals: Tuple[str, ...] = ()
+            text = term or ""
+            if len(node.args) >= 2 and isinstance(node.args[1],
+                                                  ast.Name):
+                hname = node.args[1].id
+                text = hname
+                for cq, cnode in idx.classes.items():
+                    if cq.split(".")[-1] == hname:
+                        handler_quals = tuple(
+                            q for q in idx.funcs
+                            if idx.class_of.get(q) == cq)
+                        break
+            spawns.append(SpawnSite(
+                node=node, kind="server", owner=owner,
+                target_quals=handler_quals, target_text=text,
+                binding=_binding_of(node)))
+
+    thread_funcs: Set[str] = set()
+    frontier = [q for s in spawns for q in s.target_quals]
+    while frontier:
+        qual = frontier.pop()
+        if qual in thread_funcs:
+            continue
+        thread_funcs.add(qual)
+        for callee in idx.calls.get(qual, ()):
+            frontier.extend(_callee_quals(idx, qual, callee))
+        # nested defs of a thread function run on the thread too
+        frontier.extend(q for q in idx.funcs
+                        if q.startswith(qual + "."))
+    model = ThreadModel(spawns=spawns, thread_funcs=thread_funcs,
+                        index=idx)
+    mod._thread_model_cache = model
+    return model
+
+
+# ---------------------------------------------------------------------------
+# attribute accesses + lock-guard context
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    node: ast.AST
+    func: str            # qualname of the enclosing function
+    cls: str             # class of the instance accessed (via alias)
+    is_write: bool
+    guards: frozenset    # normalized lock exprs held at the access
+
+
+def _lock_names(mod: ModuleInfo) -> Set[str]:
+    """Names/attrs assigned a Lock-family constructor anywhere in the
+    module (so ``with self._visit_lock:`` guards even if the name
+    doesn't contain 'lock')."""
+    cached = getattr(mod, "_lock_names_cache", None)
+    if cached is not None:
+        return cached
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _terminal(_dotted(node.value.func))
+                in SAFE_TYPE_KEYWORDS["lock"]):
+            for t in node.targets:
+                seg = mod.segment(t)
+                if seg:
+                    out.add(_norm_lock(seg))
+    mod._lock_names_cache = out
+    return out
+
+
+def _norm_lock(expr_text: str) -> str:
+    return "".join(expr_text.split())
+
+
+def _is_lock_expr(mod: ModuleInfo, expr: ast.AST) -> bool:
+    text = _dotted(expr)
+    if text is None:
+        return False
+    if "lock" in text.rsplit(".", 1)[-1].lower():
+        return True
+    return _norm_lock(text) in _lock_names(mod)
+
+
+def _guards_at(mod: ModuleInfo, node: ast.AST) -> frozenset:
+    parents = mod.parents()
+    held: Set[str] = set()
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                if _is_lock_expr(mod, item.context_expr):
+                    held.add(_norm_lock(
+                        _dotted(item.context_expr) or ""))
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+        cur = parents.get(cur)
+    return frozenset(held)
+
+
+def _self_accesses(mod: ModuleInfo) -> List[_Access]:
+    """Every ``self.<attr>`` access inside a method, classified
+    read/write (attr assignment, subscript store on the attr, mutating
+    method call, del) with the lock guards held at the site."""
+    cached = getattr(mod, "_self_accesses_cache", None)
+    if cached is not None:
+        return cached
+    idx = _index(mod)
+    parents = mod.parents()
+    out: List[_Access] = []
+    for qual, fnode in idx.funcs.items():
+        aliases = _alias_classes(idx, qual)
+        if not aliases:
+            continue
+        # walk this function's own body, not nested defs (those are
+        # their own quals)
+        stack = list(fnode.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for child in ast.iter_child_nodes(node):
+                stack.append(child)
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in aliases):
+                continue
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            parent = parents.get(node)
+            if (not is_write and isinstance(parent, ast.Subscript)
+                    and parent.value is node
+                    and isinstance(parent.ctx, (ast.Store, ast.Del))):
+                is_write = True        # self.x[k] = v
+            if (not is_write and isinstance(parent, ast.Attribute)
+                    and parent.attr in _MUTATORS):
+                gp = parents.get(parent)
+                if isinstance(gp, ast.Call) and gp.func is parent:
+                    is_write = True    # self.x.append(v)
+            out.append(_Access(
+                attr=node.attr, node=node, func=qual,
+                cls=aliases[node.value.id],
+                is_write=is_write, guards=_guards_at(mod, node)))
+    mod._self_accesses_cache = out
+    return out
+
+
+def _annotated_attrs(mod: ModuleInfo) -> Dict[Tuple[Optional[str], str],
+                                              Tuple[GuardSpec, ast.AST]]:
+    """(class_qual | None, attr-or-name) -> (spec, annotated node) for
+    every ``# guarded-by:`` annotation in the module.  ``class_qual``
+    is None for module-level names; local names register under their
+    enclosing function's qualname prefixed with ``<local>``."""
+    cached = getattr(mod, "_guard_annotations_cache", None)
+    if cached is not None:
+        return cached
+    idx = _index(mod)
+    parents = mod.parents()
+    out: Dict[Tuple[Optional[str], str], Tuple[GuardSpec, ast.AST]] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        tail = _guard_annotation(mod, node)
+        if tail is None:
+            continue
+        spec = parse_guard_spec(tail)
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                # class attr: find the enclosing class
+                cur = parents.get(node)
+                cls = None
+                while cur is not None:
+                    if isinstance(cur, ast.ClassDef):
+                        for cq, cnode in idx.classes.items():
+                            if cnode is cur:
+                                cls = cq
+                                break
+                        break
+                    cur = parents.get(cur)
+                out[(cls, t.attr)] = (spec, node)
+            elif isinstance(t, ast.Name):
+                cur = parents.get(node)
+                func = None
+                while cur is not None:
+                    if isinstance(cur, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        func = cur.name
+                        break
+                    cur = parents.get(cur)
+                if func is None:
+                    out[(None, t.id)] = (spec, node)        # module
+                else:
+                    out[(f"<local>{func}", t.id)] = (spec, node)
+    mod._guard_annotations_cache = out
+    return out
+
+
+def _init_safe_type(mod: ModuleInfo, cls: Optional[str],
+                    attr: str) -> bool:
+    """True when the attribute's initializer constructs an inherently
+    thread-safe type (Queue/Event/deque/Lock/local)."""
+    idx = _index(mod)
+    for qual, fnode in idx.funcs.items():
+        if idx.class_of.get(qual) != cls:
+            continue
+        for node in ast.walk(fnode):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and _terminal(_dotted(node.value.func))
+                    in _SAFE_CONSTRUCTORS):
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and t.attr == attr):
+                        return True
+    return False
+
+
+def is_thread_join(node: ast.AST) -> bool:
+    """A ``.join(...)`` call that is plausibly ``Thread.join`` rather
+    than ``str.join``: thread joins take no positional args (or a
+    numeric timeout / ``timeout=`` kwarg); ``str.join`` always takes
+    exactly one iterable and often a literal receiver.  Without this
+    shape check, a ``", ".join(parts)`` line silently satisfies the
+    join-ordering rules — the exact class of false negative they were
+    written to prevent."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"):
+        return False
+    if isinstance(node.func.value, (ast.Constant, ast.JoinedStr)):
+        return False                    # literal string receiver
+    if any(kw.arg == "timeout" for kw in node.keywords):
+        return True
+    if not node.args:
+        return True
+    if len(node.args) != 1:
+        return False
+    arg = node.args[0]
+    # a timeout is a number or a scalar variable; str.join's one arg
+    # is iterable-shaped (list/genexp/comprehension/call/literal)
+    if isinstance(arg, ast.Constant):
+        return isinstance(arg.value, (int, float))
+    return isinstance(arg, (ast.Name, ast.Attribute))
+
+
+def _func_joins(fnode: ast.AST) -> bool:
+    """Does the function body contain a thread-shaped ``.join(...)``
+    call (the join-form ordering witness)?"""
+    return any(is_thread_join(node) for node in ast.walk(fnode))
+
+
+# ---------------------------------------------------------------------------
+# APX501 — unguarded cross-thread mutation
+# ---------------------------------------------------------------------------
+
+
+class CrossThreadMutationRule(Rule):
+    id = "APX501"
+    name = "unguarded-cross-thread-mutation"
+    tier = "C"
+    description = ("an attribute written on both the spawning side and "
+                   "the thread side of a Thread/server spawn site with "
+                   "no common `with <lock>:` scope — a torn write "
+                   "waiting for a scheduler interleaving")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not mod.in_pkg:
+            return
+        model = thread_model(mod)
+        if not model.thread_funcs:
+            return
+        annotated = _annotated_attrs(mod)
+        accesses = _self_accesses(mod)
+        spawn_lines = {s.node.lineno: s for s in model.spawns}
+        # group writes per (class, attr) and side
+        writes: Dict[Tuple[Optional[str], str],
+                     Dict[str, List[_Access]]] = {}
+        for acc in accesses:
+            if not acc.is_write:
+                continue
+            if acc.func.split(".")[-1] == "__init__":
+                continue   # construction happens-before the spawn
+            side = ("thread" if model.is_thread_side(acc.func)
+                    else "main")
+            writes.setdefault((acc.cls, acc.attr), {}).setdefault(
+                side, []).append(acc)
+        for (cls, attr), sides in sorted(
+                writes.items(), key=lambda kv: (kv[0][0] or "",
+                                                kv[0][1])):
+            if "thread" not in sides or "main" not in sides:
+                continue
+            if (cls, attr) in annotated:
+                continue   # APX502 owns annotated attributes
+            if _init_safe_type(mod, cls, attr):
+                continue
+            all_writes = sides["thread"] + sides["main"]
+            common = frozenset.intersection(
+                *[a.guards for a in all_writes])
+            if common:
+                continue
+            first = min(all_writes, key=lambda a: a.node.lineno)
+            other_side = ("thread" if first in sides["main"]
+                          else "main")
+            other = min(sides[other_side],
+                        key=lambda a: a.node.lineno)
+            spawn = min(spawn_lines) if spawn_lines else 0
+            yield self.finding(
+                mod, first.node,
+                f"self.{attr} is written on both the spawning side "
+                f"and the thread side (other write at line "
+                f"{other.node.lineno}; thread spawned at line "
+                f"{spawn}) with no common lock — guard both with one "
+                "`with <lock>:` or annotate the attribute "
+                "`# guarded-by: ...`")
+        # nested-def targets: shared locals of the enclosing function
+        yield from self._closure_writes(mod, model)
+
+    def _closure_writes(self, mod: ModuleInfo,
+                        model: ThreadModel) -> Iterator[Finding]:
+        idx = model.index
+        for spawn in model.spawns:
+            if spawn.kind != "thread" or not spawn.owner:
+                continue
+            owner_node = idx.funcs.get(spawn.owner)
+            if owner_node is None:
+                continue
+            thread_quals = [q for q in spawn.target_quals
+                            if q.startswith(spawn.owner + ".")]
+            if not thread_quals:
+                continue
+            # Only names the thread function declares nonlocal/global
+            # actually share a binding cell with the spawner — a plain
+            # assignment in a nested def is its own local (`for line
+            # in ...` in a drain thread shadows, not shares).
+            def _name_stores(fnode, only=None):
+                out: Dict[str, ast.AST] = {}
+                stack = list(fnode.body)
+                while stack:
+                    node = stack.pop()
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        continue
+                    for child in ast.iter_child_nodes(node):
+                        stack.append(child)
+                    if (isinstance(node, ast.Name)
+                            and isinstance(node.ctx, ast.Store)
+                            and (only is None or node.id in only)):
+                        out.setdefault(node.id, node)
+                return out
+
+            thread_stores: Dict[str, ast.AST] = {}
+            for q in model.thread_funcs:
+                if not q.startswith(spawn.owner + "."):
+                    continue
+                fnode = idx.funcs.get(q)
+                if fnode is None:
+                    continue
+                shared = {
+                    n for node in ast.walk(fnode)
+                    if isinstance(node, (ast.Nonlocal, ast.Global))
+                    for n in node.names}
+                for k, v in _name_stores(fnode, only=shared).items():
+                    thread_stores.setdefault(k, v)
+            owner_stores = _name_stores(owner_node)
+            safe_locals = self._safe_locals(mod, owner_node)
+            annotated = _annotated_attrs(mod)
+            for name in sorted(set(thread_stores) & set(owner_stores)):
+                if name in safe_locals:
+                    continue
+                if (f"<local>{owner_node.name}", name) in annotated:
+                    continue
+                node = thread_stores[name]
+                if _guards_at(mod, node) & _guards_at(
+                        mod, owner_stores[name]):
+                    continue
+                yield self.finding(
+                    mod, node,
+                    f"closure variable {name!r} is written by both "
+                    f"the thread target and {spawn.owner}() with no "
+                    "common lock — hand it off through a Queue/Event "
+                    "or guard both writes")
+
+    @staticmethod
+    def _safe_locals(mod: ModuleInfo, fnode) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(fnode):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and _terminal(_dotted(node.value.func))
+                    in _SAFE_CONSTRUCTORS):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# APX502 — guarded-by discipline
+# ---------------------------------------------------------------------------
+
+
+class GuardedByRule(Rule):
+    id = "APX502"
+    name = "guarded-by-discipline"
+    tier = "C"
+    description = ("a `# guarded-by: <spec>` annotation on a shared "
+                   "attribute is enforced at every access site: lock "
+                   "form requires `with <lock>:`, join form requires a "
+                   "join-ordered reader, confined form requires the "
+                   "attribute stay off every thread target")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not mod.in_pkg:
+            return
+        annotated = _annotated_attrs(mod)
+        if not annotated:
+            return
+        model = thread_model(mod)
+        idx = _index(mod)
+        accesses = _self_accesses(mod)
+        for (scope, attr), (spec, decl) in sorted(
+                annotated.items(),
+                key=lambda kv: kv[1][1].lineno):
+            if spec.form == "bad":
+                yield self.finding(
+                    mod, decl,
+                    f"unparseable guarded-by spec {spec.raw!r} — "
+                    "expected a lock expression, join(<thread>), "
+                    "confined(<owner>), or one of "
+                    f"{sorted(SAFE_TYPE_KEYWORDS)}")
+                continue
+            if scope is not None and scope.startswith("<local>"):
+                yield from self._check_local(mod, scope, attr, spec,
+                                             decl)
+                continue
+            if scope is None:
+                yield from self._check_module_name(mod, attr, spec,
+                                                   decl)
+                continue
+            cls_accesses = [a for a in accesses
+                            if a.attr == attr and a.cls == scope]
+            if spec.form == "safe-type":
+                yield from self._check_safe_type(mod, decl, attr, spec)
+                continue
+            for acc in sorted(cls_accesses,
+                              key=lambda a: a.node.lineno):
+                if acc.func.split(".")[-1] == "__init__":
+                    continue
+                if spec.form == "lock":
+                    if _norm_lock(spec.value) not in acc.guards:
+                        yield self.finding(
+                            mod, acc.node,
+                            f"self.{attr} accessed outside `with "
+                            f"{spec.value}:` (declared guarded-by at "
+                            f"line {decl.lineno})")
+                elif spec.form == "join":
+                    if model.is_thread_side(acc.func):
+                        continue   # the writer thread owns it
+                    fnode = idx.funcs.get(acc.func)
+                    if fnode is None or not _func_joins(fnode):
+                        yield self.finding(
+                            mod, acc.node,
+                            f"self.{attr} is join-ordered (guarded-by:"
+                            f" join({spec.value}) at line "
+                            f"{decl.lineno}) but {acc.func}() touches "
+                            "it without joining the writer thread "
+                            "first")
+                elif spec.form == "confined":
+                    if model.is_thread_side(acc.func):
+                        yield self.finding(
+                            mod, acc.node,
+                            f"self.{attr} is declared confined to "
+                            f"{spec.value!r} (line {decl.lineno}) but "
+                            f"{acc.func}() runs on a spawned thread")
+
+    def _check_safe_type(self, mod, decl, attr, spec):
+        value = decl.value
+        ok = (isinstance(value, ast.Call)
+              and _terminal(_dotted(value.func))
+              in SAFE_TYPE_KEYWORDS[spec.value])
+        if not ok:
+            yield self.finding(
+                mod, decl,
+                f"{attr} declares guarded-by: {spec.value} but its "
+                "initializer does not construct one of "
+                f"{SAFE_TYPE_KEYWORDS[spec.value]}")
+
+    def _check_local(self, mod, scope, name, spec, decl):
+        # local annotations: only the safe-type form is checkable
+        if spec.form == "safe-type":
+            yield from self._check_safe_type(mod, decl, name, spec)
+
+    def _check_module_name(self, mod: ModuleInfo, name: str,
+                           spec: GuardSpec, decl: ast.AST):
+        if spec.form == "safe-type":
+            yield from self._check_safe_type(mod, decl, name, spec)
+            return
+        if spec.form != "lock":
+            return   # join/confined on module globals: not modeled
+        idx = _index(mod)
+        for qual, fnode in idx.funcs.items():
+            stack = list(fnode.body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                for child in ast.iter_child_nodes(node):
+                    stack.append(child)
+                if (isinstance(node, ast.Name) and node.id == name
+                        and isinstance(node.ctx, (ast.Load, ast.Store,
+                                                  ast.Del))
+                        and _norm_lock(spec.value)
+                        not in _guards_at(mod, node)):
+                    yield self.finding(
+                        mod, node,
+                        f"module global {name} accessed outside "
+                        f"`with {spec.value}:` (declared guarded-by "
+                        f"at line {decl.lineno})")
+
+
+# ---------------------------------------------------------------------------
+# APX503 — lock-acquisition order
+# ---------------------------------------------------------------------------
+
+
+class LockOrderRule(Rule):
+    id = "APX503"
+    name = "inconsistent-lock-order"
+    tier = "C"
+    repo_level = True
+    description = ("two code paths acquire the same pair of locks in "
+                   "opposite orders (lexical `with` nesting plus one "
+                   "level of same-module call propagation) — a "
+                   "potential deadlock")
+
+    def check_repo(self, modules: Sequence[ModuleInfo],
+                   root: str) -> Iterator[Finding]:
+        # edges: lock identity -> {inner lock identity: (mod, node)}
+        edges: Dict[str, Dict[str, Tuple[ModuleInfo, ast.AST]]] = {}
+        for mod in modules:
+            if not mod.in_pkg:
+                continue
+            try:
+                self._module_edges(mod, edges)
+            except RecursionError:   # pragma: no cover — pathological
+                continue
+        # Cycle detection: iterative color DFS, one finding per
+        # back-edge.  O(V+E) with black-node memoization — the earlier
+        # all-simple-paths form was exponential on dense graphs and
+        # its recursion could overflow on deep lock chains, neither of
+        # which a pre-commit gate can afford.
+        seen_cycles: Set[frozenset] = set()
+        black: Set[str] = set()
+        for start in sorted(edges):
+            if start in black:
+                continue
+            path: List[str] = []
+            on_path: Set[str] = set()
+            # stack of (lock, iterator over its successors)
+            stack: List[Tuple[str, Iterator[str]]] = [
+                (start, iter(sorted(edges.get(start, ()))))]
+            path.append(start)
+            on_path.add(start)
+            while stack:
+                lock, succ = stack[-1]
+                nxt = next(succ, None)
+                if nxt is None:
+                    stack.pop()
+                    path.pop()
+                    on_path.discard(lock)
+                    black.add(lock)
+                    continue
+                if nxt in on_path:
+                    members = path[path.index(nxt):]
+                    cyc = frozenset(members)
+                    if cyc not in seen_cycles:
+                        seen_cycles.add(cyc)
+                        first = members[0]
+                        second = members[1 % len(members)]
+                        mod, node = edges[first][second]
+                        yield self.finding(
+                            mod, node,
+                            "lock-order cycle: "
+                            + " -> ".join(members + [members[0]])
+                            + " — another path acquires these locks "
+                            "in the opposite order (deadlock under "
+                            "contention)")
+                elif nxt not in black and nxt in edges:
+                    stack.append(
+                        (nxt, iter(sorted(edges.get(nxt, ())))))
+                    path.append(nxt)
+                    on_path.add(nxt)
+
+    def _module_edges(self, mod: ModuleInfo, edges) -> None:
+        idx = _index(mod)
+
+        def identity(qual: Optional[str], expr: ast.AST) -> Optional[str]:
+            text = _dotted(expr)
+            if text is None:
+                return None
+            cls = idx.class_of.get(qual or "") if qual else None
+            base = text[5:] if text.startswith("self.") else text
+            where = cls or mod.relpath
+            return f"{where}::{_norm_lock(base)}"
+
+        def top_locks(fnode, qual) -> List[str]:
+            out = []
+            for node in ast.walk(fnode):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        if _is_lock_expr(mod, item.context_expr):
+                            lid = identity(qual, item.context_expr)
+                            if lid:
+                                out.append(lid)
+            return out
+
+        parents = mod.parents()
+        for qual, fnode in idx.funcs.items():
+            for node in ast.walk(fnode):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                outer = [identity(qual, i.context_expr)
+                         for i in node.items
+                         if _is_lock_expr(mod, i.context_expr)]
+                outer = [o for o in outer if o]
+                if not outer:
+                    continue
+                for sub in ast.walk(node):
+                    if sub is node:
+                        continue
+                    if isinstance(sub, (ast.With, ast.AsyncWith)):
+                        for item in sub.items:
+                            if _is_lock_expr(mod, item.context_expr):
+                                inner = identity(qual,
+                                                 item.context_expr)
+                                for o in outer:
+                                    if inner and inner != o:
+                                        edges.setdefault(
+                                            o, {}).setdefault(
+                                            inner, (mod, sub))
+                    elif isinstance(sub, ast.Call):
+                        callee = _dotted(sub.func)
+                        if callee is None:
+                            continue
+                        for cq in _callee_quals(idx, qual, callee):
+                            for inner in top_locks(idx.funcs[cq], cq):
+                                for o in outer:
+                                    if inner != o:
+                                        edges.setdefault(
+                                            o, {}).setdefault(
+                                            inner, (mod, sub))
+
+
+CONCURRENCY_RULES: Tuple[Rule, ...] = (
+    CrossThreadMutationRule(),
+    GuardedByRule(),
+    LockOrderRule(),
+)

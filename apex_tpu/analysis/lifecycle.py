@@ -1,0 +1,441 @@
+"""Tier-C lifecycle rules: threads, servers and non-memory resources
+must have reachable teardown paths.
+
+Two rule families, both of the same historical bug class fixed by hand
+one call site at a time:
+
+- ``APX504`` thread/server lifecycle — every ``threading.Thread`` and
+  ``ThreadingHTTPServer``-family construction must have a *reachable*
+  join/close path: the object is bound (not fire-and-forget started),
+  and somewhere in the module something ``.join()``s the thread (or
+  ``.shutdown()``/``.server_close()``s the server) through the binding
+  or one of its assignment aliases.  Plus the close-ordering check: in
+  a teardown function that both joins a serve thread and
+  ``server_close()``s its server, the join must come FIRST — closing
+  the socket under a thread still in ``serve_forever`` is the
+  "exporter ``close()`` vs in-flight scrape" race.
+- ``APX505`` paired acquire/release — a non-memory resource acquired
+  into a local (``socket.socket()``, ``create_connection``, ``open``,
+  ``BlockManager.alloc``/``share_prefix``/``incref``) whose lifetime
+  crosses other calls that can raise needs an *unwind edge*: either
+  ownership transfers immediately (``self.x = acquire()``, a ``with``
+  item, direct return) or a ``try``/``except``/``finally`` in the
+  function releases the local (or the list it was appended into) —
+  the PR-6 ``_admit`` leaked-blocks class as a rule.
+
+Heuristics and honest limits (docs/static_analysis.md): bindings and
+joins are matched textually through one level of assignment aliasing
+(``t = self._thread; t.join()`` resolves; handing a thread through a
+dict does not); ``daemon=True`` does NOT exempt a thread (the prefetch
+producer and the worker stdout drain were daemon threads and still
+real findings); calls on the resource itself (``conn.settimeout``)
+are not counted as raise-risk; release-in-a-callee is not followed —
+suppress with a justification where the release genuinely lives
+elsewhere.
+
+Stdlib-only by contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from apex_tpu.analysis.concurrency import (
+    _dotted,
+    _terminal,
+    is_thread_join,
+    thread_model,
+)
+from apex_tpu.analysis.rules import Finding, ModuleInfo, Rule
+
+__all__ = ["LIFECYCLE_RULES", "ACQUIRE_RELEASES"]
+
+
+# ---------------------------------------------------------------------------
+# APX504 — thread/server lifecycle
+# ---------------------------------------------------------------------------
+
+_THREAD_RELEASES = ("join",)
+_SERVER_RELEASES = ("shutdown", "server_close", "close")
+
+
+def _alias_terminals(mod: ModuleInfo, binding: str) -> Set[str]:
+    """Terminal names through which the bound object may be reached:
+    the binding's own terminal plus one hop of assignment aliasing
+    (``t = self._thread`` makes ``t`` an alias; tuple assigns pair
+    element-wise, covering the ``server, self._server = self._server,
+    None`` swap idiom)."""
+    term = binding.rsplit(".", 1)[-1]
+    out = {term}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        pairs: List[Tuple[ast.AST, ast.AST]] = []
+        for tgt in node.targets:
+            if (isinstance(tgt, (ast.Tuple, ast.List))
+                    and isinstance(node.value, (ast.Tuple, ast.List))
+                    and len(tgt.elts) == len(node.value.elts)):
+                pairs.extend(zip(tgt.elts, node.value.elts))
+            else:
+                pairs.append((tgt, node.value))
+        for tgt, val in pairs:
+            vseg = mod.segment(val)
+            if not vseg:
+                continue
+            if vseg == binding or vseg.rsplit(".", 1)[-1] == term:
+                tseg = mod.segment(tgt)
+                if tseg:
+                    out.add(tseg.rsplit(".", 1)[-1])
+    # a join loop is an alias too: `for t in threads: t.join()`
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.For)
+                and isinstance(node.target, ast.Name)):
+            iseg = mod.segment(node.iter) or ""
+            if iseg.rsplit(".", 1)[-1] in out:
+                out.add(node.target.id)
+    return out
+
+
+def _release_calls(mod: ModuleInfo, terminals: Set[str],
+                   releases: Tuple[str, ...]) -> List[ast.Call]:
+    """Calls of a release method whose receiver's terminal matches one
+    of the object's alias terminals (``join`` additionally requires
+    the thread-call shape — ``sep.join(parts)`` is not a teardown)."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in releases):
+            if node.func.attr == "join" and not is_thread_join(node):
+                continue
+            recv = _dotted(node.func.value)
+            if recv and recv.rsplit(".", 1)[-1] in terminals:
+                out.append(node)
+    return out
+
+
+class LifecycleRule(Rule):
+    id = "APX504"
+    name = "thread-lifecycle"
+    tier = "C"
+    description = ("every started thread/server needs a reachable "
+                   "join/close path (daemon=True is not a teardown "
+                   "strategy), and teardown must join the serve "
+                   "thread BEFORE closing the resources it holds")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not mod.in_pkg:
+            return
+        model = thread_model(mod)
+        if not model.spawns:
+            return
+        server_terminals: Set[str] = set()
+        for spawn in model.spawns:
+            releases = (_THREAD_RELEASES if spawn.kind == "thread"
+                        else _SERVER_RELEASES)
+            what = ("thread" if spawn.kind == "thread"
+                    else spawn.target_text or "server")
+            if spawn.binding is None:
+                yield self.finding(
+                    mod, spawn.node,
+                    f"fire-and-forget {spawn.kind} "
+                    f"({spawn.target_text}) — bind it so shutdown can "
+                    f"{'/'.join(releases)} it")
+                continue
+            terminals = _alias_terminals(mod, spawn.binding)
+            if spawn.kind == "server":
+                server_terminals |= terminals
+            if not _release_calls(mod, terminals, releases):
+                yield self.finding(
+                    mod, spawn.node,
+                    f"{spawn.kind} bound to {spawn.binding!r} "
+                    f"({what}) has no reachable "
+                    f"{'/'.join(releases)} call in this module — a "
+                    "leaked lifecycle (add a teardown path or "
+                    "suppress with the justification)")
+        # close-ordering: join before server_close in one teardown fn
+        yield from self._close_ordering(mod, server_terminals)
+
+    def _close_ordering(self, mod: ModuleInfo,
+                        server_terminals: Set[str]):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            joins: List[ast.Call] = []
+            closes: List[ast.Call] = []
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)):
+                    continue
+                if is_thread_join(sub):
+                    joins.append(sub)
+                elif sub.func.attr == "server_close":
+                    recv = _dotted(sub.func.value) or ""
+                    if (not server_terminals
+                            or recv.rsplit(".", 1)[-1]
+                            in server_terminals):
+                        closes.append(sub)
+            if not joins or not closes:
+                continue
+            first_join = min(j.lineno for j in joins)
+            for close in closes:
+                if close.lineno < first_join:
+                    yield self.finding(
+                        mod, close,
+                        "server_close() before the serve thread is "
+                        f"joined (join at line {first_join}) — an "
+                        "in-flight request thread can still be "
+                        "touching the socket/registry; join first, "
+                        "then close")
+
+
+# ---------------------------------------------------------------------------
+# APX505 — paired acquire/release with an unwind edge
+# ---------------------------------------------------------------------------
+
+# acquiring call terminal -> release vocabulary that discharges it
+ACQUIRE_RELEASES: Dict[str, Tuple[str, ...]] = {
+    "socket": ("close", "shutdown", "detach"),
+    "create_connection": ("close", "shutdown", "detach"),
+    "accept": ("close",),
+    "open": ("close",),
+    "alloc": ("decref", "free_all", "free"),
+    "share_prefix": ("decref", "free_all", "free"),
+    "incref": ("decref", "free_all", "free"),
+}
+
+_GROUP_METHODS = frozenset({"append", "extend", "add"})
+
+# builtins that do not realistically raise between an acquire and its
+# escape (`self._tables[slot, len(st.blocks)] = blk` must not count as
+# a raise-risk) — a heuristic whitelist, like the rest of this rule
+_NO_RAISE_CALLS = frozenset({
+    "len", "min", "max", "abs", "id", "isinstance", "issubclass",
+    "range", "enumerate", "zip", "list", "tuple", "dict", "set",
+    "sorted", "repr", "getattr", "hasattr",
+})
+
+
+class _Tracked:
+    """One acquired resource local and the container locals it was
+    appended into (the container inherits the release obligation)."""
+
+    def __init__(self, name: str, node: ast.AST, kind: str):
+        self.name = name
+        self.node = node
+        self.kind = kind
+        self.group: Set[str] = {name}
+
+
+class AcquireReleaseRule(Rule):
+    id = "APX505"
+    name = "unpaired-acquire"
+    tier = "C"
+    description = ("a socket/file/block-ref acquired into a local "
+                   "crosses calls that can raise with no unwind edge "
+                   "(no try/except/finally releasing it) and no "
+                   "ownership transfer — the PR-6 _admit leaked-"
+                   "blocks class")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not mod.in_pkg:
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                yield from self._check_function(mod, node)
+
+    # -- per-function analysis ----------------------------------------------
+
+    def _check_function(self, mod: ModuleInfo, fnode) -> Iterator:
+        body_nodes = self._own_body(fnode)
+        tracked = self._find_acquires(mod, body_nodes)
+        if not tracked:
+            return
+        self._attach_containers(body_nodes, tracked)
+        unwind_names = self._unwind_names(fnode)
+        for t in tracked:
+            if t.group & unwind_names:
+                continue
+            escape_line = self._escape_line(mod, body_nodes, t)
+            if escape_line is not None and escape_line <= t.node.lineno:
+                continue   # ownership transfers at the acquire itself
+            release_line = self._inline_release_line(body_nodes, t)
+            end = escape_line or (fnode.end_lineno or fnode.lineno)
+            if release_line is not None and release_line <= end:
+                # released on the straight-line path before the escape:
+                # still leaks if something between raises, but only
+                # flag when risk calls exist before the RELEASE
+                end = release_line
+            if self._risk_between(mod, body_nodes, t,
+                                  t.node.lineno, end):
+                releases = "/".join(ACQUIRE_RELEASES[t.kind])
+                yield self.finding(
+                    mod, t.node,
+                    f"{t.name!r} acquired via {t.kind}() crosses "
+                    "calls that can raise with no unwind edge — wrap "
+                    "the region in try/except (or finally) releasing "
+                    f"it ({releases}), use a `with` block, or "
+                    "transfer ownership at the acquire site")
+
+    @staticmethod
+    def _own_body(fnode) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        stack = list(fnode.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            out.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def _find_acquires(self, mod, body_nodes) -> List[_Tracked]:
+        out = []
+        for node in body_nodes:
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            kind = _terminal(_dotted(value.func))
+            if kind not in ACQUIRE_RELEASES:
+                continue
+            if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                   for t in node.targets):
+                # `self._sock = create_connection(...)` /
+                # `handles[k] = open(...)`: ownership transfers to the
+                # object/container at the acquire itself
+                continue
+            target = node.targets[0]
+            if (kind == "accept" and isinstance(target, ast.Tuple)
+                    and target.elts
+                    and isinstance(target.elts[0], ast.Name)):
+                out.append(_Tracked(target.elts[0].id, node, kind))
+            elif isinstance(target, ast.Name):
+                out.append(_Tracked(target.id, node, kind))
+        return out
+
+    @staticmethod
+    def _attach_containers(body_nodes, tracked: List[_Tracked]):
+        for t in tracked:
+            for node in body_nodes:
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _GROUP_METHODS
+                        and isinstance(node.func.value, ast.Name)
+                        and any(isinstance(a, ast.Name)
+                                and a.id in t.group
+                                for a in node.args)):
+                    t.group.add(node.func.value.id)
+
+    @staticmethod
+    def _unwind_names(fnode) -> Set[str]:
+        """Locals released inside any except-handler or finally block
+        of the function (receiver or argument of a release call)."""
+        out: Set[str] = set()
+        release_vocab = frozenset(
+            r for rs in ACQUIRE_RELEASES.values() for r in rs)
+
+        def scan(stmts):
+            for stmt in stmts:
+                for node in ast.walk(stmt):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in release_vocab):
+                        recv = node.func.value
+                        if isinstance(recv, ast.Name):
+                            out.add(recv.id)
+                        for a in node.args:
+                            if isinstance(a, ast.Name):
+                                out.add(a.id)
+                            elif (isinstance(a, ast.Starred)
+                                  and isinstance(a.value, ast.Name)):
+                                out.add(a.value.id)
+
+        for node in ast.walk(fnode):
+            if isinstance(node, ast.Try):
+                for handler in node.handlers:
+                    scan(handler.body)
+                scan(node.finalbody)
+        return out
+
+    def _escape_line(self, mod, body_nodes, t: _Tracked
+                     ) -> Optional[int]:
+        """Earliest line where ownership leaves the function: returned,
+        yielded, stored onto an attribute/subscript, or appended into
+        an attribute-held container."""
+        lines = []
+        for node in body_nodes:
+            if isinstance(node, (ast.Return, ast.Yield)):
+                val = node.value
+                if val is not None and self._mentions(val, t.group):
+                    lines.append(node.lineno)
+            elif isinstance(node, ast.Assign):
+                if self._mentions(node.value, t.group):
+                    for tgt in node.targets:
+                        if isinstance(tgt, (ast.Attribute,
+                                            ast.Subscript)):
+                            lines.append(node.lineno)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _GROUP_METHODS
+                  and isinstance(node.func.value, ast.Attribute)
+                  and any(self._mentions(a, t.group)
+                          for a in node.args)):
+                lines.append(node.lineno)
+        return min(lines) if lines else None
+
+    def _inline_release_line(self, body_nodes, t: _Tracked
+                             ) -> Optional[int]:
+        lines = []
+        for node in body_nodes:
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ACQUIRE_RELEASES[t.kind]):
+                recv = node.func.value
+                if ((isinstance(recv, ast.Name) and recv.id in t.group)
+                        or any(isinstance(a, ast.Name)
+                               and a.id in t.group
+                               for a in node.args)):
+                    lines.append(node.lineno)
+        return min(lines) if lines else None
+
+    @staticmethod
+    def _mentions(node: ast.AST, names: Set[str]) -> bool:
+        return any(isinstance(sub, ast.Name) and sub.id in names
+                   for sub in ast.walk(node))
+
+    def _risk_between(self, mod, body_nodes, t: _Tracked,
+                      lo: int, hi: int) -> bool:
+        """A call between the acquire and the escape/end that can
+        raise: anything except (a) calls on the resource itself /
+        its containers, (b) container appends, (c) more acquires of
+        the same kind, (d) the release vocabulary."""
+        release_vocab = ACQUIRE_RELEASES[t.kind]
+        for node in body_nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            if not (lo < node.lineno <= hi):
+                continue
+            term = _terminal(_dotted(node.func))
+            if (term in ACQUIRE_RELEASES or term in release_vocab
+                    or term in _NO_RAISE_CALLS):
+                continue
+            if isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                if isinstance(recv, ast.Name) and recv.id in t.group:
+                    continue   # conn.settimeout(...) — on the resource
+                if node.func.attr in _GROUP_METHODS:
+                    continue
+            return True
+        return False
+
+
+LIFECYCLE_RULES: Tuple[Rule, ...] = (
+    LifecycleRule(),
+    AcquireReleaseRule(),
+)

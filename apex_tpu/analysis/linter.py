@@ -48,6 +48,7 @@ __all__ = [
     "diff_baseline",
     "changed_files",
     "fingerprints",
+    "select_rules",
 ]
 
 # Linted by default: the package plus everything that ships invariants
@@ -122,6 +123,44 @@ def _suppressed(mod: ModuleInfo, finding: Finding) -> bool:
     wanted = set(_SUPPRESS_IDS.findall(
         spec.split("disable=", 1)[1]))
     return "all" in wanted or finding.rule in wanted
+
+
+def select_rules(tier: Optional[str] = None,
+                 ids: Optional[Sequence[str]] = None) -> Tuple[Rule, ...]:
+    """Filter the registered rule set.
+
+    - ``tier``: ``"A"`` (the repo AST rules) or ``"C"`` (the
+      concurrency/lifecycle auditor); ``None``/``"all"`` keeps both.
+    - ``ids``: rule-id patterns; a lowercase ``x`` is a digit wildcard
+      (the ``X`` in ``APX`` is literal), so ``APX5xx`` selects the
+      whole Tier-C family and ``APX105`` one rule.  Unknown patterns
+      (matching nothing) raise — a CI gate silently filtering to zero
+      rules would pass vacuously.
+    """
+    rules = tuple(ALL_RULES)
+    if tier and tier.lower() != "all":
+        rules = tuple(r for r in rules
+                      if r.tier.upper() == tier.upper())
+        if not rules:
+            raise ValueError(f"unknown tier {tier!r} (A or C)")
+    if ids:
+        tokens = [t.strip() for spec in ids for t in spec.split(",")
+                  if t.strip()]
+        if not tokens:
+            # ids was given but held nothing (an unset CI variable):
+            # scanning zero rules would pass vacuously
+            raise ValueError(
+                "--rules was given an empty pattern list")
+        patterns = [re.compile(t.replace("x", r"\d") + r"$")
+                    for t in tokens]
+        for pattern, token in zip(patterns, tokens):
+            if not any(pattern.match(r.id) for r in rules):
+                raise ValueError(
+                    f"rule pattern {token!r} matches no registered "
+                    "rule")
+        rules = tuple(r for r in rules
+                      if any(p.match(r.id) for p in patterns))
+    return rules
 
 
 def lint(root: str, targets: Optional[Sequence[str]] = None,

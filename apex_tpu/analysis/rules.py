@@ -28,6 +28,12 @@ them):
 - ``APX401`` use-after-donation             (a buffer passed at a
   donate_argnums/argnames position is dead after the call)
 
+Tier C (the APX5xx concurrency & lifecycle family) lives in the
+sibling :mod:`~apex_tpu.analysis.concurrency` and
+:mod:`~apex_tpu.analysis.lifecycle` modules and registers through
+:func:`all_rules`; it shares this module's Finding/fingerprint/
+suppression machinery unchanged.
+
 Suppression: ``# apexlint: disable=APX301`` (comma list or ``all``) on
 the offending line, or ``# apexlint: skip-file`` in a file's first ten
 lines.  Grandfathered findings live in LINT_BASELINE.json with a
@@ -54,6 +60,8 @@ __all__ = [
     "ModuleInfo",
     "Rule",
     "ALL_RULES",
+    "TIER_A_RULES",
+    "all_rules",
     "rules_by_id",
     "module_from_source",
 ]
@@ -130,6 +138,10 @@ class Rule:
     name: str = ""
     severity: str = "error"
     description: str = ""
+    # "A" = AST repo rules (this module); "C" = the concurrency/
+    # lifecycle auditor (analysis/concurrency.py + lifecycle.py).
+    # Tier B (the jaxpr auditor) is not a Rule — it needs jax.
+    tier: str = "A"
     # repo-level rules run once over the module set instead of per file
     repo_level: bool = False
 
@@ -969,7 +981,7 @@ class DonationRule(Rule):
         return None
 
 
-ALL_RULES: Tuple[Rule, ...] = (
+TIER_A_RULES: Tuple[Rule, ...] = (
     ChainedRegistryRule(),
     DirectRegistryRule(),
     PrivateGlobalRule(),
@@ -985,6 +997,26 @@ ALL_RULES: Tuple[Rule, ...] = (
 )
 
 
+def all_rules() -> Tuple[Rule, ...]:
+    """Every registered rule across tiers (A + C).  The Tier-C modules
+    import :class:`Rule` from here, so their registration is resolved
+    lazily — at call time both modules are fully initialized whichever
+    one was imported first."""
+    from apex_tpu.analysis.concurrency import CONCURRENCY_RULES
+    from apex_tpu.analysis.lifecycle import LIFECYCLE_RULES
+
+    return TIER_A_RULES + CONCURRENCY_RULES + LIFECYCLE_RULES
+
+
+def __getattr__(name):
+    # ALL_RULES predates the tiers and is part of the public surface;
+    # keep it resolving to the full cross-tier set without a circular
+    # import at module load.
+    if name == "ALL_RULES":
+        return all_rules()
+    raise AttributeError(name)
+
+
 def rules_by_id() -> Dict[str, Rule]:
     """id -> rule instance (the guard test and fixtures key on ids)."""
-    return {r.id: r for r in ALL_RULES}
+    return {r.id: r for r in all_rules()}

@@ -127,9 +127,13 @@ class AsyncCheckpointer:
         self.directory = directory
         self.keep = keep
         self.process_index = process_index
-        self.last_result: Optional[SaveResult] = None
-        self._thread: Optional[threading.Thread] = None
-        self._error: Optional[BaseException] = None
+        # The writer thread publishes results/errors; the train-loop
+        # thread reads them only after joining it (wait()), so the
+        # join IS the synchronization — no lock, by design (APX502
+        # enforces the join-ordered access pattern).
+        self.last_result: Optional[SaveResult] = None  # guarded-by: join(self._thread)
+        self._thread: Optional[threading.Thread] = None  # guarded-by: confined(train-loop)
+        self._error: Optional[BaseException] = None    # guarded-by: join(self._thread)
 
     # -- save --------------------------------------------------------------
 

@@ -95,7 +95,7 @@ class ImageFolderDataset:
         if not self.samples:
             raise ValueError(f"no images found under {root!r}")
         self._visit_lock = threading.Lock()
-        self._visits: dict = {}
+        self._visits: dict = {}       # guarded-by: self._visit_lock
 
     def __len__(self) -> int:
         return len(self.samples)

@@ -50,8 +50,8 @@ def device_prefetch(
     if size < 1:
         raise ValueError(f"prefetch size must be >= 1, got {size}")
 
-    q: "queue.Queue" = queue.Queue(maxsize=size)
-    stop = threading.Event()
+    q: "queue.Queue" = queue.Queue(maxsize=size)   # guarded-by: queue
+    stop = threading.Event()                       # guarded-by: event
 
     def _put(item) -> bool:
         # Bounded put that re-checks the stop flag: an abandoned consumer
@@ -74,7 +74,9 @@ def device_prefetch(
         except BaseException as e:  # surface pipeline errors downstream
             _put(e)
 
-    threading.Thread(target=worker, daemon=True).start()
+    producer = threading.Thread(target=worker, daemon=True,
+                                name="apex-tpu-prefetch")
+    producer.start()
     try:
         while True:
             item = q.get()
@@ -85,10 +87,14 @@ def device_prefetch(
             yield item
     finally:
         # Runs on exhaustion, consumer exception, and GeneratorExit alike:
-        # release the producer, then drop queued device batches.
+        # release the producer, drop queued device batches, then reap
+        # the thread — an abandoned consumer (break mid-epoch) must not
+        # leave a producer pinned behind a full queue (it re-checks
+        # `stop` every 0.1s, so the join bounds at one poll interval).
         stop.set()
         while True:
             try:
                 q.get_nowait()
             except queue.Empty:
                 break
+        producer.join(timeout=5.0)

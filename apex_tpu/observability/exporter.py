@@ -131,10 +131,20 @@ class TelemetryExporter:
     # -- lifecycle ---------------------------------------------------------
 
     def close(self) -> None:
-        """Stop serving and release the socket (idempotent)."""
+        """Stop serving and release the socket (idempotent).
+
+        Ordering matters (APX504's close-ordering check pins it):
+        ``shutdown()`` stops the accept loop, the JOIN waits out the
+        serve thread, and only then does ``server_close()`` release
+        the socket — closing first races an in-flight scrape that is
+        still rendering the registry through this server.  Handler
+        threads are reaped by ``server_close`` itself
+        (``ThreadingHTTPServer.block_on_close``; daemon_threads only
+        marks them for interpreter exit).
+        """
         server, self._server = self._server, None
         if server is None:
             return
         server.shutdown()
-        server.server_close()
         self._thread.join(timeout=2.0)
+        server.server_close()

@@ -131,7 +131,7 @@ class Counter:
                  tags: Optional[dict] = None):
         self.name = name
         self.tags = tags
-        self.value = 0
+        self.value = 0                 # guarded-by: self._lock
         self._lock = lock
 
     def inc(self, n: int = 1) -> None:
@@ -150,7 +150,7 @@ class Gauge:
                  tags: Optional[dict] = None):
         self.name = name
         self.tags = tags
-        self.value: Optional[float] = None
+        self.value: Optional[float] = None   # guarded-by: self._reg._lock
         self._reg = reg
 
     def set(self, value) -> None:
@@ -179,13 +179,13 @@ class Histogram:
         self.name = name
         self.tags = tags
         self.record_type = record_type
-        self.count = 0
-        self.total = 0.0
+        self.count = 0                       # guarded-by: self._reg._lock
+        self.total = 0.0                     # guarded-by: self._reg._lock
         # -inf, not 0.0: a histogram of all-negative observations must
         # report the max it actually saw (summary() maps "never
         # observed" back to 0.0 for display)
-        self.max = float("-inf")
-        self._window = deque(maxlen=self.WINDOW)
+        self.max = float("-inf")             # guarded-by: self._reg._lock
+        self._window = deque(maxlen=self.WINDOW)   # guarded-by: self._reg._lock
         self._reg = reg
 
     def observe(self, value, **extra) -> None:
@@ -254,7 +254,7 @@ class Sketch:
                  tags: Optional[dict] = None):
         self.name = name
         self.tags = tags
-        self._sketch = LogBucketSketch()
+        self._sketch = LogBucketSketch()     # guarded-by: self._lock
         self._lock = lock
 
     def observe(self, value, **extra) -> None:
@@ -302,7 +302,7 @@ class MetricsRegistry:
     def __init__(self, sinks=(), tags: Optional[dict] = None,
                  profiler: bool = False):
         self._lock = threading.Lock()
-        self._metrics: Dict[Tuple[str, str], Any] = {}
+        self._metrics: Dict[Tuple[str, str], Any] = {}   # guarded-by: self._lock
         self.sinks = list(sinks)
         self.tags = dict(tags or {})
         # Feature flag for the jax.profiler trace-annotation sink:
@@ -355,7 +355,10 @@ class MetricsRegistry:
     def _get(self, kind: str, name: str, factory,
              tags: Optional[dict] = None):
         key = (kind, name, _tags_key(tags))
-        m = self._metrics.get(key)
+        # lock-free first probe is the hot-path contract: dict.get on a
+        # never-shrinking dict is safe under the GIL, and the miss path
+        # double-checks under the lock before inserting
+        m = self._metrics.get(key)   # apexlint: disable=APX502
         if m is None:
             with self._lock:
                 m = self._metrics.get(key)

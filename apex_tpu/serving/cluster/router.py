@@ -130,9 +130,12 @@ class _Worker:
         self.addr = addr
         self.pool = pool
         self.timeout = timeout
-        self.alive = True
-        self.stats: dict = {}
-        self.in_flight: Dict[int, _Pending] = {}
+        # router state is confined to the dispatch thread (the router
+        # is stepped, never shared) — annotated so APX502 catches a
+        # future background poller mutating worker state
+        self.alive = True                        # guarded-by: confined(router-thread)
+        self.stats: dict = {}                    # guarded-by: confined(router-thread)
+        self.in_flight: Dict[int, _Pending] = {}  # guarded-by: confined(router-thread)
         # dispatches since the last stats refresh: the stats snapshot
         # goes stale inside one dispatch burst, and without this the
         # whole burst would land on whichever worker looked best at
@@ -216,8 +219,8 @@ class Router:
         self._priority = tuple(class_priority)
         self.wire_dtype = wire_dtype
         self._max_worker_queue = int(max_worker_queue)
-        self._queues: Dict[str, deque] = {}
-        self._next_rid = 0
+        self._queues: Dict[str, deque] = {}      # guarded-by: confined(router-thread)
+        self._next_rid = 0                       # guarded-by: confined(router-thread)
         self._pf_rr = 0                      # prefill round-robin cursor
         self._last_decode_pick: Optional[str] = None
         self._requeued_total = 0

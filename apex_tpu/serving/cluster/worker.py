@@ -60,7 +60,8 @@ from apex_tpu.serving.cluster import protocol
 from apex_tpu.serving.cluster.handoff import (
     WIRE_DTYPES, decode_kv, encode_kv, wire_bytes)
 
-__all__ = ["WorkerServer", "spawn_worker", "READY_PREFIX"]
+__all__ = ["WorkerServer", "spawn_worker", "shutdown_worker",
+           "READY_PREFIX"]
 
 READY_PREFIX = "APEX_TPU_CLUSTER_WORKER ready"
 
@@ -115,11 +116,15 @@ class WorkerServer:
         self.wire_dtype = wire_dtype
         self._max_len = int(max_len or cfg.max_position_embeddings)
         self._stop = False
-        self.engine: Optional[ServingEngine] = None
-        self._exec: Optional[_PrefillExec] = None
+        # engine + RPC bookkeeping are confined to the select loop by
+        # design (the module docstring's no-locking contract); the
+        # annotations make a future background-thread reach a lint
+        # failure instead of a race
+        self.engine: Optional[ServingEngine] = None     # guarded-by: confined(serve-loop)
+        self._exec: Optional[_PrefillExec] = None       # guarded-by: confined(serve-loop)
         # engine request id -> (router rid, submit wall time)
-        self._ridmap: Dict[int, tuple] = {}
-        self._outbox: List[dict] = []
+        self._ridmap: Dict[int, tuple] = {}             # guarded-by: confined(serve-loop)
+        self._outbox: List[dict] = []                   # guarded-by: confined(serve-loop)
         if role == "decode":
             self.engine = ServingEngine(
                 params, cfg, max_slots=max_slots, max_len=self._max_len,
@@ -143,7 +148,7 @@ class WorkerServer:
         self._listener.bind((host, int(port)))
         self._listener.listen(8)
         self.host, self.port = self._listener.getsockname()[:2]
-        self._clients: List[socket.socket] = []
+        self._clients: List[socket.socket] = []         # guarded-by: confined(serve-loop)
 
     @property
     def addr(self) -> str:
@@ -514,15 +519,42 @@ def spawn_worker(role: str, *, extra_args: Optional[List[str]] = None,
     import collections
     import threading
 
-    tail: collections.deque = collections.deque(maxlen=200)
+    tail: collections.deque = collections.deque(maxlen=200)   # guarded-by: deque
 
     def _drain():
         for line in proc.stdout:
             tail.append(line.rstrip())
 
-    threading.Thread(target=_drain, daemon=True).start()
+    drain = threading.Thread(target=_drain, daemon=True,
+                             name="apex-tpu-worker-drain")
+    drain.start()
     proc.output_tail = tail
+    # the drain exits on stdout EOF (child death); shutdown_worker()
+    # is the join path — callers that kill the child directly should
+    # still reap proc.drain_thread
+    proc.drain_thread = drain
     return proc, addr, metrics
+
+
+def shutdown_worker(proc, timeout: float = 10.0) -> None:
+    """Tear down a :func:`spawn_worker` child: terminate (then kill)
+    the process and JOIN its stdout drain thread — the drain exits on
+    the child's stdout EOF, so an unreaped drain after this returns
+    means the teardown genuinely wedged, not that nobody looked.
+    Idempotent; safe on a child that already died (the soak test kills
+    one on purpose and still calls this)."""
+    import subprocess
+
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout)
+    drain = getattr(proc, "drain_thread", None)
+    if drain is not None:
+        drain.join(timeout)
 
 
 if __name__ == "__main__":

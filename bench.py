@@ -899,10 +899,11 @@ def bench_serve_trace(cache_layout="paged", wire_dtype="raw",
                 != (seq_b[i: i + 1] or [None])][:8]
         router.close(shutdown_workers=True)
     finally:
+        from apex_tpu.serving.cluster.worker import shutdown_worker
+
         for proc in procs:
             try:
-                proc.terminate()
-                proc.wait(timeout=10)
+                shutdown_worker(proc)
             except Exception:
                 proc.kill()
     return row

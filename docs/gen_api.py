@@ -89,6 +89,15 @@ MODULES = [
     ("apex_tpu.analysis.jaxpr_audit", "analysis",
      "analysis.jaxpr_audit — Tier-B trace auditor (census, overlap, "
      "upcasts, donation)"),
+    ("apex_tpu.analysis.concurrency", "analysis",
+     "analysis.concurrency — Tier-C thread-escape graph + guarded-by "
+     "discipline (APX501-503)"),
+    ("apex_tpu.analysis.lifecycle", "analysis",
+     "analysis.lifecycle — Tier-C thread/server lifecycle + paired "
+     "acquire/release (APX504-505)"),
+    ("apex_tpu.analysis.stress", "analysis",
+     "analysis.stress — seeded concurrency stress smoke (the "
+     "concurrency_audit gate's dynamic half)"),
     # parallel
     ("apex_tpu.parallel.mesh", "parallel", "parallel.mesh — device mesh"),
     ("apex_tpu.parallel.launch", "parallel",

@@ -146,9 +146,11 @@ def main():
         print("   autoscale:", router.autoscale_signal())
         router.close(shutdown_workers=True)
     finally:
+        from apex_tpu.serving.cluster.worker import shutdown_worker
+
         for proc in procs:
             try:
-                proc.terminate()
+                shutdown_worker(proc)
             except Exception:
                 pass
         if args.telemetry:

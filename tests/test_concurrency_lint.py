@@ -1,0 +1,667 @@
+"""apexlint Tier C unit tests (ISSUE 13): every concurrency/lifecycle
+rule must catch its fixture and pass its clean twin; the guarded-by
+annotation grammar is pinned; the thread-escape graph resolves the
+repo's real spawn idioms (self.method targets, nested defs, handler
+classes through a `x = self` alias); and the historical PR-6 `_admit`
+leak shape is the APX505 regression fixture.
+
+Fixture style matches tests/test_lint.py: in-memory modules via
+``rules.module_from_source`` — the same ModuleInfo path the real
+linter walks.  The repo-clean-at-head pin and the tier/id selection
+machinery are covered here too; the dynamic stress smoke is gated by
+the ``concurrency_audit`` dryrun phase and smoke-tested (tiny sizes)
+in the slow marker.
+"""
+
+import os
+
+import pytest
+
+from apex_tpu.analysis import linter
+from apex_tpu.analysis.concurrency import parse_guard_spec, thread_model
+from apex_tpu.analysis.rules import module_from_source, rules_by_id
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RULES = rules_by_id()
+
+_HDR = "import threading\nimport queue\n"
+
+
+def run_rule(rule_id, source, relpath="apex_tpu/_fixture.py"):
+    return list(RULES[rule_id].check(
+        module_from_source(source, relpath)))
+
+
+def run_repo_rule(rule_id, *sources):
+    mods = [module_from_source(src, f"apex_tpu/_fix{i}.py")
+            for i, src in enumerate(sources)]
+    return list(RULES[rule_id].check_repo(mods, REPO))
+
+
+# ---------------------------------------------------------------------------
+# the thread-escape graph
+# ---------------------------------------------------------------------------
+
+
+class TestThreadModel:
+    def test_self_method_target_resolves(self):
+        mod = module_from_source(_HDR + (
+            "class W:\n"
+            "    def start(self):\n"
+            "        self._t = threading.Thread(target=self._run)\n"
+            "        self._t.start()\n"
+            "    def _run(self):\n"
+            "        self._helper()\n"
+            "    def _helper(self):\n"
+            "        pass\n"))
+        m = thread_model(mod)
+        assert len(m.spawns) == 1
+        assert m.spawns[0].target_quals == ("W._run",)
+        assert m.spawns[0].binding == "self._t"
+        # transitive closure: the helper runs on the thread too
+        assert m.is_thread_side("W._run")
+        assert m.is_thread_side("W._helper")
+        assert not m.is_thread_side("W.start")
+
+    def test_nested_def_target_resolves(self):
+        mod = module_from_source(_HDR + (
+            "def go():\n"
+            "    def worker():\n"
+            "        pass\n"
+            "    t = threading.Thread(target=worker)\n"
+            "    t.start()\n"))
+        m = thread_model(mod)
+        assert m.spawns[0].target_quals == ("go.worker",)
+        assert m.is_thread_side("go.worker")
+
+    def test_handler_class_via_self_alias(self):
+        # the exporter idiom: a nested handler class calling back
+        # through `exporter = self`
+        mod = module_from_source(_HDR + (
+            "from http.server import BaseHTTPRequestHandler, "
+            "ThreadingHTTPServer\n"
+            "class Exp:\n"
+            "    def __init__(self):\n"
+            "        exporter = self\n"
+            "        class H(BaseHTTPRequestHandler):\n"
+            "            def do_GET(self):\n"
+            "                exporter._handle(self)\n"
+            "        self._server = ThreadingHTTPServer(('', 0), H)\n"
+            "    def _handle(self, h):\n"
+            "        pass\n"))
+        m = thread_model(mod)
+        assert any(s.kind == "server" for s in m.spawns)
+        assert m.is_thread_side("Exp._handle")
+
+
+class TestGuardSpecGrammar:
+    def test_forms(self):
+        assert parse_guard_spec("self._lock").form == "lock"
+        assert parse_guard_spec("_global_lock trailing prose").value \
+            == "_global_lock"
+        j = parse_guard_spec("join(self._thread)")
+        assert (j.form, j.value) == ("join", "self._thread")
+        c = parse_guard_spec("confined(engine-loop)")
+        assert (c.form, c.value) == ("confined", "engine-loop")
+        assert parse_guard_spec("queue").form == "safe-type"
+        assert parse_guard_spec("??garbage??").form == "bad"
+
+    def test_annotation_inside_string_is_not_parsed(self):
+        # the rule's own description quotes the convention — a string
+        # literal mentioning guarded-by: must not register
+        src = ('class C:\n'
+               '    def __init__(self):\n'
+               '        self.doc = "use # guarded-by: self._lock"\n'
+               '    def touch(self):\n'
+               '        self.doc = 1\n')
+        assert not run_rule("APX502", src)
+
+
+# ---------------------------------------------------------------------------
+# APX501 — unguarded cross-thread mutation
+# ---------------------------------------------------------------------------
+
+
+class TestCrossThreadMutation:
+    _RACE = _HDR + (
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self.state = 0\n"
+        "        self._lock = threading.Lock()\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._run).start()\n"
+        "    def _run(self):\n"
+        "        self.state = 1\n"
+        "    def stop(self):\n"
+        "        {}\n")
+
+    def test_both_side_write_fires(self):
+        fs = run_rule("APX501", self._RACE.format("self.state = 2"))
+        assert len(fs) == 1 and "state" in fs[0].message
+
+    def test_common_lock_is_clean(self):
+        src = _HDR + (
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self.state = 0\n"
+            "        self._lock = threading.Lock()\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._run).start()\n"
+            "    def _run(self):\n"
+            "        with self._lock:\n"
+            "            self.state = 1\n"
+            "    def stop(self):\n"
+            "        with self._lock:\n"
+            "            self.state = 2\n")
+        assert not run_rule("APX501", src)
+
+    def test_annotated_attr_deferred_to_apx502(self):
+        src = self._RACE.format("self.state = 2").replace(
+            "self.state = 0",
+            "self.state = 0   # guarded-by: join(self._t)")
+        assert not run_rule("APX501", src)
+
+    def test_init_writes_are_happens_before(self):
+        # only __init__ writes on the spawning side: no race
+        src = _HDR + (
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self.state = 0\n"
+            "        threading.Thread(target=self._run).start()\n"
+            "    def _run(self):\n"
+            "        self.state = 1\n")
+        assert not run_rule("APX501", src)
+
+    def test_safe_type_attr_is_clean(self):
+        src = _HDR + (
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self.q = queue.Queue()\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._run).start()\n"
+            "    def _run(self):\n"
+            "        self.q.put(1)\n"
+            "    def stop(self):\n"
+            "        self.q.put(None)\n")
+        assert not run_rule("APX501", src)
+
+    def test_nonlocal_closure_write_fires(self):
+        src = _HDR + (
+            "def go():\n"
+            "    n = 0\n"
+            "    def worker():\n"
+            "        nonlocal n\n"
+            "        n += 1\n"
+            "    threading.Thread(target=worker).start()\n"
+            "    n += 1\n"
+            "    return n\n")
+        fs = run_rule("APX501", src)
+        assert fs and "'n'" in fs[0].message
+
+    def test_shadowing_local_in_thread_fn_is_clean(self):
+        # the spawn_worker drain idiom: `for line in ...` in the
+        # nested def is its own local, not a shared cell
+        src = _HDR + (
+            "def go(stream):\n"
+            "    def drain():\n"
+            "        for line in stream:\n"
+            "            pass\n"
+            "    threading.Thread(target=drain).start()\n"
+            "    line = stream.readline()\n"
+            "    return line\n")
+        assert not run_rule("APX501", src)
+
+
+# ---------------------------------------------------------------------------
+# APX502 — guarded-by discipline
+# ---------------------------------------------------------------------------
+
+
+class TestGuardedBy:
+    _LOCKED = _HDR + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = {{}}   # guarded-by: self._lock\n"
+        "    def put(self, k, v):\n"
+        "        {}\n")
+
+    def test_lock_form_unguarded_access_fires(self):
+        fs = run_rule("APX502",
+                      self._LOCKED.format("self.items[k] = v"))
+        assert len(fs) == 1 and "with self._lock" in fs[0].message
+
+    def test_lock_form_guarded_access_clean(self):
+        src = self._LOCKED.format(
+            "with self._lock:\n            self.items[k] = v")
+        assert not run_rule("APX502", src)
+
+    def test_join_form(self):
+        tmpl = _HDR + (
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._err = None   # guarded-by: join(self._t)\n"
+            "        self._t = None\n"
+            "    def save(self):\n"
+            "        self._t = threading.Thread(target=self._w)\n"
+            "        self._t.start()\n"
+            "    def _w(self):\n"
+            "        self._err = ValueError()\n"      # thread side: ok
+            "    def wait(self):\n"
+            "        {}\n"
+            "        return self._err\n")
+        # reader joins first: clean
+        assert not run_rule("APX502", tmpl.format("self._t.join()"))
+        # reader never joins: fires
+        fs = run_rule("APX502", tmpl.format("pass"))
+        assert fs and "without joining" in fs[0].message
+
+    def test_confined_form(self):
+        tmpl = _HDR + (
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self.box = []   # guarded-by: confined(loop)\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._run).start()\n"
+            "    def _run(self):\n"
+            "        {}\n"
+            "    def pump(self):\n"
+            "        self.box.append(1)\n")
+        assert not run_rule("APX502", tmpl.format("pass"))
+        fs = run_rule("APX502", tmpl.format("self.box.append(2)"))
+        assert fs and "runs on a spawned thread" in fs[0].message
+
+    def test_safe_type_form(self):
+        ok = _HDR + ("class C:\n"
+                     "    def __init__(self):\n"
+                     "        self.q = queue.Queue()   "
+                     "# guarded-by: queue\n")
+        assert not run_rule("APX502", ok)
+        bad = ok.replace("queue.Queue()", "list()")
+        fs = run_rule("APX502", bad)
+        assert fs and "does not construct" in fs[0].message
+
+    def test_module_global_lock_form(self):
+        tmpl = (_HDR +
+                "_lk = threading.Lock()\n"
+                "_count = 0   # guarded-by: _lk\n"
+                "def bump():\n"
+                "    global _count\n"
+                "    {}\n")
+        assert not run_rule(
+            "APX502",
+            tmpl.format("with _lk:\n        _count += 1"))
+        fs = run_rule("APX502", tmpl.format("_count += 1"))
+        assert fs and "_count" in fs[0].message
+
+    def test_str_join_is_not_a_join_witness(self):
+        # review regression: `", ".join(parts)` must NOT satisfy the
+        # join-ordered form — only a Thread-shaped join (no positional
+        # args, or a numeric timeout) counts
+        src = _HDR + (
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._err = None   # guarded-by: join(self._t)\n"
+            "        self._t = None\n"
+            "    def save(self):\n"
+            "        self._t = threading.Thread(target=self._w)\n"
+            "        self._t.start()\n"
+            "        self._t.join(5.0)\n"
+            "    def _w(self):\n"
+            "        self._err = ValueError()\n"
+            "    def report(self):\n"
+            "        msg = ', '.join(['a', 'b'])\n"
+            "        return msg, self._err\n")
+        fs = run_rule("APX502", src)
+        assert fs and "report" in fs[0].message
+
+    def test_bad_spec_fires(self):
+        src = ("class C:\n"
+               "    def __init__(self):\n"
+               "        self.x = 0   # guarded-by: ???\n")
+        fs = run_rule("APX502", src)
+        assert fs and "unparseable" in fs[0].message
+
+    def test_suppression_applies_at_the_access(self, tmp_path):
+        pkg = tmp_path / "apex_tpu"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(_HDR + (
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.items = {}   # guarded-by: self._lock\n"
+            "    def fast(self):\n"
+            "        return self.items   # apexlint: disable=APX502\n"))
+        assert not linter.lint(str(tmp_path), targets=("apex_tpu",),
+                               rules=[RULES["APX502"]])
+
+
+# ---------------------------------------------------------------------------
+# APX503 — lock-order cycles
+# ---------------------------------------------------------------------------
+
+
+class TestLockOrder:
+    def test_opposite_nesting_fires(self):
+        src = _HDR + (
+            "_a = threading.Lock()\n"
+            "_b = threading.Lock()\n"
+            "def f():\n"
+            "    with _a:\n"
+            "        with _b:\n"
+            "            pass\n"
+            "def g():\n"
+            "    with _b:\n"
+            "        with _a:\n"
+            "            pass\n")
+        fs = run_repo_rule("APX503", src)
+        assert fs and "lock-order cycle" in fs[0].message
+
+    def test_consistent_order_clean(self):
+        src = _HDR + (
+            "_a = threading.Lock()\n"
+            "_b = threading.Lock()\n"
+            "def f():\n"
+            "    with _a:\n"
+            "        with _b:\n"
+            "            pass\n"
+            "def g():\n"
+            "    with _a:\n"
+            "        with _b:\n"
+            "            pass\n")
+        assert not run_repo_rule("APX503", src)
+
+    def test_long_chain_terminates(self):
+        # review regression: the cycle DFS must be linear-time and
+        # iterative — a deep lock chain (plus a cycle at the end) ran
+        # the old recursive all-simple-paths form out of stack
+        n = 300
+        locks = "\n".join(f"_l{i} = threading.Lock()"
+                          for i in range(n))
+        chain = "\n".join(
+            f"def f{i}():\n    with _l{i}:\n        with _l{i + 1}:\n"
+            "            pass"
+            for i in range(n - 1))
+        cycle = (f"def back():\n    with _l{n - 1}:\n"
+                 "        with _l0:\n            pass\n")
+        fs = run_repo_rule("APX503",
+                           _HDR + locks + "\n" + chain + "\n" + cycle)
+        assert fs and "cycle" in fs[0].message
+
+    def test_call_mediated_edge(self):
+        src = _HDR + (
+            "_a = threading.Lock()\n"
+            "_b = threading.Lock()\n"
+            "def inner_b():\n"
+            "    with _b:\n"
+            "        pass\n"
+            "def f():\n"
+            "    with _a:\n"
+            "        inner_b()\n"
+            "def g():\n"
+            "    with _b:\n"
+            "        with _a:\n"
+            "            pass\n")
+        fs = run_repo_rule("APX503", src)
+        assert fs and "cycle" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# APX504 — thread/server lifecycle
+# ---------------------------------------------------------------------------
+
+
+class TestLifecycle:
+    def test_fire_and_forget_fires(self):
+        src = _HDR + (
+            "def go(fn):\n"
+            "    threading.Thread(target=fn, daemon=True).start()\n")
+        fs = run_rule("APX504", src)
+        assert fs and "fire-and-forget" in fs[0].message
+
+    def test_bound_without_join_fires(self):
+        src = _HDR + (
+            "def go(fn):\n"
+            "    t = threading.Thread(target=fn)\n"
+            "    t.start()\n")
+        fs = run_rule("APX504", src)
+        assert fs and "no reachable" in fs[0].message
+
+    def test_bound_with_join_clean(self):
+        src = _HDR + (
+            "def go(fn):\n"
+            "    t = threading.Thread(target=fn)\n"
+            "    t.start()\n"
+            "    t.join()\n")
+        assert not run_rule("APX504", src)
+
+    def test_join_through_alias_clean(self):
+        # t = self._thread; t.join() — the async_saver idiom
+        src = _HDR + (
+            "class S:\n"
+            "    def save(self, fn):\n"
+            "        self._thread = threading.Thread(target=fn)\n"
+            "        self._thread.start()\n"
+            "    def wait(self):\n"
+            "        t = self._thread\n"
+            "        if t is not None:\n"
+            "            t.join()\n")
+        assert not run_rule("APX504", src)
+
+    def test_comprehension_binding_and_join_loop_clean(self):
+        # the stress-module idiom: spawn via list comp, join in a for
+        src = _HDR + (
+            "def go(fns):\n"
+            "    threads = [threading.Thread(target=f) for f in fns]\n"
+            "    for t in threads:\n"
+            "        t.start()\n"
+            "    for t in threads:\n"
+            "        t.join()\n")
+        assert not run_rule("APX504", src)
+
+    def test_str_join_does_not_discharge_lifecycle(self):
+        # review regression: a str.join on a name aliasing the thread
+        # binding must not count as the thread's teardown path
+        src = _HDR + (
+            "def go(fn):\n"
+            "    t = threading.Thread(target=fn)\n"
+            "    t.start()\n"
+            "    label = t\n"
+            "    return ', '.join(['x'])\n")
+        fs = run_rule("APX504", src)
+        assert fs and "no reachable" in fs[0].message
+
+    def test_server_without_close_fires(self):
+        src = (
+            "from http.server import ThreadingHTTPServer\n"
+            "class E:\n"
+            "    def __init__(self, h):\n"
+            "        self._server = ThreadingHTTPServer(('', 0), h)\n")
+        fs = run_rule("APX504", src)
+        assert fs and "server" in fs[0].message
+
+    def test_close_ordering(self):
+        tmpl = (
+            "import threading\n"
+            "from http.server import ThreadingHTTPServer\n"
+            "class E:\n"
+            "    def __init__(self, h):\n"
+            "        self._server = ThreadingHTTPServer(('', 0), h)\n"
+            "        self._thread = threading.Thread(\n"
+            "            target=self._server.serve_forever)\n"
+            "        self._thread.start()\n"
+            "    def close(self):\n"
+            "        server, self._server = self._server, None\n"
+            "        server.shutdown()\n"
+            "        {}\n")
+        good = tmpl.format(
+            "self._thread.join()\n        server.server_close()")
+        assert not run_rule("APX504", good)
+        bad = tmpl.format(
+            "server.server_close()\n        self._thread.join()")
+        fs = run_rule("APX504", bad)
+        assert fs and "before the serve thread is joined" \
+            in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# APX505 — paired acquire/release (the _admit regression shape)
+# ---------------------------------------------------------------------------
+
+
+class TestAcquireRelease:
+    # The historical PR-6 bug, reduced: blocks claimed into a local
+    # list, a prefill call that can raise, THEN the table store — an
+    # exception between leaks every claimed block.
+    _ADMIT_LEAK = (
+        "class Engine:\n"
+        "    def _admit(self, prompt):\n"
+        "        claimed = []\n"
+        "        for _ in range(4):\n"
+        "            blk = self._mgr.alloc()\n"
+        "            claimed.append(blk)\n"
+        "        self._prefill(prompt)\n"
+        "        self.table.extend(claimed)\n")
+
+    def test_admit_leak_shape_fires(self):
+        fs = run_rule("APX505", self._ADMIT_LEAK)
+        assert len(fs) == 1
+        assert "alloc()" in fs[0].message
+        assert "unwind" in fs[0].message
+
+    def test_admit_with_unwind_edge_clean(self):
+        src = (
+            "class Engine:\n"
+            "    def _admit(self, prompt):\n"
+            "        claimed = []\n"
+            "        try:\n"
+            "            for _ in range(4):\n"
+            "                blk = self._mgr.alloc()\n"
+            "                claimed.append(blk)\n"
+            "            self._prefill(prompt)\n"
+            "        except Exception:\n"
+            "            self._mgr.free_all(claimed)\n"
+            "            raise\n"
+            "        self.table.extend(claimed)\n")
+        assert not run_rule("APX505", src)
+
+    def test_finally_release_clean(self):
+        src = (
+            "def probe(addr):\n"
+            "    import socket\n"
+            "    s = socket.create_connection(addr)\n"
+            "    try:\n"
+            "        return handshake(s)\n"
+            "    finally:\n"
+            "        s.close()\n")
+        assert not run_rule("APX505", src)
+
+    def test_socket_without_unwind_fires(self):
+        src = (
+            "def probe(addr):\n"
+            "    import socket\n"
+            "    s = socket.create_connection(addr)\n"
+            "    hello = handshake(s)\n"
+            "    s.close()\n"
+            "    return hello\n")
+        fs = run_rule("APX505", src)
+        assert fs and "create_connection" in fs[0].message
+
+    def test_immediate_ownership_transfer_clean(self):
+        # self._sock = create_connection(...): the object owns it now
+        src = (
+            "import socket\n"
+            "class W:\n"
+            "    def __init__(self, addr):\n"
+            "        self._sock = socket.create_connection(addr)\n"
+            "        self._sock.settimeout(5.0)\n"
+            "        self.hello = self.rpc({'op': 'hello'})\n")
+        assert not run_rule("APX505", src)
+
+    def test_with_block_clean(self):
+        src = (
+            "def read(p):\n"
+            "    with open(p) as f:\n"
+            "        return f.read()\n")
+        assert not run_rule("APX505", src)
+
+    def test_no_risk_calls_clean(self):
+        # acquire immediately escaped with only no-raise builtins in
+        # between (the engine's _ensure_tail_blocks shape)
+        src = (
+            "class E:\n"
+            "    def grow(self, st, slot):\n"
+            "        blk = self._mgr.alloc()\n"
+            "        self._tables[slot, len(st.blocks)] = blk\n"
+            "        st.blocks.append(blk)\n")
+        assert not run_rule("APX505", src)
+
+
+# ---------------------------------------------------------------------------
+# tier/id selection + the repo pin
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tierc_findings():
+    """ONE tier-C repo lint shared by the at-head assertions."""
+    return linter.lint(REPO, rules=linter.select_rules(tier="C"))
+
+
+class TestTierSelection:
+    def test_tier_filter(self):
+        ids = {r.id for r in linter.select_rules(tier="C")}
+        assert ids == {"APX501", "APX502", "APX503", "APX504",
+                       "APX505"}
+        ids_a = {r.id for r in linter.select_rules(tier="A")}
+        assert "APX101" in ids_a and not ids_a & ids
+
+    def test_id_patterns(self):
+        assert {r.id for r in linter.select_rules(ids=["APX5xx"])} \
+            == {"APX501", "APX502", "APX503", "APX504", "APX505"}
+        assert [r.id for r in linter.select_rules(
+            ids=["APX501,APX505"])] == ["APX501", "APX505"]
+
+    def test_unknown_selection_raises(self):
+        with pytest.raises(ValueError):
+            linter.select_rules(tier="B")
+        with pytest.raises(ValueError):
+            linter.select_rules(ids=["APX9xx"])
+
+    def test_empty_rules_pattern_raises(self):
+        # review regression: an unset CI variable (`--rules ""`) must
+        # exit 2, not scan zero rules and pass vacuously
+        with pytest.raises(ValueError):
+            linter.select_rules(ids=[""])
+        with pytest.raises(ValueError):
+            linter.select_rules(ids=[" , "])
+
+    def test_all_rules_carry_a_tier(self):
+        from apex_tpu.analysis.rules import all_rules
+
+        assert {r.tier for r in all_rules()} == {"A", "C"}
+
+    def test_repo_tier_c_clean_at_head(self, tierc_findings):
+        """THE enforcement pin: the threaded subsystems stay clean
+        against the concurrency/lifecycle rules (suppressions carry
+        their why inline; the baseline stays empty)."""
+        new, _ = linter.diff_baseline(REPO, tierc_findings)
+        assert not new, "new tier-C findings:\n" + "\n".join(
+            f"  {fp} {f.path}:{f.line} {f.message}" for fp, f in new)
+
+
+@pytest.mark.slow
+def test_stress_smoke_tiny():
+    """A miniature of the concurrency_audit stress (the full seeded
+    version gates in the dryrun phase): exact counts, no underflow,
+    clean shutdown."""
+    from apex_tpu.analysis.stress import run_concurrency_stress
+
+    stats = run_concurrency_stress(
+        seed=1, observers=2, observations=50, scrapers=1,
+        churn_iters=120, saves=2)
+    assert stats["sketch_count_exact"], stats
+    assert stats["refcount_underflows"] == 0
+    assert stats["drained_clean"] == 1
+    assert not stats["scrape_parse_failures"]
+    assert not stats["leaked_threads"], stats

@@ -456,9 +456,20 @@ class TestTwoProcess:
                     for r in out} == ref
             router.close(shutdown_workers=True)
         finally:
+            from apex_tpu.serving.cluster.worker import shutdown_worker
+
+            reaped = []
             for proc in procs:
                 try:
-                    proc.terminate()
-                    proc.wait(timeout=10)
+                    shutdown_worker(proc)
+                    reaped.append(proc)
                 except Exception:
                     proc.kill()
+            # the APX504 contract end to end: no drain thread survives
+            # its child (EOF + join in shutdown_worker).  Only checked
+            # where shutdown_worker actually completed — the bare-kill
+            # fallback path never joined, and asserting there would
+            # mask the real teardown failure.
+            for proc in reaped:
+                drain = getattr(proc, "drain_thread", None)
+                assert drain is None or not drain.is_alive()

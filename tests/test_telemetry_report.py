@@ -492,3 +492,69 @@ def test_audit_summary_absent_without_series(report, tmp_path):
                  '"name":"collectives.psum.calls","value":2}\n')
     summ = report.summarize(report.load_records([str(f)]))
     assert report.audit_summary(summ["counters"]) is None
+
+
+def test_audit_summary_tier_c_row(report, tmp_path):
+    """The ISSUE-13 tier-C row: audit.tierc.* counters from the
+    concurrency_audit stress smoke render under the reserved 'tier_c'
+    key with the zero-underflow / zero-new-findings gates deriving
+    'clean'; the print section carries the row next to the jaxpr
+    entries."""
+    import io
+
+    def stream(underflows, sketch_count=1600):
+        return (
+            '{"schema_version":3,"t":1,"type":"counter",'
+            '"name":"audit.tierc.scrapes","value":120}\n'
+            '{"schema_version":3,"t":1,"type":"counter",'
+            '"name":"audit.tierc.flushes","value":90}\n'
+            '{"schema_version":3,"t":1,"type":"counter",'
+            '"name":"audit.tierc.saves","value":4}\n'
+            '{"schema_version":3,"t":1,"type":"counter",'
+            '"name":"audit.tierc.admits","value":388}\n'
+            '{"schema_version":3,"t":1,"type":"counter",'
+            f'"name":"audit.tierc.sketch_count","value":{sketch_count}}}\n'
+            '{"schema_version":3,"t":1,"type":"counter",'
+            '"name":"audit.tierc.sketch_expected","value":1600}\n'
+            '{"schema_version":3,"t":1,"type":"counter",'
+            '"name":"audit.tierc.scrape_parse_failures","value":0}\n'
+            '{"schema_version":3,"t":1,"type":"counter",'
+            '"name":"audit.tierc.refcount_underflows",'
+            f'"value":{underflows}}}\n'
+            '{"schema_version":3,"t":1,"type":"counter",'
+            '"name":"audit.tierc.new_findings","value":0}\n')
+
+    f = tmp_path / "tierc.jsonl"
+    f.write_text(stream(underflows=0))
+    summ = report.summarize(report.load_records([str(f)]))
+    audit = report.audit_summary(summ["counters"])
+    assert audit is not None
+    tc = audit["tier_c"]
+    assert tc["clean"] is True
+    assert tc["stress"]["scrapes"] == 120
+    assert tc["stress"]["admits"] == 388
+    out = io.StringIO()
+    report.print_report(summ, out=out)
+    text = out.getvalue()
+    assert "tier C (concurrency stress): ok" in text
+    assert "scrapes 120" in text
+
+    # an underflow flips the row to FAILED — the report mirrors the
+    # gate, it never launders a red smoke into an 'ok' line
+    f.write_text(stream(underflows=2))
+    summ = report.summarize(report.load_records([str(f)]))
+    audit = report.audit_summary(summ["counters"])
+    assert audit["tier_c"]["clean"] is False
+    out = io.StringIO()
+    report.print_report(summ, out=out)
+    assert "FAILED — see the concurrency_audit gate" in out.getvalue()
+
+    # a torn sketch (realized count != expected) also flips it — the
+    # stream carries the REALIZED count, not the expected product
+    f.write_text(stream(underflows=0, sketch_count=1599))
+    summ = report.summarize(report.load_records([str(f)]))
+    audit = report.audit_summary(summ["counters"])
+    assert audit["tier_c"]["clean"] is False
+
+    # tier-C counters alone (no jaxpr entries) still produce a report
+    assert "moe_ragged" not in audit

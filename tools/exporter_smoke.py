@@ -114,10 +114,11 @@ def smoke_cluster() -> int:
                   f"{len(parsed['samples'])} samples, healthz up")
         router.close(shutdown_workers=True)
     finally:
+        from apex_tpu.serving.cluster.worker import shutdown_worker
+
         for proc in procs:
             try:
-                proc.terminate()
-                proc.wait(timeout=10)
+                shutdown_worker(proc)
             except Exception:
                 proc.kill()
         obs.shutdown()

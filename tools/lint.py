@@ -2,20 +2,34 @@
 
     python tools/lint.py                  # whole repo, diff vs baseline
     python tools/lint.py --changed        # pre-commit: touched files only
+    python tools/lint.py --tier C         # only the concurrency/
+                                          # lifecycle auditor (APX5xx)
+    python tools/lint.py --rules APX5xx   # id filter (x = digit
+                                          # wildcard; comma lists ok)
     python tools/lint.py --json           # machine-readable findings
     python tools/lint.py --write-baseline # grandfather current findings
     python tools/lint.py --audit          # ALSO run the Tier-B jaxpr
                                           # auditor (imports jax)
 
-Exit status: 0 when every live finding is baselined (each baseline
-entry carries a one-line justification — see LINT_BASELINE.json), 1 on
-any NEW finding, and (with ``--audit``) 1 on any Tier-B finding.
+Exit status (stable — CI gates tiers independently on these):
 
-Tier A is stdlib-only: no jax import, runnable on a router box or in a
-pre-commit hook.  ``--changed`` restricts per-file rules to files
-touched vs HEAD (staged + unstaged + untracked) — repo-level rules
-(docs-sync, env-table-sync, donation's cross-module pass) only see the
-changed set there, so CI runs the full form.
+- ``0`` — every live finding is baselined (each baseline entry carries
+  a one-line justification — see LINT_BASELINE.json), or the scan was
+  clean;
+- ``1`` — at least one NEW finding (absent from the baseline), or —
+  with ``--audit`` — any Tier-B finding;
+- ``2`` — usage error (argparse; also an unknown ``--tier`` or a
+  ``--rules`` pattern matching no registered rule — a gate silently
+  filtering to zero rules must not pass vacuously).
+
+Tiers A and C are stdlib-only: no jax import, runnable on a router box
+or in a pre-commit hook.  ``--changed`` restricts per-file rules to
+files touched vs HEAD (staged + unstaged + untracked) — repo-level
+rules (docs-sync, env-table-sync, donation's cross-module pass, the
+lock-order graph) only see the changed set there, so CI runs the full
+form.  ``--tier``/``--rules`` narrow the rule set; stale-baseline
+detection is skipped under any narrowing (an entry for an unscanned
+rule is absent by construction, not fixed).
 
 The rule table, suppression syntax and baseline workflow are in
 docs/static_analysis.md.
@@ -52,6 +66,14 @@ def main(argv=None) -> int:
     ap.add_argument("--changed", action="store_true",
                     help="lint only python files touched vs HEAD "
                          "(the pre-commit scope)")
+    ap.add_argument("--tier", default=None, metavar="A|C|all",
+                    help="run only this tier's rules (A = repo AST "
+                         "rules, C = concurrency/lifecycle auditor)")
+    ap.add_argument("--rules", action="append", default=None,
+                    metavar="IDS",
+                    help="rule-id filter, e.g. APX5xx or "
+                         "APX501,APX505 (x = digit wildcard; "
+                         "repeatable)")
     ap.add_argument("--json", action="store_true",
                     help="emit findings as JSON")
     ap.add_argument("--baseline", default=None,
@@ -78,15 +100,30 @@ def main(argv=None) -> int:
             print("apexlint: no changed python files")
             return 0
         targets = changed
-    if args.write_baseline and targets is not None:
+    rules = None
+    # `--tier all` is the full scan, not a narrowing: stale-baseline
+    # detection and --write-baseline must behave as if no filter was
+    # given (an unknown tier still routes through select_rules → 2)
+    narrowing_tier = args.tier if (
+        args.tier and args.tier.lower() != "all") else None
+    if narrowing_tier or args.rules:
+        try:
+            rules = linter.select_rules(tier=narrowing_tier,
+                                        ids=args.rules)
+        except ValueError as e:
+            print(f"apexlint: {e}", file=sys.stderr)
+            return 2
+    if args.write_baseline and (targets is not None
+                                or rules is not None):
         # the baseline file is the WHOLE repo's grandfather list: a
         # narrowed scan would silently delete every entry for a file
-        # outside the scope, and the next full CI lint re-reports them
-        # all as NEW
+        # (or rule) outside the scope, and the next full CI lint
+        # re-reports them all as NEW
         print("apexlint: --write-baseline always scans the full repo "
-              "(--changed/paths ignored for the write)")
-        targets = None
-    findings = linter.lint(ROOT, targets=targets)
+              "with every rule (--changed/--tier/--rules/paths "
+              "ignored for the write)")
+        targets = rules = None
+    findings = linter.lint(ROOT, targets=targets, rules=rules)
 
     rc = 0
     if args.write_baseline:
@@ -107,11 +144,11 @@ def main(argv=None) -> int:
     else:
         new, stale = linter.diff_baseline(ROOT, findings,
                                           path=args.baseline)
-        if targets is not None:
-            # narrowed scope (--changed / explicit paths): a baseline
-            # entry for an un-scanned file is absent from the findings
-            # by construction, not fixed — stale detection is only
-            # meaningful on a full-repo scan
+        if targets is not None or rules is not None:
+            # narrowed scope (--changed / --tier / --rules / paths):
+            # a baseline entry for an un-scanned file or rule is
+            # absent from the findings by construction, not fixed —
+            # stale detection is only meaningful on a full scan
             stale = []
         if args.json:
             print(json.dumps({

@@ -163,6 +163,20 @@ def main():
                    "APEX_TPU_TELEMETRY": os.path.join(
                        LOGS, "audit_telemetry.jsonl")},
         timeout=1200)
+    # Tier C (ISSUE 13): the concurrency/lifecycle lint repo-wide plus
+    # the seeded stress smoke (scrape/flush/save/admit churn with
+    # exact-count + zero-underflow + clean-shutdown gates).  Chip-free
+    # and fast, so it rides the same early abort-signal block; its
+    # audit.tierc.* counters append to the same audit stream the
+    # telemetry_report tier-C row reads.
+    results["dryrun_concurrency_audit"] = _run(
+        "dryrun_concurrency_audit",
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        env_extra={"APEX_TPU_DRYRUN_PHASE": "concurrency_audit",
+                   "APEX_TPU_TELEMETRY": os.path.join(
+                       LOGS, "audit_telemetry.jsonl")},
+        timeout=900)
     results["bench"] = _run("bench", [sys.executable, "bench.py"],
                             timeout=3600)
     # the inference fast path (prefill/decode split + serving engine):

@@ -40,7 +40,9 @@ prints:
   save/restore ms p50/p95, bytes, overlap ratio, rollback count), and
   the Tier-B jaxpr audit (``audit.*`` → per-entry-point
   census-vs-counter deltas — accounting drift visible in reports, not
-  just in the static_audit CI gate).
+  just in the static_audit CI gate), and the Tier-C concurrency
+  stress (``audit.tierc.*`` → realized scrape/flush/save/churn counts
+  with the zero-underflow / zero-new-findings gates).
 
 ``--since-step N`` keeps only records stamped with ``step >= N``
 (schema v2 stamps every record emitted after the loop declared a step
@@ -350,10 +352,24 @@ def audit_summary(counters: Dict[str, float]) -> Optional[dict]:
     auditor observed while tracing it.  ``census > counted`` is the
     accounting hole the static_audit gate fails on (a collective
     emitted around the counted wrappers); ``counted > census`` is the
-    benign custom_vjp re-trace direction.  None when the stream carries
-    no audit counters (runs without ``tools/lint.py --audit`` or the
-    ``dryrun_static_audit`` stage)."""
+    benign custom_vjp re-trace direction.
+
+    ISSUE 13 adds the **tier-C row** under the reserved key
+    ``"tier_c"``: the ``audit.tierc.*`` counters the
+    ``concurrency_audit`` stress smoke emits (scrapes / flushes /
+    saves / admits / preempts, the realized ``sketch_count`` vs
+    ``sketch_expected``, and the must-be-zero gates
+    ``refcount_underflows`` / ``new_findings`` /
+    ``scrape_parse_failures`` / ``prefetch_leaked`` /
+    ``threads_wedged`` / ``pool_undrained``).  ``clean`` folds every
+    gate present in the stream, so a smoke the dryrun phase failed
+    can never render as ok — with ONE documented exception: the
+    apex-tpu-* thread-leak check runs after telemetry shutdown and is
+    therefore gate-only.  None when the stream carries no audit
+    counters (runs without ``tools/lint.py --audit`` or the
+    ``dryrun_static_audit``/``dryrun_concurrency_audit`` stages)."""
     entries: Dict[str, dict] = {}
+    tier_c: Dict[str, float] = {}
     for key, val in counters.items():
         if not key.startswith("audit."):
             continue
@@ -362,13 +378,18 @@ def audit_summary(counters: Dict[str, float]) -> Optional[dict]:
         if tag.startswith("entry="):
             entry = tag[len("entry="):].rstrip("}")
         parts = base.split(".")
-        if len(parts) != 3 or parts[1] not in ("census", "counted"):
+        if len(parts) != 3:
+            continue
+        if parts[1] == "tierc":
+            tier_c[parts[2]] = tier_c.get(parts[2], 0.0) + val
+            continue
+        if parts[1] not in ("census", "counted"):
             continue
         kind = parts[2]
         slot = entries.setdefault(entry, {}).setdefault(
             kind, {"census": 0.0, "counted": 0.0})
         slot[parts[1]] += val
-    if not entries:
+    if not entries and not tier_c:
         return None
     out: Dict[str, dict] = {}
     for entry, kinds in sorted(entries.items()):
@@ -382,6 +403,18 @@ def audit_summary(counters: Dict[str, float]) -> Optional[dict]:
         out[entry] = {
             "kinds": rows,
             "drift": any(r["delta"] > 0 for r in rows.values()),
+        }
+    if tier_c:
+        zero_gates = ("refcount_underflows", "new_findings",
+                      "scrape_parse_failures", "prefetch_leaked",
+                      "threads_wedged", "pool_undrained")
+        clean = all(tier_c.get(g, 0.0) == 0.0 for g in zero_gates)
+        if "sketch_count" in tier_c and "sketch_expected" in tier_c:
+            clean = clean and (tier_c["sketch_count"]
+                               == tier_c["sketch_expected"])
+        out["tier_c"] = {
+            "stress": dict(sorted(tier_c.items())),
+            "clean": clean,
         }
     return out
 
@@ -540,7 +573,10 @@ def print_report(summary: dict, out=None) -> None:
     audit = audit_summary(counters) if counters else None
     if audit:
         print("== jaxpr audit (audit.*) ==", file=out)
+        tier_c = audit.get("tier_c")
         for entry, info in audit.items():
+            if entry == "tier_c":
+                continue
             flag = ("ACCOUNTING DRIFT — census exceeds counters; see "
                     "the static_audit gate" if info["drift"] else "ok")
             print(f"  {entry}: {flag}", file=out)
@@ -552,6 +588,14 @@ def print_report(summary: dict, out=None) -> None:
                     mark = "  (custom_vjp re-trace overcount)"
                 print(f"    {kind:<14} census {r['census']:g}  counted "
                       f"{r['counted']:g}{mark}", file=out)
+        if tier_c:
+            flag = ("ok" if tier_c["clean"] else
+                    "FAILED — see the concurrency_audit gate")
+            s = tier_c["stress"]
+            print(f"  tier C (concurrency stress): {flag}", file=out)
+            print("    "
+                  + "  ".join(f"{k} {v:g}" for k, v in s.items()),
+                  file=out)
     serving = serving_summary(summary)
     if serving:
         print("== paged serving (serving.blocks_*) ==", file=out)
