@@ -96,6 +96,10 @@ ENV_REGISTRY: Dict[str, EnvVar] = dict([
     _v("APEX_TPU_GROUPED_MATMUL", "apex_tpu.ops.grouped_matmul",
        "docs/parallelism.md",
        "grouped (ragged expert) matmul routing (kernel|reference|auto)"),
+    _v("APEX_TPU_QUANT_MATMUL", "apex_tpu.ops.dense",
+       "docs/inference.md",
+       "weight-only int8 dense/grouped matmul routing "
+       "(kernel|reference|auto)"),
     # ---- training / parallel knobs -----------------------------------
     _v("APEX_TPU_ALLOW_FP16", "apex_tpu.amp.policy",
        "docs/amp.md", "permit raw fp16 on TPU (default maps to bf16)"),

@@ -34,6 +34,10 @@ from apex_tpu.models.generate import (  # noqa: F401
     prefill,
     sample_logits,
 )
+from apex_tpu.models.quantized import (  # noqa: F401
+    dequantize_params,
+    quantize_params,
+)
 from apex_tpu.models.gpt import (  # noqa: F401
     gpt_pipeline_loss_and_grads,
     make_gpt_pipeline_stage,

@@ -67,7 +67,8 @@ DEFAULT_BLOCK_SIZE = 16
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
                   cache_dtype=None, *, cache_layout: str = "contiguous",
-                  block_size: int = DEFAULT_BLOCK_SIZE):
+                  block_size: int = DEFAULT_BLOCK_SIZE,
+                  cache_wire=None):
     """KV cache for ``batch`` sequences of up to ``max_len`` tokens.
 
     ``cache_layout="contiguous"`` (default): ``[L, b, max_len,
@@ -96,11 +97,22 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
     ``pos`` is per-sequence: sequence ``i``'s next token lands at
     ``pos[i]`` and its attention sees ``t <= pos[i]``, which is what
     lets ragged prompts share one batch.
+
+    ``cache_wire="int8"`` (ISSUE 14, paged layout only) stores the
+    pool at rest as block-scaled int8 — K/V quantize per (token, kv
+    group) at every write edge and the paged-attention kernel
+    dequantizes in-VMEM; the dict carries the parallel
+    ``k_scale``/``v_scale`` pools.  ~0.53x a bf16 pool's resident
+    bytes (``1 + 4/dh`` bytes/element).
     """
     dt = cfg.compute_dtype if cache_dtype is None else cache_dtype
     nh = cfg.kv_groups
     dh = cfg.kv_channels
     if cache_layout == "contiguous":
+        if cache_wire not in (None, "native"):
+            raise ValueError(
+                f"cache_wire={cache_wire!r} is a paged-pool form; the "
+                "contiguous stripe layout stores the cache dtype only")
         shape = (cfg.num_layers, batch, max_len, nh, dh)
         return {
             "k": jnp.zeros(shape, dt),
@@ -115,7 +127,8 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
 
     mb = blocks_for(max_len, block_size)
     pool = init_paged_pool(cfg, batch * mb, block_size,
-                           cache_dtype=cache_dtype)
+                           cache_dtype=cache_dtype,
+                           cache_wire=cache_wire)
     tables = (jnp.arange(batch, dtype=jnp.int32)[:, None] * mb
               + jnp.arange(mb, dtype=jnp.int32)[None])
     pool["pos"] = jnp.zeros((batch,), jnp.int32)
@@ -142,7 +155,7 @@ def extract_kv(cache: dict, length: int, *, row: int = 0):
         raise ValueError(f"length={length} must be >= 1")
     if "block_tables" in cache:
         from apex_tpu.serving.paged_cache import (
-            blocks_for, gather_block_kv)
+            blocks_for, dequantize_kv, gather_block_kv)
 
         bs = cache["k"].shape[2]
         tables = cache["block_tables"]
@@ -163,6 +176,19 @@ def extract_kv(cache: dict, length: int, *, row: int = 0):
                 f"row {row} (sentinel >= {nb}); it exceeds the row's "
                 "materialized tokens")
         k, v = gather_block_kv(cache["k"], cache["v"], ids)
+        if "k_scale" in cache:
+            # int8 pool: the handoff contract ships FLOAT per-token K/V
+            # (the wire layer owns its own quantization); dequantize
+            # through the gathered scales — fp32, since the at-rest
+            # quantization already spent the precision budget
+            idj = jnp.asarray(ids, jnp.int32)
+            L, g = cache["k"].shape[0], cache["k"].shape[3]
+            sk = jnp.take(cache["k_scale"], idj, axis=1).reshape(
+                L, need * bs, g)
+            sv = jnp.take(cache["v_scale"], idj, axis=1).reshape(
+                L, need * bs, g)
+            k = dequantize_kv(k, sk)
+            v = dequantize_kv(v, sv)
         return k[:, :length], v[:, :length]
     if length > cache["k"].shape[2]:
         raise ValueError(
@@ -212,6 +238,19 @@ def inject_kv(cache: dict, k, v, *, row: int = 0) -> dict:
         blk = tables[row, jnp.minimum(t // bs, mb - 1)]
         blk = jnp.where(t < mb * bs, blk, nb)
         off = t % bs
+        if "k_scale" in cache:
+            # int8 pool: quantize the float handoff at the write edge
+            # (the shared scatter keeps wire + scale cells paired)
+            from apex_tpu.serving.paged_cache import scatter_kv_quantized
+
+            ck, cv, sk, sv = scatter_kv_quantized(
+                cache["k"], cache["v"], cache["k_scale"],
+                cache["v_scale"], k, v, (slice(None), blk, off))
+            return {
+                "k": ck, "v": cv, "k_scale": sk, "v_scale": sv,
+                "pos": cache["pos"].at[row].set(n),
+                "block_tables": cache["block_tables"],
+            }
         return {
             "k": cache["k"].at[:, blk, off].set(
                 k.astype(cache["k"].dtype), mode="drop"),
@@ -279,11 +318,17 @@ def _decode_qkv(cfg, lp, x, pos, rope):
     verify block): the contiguous and paged layer bodies differ only in
     where K/V land and how the cache is read, so this is ONE
     implementation of everything before that fork."""
+    from apex_tpu.ops.dense import quantized_matmul
+
     b, s = x.shape[0], x.shape[1]
     nh = cfg.num_attention_heads
     dh = cfg.kv_channels
     h = apply_norm(cfg, x, lp["ln1_scale"], lp["ln1_bias"])
-    qkv = h @ lp["qkv_kernel"].astype(x.dtype) + lp["qkv_bias"].astype(
+    # quantized_matmul: the plain array path is byte-identical to the
+    # historical `h @ kernel.astype(...)`; an int8 weight-slab leaf
+    # (models/quantized.quantize_params, ISSUE 14) runs the in-kernel
+    # dequantizing matmul so decode reads int8 weight bytes
+    qkv = quantized_matmul(h, lp["qkv_kernel"]) + lp["qkv_bias"].astype(
         x.dtype)
     if cfg.is_gqa:
         from apex_tpu.models.transformer_lm import split_qkv_gqa
@@ -303,7 +348,9 @@ def _decode_qkv(cfg, lp, x, pos, rope):
 def _decode_out(cfg, lp, x, h, ctx_flat):
     """Shared post-attention math (output projection → residual →
     MLP); ``ctx_flat`` [b, s, nh*dh] (s=1 decode, s=k+1 verify)."""
-    a = ctx_flat @ lp["proj_kernel"].astype(x.dtype)
+    from apex_tpu.ops.dense import quantized_matmul
+
+    a = quantized_matmul(ctx_flat, lp["proj_kernel"])
     a = a + lp["proj_bias"].astype(x.dtype)
     res = h if cfg.apply_residual_connection_post_layernorm else x
     x = res + a
@@ -352,13 +399,19 @@ def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
     return x, cache_k, cache_v
 
 
-def _layer_decode_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope):
+def _layer_decode_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope,
+                        k_scale=None, v_scale=None):
     """One layer, one token, paged layout: x [b, 1, h] + this layer's
     block pool [num_blocks, block_size, g, dh] + ``tables``
     [b, max_blocks].  The new K/V append to each sequence's tail block
     (one-cell scatter through the table); attention runs the fused
     ragged-paged kernel over the block list — the gathered cache never
-    materializes."""
+    materializes.
+
+    int8 pool (``k_scale``/``v_scale`` given, ISSUE 14): the append
+    quantizes the fresh token per (sequence, group) and scatters wire +
+    scale through the same table cell; the attention kernel dequantizes
+    in-VMEM (scales ride the table-dereferenced DMA)."""
     from apex_tpu.ops.paged_attention import ragged_paged_attention
 
     b = x.shape[0]
@@ -376,16 +429,24 @@ def _layer_decode_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope):
         tables, jnp.minimum(pos // bs, mb - 1)[:, None], axis=1)[:, 0]
     blk = jnp.where(pos < mb * bs, blk, nb)
     off = pos % bs
-    cache_k = cache_k.at[blk, off].set(
-        k[:, 0].astype(cache_k.dtype), mode="drop")
-    cache_v = cache_v.at[blk, off].set(
-        v[:, 0].astype(cache_v.dtype), mode="drop")
+    if k_scale is not None:
+        from apex_tpu.serving.paged_cache import scatter_kv_quantized
+
+        cache_k, cache_v, k_scale, v_scale = scatter_kv_quantized(
+            cache_k, cache_v, k_scale, v_scale, k[:, 0], v[:, 0],
+            (blk, off))
+    else:
+        cache_k = cache_k.at[blk, off].set(
+            k[:, 0].astype(cache_k.dtype), mode="drop")
+        cache_v = cache_v.at[blk, off].set(
+            v[:, 0].astype(cache_v.dtype), mode="drop")
 
     ctx = ragged_paged_attention(q[:, 0], cache_k, cache_v, tables,
-                                 pos + 1)
+                                 pos + 1, k_scale=k_scale,
+                                 v_scale=v_scale)
     x = _decode_out(cfg, lp, x, h,
                     ctx.astype(x.dtype).reshape(b, 1, nh * dh))
-    return x, cache_k, cache_v
+    return x, cache_k, cache_v, k_scale, v_scale
 
 
 def decode_step(params: dict, token: jax.Array, cache: dict,
@@ -416,22 +477,39 @@ def decode_step(params: dict, token: jax.Array, cache: dict,
 
     # one compiled layer body scanned over the stacked layer params
     # (transformer_backbone's shape — compile time constant in depth)
-    if paged:
+    quant = "k_scale" in cache
+    new_scales = None
+    if paged and quant:
+        tables = cache["block_tables"].astype(jnp.int32)
+
+        def body(x, layer_in):
+            lp, ck, cv, sk, sv = layer_in
+            x, ck, cv, sk, sv = _layer_decode_paged(
+                cfg, lp, x, ck, cv, tables, pos, rope, sk, sv)
+            return x, (ck, cv, sk, sv)
+
+        x, (new_k, new_v, *new_scales) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"],
+                      cache["k_scale"], cache["v_scale"]))
+    elif paged:
         tables = cache["block_tables"].astype(jnp.int32)
 
         def body(x, layer_in):
             lp, ck, cv = layer_in
-            x, ck, cv = _layer_decode_paged(cfg, lp, x, ck, cv, tables,
-                                            pos, rope)
+            x, ck, cv, _sk, _sv = _layer_decode_paged(
+                cfg, lp, x, ck, cv, tables, pos, rope)
             return x, (ck, cv)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
     else:
         def body(x, layer_in):
             lp, ck, cv = layer_in
             x, ck, cv = _layer_decode(cfg, lp, x, ck, cv, pos, rope)
             return x, (ck, cv)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
 
     x = apply_norm(cfg, x, params["final_ln"]["scale"],
                    params["final_ln"]["bias"])
@@ -439,6 +517,8 @@ def decode_step(params: dict, token: jax.Array, cache: dict,
         "bsh,vh->bsv", x, lm_head_weight(params, cfg).astype(cd),
         preferred_element_type=jnp.float32)[:, 0]
     cache = {"k": new_k, "v": new_v, "pos": pos + 1}
+    if new_scales is not None:
+        cache["k_scale"], cache["v_scale"] = new_scales
     if paged:
         cache["block_tables"] = tables
     return logits, cache
@@ -486,14 +566,20 @@ def _layer_verify(cfg, lp, x, cache_k, cache_v, pos, rope):
     return x, cache_k, cache_v
 
 
-def _layer_verify_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope):
+def _layer_verify_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope,
+                        k_scale=None, v_scale=None):
     """One layer, ``m`` appended tokens, paged layout: the new K/V
     scatter through the block tables (cells ``(tables[i, p//bs],
     p % bs)``, unmapped entries drop), then attention runs over the
     gathered block view.  Unlike the sq=1 decode step this
     materializes the gather — a verification block amortizes the one
     gather over its m tokens, which is exactly the batched-prefill
-    economics speculative decoding exists to exploit."""
+    economics speculative decoding exists to exploit.  int8 pool: the
+    drafted K/V quantize at the write edge and the gathered view
+    dequantizes through the gathered scales; rejected drafts roll back
+    by the caller's pos decrement exactly as in the native pool (their
+    wire cells and scale cells are overwritten together by the next
+    append)."""
     b, m = x.shape[0], x.shape[1]
     h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope)
     nb, bs = cache_k.shape[0], cache_k.shape[1]
@@ -503,17 +589,28 @@ def _layer_verify_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope):
         tables, jnp.clip(wpos // bs, 0, mb - 1), axis=1)
     blk = jnp.where(wpos < mb * bs, blk, nb)
     off = wpos % bs
-    cache_k = cache_k.at[blk, off].set(
-        k.astype(cache_k.dtype), mode="drop")
-    cache_v = cache_v.at[blk, off].set(
-        v.astype(cache_v.dtype), mode="drop")
+    if k_scale is not None:
+        from apex_tpu.serving.paged_cache import scatter_kv_quantized
+
+        cache_k, cache_v, k_scale, v_scale = scatter_kv_quantized(
+            cache_k, cache_v, k_scale, v_scale, k, v, (blk, off))
+    else:
+        cache_k = cache_k.at[blk, off].set(
+            k.astype(cache_k.dtype), mode="drop")
+        cache_v = cache_v.at[blk, off].set(
+            v.astype(cache_v.dtype), mode="drop")
     tbl = jnp.minimum(tables, nb - 1)
     kk = cache_k[tbl].reshape(b, mb * bs, cache_k.shape[2],
                               cache_k.shape[3])
     vv = cache_v[tbl].reshape(b, mb * bs, cache_v.shape[2],
                               cache_v.shape[3])
+    if k_scale is not None:
+        from apex_tpu.serving.paged_cache import dequantize_kv
+
+        kk = dequantize_kv(kk, k_scale[tbl].reshape(b, mb * bs, -1))
+        vv = dequantize_kv(vv, v_scale[tbl].reshape(b, mb * bs, -1))
     x = _verify_attention(cfg, x, h, lp, q, kk, vv, pos)
-    return x, cache_k, cache_v
+    return x, cache_k, cache_v, k_scale, v_scale
 
 
 def decode_verify(params: dict, tokens: jax.Array, cache: dict,
@@ -556,28 +653,47 @@ def decode_verify(params: dict, tokens: jax.Array, cache: dict,
             max_pos = cache["k"].shape[2]
         rope = rope_cos_sin(max_pos, cfg.kv_channels)
 
-    if paged:
+    quant = "k_scale" in cache
+    new_scales = None
+    if paged and quant:
+        tables = cache["block_tables"].astype(jnp.int32)
+
+        def body(x, layer_in):
+            lp, ck, cv, sk, sv = layer_in
+            x, ck, cv, sk, sv = _layer_verify_paged(
+                cfg, lp, x, ck, cv, tables, pos, rope, sk, sv)
+            return x, (ck, cv, sk, sv)
+
+        x, (new_k, new_v, *new_scales) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"],
+                      cache["k_scale"], cache["v_scale"]))
+    elif paged:
         tables = cache["block_tables"].astype(jnp.int32)
 
         def body(x, layer_in):
             lp, ck, cv = layer_in
-            x, ck, cv = _layer_verify_paged(cfg, lp, x, ck, cv, tables,
-                                            pos, rope)
+            x, ck, cv, _sk, _sv = _layer_verify_paged(
+                cfg, lp, x, ck, cv, tables, pos, rope)
             return x, (ck, cv)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
     else:
         def body(x, layer_in):
             lp, ck, cv = layer_in
             x, ck, cv = _layer_verify(cfg, lp, x, ck, cv, pos, rope)
             return x, (ck, cv)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
     x = apply_norm(cfg, x, params["final_ln"]["scale"],
                    params["final_ln"]["bias"])
     logits = jnp.einsum(
         "bsh,vh->bsv", x, lm_head_weight(params, cfg).astype(cd),
         preferred_element_type=jnp.float32)
     cache = {"k": new_k, "v": new_v, "pos": pos + m}
+    if new_scales is not None:
+        cache["k_scale"], cache["v_scale"] = new_scales
     if paged:
         cache["block_tables"] = tables
     return logits, cache
@@ -669,8 +785,14 @@ def prefill(
     if cfg.position_embedding_type == "rope":
         rope = rope_cos_sin(s, cfg.kv_channels)
 
+    quant = "k_scale" in cache
+
     def body(x, lp):
         x, k, v = _layer_prefill(cfg, lp, x, kpm, rope)
+        if quant:
+            # int8 pool: keep the float K/V through the scan and
+            # quantize once at the scatter edge below
+            return x, (k, v)
         return x, (k.astype(cache["k"].dtype), v.astype(cache["v"].dtype))
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
@@ -698,6 +820,21 @@ def prefill(
                 jnp.minimum(t // bs, mb - 1)[None], (b, s)), axis=1)
         blk = jnp.where(t[None] < lens[:, None], blk, nb)
         off = jnp.broadcast_to(t % bs, (b, s))
+        if quant:
+            # quantize the whole prompt's K/V per (token, group); the
+            # shared scatter keeps wire + scale cells paired (padding
+            # and unmapped pages drop both together)
+            from apex_tpu.serving.paged_cache import scatter_kv_quantized
+
+            ck, cv, sk, sv = scatter_kv_quantized(
+                cache["k"], cache["v"], cache["k_scale"],
+                cache["v_scale"], ks, vs, (slice(None), blk, off))
+            cache = {
+                "k": ck, "v": cv, "k_scale": sk, "v_scale": sv,
+                "pos": lens,
+                "block_tables": tables,
+            }
+            return logits, cache
         cache = {
             "k": cache["k"].at[:, blk, off].set(ks, mode="drop"),
             "v": cache["v"].at[:, blk, off].set(vs, mode="drop"),
@@ -748,17 +885,17 @@ def sample_logits(logits, key, *, temperature: float = 0.0,
 @functools.partial(jax.jit, static_argnames=(
     "cfg", "max_new_tokens", "temperature", "top_k", "top_p",
     "vocab_limit", "eos_token_id", "cache_dtype", "cache_layout",
-    "block_size"))
+    "block_size", "cache_wire"))
 def _generate_impl(params, prompt, prompt_lens, rng, *, cfg,
                    max_new_tokens, temperature, top_k, top_p,
                    vocab_limit, eos_token_id, cache_dtype,
-                   cache_layout, block_size):
+                   cache_layout, block_size, cache_wire=None):
     """Prefill + while-loop decode; returns (tokens, realized steps)."""
     b, s = prompt.shape
     total = s + max_new_tokens
     cache = init_kv_cache(cfg, b, total, cache_dtype=cache_dtype,
                           cache_layout=cache_layout,
-                          block_size=block_size)
+                          block_size=block_size, cache_wire=cache_wire)
     lens = (jnp.full((b,), s, jnp.int32) if prompt_lens is None
             else prompt_lens.astype(jnp.int32))
     logits, cache = prefill(params, prompt, cfg,
@@ -829,10 +966,20 @@ def generate(
     cache_dtype=None,
     cache_layout: str = "contiguous",
     block_size: int = DEFAULT_BLOCK_SIZE,
+    cache_wire=None,
     spec=None,
 ) -> jax.Array:
     """Decode up to ``max_new_tokens`` past ``prompt`` [b, s] →
     [b, s+max_new_tokens].
+
+    ``cache_wire="int8"`` (paged layout only, ISSUE 14) stores the
+    block pool at rest as block-scaled int8 — halving-plus the
+    resident cache bytes, with K/V quantized at every write and
+    dequantized inside the paged-attention kernel.  Greedy output is
+    deterministic but MAY diverge from the native-pool trajectory
+    (each decoded token's hidden state reads slightly-lossy K/V);
+    docs/inference.md "Quantized serving" has the accuracy story and
+    the spec-decode accept-rate gate that bounds it.
 
     ``spec`` enables speculative decoding (``"ngram"`` for n-gram
     self-drafting with the default knobs, a ``models.speculative.
@@ -907,7 +1054,7 @@ def generate(
             top_k=top_k, top_p=top_p, rng=rng, vocab_limit=vocab_limit,
             prompt_lens=prompt_lens, eos_token_id=eos_token_id,
             cache_dtype=cache_dtype, cache_layout=cache_layout,
-            block_size=block_size)
+            block_size=block_size, cache_wire=cache_wire)
         if _telemetry.enabled():
             _telemetry.counter("generate.prefill_calls").inc()
             _telemetry.counter("generate.spec.draft_tokens").inc(
@@ -922,7 +1069,8 @@ def generate(
         max_new_tokens=max_new_tokens, temperature=temperature,
         top_k=top_k, top_p=top_p, vocab_limit=vocab_limit,
         eos_token_id=eos_token_id, cache_dtype=cache_dtype,
-        cache_layout=cache_layout, block_size=block_size)
+        cache_layout=cache_layout, block_size=block_size,
+        cache_wire=cache_wire)
     if _telemetry.enabled():
         # host-side counters (the jitted loop cannot emit); reading the
         # realized trip count syncs — acceptable when telemetry is on

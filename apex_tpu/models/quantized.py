@@ -1,0 +1,137 @@
+"""Weight-only quantized serving params: the one-shot conversion.
+
+Decode is HBM-bandwidth-bound — every generated token re-reads the
+whole weight set, so resident weight bytes set tokens/s (ISSUE 14,
+ROADMAP item 3).  :func:`quantize_params` converts a trained/imported
+GPT parameter tree into the int8 weight-slab form the serving stack
+consumes: every per-layer matmul kernel (``qkv_kernel``,
+``proj_kernel``, ``fc1_kernel``, ``fc2_kernel``, and — MoE configs —
+the ``moe_fc1``/``moe_fc2`` expert slabs) becomes a ``{"wire": int8,
+"scale": fp32}`` dict with per-(contraction-block, output-column)
+scales (:func:`~apex_tpu.ops.dense.quantize_weight` /
+:func:`~apex_tpu.ops.grouped_matmul.quantize_group_weights`).  The
+model code branches on :func:`~apex_tpu.ops.dense.is_quantized` at
+each matmul site and runs the in-kernel dequantizing matmul, so the
+HBM weight read per decode step drops to the int8 bytes
+(~1/4 of fp32, ~1/2 of bf16) — compounding with the int8 KV pool.
+
+What stays high-precision, on purpose:
+
+- **embedding / LM head** — the embedding is a gather (no bandwidth
+  win from int8 without a fused dequantizing gather) and the tied head
+  shares its table; the head matmul runs once per token against
+  activations that just left a norm — keep it exact;
+- **biases, norms, rope** — O(h) parameters, noise in the byte budget;
+- **everything under training** — the quantized tree is a SERVING
+  artifact: gradients through :func:`~apex_tpu.ops.dense.
+  dense_quantized` flow to activations only (wire/scales frozen), and
+  the manual-TP training contexts reject quantized leaves loudly.
+
+:func:`dequantize_params` is the fake-quant oracle: a float tree whose
+kernels equal the dequantized slabs exactly, so
+``generate(quantize_params(p)) == generate(dequantize_params(
+quantize_params(p)))`` greedy token-for-token — the pin that separates
+"the int8 path computes what it claims" from "int8 changed the model"
+(tests/test_quantized_matmul.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.dense import (
+    QUANT_BLOCK, dequantize_weight, is_quantized, quantize_weight)
+from apex_tpu.ops.grouped_matmul import quantize_group_weights
+
+__all__ = ["dequantize_params", "is_quantized_tree", "param_bytes",
+           "quantize_params"]
+
+# per-layer kernels quantized through the dense path ([in, *out],
+# contraction axis first)
+_DENSE_KERNELS = ("qkv_kernel", "proj_kernel", "fc1_kernel",
+                  "fc2_kernel")
+# expert slabs quantized through the grouped path ([G, k, p])
+_GROUPED_KERNELS = ("moe_fc1", "moe_fc2")
+
+
+def _q_dense_stacked(w, block):
+    """Quantize a stacked per-layer kernel ``[L, in, *out]`` (vmapped
+    over the layer axis; the block is picked ONCE from the shared
+    in-dim so every layer's scale grid lines up)."""
+    return jax.vmap(lambda wl: quantize_weight(wl, block))(w)
+
+
+def _q_grouped_stacked(w, block):
+    """Quantize a stacked expert slab ``[L, G, k, p]``."""
+    return jax.vmap(lambda wl: quantize_group_weights(wl, block))(w)
+
+
+def quantize_params(params: dict, *,
+                    block: Optional[int] = None) -> dict:
+    """One-shot serving conversion: return a new parameter tree whose
+    per-layer matmul kernels are int8 weight slabs (module docstring
+    has the scope).  ``block`` bounds the contraction-axis scale block
+    (default 128, clamped to a divisor of each kernel's in-dim).  The
+    input tree is not modified; unquantized leaves are shared, not
+    copied.  Idempotent-hostile by design: quantizing an
+    already-quantized tree raises (re-quantizing dequantized weights
+    would silently stack error)."""
+    block = int(block or QUANT_BLOCK)
+    layers = dict(params["layers"])
+    for name in _DENSE_KERNELS:
+        w = layers.get(name)
+        if w is None:
+            continue
+        if is_quantized(w):
+            raise ValueError(
+                f"params['layers'][{name!r}] is already quantized — "
+                "quantize_params expects a float tree")
+        layers[name] = _q_dense_stacked(jnp.asarray(w), block)
+    for name in _GROUPED_KERNELS:
+        w = layers.get(name)
+        if w is None:
+            continue
+        if is_quantized(w):
+            raise ValueError(
+                f"params['layers'][{name!r}] is already quantized — "
+                "quantize_params expects a float tree")
+        layers[name] = _q_grouped_stacked(jnp.asarray(w), block)
+    return dict(params, layers=layers)
+
+
+def dequantize_params(params: dict) -> dict:
+    """The fake-quant oracle: replace every quantized slab with its
+    fp32-dequantized float kernel.  ``generate`` over this tree is
+    greedy token-identical to the quantized tree (the quantized matmul
+    computes exactly ``x @ dequantize(w)`` up to fp32 summation
+    order)."""
+    layers = dict(params["layers"])
+    for name, leaf in list(layers.items()):
+        if not is_quantized(leaf):
+            continue
+        wire, scale = leaf["wire"], leaf["scale"]
+        if name in _GROUPED_KERNELS:
+            # stacked [L, G, k, p] expert slab: per-layer grouped form
+            from apex_tpu.ops.grouped_matmul import _dequantize_group
+
+            layers[name] = jax.vmap(_dequantize_group)(wire, scale)
+        else:
+            # stacked [L, in, *out] dense kernel (swiglu fc1 included)
+            layers[name] = jax.vmap(dequantize_weight)(wire, scale)
+    return dict(params, layers=layers)
+
+
+def is_quantized_tree(params: dict) -> bool:
+    """True when any layer kernel carries the int8 slab form."""
+    return any(is_quantized(leaf)
+               for leaf in params.get("layers", {}).values())
+
+
+def param_bytes(params: dict) -> int:
+    """Resident bytes of a parameter tree (quantized dicts count wire
+    + scales) — the number the bench weight-bytes ratio reports."""
+    return sum(x.size * jnp.dtype(x.dtype).itemsize
+               for x in jax.tree_util.tree_leaves(params))

@@ -277,11 +277,11 @@ def spec_round(params, cfg, cache, nxt, tokens, lens, key, *, spec,
 @functools.partial(jax.jit, static_argnames=(
     "cfg", "spec", "max_new_tokens", "temperature", "top_k", "top_p",
     "vocab_limit", "eos_token_id", "cache_dtype", "cache_layout",
-    "block_size"))
+    "block_size", "cache_wire"))
 def _spec_generate_impl(params, prompt, prompt_lens, rng, *, cfg, spec,
                         max_new_tokens, temperature, top_k, top_p,
                         vocab_limit, eos_token_id, cache_dtype,
-                        cache_layout, block_size):
+                        cache_layout, block_size, cache_wire=None):
     """Prefill + while-loop of spec rounds; returns (tokens [b,
     s+max_new], stats [3] = draft/accepted/verify counters)."""
     b, s = prompt.shape
@@ -291,7 +291,7 @@ def _spec_generate_impl(params, prompt, prompt_lens, rng, *, cfg, spec,
     # tail is rolled back — those cells must exist in both layouts
     cache = init_kv_cache(cfg, b, total + k + 1, cache_dtype=cache_dtype,
                           cache_layout=cache_layout,
-                          block_size=block_size)
+                          block_size=block_size, cache_wire=cache_wire)
     lens = (jnp.full((b,), s, jnp.int32) if prompt_lens is None
             else prompt_lens.astype(jnp.int32))
     logits, cache = prefill(params, prompt, cfg,
@@ -394,6 +394,7 @@ def spec_generate(
     cache_dtype=None,
     cache_layout: str = "contiguous",
     block_size: int = 16,
+    cache_wire=None,
 ):
     """Speculative decoding past ``prompt`` [b, s] → (tokens
     [b, s+max_new_tokens], stats dict).
@@ -435,7 +436,8 @@ def spec_generate(
         max_new_tokens=max_new_tokens, temperature=temperature,
         top_k=top_k, top_p=top_p, vocab_limit=vocab_limit,
         eos_token_id=eos_token_id, cache_dtype=cache_dtype,
-        cache_layout=cache_layout, block_size=block_size)
+        cache_layout=cache_layout, block_size=block_size,
+        cache_wire=cache_wire)
     stats = {
         "draft_tokens": int(stats[0]),
         "accepted_tokens": int(stats[1]),

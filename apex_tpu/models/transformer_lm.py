@@ -47,6 +47,7 @@ from apex_tpu.ops import (
     scaled_upper_triang_masked_softmax,
     softmax_cross_entropy_loss,
 )
+from apex_tpu.ops.dense import is_quantized as _is_quantized
 from apex_tpu.ops.swiglu import fused_bias_swiglu_paired
 from apex_tpu.transformer.tensor_parallel.mappings import (
     copy_to_tensor_model_parallel_region,
@@ -631,8 +632,21 @@ def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
     b, s, _ = x.shape
 
     xi = ctx.copy_in(x)
-    qkv = xi @ lp["qkv_kernel"].astype(x.dtype) + lp["qkv_bias"].astype(
-        x.dtype)
+    wq = lp["qkv_kernel"]
+    if _is_quantized(wq):
+        # weight-only int8 serving path (ISSUE 14): single-device by
+        # contract — quantize_params is a serving conversion, manual-TP
+        # training never sees quantized leaves
+        if ctx.tp > 1:
+            raise ValueError(
+                "quantized kernels (models/quantized.quantize_params) "
+                "are a single-device serving path; they cannot shard "
+                f"over the manual tp={ctx.tp} context")
+        from apex_tpu.ops.dense import quantized_matmul
+
+        qkv = quantized_matmul(xi, wq) + lp["qkv_bias"].astype(x.dtype)
+    else:
+        qkv = xi @ wq.astype(x.dtype) + lp["qkv_bias"].astype(x.dtype)
     qkv = ctx.constrain_col(qkv)
     if cfg.is_gqa:
         # group-major layout (per group [q x rep | k | v]): a contiguous
@@ -672,7 +686,13 @@ def _attention(cfg: TransformerConfig, lp: dict, x, ctx: TPContext,
         ctxv = _core_attention(cfg, q, k, v, attention_mask, dropout_rng,
                                ctx)
     ctxv = ctxv.reshape(b, s, -1)
-    out = _row_parallel_out(ctx, ctxv, lp["proj_kernel"].astype(x.dtype))
+    wp = lp["proj_kernel"]
+    if _is_quantized(wp):
+        from apex_tpu.ops.dense import quantized_matmul
+
+        out = ctx.reduce_out(quantized_matmul(ctxv, wp))
+    else:
+        out = _row_parallel_out(ctx, ctxv, wp.astype(x.dtype))
     out = out + lp["proj_bias"].astype(x.dtype)
     return (out, k, v) if return_kv else out
 
@@ -719,17 +739,33 @@ def _moe_mlp(cfg: TransformerConfig, lp: dict, x):
 
 def _mlp(cfg: TransformerConfig, lp: dict, x, ctx: TPContext):
     """ParallelMLP (reference :165): column-parallel fc1 + fused bias-act,
-    row-parallel fc2 (fused bias_swiglu / bias+gelu epilogues)."""
+    row-parallel fc2 (fused bias_swiglu / bias+gelu epilogues).
+
+    Quantized fc kernels (ISSUE 14, ``_is_quantized`` dict leaves from
+    ``models/quantized.quantize_params``) run the int8 weight-slab
+    matmul instead — single-device serving path; the 3-D swiglu paired
+    kernel's trailing axes flatten inside ``dense_quantized`` so the
+    ``[b, s, 2, f]`` layout is unchanged."""
     xi = ctx.copy_in(x)
+    w1 = lp["fc1_kernel"]
     if cfg.activation == "swiglu":
-        # paired [h, 2, f] kernel: each tp shard of the f dim is a
-        # (gate, up) pair, matching the single-device layout exactly
-        y = jnp.einsum("bsh,hcf->bscf", xi, lp["fc1_kernel"].astype(x.dtype))
+        if _is_quantized(w1):
+            from apex_tpu.ops.dense import quantized_matmul
+
+            y = quantized_matmul(xi, w1)          # [b, s, 2, f]
+        else:
+            # paired [h, 2, f] kernel: each tp shard of the f dim is a
+            # (gate, up) pair, matching the single-device layout exactly
+            y = jnp.einsum("bsh,hcf->bscf", xi, w1.astype(x.dtype))
         y = ctx.constrain_col(y)
         y = fused_bias_swiglu_paired(y, lp["fc1_bias"].astype(x.dtype))
     else:
-        y = xi @ lp["fc1_kernel"].astype(x.dtype) + lp["fc1_bias"].astype(
-            x.dtype)
+        if _is_quantized(w1):
+            from apex_tpu.ops.dense import quantized_matmul
+
+            y = quantized_matmul(xi, w1) + lp["fc1_bias"].astype(x.dtype)
+        else:
+            y = xi @ w1.astype(x.dtype) + lp["fc1_bias"].astype(x.dtype)
         y = ctx.constrain_col(y)
         # 'gelu_tanh' = the tanh approximation (HF gpt2's gelu_new) —
         # needed for bit-comparable imports of reference-ecosystem
@@ -737,7 +773,13 @@ def _mlp(cfg: TransformerConfig, lp: dict, x, ctx: TPContext):
         y = jax.nn.gelu(
             y.astype(jnp.float32),
             approximate=cfg.activation == "gelu_tanh").astype(x.dtype)
-    out = _row_parallel_out(ctx, y, lp["fc2_kernel"].astype(x.dtype))
+    w2 = lp["fc2_kernel"]
+    if _is_quantized(w2):
+        from apex_tpu.ops.dense import quantized_matmul
+
+        out = ctx.reduce_out(quantized_matmul(y, w2))
+    else:
+        out = _row_parallel_out(ctx, y, w2.astype(x.dtype))
     return out + lp["fc2_bias"].astype(x.dtype)
 
 

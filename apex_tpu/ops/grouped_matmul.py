@@ -50,7 +50,9 @@ from jax.experimental import pallas as pl
 from apex_tpu.ops._pallas_utils import out_struct
 from apex_tpu.utils.registry import on_tpu
 
-__all__ = ["grouped_matmul", "grouped_matmul_reference", "group_ids"]
+__all__ = ["grouped_matmul", "grouped_matmul_quantized",
+           "grouped_matmul_reference", "group_ids",
+           "quantize_group_weights"]
 
 
 def group_ids(offsets: jax.Array, n_rows: int, n_groups: int) -> jax.Array:
@@ -108,15 +110,25 @@ def grouped_matmul_reference(x: jax.Array, w: jax.Array,
 _BLOCK_ROWS = 128
 
 
-def _gmm_kernel(bm, n_rows, *refs):
+def _gmm_kernel(bm, n_rows, quant, *refs):
     """One grid step = one (row-block, group) intersection.  Consecutive
     steps share a row block (the f32 accumulator stays VMEM-resident);
     the first visit of a block overwrites, later visits add.  Rows
     outside the step's group span are zeroed *on the input side*, so a
     block straddling two groups gets each row exactly its own expert's
-    product."""
-    (blk_ref, grp_ref, fst_ref, off_ref, nst_ref,
-     x_ref, w_ref, out_ref, acc) = refs
+    product.
+
+    ``quant`` (ISSUE 14): the expert slab is pre-quantized int8 and an
+    extra ref carries its per-(k-block, column) scales (dereferenced by
+    the same group index map) — the slab dequantizes in VMEM right
+    before the dot, so the HBM read of the weights is the int8 bytes."""
+    if quant:
+        (blk_ref, grp_ref, fst_ref, off_ref, nst_ref,
+         x_ref, w_ref, s_ref, out_ref, acc) = refs
+    else:
+        (blk_ref, grp_ref, fst_ref, off_ref, nst_ref,
+         x_ref, w_ref, out_ref, acc) = refs
+        s_ref = None
     s = pl.program_id(0)
     g = grp_ref[s]
     start = off_ref[g]
@@ -128,8 +140,13 @@ def _gmm_kernel(bm, n_rows, *refs):
     live = (rows >= start) & (rows < end) & (rows < n_rows) \
         & (s < nst_ref[0])
     xm = jnp.where(live, x_ref[:].astype(jnp.float32), 0.0)
-    part = jax.lax.dot(xm, w_ref[0].astype(jnp.float32),
-                       preferred_element_type=jnp.float32)
+    w = w_ref[0].astype(jnp.float32)
+    if quant:
+        k, p = w.shape
+        nkb = s_ref.shape[1]
+        w = (w.reshape(nkb, k // nkb, p)
+             * s_ref[0][:, None, :]).reshape(k, p)
+    part = jax.lax.dot(xm, w, preferred_element_type=jnp.float32)
 
     @pl.when(fst_ref[s] == 1)
     def _init():
@@ -176,7 +193,7 @@ def _step_metadata(offsets, n_rows, n_groups, bm):
     return step_block, step_group, first, total.reshape(1)
 
 
-def _gmm_pallas(x, w, offsets, interpret):
+def _gmm_pallas(x, w, offsets, interpret, scale=None):
     from jax.experimental.pallas import tpu as pltpu
 
     n, k = x.shape
@@ -185,27 +202,37 @@ def _gmm_pallas(x, w, offsets, interpret):
         8, 8 * pl.cdiv(n, 8))
     blk, grp, fst, nst = _step_metadata(offsets, n, g_n, bm)
     n_steps = int(blk.shape[0])
-    out_dtype = jnp.result_type(x, w)
+    out_dtype = x.dtype if scale is not None else jnp.result_type(x, w)
+    in_specs = [
+        pl.BlockSpec((bm, k),
+                     lambda s, blk, grp, fst, off, nst: (blk[s], 0)),
+        pl.BlockSpec((1, k, p),
+                     lambda s, blk, grp, fst, off, nst:
+                     (grp[s], 0, 0)),
+    ]
+    inputs = [x, w]
+    if scale is not None:
+        # the scale slab dereferences through the SAME per-step group
+        # id, so the weight tile and its scales arrive together
+        nkb = scale.shape[1]
+        in_specs.append(pl.BlockSpec(
+            (1, nkb, p),
+            lambda s, blk, grp, fst, off, nst: (grp[s], 0, 0)))
+        inputs.append(scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(n_steps,),
-        in_specs=[
-            pl.BlockSpec((bm, k),
-                         lambda s, blk, grp, fst, off, nst: (blk[s], 0)),
-            pl.BlockSpec((1, k, p),
-                         lambda s, blk, grp, fst, off, nst:
-                         (grp[s], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (bm, p), lambda s, blk, grp, fst, off, nst: (blk[s], 0)),
         scratch_shapes=[pltpu.VMEM((bm, p), jnp.float32)],
     )
     return pl.pallas_call(
-        functools.partial(_gmm_kernel, bm, n),
+        functools.partial(_gmm_kernel, bm, n, scale is not None),
         grid_spec=grid_spec,
         out_shape=out_struct((n, p), out_dtype, x),
         interpret=interpret,
-    )(blk, grp, fst, offsets.astype(jnp.int32), nst, x, w)
+    )(blk, grp, fst, offsets.astype(jnp.int32), nst, *inputs)
 
 
 # ---------------------------------------------------------------------------
@@ -295,3 +322,116 @@ def grouped_matmul(x: jax.Array, w: jax.Array, offsets: jax.Array, *,
     """
     _check(x, w, offsets)
     return _gmm(x, w, offsets, backend)
+
+
+# ---------------------------------------------------------------------------
+# Weight-only int8 quantized slab path (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def quantize_group_weights(w, block: Optional[int] = None) -> dict:
+    """Pre-quantize an expert weight slab ``[G, k, p]`` → ``{"wire":
+    int8 [G, k, p], "scale": fp32 [G, k/kb, p]}`` — per-expert exactly
+    :func:`~apex_tpu.ops.dense.quantize_weight` vmapped over the
+    expert axis, so the dense and grouped slab forms share ONE
+    quantization definition (one fp32 scale per (k-block, output
+    column); the block is recoverable from the shapes, so the dict
+    stays a pure array pytree)."""
+    from apex_tpu.ops.dense import quantize_weight
+
+    w = jnp.asarray(w)
+    if w.ndim != 3:
+        raise ValueError(
+            f"quantize_group_weights expects [G, k, p] slabs, got "
+            f"{w.shape}")
+    return jax.vmap(lambda we: quantize_weight(we, block))(w)
+
+
+def _check_group_slab(wire, scale) -> None:
+    g_n, k, p = wire.shape
+    if (scale.ndim != 3 or scale.shape[0] != g_n
+            or scale.shape[2] != p or not scale.shape[1]
+            or k % scale.shape[1]):
+        raise ValueError(
+            f"scale {scale.shape} does not tile slab {wire.shape}")
+
+
+def _dequantize_group(wire, scale):
+    from apex_tpu.ops.dense import dequantize_weight
+
+    _check_group_slab(wire, scale)
+    return jax.vmap(dequantize_weight)(wire, scale)
+
+
+def _gmmq_impl(x, wire, scale, offsets, backend):
+    from apex_tpu.ops.dense import route_quant_backend
+
+    if x.shape[0] == 0:
+        return jnp.zeros((0, wire.shape[-1]), x.dtype)
+    if route_quant_backend(backend) == "reference":
+        return grouped_matmul_reference(
+            x, _dequantize_group(wire, scale), offsets).astype(x.dtype)
+    return _gmm_pallas(x, wire, offsets, interpret=not on_tpu(),
+                       scale=scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _gmmq(x, wire, scale, offsets, backend, x_dtype):
+    return _gmmq_impl(x, wire, scale, offsets, backend)
+
+
+def _gmmq_fwd(x, wire, scale, offsets, backend, x_dtype):
+    return _gmmq(x, wire, scale, offsets, backend, x_dtype), (
+        wire, scale, offsets)
+
+
+def _gmmq_bwd(backend, x_dtype, res, g):
+    # high-precision backward: dx runs the ROUTED float primitive over
+    # the fp32-dequantized slab (transposed), so no requantization
+    # error enters the cotangent; the frozen wire gets a float0
+    # cotangent (int8) and the scales zeros — serving constants, the
+    # same contract as ops/dense.quantize_weight
+    wire, scale, offsets = res
+    deq = _dequantize_group(wire, scale)
+    dx = _gmm_impl(g.astype(jnp.float32), deq.swapaxes(1, 2), offsets,
+                   backend).astype(x_dtype)
+    d_off = np.zeros(offsets.shape, jax.dtypes.float0)
+    return (dx, np.zeros(wire.shape, jax.dtypes.float0),
+            jnp.zeros_like(scale), d_off)
+
+
+_gmmq.defvjp(_gmmq_fwd, _gmmq_bwd)
+
+
+def grouped_matmul_quantized(x: jax.Array, wire: jax.Array,
+                             scale: jax.Array, offsets: jax.Array, *,
+                             backend: Optional[str] = None) -> jax.Array:
+    """:func:`grouped_matmul` off a pre-quantized expert slab
+    (:func:`quantize_group_weights`): ``out[r] = x[r] @ deq(w[g])`` for
+    rows in group ``g``'s span, rows outside every span exactly zero,
+    output in ``x.dtype`` with fp32 accumulation.
+
+    The kernel route extends the float grouped kernel: the per-step
+    group index also dereferences the slab's scale rows, and each
+    step's ``[k, p]`` expert tile dequantizes in VMEM before its dot —
+    the HBM weight read per step is the int8 bytes, which is the
+    decode-bandwidth win.  ``APEX_TPU_QUANT_MATMUL`` routes (shared
+    with ``ops/dense.dense_quantized``); the XLA reference dequantizes
+    the whole slab — the parity oracle.  Backward stays high-precision
+    (``dx`` against fp32 dequantized weights; wire/scales frozen)."""
+    if x.ndim != 2 or wire.ndim != 3 or offsets.ndim != 1:
+        raise ValueError(
+            f"grouped_matmul_quantized: expected x [N, k], wire "
+            f"[G, k, p], offsets [G+1]; got {x.shape}, {wire.shape}, "
+            f"{offsets.shape}")
+    if wire.shape[0] + 1 != offsets.shape[0]:
+        raise ValueError(
+            f"grouped_matmul_quantized: offsets length "
+            f"{offsets.shape[0]} != G + 1 = {wire.shape[0] + 1}")
+    if x.shape[1] != wire.shape[1]:
+        raise ValueError(
+            f"grouped_matmul_quantized: contraction mismatch — x "
+            f"[..., {x.shape[1]}] vs wire [., {wire.shape[1]}, .]")
+    _check_group_slab(wire, scale)
+    return _gmmq(x, wire, scale, offsets, backend,
+                 jnp.dtype(x.dtype).name)

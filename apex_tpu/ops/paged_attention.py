@@ -61,7 +61,8 @@ __all__ = ["ragged_paged_attention", "paged_attention_reference"]
 _NEG_INF = -1e30
 
 
-def _check_paged_shapes(q, k_pool, v_pool, block_tables, lengths):
+def _check_paged_shapes(q, k_pool, v_pool, block_tables, lengths,
+                        k_scale=None, v_scale=None):
     if q.ndim != 3:
         raise ValueError(
             f"expected q [b, num_heads, dh] (one decode token per "
@@ -86,10 +87,28 @@ def _check_paged_shapes(q, k_pool, v_pool, block_tables, lengths):
     if lengths.shape != (b,):
         raise ValueError(
             f"expected lengths [b={b}], got {lengths.shape}")
+    quant = jnp.dtype(k_pool.dtype) == jnp.int8
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError(
+            "int8 pools need k_scale/v_scale [num_blocks, block_size, "
+            "kv_groups] (the block-scaled at-rest form of "
+            "serving/paged_cache.py) — refusing to treat raw int8 as "
+            "attention values")
+    if not quant and (k_scale is not None or v_scale is not None):
+        raise ValueError(
+            f"k_scale/v_scale only apply to int8 pools, got pool dtype "
+            f"{k_pool.dtype}")
+    if quant:
+        want = k_pool.shape[:3]
+        if k_scale.shape != want or v_scale.shape != want:
+            raise ValueError(
+                f"expected scales {want}, got k {k_scale.shape} "
+                f"v {v_scale.shape}")
 
 
 def paged_attention_reference(q, k_pool, v_pool, block_tables, lengths,
-                              *, scale: Optional[float] = None):
+                              *, scale: Optional[float] = None,
+                              k_scale=None, v_scale=None):
     """XLA composition: gather the listed blocks, then run the dense
     masked decode attention over them.
 
@@ -97,8 +116,14 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, lengths,
     avoid (``pool[tables]`` builds the full ``[b, max_blocks·bs, g,
     dh]`` view in HBM every step) — kept as the always-available
     fallback and the numerics oracle of the parity suite, the same
-    role ``mha_reference`` plays for the flash kernel."""
-    _check_paged_shapes(q, k_pool, v_pool, block_tables, lengths)
+    role ``mha_reference`` plays for the flash kernel.
+
+    int8 pools (``k_scale``/``v_scale`` given): the gather also pulls
+    each block's per-(token, group) scales and dequantizes before the
+    math — the matching gather+dequant oracle of the in-kernel
+    dequantizing path."""
+    _check_paged_shapes(q, k_pool, v_pool, block_tables, lengths,
+                        k_scale, v_scale)
     b, nh, dh = q.shape
     nb, bs, g, _ = k_pool.shape
     mb = block_tables.shape[1]
@@ -108,6 +133,11 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, lengths,
     tbl = jnp.minimum(block_tables.astype(jnp.int32), nb - 1)
     k = k_pool[tbl].reshape(b, mb * bs, g, dh)
     v = v_pool[tbl].reshape(b, mb * bs, g, dh)
+    if k_scale is not None:
+        sk = k_scale[tbl].reshape(b, mb * bs, g)
+        sv = v_scale[tbl].reshape(b, mb * bs, g)
+        k = k.astype(jnp.float32) * sk[..., None]
+        v = v.astype(jnp.float32) * sv[..., None]
     rep = nh // g
     qg = q.reshape(b, g, rep, dh)
     s = jnp.einsum("bgrd,btgd->bgrt", qg.astype(jnp.float32),
@@ -127,14 +157,25 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, lengths,
 # ---------------------------------------------------------------------------
 
 
-def _paged_kernel(scale, bs, g, rep, *refs):
+def _paged_kernel(scale, bs, g, rep, quant, *refs):
     """Grid (b, max_blocks): sequence-major, one physical K/V block per
     step, online softmax across the block steps.  The block table and
     lengths ride in SMEM (scalar prefetch); the BlockSpec index maps
     already dereferenced the table, so ``k_ref``/``v_ref`` hold the
-    right physical block — the fused-gather property."""
-    (tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-     m_s, l_s, acc) = refs
+    right physical block — the fused-gather property.
+
+    ``quant``: the pool is block-scaled int8 and two extra refs carry
+    the step's per-(token, group) scale blocks (dereferenced through
+    the SAME table index map as the payload), so dequantization is one
+    VMEM-resident multiply per block — the float K/V never exists in
+    HBM, which is the whole at-rest win."""
+    if quant:
+        (tbl_ref, len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+         m_s, l_s, acc) = refs
+    else:
+        (tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+         m_s, l_s, acc) = refs
+        ks_ref = vs_ref = None
     i, j = pl.program_id(0), pl.program_id(1)
     nh = g * rep
 
@@ -149,6 +190,8 @@ def _paged_kernel(scale, bs, g, rep, *refs):
     def _compute():
         q = q_ref[0].astype(jnp.float32)          # [nh, dh]
         k = k_ref[0].astype(jnp.float32)          # [bs, g, dh]
+        if quant:
+            k = k * ks_ref[0][..., None]          # [bs, g, 1] scales
         qg = q.reshape(g, rep, q.shape[-1])
         # batched over the group axis: [g, rep, dh] x [bs, g, dh]
         # -> [g, rep, bs]; the rep query heads of a group share its
@@ -171,6 +214,8 @@ def _paged_kernel(scale, bs, g, rep, *refs):
         alpha = jnp.where(m_new > _NEG_INF / 2, alpha, 0.0)
         l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         v = v_ref[0].astype(jnp.float32)          # [bs, g, dh]
+        if quant:
+            v = v * vs_ref[0][..., None]
         pg = p.reshape(g, rep, bs)
         ctx = jax.lax.dot_general(
             pg, v, (((2,), (0,)), ((0,), (1,))),
@@ -191,13 +236,14 @@ def _paged_kernel(scale, bs, g, rep, *refs):
 
 
 def _paged_pallas(q, k_pool, v_pool, block_tables, lengths, scale,
-                  interpret):
+                  interpret, k_scale=None, v_scale=None):
     from jax.experimental.pallas import tpu as pltpu
 
     b, nh, dh = q.shape
     nb, bs, g, _ = k_pool.shape
     mb = block_tables.shape[1]
     rep = nh // g
+    quant = k_scale is not None
     # the index map runs for EVERY grid step, skipped blocks included:
     # clamp unmapped sentinels to a valid pool index here (host-side,
     # once) so the DMA source is always in range — the kernel's ragged
@@ -208,15 +254,30 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, lengths, scale,
     kv_spec = pl.BlockSpec(
         (1, bs, g, dh),
         lambda i, j, tbl_ref, len_ref: (tbl_ref[i, j], 0, 0, 0))
+    # the scale pool dereferences through the SAME table entry, so each
+    # step's DMA brings the block's payload AND its scales — the
+    # gather+dequant is fused exactly like the gather itself
+    sc_spec = pl.BlockSpec(
+        (1, bs, g),
+        lambda i, j, tbl_ref, len_ref: (tbl_ref[i, j], 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, nh, dh),
+                     lambda i, j, tbl_ref, len_ref: (i, 0, 0)),
+        kv_spec,
+    ]
+    inputs = [q, k_pool]
+    if quant:
+        in_specs.append(sc_spec)
+        inputs.append(k_scale)
+    in_specs.append(kv_spec)
+    inputs.append(v_pool)
+    if quant:
+        in_specs.append(sc_spec)
+        inputs.append(v_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, mb),
-        in_specs=[
-            pl.BlockSpec((1, nh, dh),
-                         lambda i, j, tbl_ref, len_ref: (i, 0, 0)),
-            kv_spec,
-            kv_spec,
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, nh, dh), lambda i, j, tbl_ref, len_ref: (i, 0, 0)),
         scratch_shapes=[
@@ -226,11 +287,11 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, lengths, scale,
         ],
     )
     return pl.pallas_call(
-        functools.partial(_paged_kernel, scale, bs, g, rep),
+        functools.partial(_paged_kernel, scale, bs, g, rep, quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, nh, dh), q.dtype),
         interpret=interpret,
-    )(tbl, lens, q, k_pool, v_pool)
+    )(tbl, lens, *inputs)
 
 
 def _route(backend: Optional[str]) -> str:
@@ -255,6 +316,8 @@ def ragged_paged_attention(
     *,
     scale: Optional[float] = None,
     backend: Optional[str] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """One decode token per sequence attends over its paged KV blocks.
 
@@ -262,6 +325,12 @@ def ragged_paged_attention(
     kv_groups, dh]``, ``block_tables`` ``[b, max_blocks]`` (entries
     ``>= num_blocks`` are unmapped), ``lengths`` ``[b]`` live token
     counts → context ``[b, num_heads, dh]``.
+
+    int8 pools (ISSUE 14): pass the pool's per-(token, group) fp32
+    scales as ``k_scale``/``v_scale`` ``[num_blocks, block_size,
+    kv_groups]`` — the kernel dequantizes each block in VMEM right
+    after its table-dereferenced DMA (the float K/V never exists in
+    HBM), the reference runs the matching gather+dequant.
 
     ``backend``: ``None`` routes automatically (fused Pallas kernel on
     TPU or under ``APEX_TPU_PALLAS_INTERPRET=1``; XLA gather reference
@@ -272,11 +341,14 @@ def ragged_paged_attention(
     through the serving decode step, and keeping the kernel
     forward-only keeps its VMEM budget at one block.
     """
-    _check_paged_shapes(q, k_pool, v_pool, block_tables, lengths)
+    _check_paged_shapes(q, k_pool, v_pool, block_tables, lengths,
+                        k_scale, v_scale)
     dh = q.shape[-1]
     scale = (1.0 / dh ** 0.5) if scale is None else float(scale)
     if _route(backend) == "reference":
         return paged_attention_reference(
-            q, k_pool, v_pool, block_tables, lengths, scale=scale)
+            q, k_pool, v_pool, block_tables, lengths, scale=scale,
+            k_scale=k_scale, v_scale=v_scale)
     return _paged_pallas(q, k_pool, v_pool, block_tables, lengths,
-                         scale, interpret=not on_tpu())
+                         scale, interpret=not on_tpu(),
+                         k_scale=k_scale, v_scale=v_scale)

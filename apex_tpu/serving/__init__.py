@@ -16,7 +16,10 @@ engine:
   either one contiguous ``max_len`` stripe per slot
   (``cache_layout="contiguous"``) or the paged block pool
   (``cache_layout="paged"`` — block-budget admission, prefix sharing,
-  preempt/resume; ISSUE 6);
+  preempt/resume; ISSUE 6), optionally stored at rest as block-scaled
+  int8 (``cache_wire="int8"``, ISSUE 14 — ~0.53x a bf16 pool's bytes,
+  so byte-matched admission carries ~2x the requests; quantized at
+  every write edge, dequantized inside the paged-attention kernel);
 - :mod:`~apex_tpu.serving.paged_cache` — the block pool:
   :class:`~apex_tpu.serving.paged_cache.BlockManager` (free list,
   refcounts, chained prefix hashes for copy-on-write sharing) plus the
@@ -65,11 +68,15 @@ from apex_tpu.serving.engine import (  # noqa: F401
     ServingEngine,
 )
 from apex_tpu.serving.paged_cache import (  # noqa: F401
+    CACHE_WIRES,
     BlockManager,
     blocks_for,
+    dequantize_kv,
     init_paged_pool,
     paged_insert_prefill,
+    paged_insert_prefill_q,
     prefix_block_hashes,
+    quantize_kv,
 )
 from apex_tpu.serving.slo import (  # noqa: F401
     DEFAULT_SLO_TARGETS,
@@ -79,6 +86,7 @@ from apex_tpu.serving.slo import (  # noqa: F401
 
 __all__ = [
     "BlockManager",
+    "CACHE_WIRES",
     "DEFAULT_SLO_TARGETS",
     "Request",
     "Response",
@@ -87,10 +95,13 @@ __all__ = [
     "SlotPool",
     "blocks_for",
     "default_buckets",
+    "dequantize_kv",
     "init_paged_pool",
     "pad_prompt",
     "paged_insert_prefill",
+    "paged_insert_prefill_q",
     "pick_bucket",
     "prefix_block_hashes",
+    "quantize_kv",
     "resolve_slo_targets",
 ]

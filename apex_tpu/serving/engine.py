@@ -122,7 +122,7 @@ from apex_tpu.serving.batching import (
     SlotPool, default_buckets, pad_prompt, pick_bucket)
 from apex_tpu.serving.paged_cache import (
     BlockManager, blocks_for, init_paged_pool, paged_insert_prefill,
-    prefix_block_hashes)
+    paged_insert_prefill_q, prefix_block_hashes, resolve_cache_wire)
 from apex_tpu.serving.slo import judge as _judge_slo
 from apex_tpu.serving.slo import resolve_slo_targets
 from apex_tpu.serving.slo import tpot_ms as _tpot_ms
@@ -279,6 +279,7 @@ class ServingEngine:
                  max_slots: int = 8, max_len: Optional[int] = None,
                  prompt_buckets: Optional[Sequence[int]] = None,
                  cache_dtype=None, cache_layout: str = "contiguous",
+                 cache_wire=None,
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  reserve_blocks: int = 1,
                  top_k: Optional[int] = None,
@@ -292,6 +293,11 @@ class ServingEngine:
             raise ValueError(
                 f"cache_layout={cache_layout!r}: expected 'contiguous' "
                 "or 'paged'")
+        self.cache_wire = resolve_cache_wire(cache_wire)
+        if self.cache_wire != "native" and cache_layout != "paged":
+            raise ValueError(
+                f"cache_wire={cache_wire!r} needs cache_layout='paged' "
+                "— int8 at rest is a block-pool form (ISSUE 14)")
         self.params = params
         self.cfg = cfg
         self.max_slots = int(max_slots)
@@ -329,19 +335,37 @@ class ServingEngine:
                 | {b for b in default_buckets(self.max_len)
                    if b > self.buckets[-1]}))
         self.cache_layout = cache_layout
+        # the dtype K/V are COMPUTED and handled in (prefill buckets,
+        # handoff padding); the pool may store a different wire form
+        self._cache_dtype = jnp.dtype(cache_dtype or cfg.compute_dtype)
         if cache_layout == "paged":
             self.block_size = int(block_size)
             mb = blocks_for(self.max_len, self.block_size)
-            self.num_blocks = int(
-                num_blocks or self.max_slots * mb)
+            if num_blocks:
+                self.num_blocks = int(num_blocks)
+            elif self.cache_wire == "int8":
+                # byte-parity default at the WIRE form (ISSUE 14): the
+                # same HBM the native pool would commit buys
+                # native_bytes/int8_bytes ≈ itemsize/(1 + 4/dh) times
+                # the blocks — the admission-concurrency multiple the
+                # --cache-dtype bench ablation measures
+                cell = self.block_size * cfg.kv_groups
+                native_b = cell * cfg.kv_channels * \
+                    self._cache_dtype.itemsize
+                int8_b = cell * cfg.kv_channels + 4 * cell
+                self.num_blocks = max(
+                    mb, self.max_slots * mb * native_b // int8_b)
+            else:
+                self.num_blocks = self.max_slots * mb
             if reserve_blocks < 0:
                 raise ValueError(
                     f"reserve_blocks={reserve_blocks} must be >= 0")
             self.reserve_blocks = int(reserve_blocks)
             pool = init_paged_pool(cfg, self.num_blocks, self.block_size,
-                                   cache_dtype=cache_dtype)
-            self.cache = {"k": pool["k"], "v": pool["v"],
-                          "pos": jnp.zeros((self.max_slots,), jnp.int32)}
+                                   cache_dtype=cache_dtype,
+                                   cache_wire=self.cache_wire)
+            self.cache = dict(
+                pool, pos=jnp.zeros((self.max_slots,), jnp.int32))
             self._mgr = BlockManager(self.num_blocks, self.block_size)
             # per-lane block tables, host-mirrored; num_blocks is the
             # UNMAPPED sentinel (reads clamp+mask, writes drop), so a
@@ -353,7 +377,18 @@ class ServingEngine:
                                        cache_dtype=cache_dtype)
             self._mgr = None
             self._tables = None
-        self._cache_dtype = self.cache["k"].dtype
+        # resident cache bytes at the wire form (scale pools included)
+        # — the serving.cache_bytes{dtype=} gauge and the bench
+        # matched-bytes ablation both read this number
+        self._cache_bytes = int(sum(
+            v.size * v.dtype.itemsize for k, v in self.cache.items()
+            if k != "pos"))
+        self._wire_dtype_name = ("int8" if self.cache_wire == "int8"
+                                 else jnp.dtype(self._cache_dtype).name)
+        self._capacity_tokens = (
+            self.num_blocks * self.block_size if self._mgr is not None
+            else self.max_slots * self.max_len)
+        self._blocks_hw = 0
         self._pool = SlotPool(self.max_slots)
         self._slots: List[Optional[_Slot]] = [None] * self.max_slots
         self._queue: deque = deque()
@@ -560,6 +595,8 @@ class ServingEngine:
             "max_len": self.max_len,
             "buckets": self.buckets,
             "cache_layout": self.cache_layout,
+            "cache_wire": self.cache_wire,
+            "cache_bytes": self._cache_bytes,
             "sampling": dict(self._sampling),
             "spec_k": None if self._spec is None else self._spec.k,
         }
@@ -584,12 +621,25 @@ class ServingEngine:
         _telemetry.gauge("serving.slot_occupancy").set(
             self._pool.n_active / self.max_slots)
         _telemetry.gauge("serving.queue_depth").set(len(self._queue))
+        # quantized-cache accounting (ISSUE 14): pool bytes at the wire
+        # form and capacity in tokens, tagged by the at-rest dtype so a
+        # stream holding both ends of the --cache-dtype ablation keeps
+        # the engines separable (tools/telemetry_report.py derives
+        # bytes-per-resident-token and the admission multiple)
+        tags = {"dtype": self._wire_dtype_name}
+        _telemetry.gauge("serving.cache_bytes", tags).set(
+            self._cache_bytes)
+        _telemetry.gauge("serving.cache_capacity_tokens", tags).set(
+            self._capacity_tokens)
         if self._mgr is not None:
+            self._blocks_hw = max(self._blocks_hw, self._mgr.n_in_use)
             _telemetry.gauge("serving.blocks_in_use").set(
                 self._mgr.n_in_use)
             _telemetry.gauge("serving.blocks_free").set(self._mgr.n_free)
             _telemetry.gauge("serving.prefix_shared_blocks").set(
                 self._mgr.n_shared)
+            _telemetry.gauge("serving.cache_blocks_hw", tags).set(
+                self._blocks_hw)
 
     def _feed_queue_detector(self) -> None:
         """Anomaly feed for the queue detector (see step() for why the
@@ -746,14 +796,25 @@ class ServingEngine:
             wid = np.full((blocks_for(bucket, self.block_size),),
                           self.num_blocks, np.int32)
             wid[: len(write_ids)] = write_ids
-            k, v = paged_insert_prefill(
-                self.cache["k"], self.cache["v"], ks, vs,
-                jnp.asarray(wid), jnp.int32(n),
-                block_size=self.block_size)
-            self.cache = {
-                "k": k, "v": v,
-                "pos": self.cache["pos"].at[slot].set(n),
-            }
+            if self.cache_wire == "int8":
+                k, v, sk, sv = paged_insert_prefill_q(
+                    self.cache["k"], self.cache["v"],
+                    self.cache["k_scale"], self.cache["v_scale"],
+                    ks, vs, jnp.asarray(wid), jnp.int32(n),
+                    block_size=self.block_size)
+                self.cache = {
+                    "k": k, "v": v, "k_scale": sk, "v_scale": sv,
+                    "pos": self.cache["pos"].at[slot].set(n),
+                }
+            else:
+                k, v = paged_insert_prefill(
+                    self.cache["k"], self.cache["v"], ks, vs,
+                    jnp.asarray(wid), jnp.int32(n),
+                    block_size=self.block_size)
+                self.cache = {
+                    "k": k, "v": v,
+                    "pos": self.cache["pos"].at[slot].set(n),
+                }
         else:
             self.cache = _insert_slot(self.cache, ks, vs,
                                       jnp.int32(slot), jnp.int32(n))
@@ -837,6 +898,11 @@ class ServingEngine:
             if self._mgr is not None:
                 self._tables[slot, :] = self.num_blocks
                 self._tables[slot, : len(blocks)] = blocks
+                # high-water at the claim edge, not the gauge edge — a
+                # request that admits and completes within one step
+                # must still register its pool footprint
+                self._blocks_hw = max(self._blocks_hw,
+                                      self._mgr.n_in_use)
             now = time.perf_counter()
             ms = (now - t0) * 1e3
             if req.first_token_t == 0.0:
@@ -961,6 +1027,8 @@ class ServingEngine:
                 if blk is not None:
                     self._tables[slot, len(st.blocks)] = blk
                     st.blocks.append(blk)
+                    self._blocks_hw = max(self._blocks_hw,
+                                          self._mgr.n_in_use)
                     continue
                 self._preempt(self._youngest_slot())
 
@@ -1211,9 +1279,11 @@ def _make_decode_fn(cfg, top_k, top_p, vocab_limit, paged, spec=None):
                 spec=spec, temperature=temps, top_k=top_k, top_p=top_p,
                 vocab_limit=vocab_limit)
             n_raw = n_acc + 1
-            cache = {"k": new["k"], "v": new["v"],
-                     "pos": jnp.where(active, prev_pos + n_raw,
-                                      prev_pos)}
+            # key-generic rebuild: an int8 pool carries k_scale/v_scale
+            # alongside k/v — whatever the layout stores rides through
+            cache = {kk: vv for kk, vv in new.items()
+                     if kk not in ("pos", "block_tables")}
+            cache["pos"] = jnp.where(active, prev_pos + n_raw, prev_pos)
             # device-side history append: this poll's delivered tokens
             # scatter in at each live lane's length (frozen lanes and
             # past-the-buffer columns drop) — the steady-state poll
@@ -1258,11 +1328,12 @@ def _make_decode_fn(cfg, top_k, top_p, vocab_limit, paged, spec=None):
             logits, new = decode_step(
                 params, tokens, dict(cache, block_tables=tables), cfg)
             # free lanes ride along: frozen position + sentinel table
-            # rows (writes drop), so they can't corrupt live blocks
-            cache = {
-                "k": new["k"], "v": new["v"],
-                "pos": jnp.where(active, new["pos"], prev_pos),
-            }
+            # rows (writes drop), so they can't corrupt live blocks.
+            # Key-generic rebuild so the int8 pool's scale arrays ride
+            # through the donation untouched.
+            cache = {kk: vv for kk, vv in new.items()
+                     if kk not in ("pos", "block_tables")}
+            cache["pos"] = jnp.where(active, new["pos"], prev_pos)
             nxt = _mixed_sample(logits, temps, key, top_k=top_k,
                                 top_p=top_p, vocab_limit=vocab_limit)
             return nxt, cache

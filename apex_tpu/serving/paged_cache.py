@@ -55,9 +55,26 @@ import numpy as np
 
 from apex_tpu.models.config import TransformerConfig
 
-__all__ = ["BlockManager", "blocks_for", "gather_block_kv",
-           "init_paged_pool", "paged_insert_prefill",
-           "prefix_block_hashes"]
+__all__ = ["BlockManager", "CACHE_WIRES", "blocks_for", "dequantize_kv",
+           "gather_block_kv", "init_paged_pool", "paged_insert_prefill",
+           "paged_insert_prefill_q", "prefix_block_hashes",
+           "quantize_kv", "resolve_cache_wire", "scatter_kv_quantized"]
+
+# Pool storage forms (ISSUE 14): "native" keeps K/V at the cache dtype
+# (bf16/fp16/fp32 — the form every prior PR used); "int8" stores
+# block-scaled int8 with one fp32 scale per (token, kv group) riding in
+# a parallel scale pool, dequantized inside the paged-attention kernel.
+CACHE_WIRES = ("native", "int8")
+
+
+def resolve_cache_wire(cache_wire) -> str:
+    """Normalize the pool-form knob (None == "native")."""
+    wire = "native" if cache_wire is None else str(cache_wire)
+    if wire not in CACHE_WIRES:
+        raise ValueError(
+            f"cache_wire={cache_wire!r}: expected one of {CACHE_WIRES} "
+            "(or None for native)")
+    return wire
 
 
 def blocks_for(n_tokens: int, block_size: int) -> int:
@@ -70,21 +87,86 @@ def blocks_for(n_tokens: int, block_size: int) -> int:
 
 
 def init_paged_pool(cfg: TransformerConfig, num_blocks: int,
-                    block_size: int, cache_dtype=None) -> dict:
+                    block_size: int, cache_dtype=None,
+                    cache_wire=None) -> dict:
     """Allocate the global K/V block pool:
     ``[num_layers, num_blocks, block_size, kv_groups, dh]`` per side.
 
     Same dtype contract as the contiguous ``init_kv_cache`` — GQA holds
     only the group heads, ``cache_dtype`` downcasts under an fp32
-    compute config."""
+    compute config.
+
+    ``cache_wire="int8"`` (ISSUE 14) stores the pool at rest as
+    block-scaled int8: the K/V buffers become int8 and two fp32 scale
+    pools ``k_scale``/``v_scale`` ``[L, num_blocks, block_size,
+    kv_groups]`` ride alongside — one symmetric scale per (token, kv
+    group) over the ``dh`` head lane (the EQuARX per-block scaling of
+    ``comm/quantize`` applied at rest; writes quantize via
+    :func:`quantize_kv`, the paged-attention kernel dequantizes
+    in-VMEM).  At ~``1 + 4/dh`` bytes/element the resident cache costs
+    ~0.53x a bf16 pool and ~0.27x an fp32 one, which is what lets
+    byte-matched admission carry ~2x the live requests.  Scales
+    initialize to 1 so an untouched (all-zero) block dequantizes
+    exactly."""
     if num_blocks < 1:
         raise ValueError(f"num_blocks={num_blocks} must be positive")
     if block_size < 1:
         raise ValueError(f"block_size={block_size} must be positive")
+    wire = resolve_cache_wire(cache_wire)
     dt = cfg.compute_dtype if cache_dtype is None else cache_dtype
     shape = (cfg.num_layers, num_blocks, block_size, cfg.kv_groups,
              cfg.kv_channels)
-    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if wire == "native":
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    return {
+        "k": jnp.zeros(shape, jnp.int8),
+        "v": jnp.zeros(shape, jnp.int8),
+        "k_scale": jnp.ones(shape[:-1], jnp.float32),
+        "v_scale": jnp.ones(shape[:-1], jnp.float32),
+    }
+
+
+def quantize_kv(x):
+    """Symmetric round-to-nearest int8 over the head dim: ``x``
+    ``[..., dh]`` float → ``(wire int8 [..., dh], scale fp32 [...])``
+    with one scale per (…, token, group) row — the
+    :func:`~apex_tpu.comm.quantize.quantize_blocks` math at block
+    ``dh``, so the at-rest form and the grad/dispatch/handoff wires
+    share ONE quantization definition (all-zero rows get scale 1 and
+    round-trip exactly; a NaN poisons its scale rather than laundering
+    into finite int8)."""
+    from apex_tpu.comm.quantize import quantize_blocks
+
+    wire, scales = quantize_blocks(x.astype(jnp.float32), "int8",
+                                   int(x.shape[-1]))
+    return wire, scales[..., 0]
+
+
+def dequantize_kv(wire, scale, dtype=jnp.float32):
+    """Invert :func:`quantize_kv`: ``wire`` int8 ``[..., dh]`` ×
+    ``scale`` ``[...]`` → float ``[..., dh]``."""
+    return (wire.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def scatter_kv_quantized(pool_k, pool_v, k_scale, v_scale, k, v, idx):
+    """THE quantized write edge: quantize float K/V per (token, group)
+    and scatter wire + scales through the SAME index tuple with the
+    same ``mode="drop"`` semantics → ``(pool_k, pool_v, k_scale,
+    v_scale)`` updated.
+
+    Every writer (prefill's whole-page scatter, the decode tail-block
+    append, the spec-verify block write, KV-handoff injection) goes
+    through here, so the invariant that a payload cell and its scale
+    cell can never desynchronize — same block id, same offset, same
+    drop — is stated once, not five times.  ``idx`` is the advanced
+    index tuple addressing ``(block, offset)`` cells, with a leading
+    ``slice(None)`` when the pools carry the layer axis."""
+    qk, sk = quantize_kv(k)
+    qv, sv = quantize_kv(v)
+    return (pool_k.at[idx].set(qk, mode="drop"),
+            pool_v.at[idx].set(qv, mode="drop"),
+            k_scale.at[idx].set(sk, mode="drop"),
+            v_scale.at[idx].set(sv, mode="drop"))
 
 
 def prefix_block_hashes(tokens: np.ndarray,
@@ -280,3 +362,28 @@ def paged_insert_prefill(pool_k, pool_v, ks, vs, write_ids, length,
         vs[:, 0].astype(pool_v.dtype), mode="drop")
     del L  # shape bound only for readability
     return k, v
+
+
+@functools.partial(jax.jit, donate_argnames=("pool_k", "pool_v",
+                                             "k_scale", "v_scale"),
+                   static_argnames=("block_size",))
+def paged_insert_prefill_q(pool_k, pool_v, k_scale, v_scale, ks, vs,
+                           write_ids, length, *, block_size: int):
+    """The int8-pool form of :func:`paged_insert_prefill`: the float
+    bucket cache ``[L, 1, S, g, dh]`` is quantized per (token, group)
+    at the write edge (:func:`quantize_kv`) and the wire values scatter
+    into the int8 pool while the scales scatter into the parallel
+    scale pool — same ``write_ids`` drop semantics, so prefix-shared
+    blocks and bucket padding skip the scale writes exactly like the
+    payload writes (a shared block's scales stay the first writer's,
+    which is also every later writer's: quantization is
+    deterministic)."""
+    S = ks.shape[2]
+    nb = pool_k.shape[1]
+    t = jnp.arange(S)
+    blk = write_ids.astype(jnp.int32)[t // block_size]
+    blk = jnp.where(t < length, blk, nb)          # padding -> dropped
+    off = t % block_size
+    return scatter_kv_quantized(pool_k, pool_v, k_scale, v_scale,
+                                ks[:, 0], vs[:, 0],
+                                (slice(None), blk, off))

@@ -206,20 +206,51 @@ def _wire_block(h: int, block: int) -> int:
 # ---------------------------------------------------------------------------
 
 
+def _expert_matmul(xs, w, offsets, dtype, backend):
+    """One expert-slab matmul: a float slab runs the historical
+    :func:`grouped_matmul` path byte-identically; a pre-quantized slab
+    (``{"wire", "scale"}`` from ``ops/grouped_matmul.
+    quantize_group_weights`` via ``models/quantized.quantize_params``,
+    ISSUE 14) runs the in-kernel dequantizing grouped matmul so the
+    HBM expert-weight read is the int8 bytes."""
+    from apex_tpu.ops.dense import is_quantized
+
+    if is_quantized(w):
+        from apex_tpu.ops.grouped_matmul import grouped_matmul_quantized
+
+        # the caller's backend pin carries through (a parity run that
+        # pinned the reference must not get the kernel's summation
+        # order); None keeps the APEX_TPU_QUANT_MATMUL/auto routing
+        return grouped_matmul_quantized(
+            xs.astype(dtype), w["wire"], w["scale"], offsets,
+            backend=backend)
+    return grouped_matmul(xs.astype(dtype), w.astype(dtype), offsets,
+                          backend=backend)
+
+
+def _slab_groups(w) -> int:
+    from apex_tpu.ops.dense import is_quantized
+
+    if is_quantized(w):
+        return int(w["wire"].shape[0])
+    return int(w.shape[0])
+
+
 def _grouped_ffn(xs, offsets, fc1, b1, fc2, b2, activation, dtype,
                  backend=None):
     """Expert FFN over ``xs`` [N, h] sorted by expert with segment
     ``offsets`` [G+1] (window allowed: rows outside stay exactly zero).
     Per-row biases gather through a zero-padded table so sentinel rows
-    (outside the window / past the valid count) contribute nothing."""
-    g_n = fc1.shape[0]
+    (outside the window / past the valid count) contribute nothing.
+    ``fc1``/``fc2`` may be weight-only quantized slabs (ISSUE 14) —
+    see :func:`_expert_matmul`."""
+    g_n = _slab_groups(fc1)
     gid = group_ids(offsets, xs.shape[0], g_n)
     b1e = jnp.concatenate(
         [b1, jnp.zeros((1,) + b1.shape[1:], b1.dtype)])[gid]
     b2e = jnp.concatenate(
         [b2, jnp.zeros((1,) + b2.shape[1:], b2.dtype)])[gid]
-    h1 = grouped_matmul(xs.astype(dtype), fc1.astype(dtype), offsets,
-                        backend=backend)
+    h1 = _expert_matmul(xs, fc1, offsets, dtype, backend)
     if activation == "swiglu":
         from apex_tpu.ops.swiglu import fused_bias_swiglu
 
@@ -231,7 +262,7 @@ def _grouped_ffn(xs, offsets, fc1, b1, fc2, b2, activation, dtype,
         h1 = jax.nn.gelu(h1.astype(jnp.float32),
                          approximate=activation == "gelu_tanh"
                          ).astype(dtype)
-    h2 = grouped_matmul(h1, fc2.astype(dtype), offsets, backend=backend)
+    h2 = _expert_matmul(h1, fc2, offsets, dtype, backend)
     return h2 + b2e.astype(dtype)
 
 
@@ -663,6 +694,22 @@ def switch_moe_mlp(
     if moe_comm not in WIRE_DTYPES:
         raise ValueError(
             f"moe_comm={moe_comm!r}: expected one of {WIRE_DTYPES}")
+    from apex_tpu.ops.dense import is_quantized
+
+    if is_quantized(params.get("fc1")) or is_quantized(params.get("fc2")):
+        # weight-only quantized expert slabs (ISSUE 14) run ONLY on the
+        # local ragged path: the capacity einsum would need a dense
+        # dequantize (no bandwidth win) and the EP island would ship
+        # dict leaves through shard_map specs built for arrays
+        if routing != "ragged":
+            raise ValueError(
+                "quantized expert slabs need routing='ragged' (the "
+                "capacity einsum path has no int8 form)")
+        if ep_mesh is not None or _mesh_axis_size(
+                _ep_abstract_mesh(), ep_axis) >= 2:
+            raise ValueError(
+                "quantized expert slabs are a single-device serving "
+                "path; run them outside an expert-parallel mesh")
     if routing == "capacity":
         return _capacity_moe(
             params, x, capacity_factor=capacity_factor, top_k=top_k,

@@ -573,6 +573,177 @@ def bench_cache_layout_ablation(on_tpu, layouts):
     return rows
 
 
+def bench_cache_dtype_ablation(on_tpu, wires, platform="cpu"):
+    """Quantized-serving ablation (ISSUE 14): the paged pool at rest in
+    bf16 vs block-scaled int8, at MATCHED pool bytes.
+
+    Three row families, every one carrying the PR-11 ``backend`` /
+    ``skipped`` fields so a CPU-smoke run is machine-readably caveated:
+
+    - **admission rows** — the long-prompt starvation mix against
+      byte-matched pools: int8 blocks cost ``(1 + 4/dh)/itemsize`` of
+      native blocks, so the same HBM holds ~1.88x the blocks under a
+      bf16 baseline and the realized ``max_concurrent_requests``
+      multiple (plus preemption counts) is the headline —
+      ``admitted_concurrency_multiple`` with the >= 1.8 acceptance
+      gate;
+    - **spec-decode accept-rate gate** — the PR-8 n-gram sweep over
+      both pool forms; the accept-rate delta is the cheap proxy for
+      distribution drift of int8-at-rest (``accept_gate_ok`` asserts
+      it bounded) and ``greedy_divergence_rate`` reports how many
+      token positions actually moved (documented, not hidden — the
+      first token never diverges, prefill logits precede any
+      quantization);
+    - **weight-only matmul rows** — ``generate`` decode rate with
+      float params vs ``models/quantized.quantize_params`` (int8
+      weight slabs, in-kernel dequant) plus the resident
+      ``param_bytes`` ratio.  On CPU the rate is NOT the story (the
+      win is HBM bandwidth); the byte ratio is.
+    """
+    from apex_tpu.models.generate import generate
+    from apex_tpu.models.quantized import param_bytes, quantize_params
+    from apex_tpu.models.speculative import SpecConfig, spec_generate
+    from apex_tpu.models.transformer_lm import init_gpt_params
+    from apex_tpu.serving import ServingEngine
+
+    bad = [w for w in wires if w not in ("bf16", "int8")]
+    if bad:
+        raise ValueError(f"cache dtypes {bad}: expected bf16, int8")
+    # dh = 64 geometry (hidden/heads): the per-(token, group) scale
+    # rides one fp32 per dh lane, so dh sets the int8 byte ratio —
+    # 1 + 4/64 = 1.0625 B/elem vs bf16's 2 (the 1.88x block multiple)
+    if on_tpu:
+        cfg = gpt_125m(max_position_embeddings=1024)
+        slots, bs, max_len = 48, 16, 512
+        n_short, short_prompt, short_new = 48, 62, 4
+        n_long, long_prompt, long_new = 2, 384, 8
+        base_blocks = 112
+        spec_prompt, spec_new = 64, 96
+    else:
+        cfg = gpt_125m(num_layers=2, hidden_size=128,
+                       num_attention_heads=2, vocab_size=1024,
+                       max_position_embeddings=256)
+        slots, bs, max_len = 24, 16, 128
+        n_short, short_prompt, short_new = 20, 30, 4
+        n_long, long_prompt, long_new = 1, 96, 8
+        base_blocks = 24
+        spec_prompt, spec_new = 16, 48
+    rng = np.random.RandomState(0)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    g, dh = cfg.kv_groups, cfg.kv_channels
+    bf16_block_bytes = bs * g * dh * 2
+    int8_block_bytes = bs * g * (dh + 4)
+    reqs = [dict(prompt=rng.randint(0, cfg.vocab_size, (long_prompt,)),
+                 max_new_tokens=long_new, slo_class="batch")
+            for _ in range(n_long)]
+    reqs += [dict(prompt=rng.randint(0, cfg.vocab_size, (short_prompt,)),
+                  max_new_tokens=short_new)
+             for _ in range(n_short)]
+
+    def engine_for(wire):
+        kw = dict(max_slots=slots, max_len=max_len, cache_layout="paged",
+                  block_size=bs, cache_dtype=jnp.bfloat16,
+                  reserve_blocks=1)
+        if wire == "int8":
+            kw.update(cache_wire="int8",
+                      num_blocks=base_blocks * bf16_block_bytes
+                      // int8_block_bytes)
+        else:
+            kw.update(num_blocks=base_blocks)
+        return ServingEngine(params, cfg, **kw)
+
+    rows = {"mix": "long_prompt_starvation", "block_size": bs,
+            "max_len": max_len, "requests": len(reqs),
+            "backend": platform, "skipped": False}
+    for wire in wires:
+        engine_for(wire).run(list(reqs))              # warmup compiles
+        engine = engine_for(wire)
+        resps, wall, hw = _drive_engine(engine, list(reqs))
+        st = engine.stats()
+        gen_tokens = sum(r.tokens.size for r in resps)
+        rows[wire] = {
+            "cache_wire": wire,
+            "num_blocks": st["num_blocks"],
+            "cache_bytes": st["cache_bytes"],
+            "max_concurrent_requests": hw,
+            "preemptions": st["preemptions"],
+            "completed": len(resps),
+            "wall_ms": round(wall * 1e3, 2),
+            "gen_tokens_per_sec": round(gen_tokens / wall, 1),
+            "backend": platform,
+            "skipped": False,
+        }
+    if "bf16" in rows and "int8" in rows:
+        rows["admitted_concurrency_multiple"] = round(
+            rows["int8"]["max_concurrent_requests"]
+            / max(rows["bf16"]["max_concurrent_requests"], 1), 2)
+        rows["pool_bytes_ratio"] = round(
+            rows["int8"]["cache_bytes"] / rows["bf16"]["cache_bytes"], 3)
+
+    # -- spec-decode accept-rate gate (the quality proxy) -------------------
+    pattern = rng.randint(0, cfg.vocab_size, (4,))
+    rep_prompt = jnp.asarray(
+        np.tile(pattern, (2, -(-spec_prompt // 4)))[:, :spec_prompt],
+        jnp.int32)
+    spec_rows = {"backend": platform, "skipped": False}
+    outs = {}
+    for wire in wires:
+        cw = "int8" if wire == "int8" else None
+        out, stats = spec_generate(
+            params, rep_prompt, cfg, spec=SpecConfig(k=8),
+            max_new_tokens=spec_new, cache_layout="paged",
+            block_size=bs, cache_dtype=jnp.bfloat16, cache_wire=cw)
+        outs[wire] = np.asarray(out)[:, spec_prompt:]
+        draft = max(stats["draft_tokens"], 1)
+        spec_rows[wire] = {
+            "accept_rate": round(stats["accepted_tokens"] / draft, 4),
+            "draft_tokens": stats["draft_tokens"],
+            "accepted_tokens": stats["accepted_tokens"],
+            "verify_calls": stats["verify_calls"],
+        }
+    if "bf16" in spec_rows and "int8" in spec_rows:
+        delta = abs(spec_rows["bf16"]["accept_rate"]
+                    - spec_rows["int8"]["accept_rate"])
+        spec_rows["accept_rate_delta"] = round(delta, 4)
+        spec_rows["accept_gate_ok"] = delta <= ACCEPT_RATE_GATE
+        spec_rows["greedy_divergence_rate"] = round(float(
+            (outs["bf16"] != outs["int8"]).mean()), 4)
+    rows["spec_accept_gate"] = spec_rows
+
+    # -- weight-only quantized matmul rows ----------------------------------
+    wq_rows = {"backend": platform, "skipped": False}
+    qparams = quantize_params(params)
+    prompt = jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (4, spec_prompt)), jnp.int32)
+    for name, p in (("float", params), ("int8_weights", qparams)):
+        def run(_, p=p):
+            out = generate(p, prompt, cfg, max_new_tokens=short_new * 4,
+                           cache_layout="paged", block_size=bs)
+            return (out, out)
+
+        sec = _time_fn(run, n_warmup=1, iters=3 if on_tpu else 2,
+                       name=f"wq_{name}")
+        wq_rows[name] = {
+            "decode_tokens_per_sec": round(
+                4 * short_new * 4 / sec, 1),
+            "param_bytes": param_bytes(p),
+        }
+    wq_rows["weight_bytes_ratio"] = round(
+        wq_rows["int8_weights"]["param_bytes"]
+        / wq_rows["float"]["param_bytes"], 3)
+    wq_rows["note"] = ("CPU smoke: the weight win is HBM bandwidth — "
+                       "the byte ratio is the signal, not the rate"
+                       if not on_tpu else "")
+    rows["weight_only"] = wq_rows
+    return rows
+
+
+# the spec-decode accept-rate delta bound between the bf16 and int8
+# pool forms — the cheap perplexity-drift proxy of ISSUE 14 (the same
+# constant gates the test in tests/test_serving_quantized.py)
+ACCEPT_RATE_GATE = 0.10
+
+
 def bench_spec_ablation(on_tpu, specs, cache_layout="contiguous"):
     """Speculative-decoding ablation (ISSUE 8): ``generate`` timed with
     spec off vs n-gram self-drafting, over the accept-rate sweep —
@@ -716,6 +887,47 @@ def _print_spec_table(details, out=None):
                 print(f"{layout:<12} {sweep:<12} {'x':<7} "
                       f"{srow['ngram_over_off']:>9} (ngram/off)",
                       file=out)
+
+
+def _print_cache_dtype_table(rows, out=None):
+    """Human-readable stderr table for the --cache-dtype ablation (the
+    JSON line is the machine record) — concurrency multiple, preempts,
+    the accept-rate gate verdict, and the weight byte ratio."""
+    import sys
+
+    out = sys.stderr if out is None else out
+    print("== quantized serving (--cache-dtype) ==", file=out)
+    if "error" in rows:
+        print(f"  ERROR: {rows['error']}", file=out)
+        return
+    print(f"{'wire':<6} {'blocks':>7} {'pool MB':>8} {'max conc':>9} "
+          f"{'preempt':>8} {'tok/s':>9}", file=out)
+    for wire in ("bf16", "int8"):
+        r = rows.get(wire)
+        if not isinstance(r, dict):
+            continue
+        print(f"{wire:<6} {r['num_blocks']:>7} "
+              f"{r['cache_bytes'] / 1e6:>8.2f} "
+              f"{r['max_concurrent_requests']:>9} "
+              f"{r['preemptions']:>8} {r['gen_tokens_per_sec']:>9.1f}",
+              file=out)
+    if "admitted_concurrency_multiple" in rows:
+        print(f"admitted concurrency multiple (int8/bf16): "
+              f"{rows['admitted_concurrency_multiple']} at pool-bytes "
+              f"ratio {rows['pool_bytes_ratio']}", file=out)
+    sg = rows.get("spec_accept_gate", {})
+    if "accept_rate_delta" in sg:
+        verdict = "OK" if sg.get("accept_gate_ok") else "FAILED"
+        print(f"spec accept-rate: bf16 {sg['bf16']['accept_rate']} vs "
+              f"int8 {sg['int8']['accept_rate']} (delta "
+              f"{sg['accept_rate_delta']} <= {ACCEPT_RATE_GATE}: "
+              f"{verdict}); greedy divergence "
+              f"{sg.get('greedy_divergence_rate')}", file=out)
+    wq = rows.get("weight_only", {})
+    if "weight_bytes_ratio" in wq:
+        print(f"weight-only int8: param bytes x{wq['weight_bytes_ratio']}"
+              f" of float ({wq['float']['param_bytes']} -> "
+              f"{wq['int8_weights']['param_bytes']})", file=out)
 
 
 # -- serve-trace: single-engine vs disaggregated topology (ISSUE 9) ---------
@@ -1602,6 +1814,15 @@ def main():
              "for the --serve-trace rows; raw is the token-identity "
              "form, bf16/int8 trade parity for wire bytes")
     parser.add_argument(
+        "--cache-dtype", default=None, metavar="DTYPES",
+        help="comma list of paged-pool at-rest forms (bf16, int8): "
+             "with --decode, run ONLY the quantized-serving ablation "
+             "(bench_cache_dtype_ablation — byte-matched admission "
+             "concurrency + preemption rows, the spec-decode "
+             "accept-rate delta gate, and the weight-only quantized "
+             "matmul rows) instead of the full inference matrix "
+             "(ISSUE 14)")
+    parser.add_argument(
         "--spec", default=None, metavar="SPECS",
         help="comma list of speculative-decoding modes (off, ngram): "
              "with --decode, run ONLY the spec ablation rows "
@@ -1609,6 +1830,20 @@ def main():
              "layout, stderr table with the accept-rate column) "
              "instead of the full inference matrix (ISSUE 8)")
     args = parser.parse_args()
+    cache_dtypes = None
+    if args.cache_dtype is not None:
+        cache_dtypes = tuple(
+            w.strip() for w in args.cache_dtype.split(",") if w.strip())
+        bad = [w for w in cache_dtypes if w not in ("bf16", "int8")]
+        if bad or not cache_dtypes:
+            parser.error(f"--cache-dtype {args.cache_dtype!r}: expected "
+                         "a comma list of bf16, int8")
+        if not args.decode:
+            parser.error("--cache-dtype only applies to the --decode "
+                         "rows")
+        if args.spec is not None:
+            parser.error("--cache-dtype and --spec are separate "
+                         "ablations; run them as separate invocations")
     spec_modes = None
     if args.spec is not None:
         spec_modes = tuple(
@@ -1746,6 +1981,36 @@ def main():
             "backend": platform,
             "skipped": False,
             "details": details,
+            "runtime": runtime_summary(),
+        }))
+        return
+    if args.decode and cache_dtypes:
+        try:
+            rows = bench_cache_dtype_ablation(on_tpu, cache_dtypes,
+                                              platform=platform)
+        except Exception as e:
+            rows = {"error": f"{type(e).__name__}: {e}"[:200]}
+        _print_cache_dtype_table(rows)
+        # a single-dtype run measures no multiple: the headline must
+        # carry a machine-readable caveat, not a 0.0 that reads as a
+        # catastrophic regression against the >= 1.8x gate
+        if "error" in rows:
+            skipped = f"bench_cache_dtype failed: {rows['error']}"
+        elif "admitted_concurrency_multiple" not in rows:
+            skipped = ("single-dtype run: no concurrency multiple "
+                       "(pass --cache-dtype bf16,int8)")
+        else:
+            skipped = False
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "metric": "quantized_serving_cache_dtype_ablation",
+            # headline: admitted concurrency at matched pool bytes,
+            # int8 over bf16 (the >= 1.8x ISSUE 14 acceptance gate)
+            "value": rows.get("admitted_concurrency_multiple", 0.0),
+            "unit": "x",
+            "backend": platform,
+            "skipped": skipped,
+            "details": {"cache_dtype_ablation": rows},
             "runtime": runtime_summary(),
         }))
         return

@@ -143,6 +143,8 @@ MODULES = [
      "models.generate — flash prefill + ragged KV-cache decoding"),
     ("apex_tpu.models.speculative", "models",
      "models.speculative — n-gram drafting + batched verification"),
+    ("apex_tpu.models.quantized", "models",
+     "models.quantized — weight-only int8 serving conversion"),
     ("apex_tpu.models.bert", "models", "models.bert"),
     ("apex_tpu.models.resnet", "models", "models.resnet"),
     # serving

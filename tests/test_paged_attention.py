@@ -114,6 +114,84 @@ class TestKernelParity:
                                        atol=2e-5, rtol=2e-5)
 
 
+class TestInt8PoolParity:
+    """ISSUE 14: the dequantizing kernel (scales dereferenced through
+    the same table index map, dequant in VMEM) vs the gather+dequant
+    reference — the same tail-block geometries as the float suite."""
+
+    def _quant_case(self, rng, *, b, mb, nb, bs, nh, g, dh, lens,
+                    dtype=jnp.float32):
+        from apex_tpu.serving.paged_cache import quantize_kv
+
+        q, kp, vp, tbl, lens_j = _case(
+            rng, b=b, mb=mb, nb=nb, bs=bs, nh=nh, g=g, dh=dh,
+            lens=lens, dtype=dtype)
+        kq, ks = quantize_kv(kp)
+        vq, vs = quantize_kv(vp)
+        return q, kp, vp, kq, ks, vq, vs, tbl, lens_j
+
+    @pytest.mark.parametrize("nh,g", [(4, 4), (8, 2), (4, 1)])
+    def test_block_boundary_lengths_fp32(self, nh, g):
+        """lens straddle every boundary class: bs-aligned, one past,
+        one short — kernel == dequantizing reference fp32-tight."""
+        bs = 8
+        rng = np.random.RandomState(20)
+        (q, _kp, _vp, kq, ks, vq, vs, tbl, lens) = self._quant_case(
+            rng, b=4, mb=4, nb=16, bs=bs, nh=nh, g=g, dh=64,
+            lens=[2 * bs, 2 * bs + 1, 3 * bs - 1, 1])
+        ref = paged_attention_reference(q, kq, vq, tbl, lens,
+                                        k_scale=ks, v_scale=vs)
+        ker = ragged_paged_attention(q, kq, vq, tbl, lens,
+                                     backend="kernel",
+                                     k_scale=ks, v_scale=vs)
+        np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_quantization_error_bounded_vs_float_pool(self):
+        """The dequantized attention tracks the float-pool oracle
+        within the per-(token, group) int8 budget — loose, but a real
+        bound: a broken scale layout shows up as O(1) error."""
+        bs = 8
+        rng = np.random.RandomState(21)
+        (q, kp, vp, kq, ks, vq, vs, tbl, lens) = self._quant_case(
+            rng, b=3, mb=3, nb=12, bs=bs, nh=4, g=2, dh=64,
+            lens=[bs, bs + 1, 2 * bs - 1])
+        full = paged_attention_reference(q, kp, vp, tbl, lens)
+        quant = ragged_paged_attention(q, kq, vq, tbl, lens,
+                                       backend="kernel",
+                                       k_scale=ks, v_scale=vs)
+        np.testing.assert_allclose(np.asarray(quant), np.asarray(full),
+                                   atol=5e-2, rtol=5e-2)
+
+    def test_bf16_queries_loose(self):
+        bs = 8
+        rng = np.random.RandomState(22)
+        (q, _kp, _vp, kq, ks, vq, vs, tbl, lens) = self._quant_case(
+            rng, b=2, mb=3, nb=8, bs=bs, nh=4, g=2, dh=64,
+            lens=[2 * bs, bs + 1], dtype=jnp.bfloat16)
+        ref = paged_attention_reference(q, kq, vq, tbl, lens,
+                                        k_scale=ks, v_scale=vs)
+        ker = ragged_paged_attention(q, kq, vq, tbl, lens,
+                                     backend="kernel",
+                                     k_scale=ks, v_scale=vs)
+        np.testing.assert_allclose(
+            np.asarray(ker, np.float32), np.asarray(ref, np.float32),
+            atol=2e-2, rtol=2e-2)
+
+    def test_scale_validation(self):
+        rng = np.random.RandomState(23)
+        (q, kp, vp, kq, ks, vq, vs, tbl, lens) = self._quant_case(
+            rng, b=2, mb=2, nb=4, bs=4, nh=2, g=2, dh=64, lens=[5, 3])
+        with pytest.raises(ValueError, match="int8 pools need"):
+            ragged_paged_attention(q, kq, vq, tbl, lens)
+        with pytest.raises(ValueError, match="only apply to int8"):
+            ragged_paged_attention(q, kp, vp, tbl, lens,
+                                   k_scale=ks, v_scale=vs)
+        with pytest.raises(ValueError, match="expected scales"):
+            ragged_paged_attention(q, kq, vq, tbl, lens,
+                                   k_scale=ks[:, :2], v_scale=vs)
+
+
 class TestRoutingAndValidation:
     def test_backend_routing(self, monkeypatch):
         rng = np.random.RandomState(4)

@@ -558,3 +558,54 @@ def test_audit_summary_tier_c_row(report, tmp_path):
 
     # tier-C counters alone (no jaxpr entries) still produce a report
     assert "moe_ragged" not in audit
+
+
+def test_quantized_cache_summary_from_stream(report, tmp_path):
+    """ISSUE 14 satellite: the dtype-tagged serving.cache_* gauges fold
+    into bytes-per-resident-token per dtype, pool high-water, and —
+    with both ablation dtypes in one stream — the implied admission
+    multiple at matched pool bytes."""
+    f = tmp_path / "quant.jsonl"
+    rows = []
+    for dtype, cb, cap, hw in (("bfloat16", 393216, 3072, 20),
+                               ("int8", 391680, 5760, 38)):
+        tags = '"tags":{"dtype":"%s"}' % dtype
+        rows += [
+            '{"schema_version":3,"t":1,"type":"gauge",'
+            '"name":"serving.cache_bytes","value":%d,%s}' % (cb, tags),
+            '{"schema_version":3,"t":2,"type":"gauge",'
+            '"name":"serving.cache_capacity_tokens","value":%d,%s}'
+            % (cap, tags),
+            '{"schema_version":3,"t":3,"type":"gauge",'
+            '"name":"serving.cache_blocks_hw","value":%d,%s}'
+            % (hw, tags),
+        ]
+    f.write_text("\n".join(rows) + "\n")
+    summ = report.summarize(report.load_records([str(f)]))
+    # tagged gauges keep their tag suffix as distinct series
+    assert "serving.cache_bytes{dtype=int8}" in summ["gauges"]
+    q = report.quantized_cache_summary(summ)
+    bf = q["dtypes"]["bfloat16"]
+    i8 = q["dtypes"]["int8"]
+    assert bf["bytes_per_token"] == 393216 / 3072   # 128 B/token
+    assert i8["bytes_per_token"] == 391680 / 5760   # 68 B/token
+    assert i8["pool_high_water_blocks"] == 38
+    assert q["cheapest"] == "int8" and q["dearest"] == "bfloat16"
+    assert abs(q["admission_multiple"] - 128 / 68) < 1e-9
+    out = io.StringIO()
+    report.print_report(summ, out=out)
+    text = out.getvalue()
+    assert "quantized KV cache" in text
+    assert "admission multiple at matched bytes" in text
+    assert "1.88x" in text
+
+    # one dtype only: per-dtype rows, no multiple
+    single = report.quantized_cache_summary({
+        "gauges": {"serving.cache_bytes{dtype=int8}": [100.0],
+                   "serving.cache_capacity_tokens{dtype=int8}": [50.0]}})
+    assert single["dtypes"]["int8"]["bytes_per_token"] == 2.0
+    assert single["admission_multiple"] is None
+
+    # a pre-ISSUE-14 stream -> no section
+    assert report.quantized_cache_summary(
+        {"gauges": {"serving.blocks_in_use": [1.0]}}) is None

@@ -21,6 +21,9 @@ ended — costs the least-valuable stages:
    then ``bench.py --decode --spec off,ngram --cache-layout
    contiguous,paged`` — the speculative-decoding ablation (ISSUE 8):
    accept-rate sweep rows + the stderr accept-rate table;
+   then ``bench.py --decode --cache-dtype bf16,int8`` — the quantized
+   serving ablation (ISSUE 14): byte-matched pool admission rows, the
+   spec accept-rate delta gate, weight-only matmul rows;
    then ``bench.py --tp-overlap`` — the ring collective-matmul off/on
    ablation rows — and the ``tp_overlap`` dryrun parity phase
    (overlapped == monolithic fwd+bwd on the 8-virtual-device mesh).
@@ -201,6 +204,14 @@ def main():
         "bench_spec", [sys.executable, "bench.py", "--decode",
                        "--spec", "off,ngram",
                        "--cache-layout", "contiguous,paged"],
+        timeout=3600)
+    # quantized serving (ISSUE 14): byte-matched bf16-vs-int8 pool
+    # admission rows (the >= 1.8x concurrency gate), the spec-decode
+    # accept-rate delta gate, and the weight-only quantized matmul
+    # byte/rate rows — its own JSON line + stderr gate table
+    results["bench_cache_dtype"] = _run(
+        "bench_cache_dtype", [sys.executable, "bench.py", "--decode",
+                              "--cache-dtype", "bf16,int8"],
         timeout=3600)
     # TP comm overlap (ISSUE 5): the ring collective-matmul off/on
     # ablation rows, then the tp_overlap dryrun parity phase alone on

@@ -188,7 +188,13 @@ def summarize(records: List[dict]) -> dict:
                 truncated[name + _tags_suffix(rec.get("tags"))] = v
         elif rtype == "gauge" and name is not None:
             try:
-                gauges.setdefault(name, []).append(float(rec["value"]))
+                # tagged gauges keep their tag suffix (ISSUE 14: the
+                # per-dtype serving.cache_* series must stay separable
+                # when one stream holds both ablation engines);
+                # untagged gauges keep their historical bare keys
+                gauges.setdefault(
+                    name + _tags_suffix(rec.get("tags")), []).append(
+                        float(rec["value"]))
             except (KeyError, TypeError, ValueError):
                 pass
         elif rtype == "event" and name is not None:
@@ -456,6 +462,46 @@ def serving_summary(summary: dict) -> Optional[dict]:
     }
 
 
+def quantized_cache_summary(summary: dict) -> Optional[dict]:
+    """Derived view of the at-rest KV-pool accounting (ISSUE 14): the
+    ``serving.cache_bytes{dtype=}`` / ``serving.cache_capacity_tokens
+    {dtype=}`` / ``serving.cache_blocks_hw{dtype=}`` gauges, folded
+    per dtype into bytes-per-resident-token and — when the stream
+    holds two dtypes (the ``--cache-dtype`` ablation) — the implied
+    admission multiple at matched pool bytes (tokens-per-byte ratio of
+    the cheapest form over the dearest).  None when the stream carries
+    no cache_bytes series (pre-ISSUE-14 writers)."""
+    gauges = summary["gauges"]
+    per_dtype: Dict[str, dict] = {}
+    for key, vals in gauges.items():
+        if not key.startswith("serving.cache_bytes{dtype="):
+            continue
+        dtype = key[len("serving.cache_bytes{dtype="):].rstrip("}")
+        cap = gauges.get(
+            f"serving.cache_capacity_tokens{{dtype={dtype}}}")
+        hw = gauges.get(f"serving.cache_blocks_hw{{dtype={dtype}}}")
+        entry = {
+            "cache_bytes": vals[-1],
+            "capacity_tokens": cap[-1] if cap else None,
+            "pool_high_water_blocks": max(hw) if hw else None,
+            "bytes_per_token": (vals[-1] / cap[-1])
+            if cap and cap[-1] else None,
+        }
+        per_dtype[dtype] = entry
+    if not per_dtype:
+        return None
+    out = {"dtypes": per_dtype, "admission_multiple": None}
+    rated = {d: e["bytes_per_token"] for d, e in per_dtype.items()
+             if e["bytes_per_token"]}
+    if len(rated) >= 2:
+        cheap = min(rated, key=rated.get)
+        dear = max(rated, key=rated.get)
+        out["admission_multiple"] = rated[dear] / rated[cheap]
+        out["cheapest"] = cheap
+        out["dearest"] = dear
+    return out
+
+
 def print_report(summary: dict, out=None) -> None:
     out = sys.stdout if out is None else out
     if summary["unknown_schema"]:
@@ -595,6 +641,24 @@ def print_report(summary: dict, out=None) -> None:
             print(f"  tier C (concurrency stress): {flag}", file=out)
             print("    "
                   + "  ".join(f"{k} {v:g}" for k, v in s.items()),
+                  file=out)
+    qcache = quantized_cache_summary(summary)
+    if qcache:
+        print("== quantized KV cache (serving.cache_bytes{dtype=}) ==",
+              file=out)
+        for dtype, e in sorted(qcache["dtypes"].items()):
+            bpt = e["bytes_per_token"]
+            line = f"  {dtype}: pool {e['cache_bytes']:g} B"
+            if bpt is not None:
+                line += f"  {bpt:.4g} B/resident-token"
+            if e["pool_high_water_blocks"] is not None:
+                line += (f"  high-water "
+                         f"{e['pool_high_water_blocks']:g} blocks")
+            print(line, file=out)
+        if qcache["admission_multiple"] is not None:
+            print(f"  admission multiple at matched bytes: "
+                  f"{qcache['admission_multiple']:.3g}x "
+                  f"({qcache['cheapest']} over {qcache['dearest']})",
                   file=out)
     serving = serving_summary(summary)
     if serving:
