@@ -100,6 +100,11 @@ ENV_REGISTRY: Dict[str, EnvVar] = dict([
        "docs/inference.md",
        "weight-only int8 dense/grouped matmul routing "
        "(kernel|reference|auto)"),
+    # ---- serving knobs -----------------------------------------------
+    _v("APEX_TPU_CHUNK_TOKENS", "apex_tpu.serving.engine",
+       "docs/serving.md",
+       "chunked-prefill chunk size override (positive int; off/0 "
+       "forces monolithic prefill)"),
     # ---- training / parallel knobs -----------------------------------
     _v("APEX_TPU_ALLOW_FP16", "apex_tpu.amp.policy",
        "docs/amp.md", "permit raw fp16 on TPU (default maps to bf16)"),

@@ -59,7 +59,8 @@ from apex_tpu.observability import metrics as _telemetry
 from apex_tpu.ops.fused_sampling import fused_sample
 
 __all__ = ["init_kv_cache", "decode_step", "decode_verify", "prefill",
-           "generate", "sample_logits", "extract_kv", "inject_kv"]
+           "prefill_chunked", "generate", "sample_logits", "extract_kv",
+           "inject_kv"]
 
 
 DEFAULT_BLOCK_SIZE = 16
@@ -850,6 +851,117 @@ def prefill(
         "pos": lens,
     }
     return logits, cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _prefill_chunk_forward(params, chunk, cache, cfg):
+    """One jitted chunk of a chunked prefill: ``chunk`` [b, m] appends
+    at ``cache['pos']`` and attends to the already-written KV prefix
+    plus itself causally — exactly a verification forward, so this IS
+    :func:`decode_verify` under a shape-keyed jit (equal chunk sizes
+    share one compile; the serving engine additionally pins its chunk
+    shape to a single bucket)."""
+    return decode_verify(params, chunk, cache, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache",))
+def _prefill_chunk_forward_donated(params, chunk, cache, cfg):
+    """The donated form for chunks after the first: their input cache
+    is loop-local (the previous chunk's output), so the pool updates
+    in place instead of copying the whole K/V buffer per chunk.  The
+    FIRST chunk must not donate — its cache belongs to the caller."""
+    return decode_verify(params, chunk, cache, cfg)
+
+
+def prefill_chunked(
+    params: dict,
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    chunk_tokens: int,
+    prompt_lens: Optional[jax.Array] = None,
+    cache: Optional[dict] = None,
+    max_len: Optional[int] = None,
+    cache_dtype=None,
+):
+    """Chunked prefill (ISSUE 15, Sarathi-style): consume a prompt
+    [b, s] in ``ceil(s / chunk_tokens)`` fixed-size forwards instead of
+    one monolithic pass → (last-real-token logits [b, v], filled KV
+    cache) — the same contract as :func:`prefill`.
+
+    Each chunk is ONE batched forward whose queries attend to the KV
+    prefix the earlier chunks already wrote plus the chunk itself
+    causally — the verification-block attention pattern
+    (:func:`decode_verify`), which is why chunk c's compute is
+    O(chunk · (c·chunk)) and the total stays the O(s²) of the
+    monolithic prefill: nothing is recomputed, only *scheduled*
+    differently.  That scheduling is the point: a serving engine can
+    interleave decode steps for co-resident requests between chunks,
+    so a 32k-token prompt stalls its neighbors for one ``chunk_tokens``
+    forward at a time instead of one 32k forward
+    (``ServingEngine(chunk_tokens=...)`` builds on this; the TPOT
+    interference bound is measured by ``bench.py``'s chunked
+    starvation row).
+
+    Greedy-token-identity: the final chunk's last-token logits ARE the
+    first-token logits — ``argmax`` equal to :func:`prefill`'s, and a
+    greedy continuation from the chunked cache is token-identical to
+    one from the monolithic cache on BOTH cache layouts
+    (tests/test_serving_chunked.py pins it; K/V written by a chunk
+    may differ from the monolithic writer's in low-order bits — flash
+    vs verify accumulation order — which is also why the serving
+    engine never prefix-shares chunk-written blocks).  On an int8
+    ``cache_wire`` pool later chunks read the *quantized* prefix
+    (monolithic prefill quantizes only at the final scatter), so the
+    contract there is the PR-14 one: deterministic,
+    first-token-identical, trajectory may diverge.
+
+    Ragged batches: ``prompt_lens`` [b] marks real row lengths.  Rows
+    whose prompt ends inside an earlier chunk ride later chunks
+    inertly — their writes land past their length (invisible to every
+    masked read, overwritten by decode before it ever attends there)
+    and their last-token logits are taken from the chunk that held
+    position ``lens[i]-1``.
+
+    ``cache`` / ``max_len`` / ``cache_dtype`` behave as in
+    :func:`prefill`; a paged cache (``block_tables`` present, int8
+    ``cache_wire`` included) scatters each chunk through its block
+    tables via the existing verify write edges.
+    """
+    _check_decode_cfg(cfg)
+    if chunk_tokens < 1:
+        raise ValueError(
+            f"chunk_tokens={chunk_tokens} must be >= 1")
+    b, s = prompt.shape
+    if cache is None:
+        cache = init_kv_cache(cfg, b, max_len if max_len else s,
+                              cache_dtype=cache_dtype)
+    paged = "block_tables" in cache
+    cache_len = (cache["block_tables"].shape[1] * cache["k"].shape[2]
+                 if paged else cache["k"].shape[2])
+    if s > cache_len:
+        raise ValueError(
+            f"prompt length {s} exceeds the cache max_len {cache_len}")
+    lens = (jnp.full((b,), s, jnp.int32) if prompt_lens is None
+            else jnp.asarray(prompt_lens, jnp.int32))
+    logits_last = None
+    for lo in range(0, s, chunk_tokens):
+        hi = min(s, lo + chunk_tokens)
+        # rows already complete park at their own length: their chunk
+        # writes land past it (masked reads never see them, decode
+        # overwrites them in place) and their pos is restored below
+        cache = dict(cache, pos=jnp.minimum(lens, lo))
+        fwd = (_prefill_chunk_forward if lo == 0
+               else _prefill_chunk_forward_donated)
+        logits, cache = fwd(params, prompt[:, lo:hi], cache, cfg)
+        take = jnp.clip(lens - 1 - lo, 0, hi - lo - 1)
+        lg = jnp.take_along_axis(
+            logits, take[:, None, None], axis=1)[:, 0]
+        hit = (lens - 1 >= lo) & (lens - 1 < hi)
+        logits_last = (lg if logits_last is None
+                       else jnp.where(hit[:, None], lg, logits_last))
+    return logits_last, dict(cache, pos=lens)
 
 
 def sample_logits(logits, key, *, temperature: float = 0.0,

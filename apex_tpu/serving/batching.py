@@ -9,7 +9,12 @@ independently testable:
   the compile cache at ``len(buckets)`` entries (default: powers of two,
   O(log max_len)) no matter how many requests arrive — the classic
   static-shape serving trade: a few wasted padded columns per prefill
-  against an unbounded recompile tail.
+  against an unbounded recompile tail.  Chunked prefill (ISSUE 15)
+  takes the discipline to its limit: chunks are their own one-rung
+  ladder — every chunk is exactly ``chunk_tokens`` wide (tail chunks
+  right-padded through :func:`pad_prompt`, same left-aligned
+  contract), so streaming a long prompt adds exactly ONE compile to
+  the engine's budget.
 - **slot pool** — free-list arithmetic over the cache's batch axis.
   A slot is one row of the engine's pre-allocated KV cache; admission
   claims a free slot, completion releases it.

@@ -24,7 +24,12 @@ splits them:
   decode placement, requeue-on-worker-death, ``cluster.*`` telemetry,
   ``/healthz`` degradation latching via the pool-stall detector, and
   autoscaling hints fused from live scrapes + windowed
-  ``aggregate_telemetry`` fleet summaries.
+  ``aggregate_telemetry`` fleet summaries;
+- :mod:`~apex_tpu.serving.cluster.controller` — the elastic pool
+  controller (ISSUE 15) that ACTS on those hints: hysteresis-damped
+  spawn/drain of pool members, with scale-down draining losslessly
+  (in-flight KV migrated to survivors over the bit-exact raw handoff
+  wire) before the process is reaped.
 
 ``bench.py --serve-trace`` replays a bursty open-loop trace against a
 single engine and the two-process disaggregated topology on one host;
@@ -32,6 +37,9 @@ single engine and the two-process disaggregated topology on one host;
 has the topology diagram and the wire format.
 """
 
+from apex_tpu.serving.cluster.controller import (  # noqa: F401
+    PoolController,
+)
 from apex_tpu.serving.cluster.handoff import (  # noqa: F401
     WIRE_DTYPES,
     decode_kv,
@@ -57,6 +65,7 @@ from apex_tpu.serving.cluster.worker import (  # noqa: F401
 __all__ = [
     "DEFAULT_CLASS_PRIORITY",
     "ClusterResponse",
+    "PoolController",
     "ProtocolError",
     "Router",
     "RouterBusy",

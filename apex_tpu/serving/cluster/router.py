@@ -100,6 +100,7 @@ class ClusterResponse:
     decode_steps: int = 0
     preemptions: int = 0
     requeues: int = 0              # decode-worker deaths survived
+    migrations: int = 0            # scale-down drains survived
     handoff_bytes: int = 0
     pool: str = ""                 # decode worker that finished it
     slo_met: bool = True
@@ -119,6 +120,33 @@ class _Pending:
     prefill_ms: float = 0.0
     handoff_bytes: int = 0
     requeues: int = 0
+    # tokens already generated before a scale-down migration moved the
+    # request to a survivor (ISSUE 15): the survivor's response carries
+    # only its own half, and _finalize stitches prior + survivor back
+    # into the full sequence.  Reset whenever the request goes back
+    # through a fresh prefill dispatch (which regenerates everything).
+    prior_tokens: List[int] = dataclasses.field(default_factory=list)
+    migrations: int = 0
+    # source-leg accounting carried across migrations (the survivor's
+    # response covers only its own leg)
+    prior_preemptions: int = 0
+    prior_decode_steps: int = 0
+
+
+def _headroom_tokens(stats: dict) -> float:
+    """Free capacity of one worker in TOKENS ADMITTABLE (ISSUE 15
+    satellite: block counts lie across block sizes, bytes lie across
+    ``cache_wire`` forms — an int8 pool holds ~1.88x the blocks at
+    matched bytes).  Tokens are the one unit every pool form shares.
+    Older workers without the key fall back to blocks x the worker's
+    allocation unit (a block on paged workers, a whole ``max_len``
+    stripe on contiguous ones) — consistent ordering within a
+    homogeneous pool.  Dispatch ordering (``_pick_decode``) and the
+    autoscale hint MUST share this conversion or they disagree about
+    the same worker's capacity."""
+    unit = stats.get("block_size") or stats.get("max_len", 1)
+    return stats.get("headroom_tokens",
+                     stats.get("free_block_headroom", 0) * unit)
 
 
 class _Worker:
@@ -134,6 +162,10 @@ class _Worker:
         # is stepped, never shared) — annotated so APX502 catches a
         # future background poller mutating worker state
         self.alive = True                        # guarded-by: confined(router-thread)
+        # draining (ISSUE 15): the elastic controller marked this
+        # worker for scale-down — no NEW work lands on it while its
+        # in-flight state migrates to survivors
+        self.draining = False                    # guarded-by: confined(router-thread)
         self.stats: dict = {}                    # guarded-by: confined(router-thread)
         self.in_flight: Dict[int, _Pending] = {}  # guarded-by: confined(router-thread)
         # dispatches since the last stats refresh: the stats snapshot
@@ -203,6 +235,7 @@ class Router:
         if not prefill or not decode:
             raise ValueError("need at least one prefill and one decode "
                              "worker address")
+        self._rpc_timeout = float(rpc_timeout)
         self._prefill = [_Worker(a, "prefill", rpc_timeout)
                          for a in prefill]
         self._decode = [_Worker(a, "decode", rpc_timeout)
@@ -225,6 +258,9 @@ class Router:
         self._last_decode_pick: Optional[str] = None
         self._requeued_total = 0
         self._completed_total = 0
+        # responses banked by drain_worker (completed-but-unpolled at
+        # the drained worker), collected via take_drain_completions
+        self._drain_completed: List[ClusterResponse] = []   # guarded-by: confined(router-thread)
 
     # -- admission ----------------------------------------------------------
 
@@ -264,23 +300,32 @@ class Router:
 
     def step(self) -> List[ClusterResponse]:
         """One router cycle: collect completions from every decode
-        worker, then dispatch as much queued work as the pools have
-        appetite for.  Returns the requests completed this cycle."""
+        worker — responses a scale-down drain banked included, so a
+        plain submit+step driver never loses a drain-time finish —
+        then dispatch as much queued work as the pools have appetite
+        for.  Returns the requests completed this cycle."""
         completed = self._poll_decode()
+        completed.extend(self.take_drain_completions())
         self._dispatch()
         self._set_gauges()
         return completed
 
-    def run(self, max_wall_s: float = 300.0,
-            poll_s: float = 0.005) -> List[ClusterResponse]:
+    def run(self, max_wall_s: float = 300.0, poll_s: float = 0.005,
+            on_step=None) -> List[ClusterResponse]:
         """Drive :meth:`step` until every queued/in-flight request
         completed (or the wall budget runs out — whatever is still
-        pending stays pending, visible in :meth:`stats`)."""
+        pending stays pending, visible in :meth:`stats`).  ``on_step``
+        (no-arg callable) runs every cycle on THIS thread — the
+        elastic controller's ``maybe_tick`` rides here so its state
+        stays inside the router's single-thread confinement."""
         out: List[ClusterResponse] = []
         deadline = time.time() + max_wall_s
         while self.pending and time.time() < deadline:
             got = self.step()
             out.extend(got)
+            if on_step is not None:
+                on_step()
+                out.extend(self.take_drain_completions())
             if not got and self.pending:
                 if not any(w.alive for w in self._decode):
                     raise RuntimeError(
@@ -291,12 +336,14 @@ class Router:
         return out
 
     def run_trace(self, trace: Sequence[Tuple[float, dict]],
-                  max_wall_s: float = 300.0) -> List[ClusterResponse]:
+                  max_wall_s: float = 300.0,
+                  on_step=None) -> List[ClusterResponse]:
         """Open-loop replay: submit each ``(t_offset_s, submit_kwargs)``
         at its offset from now — arrivals do NOT wait for completions
         (the load a real fleet sees) — stepping continuously; then
         drain.  Requests a cap rejects are dropped from the replay (the
-        shed-load outcome) and counted in ``cluster.rejected``."""
+        shed-load outcome) and counted in ``cluster.rejected``.
+        ``on_step`` as in :meth:`run` (the controller hook)."""
         t0 = time.perf_counter()
         order = sorted(trace, key=lambda item: item[0])
         i = 0
@@ -311,6 +358,9 @@ class Router:
                 i += 1
             got = self.step()
             out.extend(got)
+            if on_step is not None:
+                on_step()
+                out.extend(self.take_drain_completions())
             if i < len(order):
                 wait = min(order[i][0] - (time.perf_counter() - t0),
                            0.002)
@@ -362,7 +412,8 @@ class Router:
         return ranked[0] if ranked else None
 
     def _pick_prefill(self) -> Optional[_Worker]:
-        alive = [w for w in self._prefill if w.alive]
+        alive = [w for w in self._prefill
+                 if w.alive and not w.draining]
         if not alive:
             return None
         w = alive[self._pf_rr % len(alive)]
@@ -379,14 +430,29 @@ class Router:
         would forfeit the interactive-ahead-of-batch property)."""
         best, best_key = None, None
         for w in self._decode:
-            if not w.alive:
+            if not w.alive or w.draining:
                 continue
             backlog = (w.stats.get("queued", 0)
                        + w.dispatched_since_poll)
             if backlog >= self._max_worker_queue:
                 continue
-            key = (w.stats.get("free_block_headroom", 0)
-                   - w.dispatched_since_poll, -backlog)
+            # headroom in TOKENS ADMITTABLE (ISSUE 15 satellite):
+            # block counts lie across heterogeneous block sizes and
+            # bytes lie across cache_wire forms (an int8 pool holds
+            # ~1.88x the blocks at matched bytes) — tokens are the one
+            # unit every pool form shares.  The dispatch correction
+            # estimates one allocation unit per dispatch-since-poll —
+            # a block on paged workers, a whole max_len stripe on
+            # contiguous ones (slot admission reserves the stripe) —
+            # matching the historical per-unit arithmetic in both
+            # layouts.  Older workers without the key fall back to
+            # block units (consistent ordering within a homogeneous
+            # pool).
+            unit = (w.stats.get("block_size")
+                    or w.stats.get("max_len", 1))
+            key = (_headroom_tokens(w.stats)
+                   - w.dispatched_since_poll * unit,
+                   -backlog)
             if best_key is None or key > best_key:
                 best, best_key = w, key
         return best
@@ -429,8 +495,14 @@ class Router:
                     return
                 continue                    # retry on the next worker
             except RuntimeError as e:
-                # an application-level refusal is deterministic —
-                # requeueing would loop forever.  Fail the request
+                if "draining" in str(e):
+                    # an externally drain-flagged prefill worker:
+                    # adopt the flag and retry on the next member
+                    pf.draining = True
+                    self._queues[cls].appendleft(pend)
+                    continue
+                # any other application-level refusal is deterministic
+                # — requeueing would loop forever.  Fail the request
                 # loudly instead of wedging the class queue.
                 _telemetry.counter("cluster.failed",
                                    {"slo_class": cls}).inc()
@@ -457,20 +529,29 @@ class Router:
                 }, blobs)
             except WorkerDied as e:
                 self._feed_pool("decode", False, str(e))
-                pend.requeues += 1
-                self._requeued_total += 1
-                _telemetry.counter("cluster.requeued").inc()
-                self._queues[cls].appendleft(pend)
+                self._requeue_pending(pend)
                 if not any(w.alive for w in self._decode):
                     return
                 continue
             except RuntimeError as e:
+                if "draining" in str(e):
+                    # the worker told us it is draining before our own
+                    # flag landed (another router, an external drain):
+                    # adopt the flag so _pick_decode routes around it
+                    # and requeue — a drain refusal is backpressure,
+                    # never a lost request
+                    target.draining = True
+                    self._queues[cls].appendleft(pend)
+                    continue
                 _telemetry.counter("cluster.failed",
                                    {"slo_class": cls}).inc()
                 _telemetry.event("cluster.request.failed",
                                  rid=pend.rid, error=str(e)[:200])
                 continue
             self._feed_pool("decode", True)
+            # a fresh prefill dispatch regenerates the whole sequence:
+            # any migration-carried prefix would now double-count
+            pend.prior_tokens = []
             target.in_flight[pend.rid] = pend
             target.dispatched_since_poll += 1
             if (self._last_decode_pick is not None
@@ -515,22 +596,38 @@ class Router:
         self._completed_total += len(completed)
         return completed
 
+    def _requeue_pending(self, pend: _Pending) -> None:
+        """Put one in-flight request back at the FRONT of its class
+        queue for a fresh prefill→decode dispatch (worker death, or a
+        drain record that could not migrate).  The fresh dispatch
+        regenerates the whole sequence, so any migration-carried
+        prefix is dropped here."""
+        pend.prior_tokens = []
+        pend.prior_preemptions = 0
+        pend.prior_decode_steps = 0
+        pend.requeues += 1
+        self._requeued_total += 1
+        _telemetry.counter("cluster.requeued").inc()
+        self._queues.setdefault(pend.slo_class,
+                                deque()).appendleft(pend)
+
     def _requeue_worker(self, w: _Worker) -> None:
         """A decode worker died: everything in flight on it goes BACK
         to the front of its class queue (re-prefill + re-dispatch —
         requests are never lost, the kill-a-worker soak pins it)."""
         for rid, pend in sorted(w.in_flight.items(), reverse=True):
-            pend.requeues += 1
-            self._requeued_total += 1
-            _telemetry.counter("cluster.requeued").inc()
-            self._queues.setdefault(pend.slo_class,
-                                    deque()).appendleft(pend)
+            self._requeue_pending(pend)
         w.in_flight.clear()
 
     def _finalize(self, pend: _Pending, rec: dict,
                   w: _Worker) -> ClusterResponse:
         now = time.perf_counter()
         tokens = np.asarray(rec.get("tokens", []), np.int32)
+        if pend.prior_tokens:
+            # scale-down migration (ISSUE 15): the survivor generated
+            # only the post-migration half — stitch the full sequence
+            tokens = np.concatenate([
+                np.asarray(pend.prior_tokens, np.int32), tokens])
         e2e_ms = (now - pend.submitted_t) * 1e3
         ttft_ms = ((pend.first_token_t or now)
                    - pend.submitted_t) * 1e3
@@ -558,13 +655,188 @@ class Router:
             tpot_ms=tpot or 0.0,
             e2e_ms=e2e_ms,
             prefill_ms=pend.prefill_ms,
-            decode_steps=int(rec.get("decode_steps", 0)),
-            preemptions=int(rec.get("preemptions", 0)),
+            decode_steps=(pend.prior_decode_steps
+                          + int(rec.get("decode_steps", 0))),
+            preemptions=(pend.prior_preemptions
+                         + int(rec.get("preemptions", 0))),
             requeues=pend.requeues,
+            migrations=pend.migrations,
             handoff_bytes=pend.handoff_bytes,
             pool=w.addr,
             slo_met=met,
         )
+
+    # -- elastic pool management (ISSUE 15) ---------------------------------
+
+    def _pool_list(self, pool: str) -> List[_Worker]:
+        if pool not in ("prefill", "decode"):
+            raise ValueError(
+                f"pool={pool!r}: expected 'prefill' or 'decode'")
+        return self._prefill if pool == "prefill" else self._decode
+
+    def _find_worker(self, addr: str) -> _Worker:
+        for w in self._prefill + self._decode:
+            if w.addr == addr:
+                return w
+        raise ValueError(f"no worker at {addr!r}")
+
+    def add_worker(self, addr: str, pool: str) -> None:
+        """Attach a new pool member at runtime — the elastic
+        controller's scale-up edge.  Same hello handshake as
+        construction (a mis-wired role is refused loudly); the worker
+        becomes dispatchable on the next cycle."""
+        workers = self._pool_list(pool)
+        w = _Worker(addr, pool, self._rpc_timeout)
+        reply, _ = w.rpc({"op": "hello"})
+        if reply.get("role") != pool:
+            w.kill()
+            raise ValueError(
+                f"{addr} answered role={reply.get('role')!r}, "
+                f"expected {pool!r} — check the pool wiring")
+        workers.append(w)
+        _telemetry.counter("cluster.workers_added",
+                           {"pool": pool}).inc()
+
+    def remove_worker(self, addr: str) -> None:
+        """Detach a pool member (scale-down's final edge, after
+        :meth:`drain_worker` migrated its state — or a hard removal,
+        in which case any in-flight requests requeue like a death)."""
+        w = self._find_worker(addr)
+        if w.in_flight:
+            self._requeue_worker(w)
+        w.kill()
+        for pool in (self._prefill, self._decode):
+            if w in pool:
+                pool.remove(w)
+        _telemetry.counter("cluster.workers_removed",
+                           {"pool": w.pool}).inc()
+
+    def drain_worker(self, addr: str) -> dict:
+        """LOSSLESS scale-down (ISSUE 15): stop admitting onto the
+        worker, pull every in-flight request's state out of it, and
+        migrate each one onto a survivor → ``{"migrated", "requeued",
+        "completed"}`` counts.
+
+        A decode worker answers the ``drain`` RPC with one record per
+        live lane — the cache's token sequence, the pending token, the
+        remaining budget, and the per-token K/V on the RAW wire
+        (bit-exact by contract: a migration must not change one
+        token) — plus the rids of its still-queued requests and any
+        completed-but-unpolled responses.  Each live record re-enters
+        a survivor through the SAME decode RPC a prefill handoff uses
+        (the router never deserializes the blobs), with the
+        already-generated prefix parked on the pending entry for
+        :meth:`_finalize` to stitch back.  Requests that cannot
+        migrate (no survivor headroom, survivor refused, or the worker
+        died mid-drain) requeue at the FRONT of their class queue for
+        a fresh prefill→decode dispatch — slower, never lost.
+
+        Prefill workers hold no request state: draining one is just
+        the flag (dispatch routes around it immediately)."""
+        w = self._find_worker(addr)
+        w.draining = True
+        out = {"migrated": 0, "requeued": 0, "completed": 0}
+        if w.pool == "prefill":
+            return out
+        completed: List[ClusterResponse] = []
+        try:
+            reply, blobs = w.rpc({"op": "drain"})
+        except (WorkerDied, RuntimeError) as e:
+            self._feed_pool("decode", False, str(e))
+            n = len(w.in_flight)
+            self._requeue_worker(w)
+            out["requeued"] = n
+            return out
+        # completed-but-unpolled responses ride the drain reply so
+        # they are not lost with the worker
+        for rec in reply.get("responses", []):
+            pend = w.in_flight.pop(rec["rid"], None)
+            if pend is not None:
+                completed.append(self._finalize(pend, rec, w))
+        bi = 0
+        to_requeue: List[_Pending] = []
+        for rec in reply.get("live", []):
+            nb = int(rec.get("n_blobs", 0))
+            rblobs = blobs[bi: bi + nb]
+            bi += nb
+            pend = w.in_flight.pop(rec["rid"], None)
+            if pend is None:
+                continue
+            if self._migrate(pend, rec, rblobs):
+                out["migrated"] += 1
+            else:
+                to_requeue.append(pend)
+        for rid in reply.get("requeue", []):
+            pend = w.in_flight.pop(rid, None)
+            if pend is not None:
+                to_requeue.append(pend)
+        # NEWEST first so the last appendleft leaves the OLDEST at the
+        # queue front — the same age-preserving order _requeue_worker
+        # uses (the oldest request is closest to its deadline)
+        for pend in sorted(to_requeue, key=lambda p: p.rid,
+                           reverse=True):
+            self._requeue_pending(pend)
+        out["requeued"] += len(to_requeue)
+        if w.in_flight:           # belt and braces: nothing is lost
+            n = len(w.in_flight)
+            self._requeue_worker(w)
+            out["requeued"] += n
+        out["completed"] = len(completed)
+        self._completed_total += len(completed)
+        self._drain_completed.extend(completed)
+        self._set_gauges()
+        return out
+
+    def _migrate(self, pend: _Pending, rec: dict,
+                 rblobs: List[bytes]) -> bool:
+        """Re-inject one drained request into a survivor; False =
+        caller requeues it for a fresh dispatch instead."""
+        target = self._pick_decode()
+        if target is None:
+            return False
+        try:
+            target.rpc({
+                "op": "decode",
+                "rid": pend.rid,
+                "prompt": rec["prompt"],
+                "first_token": int(rec["first_token"]),
+                "prefill_ms": float(rec.get("prefill_ms", 0.0)),
+                "kv": rec["kv"],
+                "slo_class": pend.slo_class,
+                "max_new_tokens": int(rec["max_new_tokens"]),
+                "temperature": float(rec.get("temperature", 0.0)),
+                "eos_token_id": rec.get("eos_token_id"),
+            }, rblobs)
+        except WorkerDied as e:
+            self._feed_pool("decode", False, str(e))
+            return False
+        except RuntimeError:
+            return False
+        self._feed_pool("decode", True)
+        # EXTEND, never replace: done_tokens covers only what THIS
+        # worker generated — a request migrated twice carries the
+        # first leg's tokens in prior_tokens already, and overwriting
+        # would silently truncate the stitched response
+        pend.prior_tokens = (pend.prior_tokens
+                             + list(rec.get("done_tokens", []))[:-1])
+        pend.migrations += 1
+        pend.prior_preemptions += int(rec.get("preemptions", 0))
+        pend.prior_decode_steps += int(rec.get("decode_polls", 0))
+        pend.handoff_bytes += sum(len(b) for b in rblobs)
+        target.in_flight[pend.rid] = pend
+        target.dispatched_since_poll += 1
+        _telemetry.counter("cluster.migrated").inc()
+        _telemetry.counter("cluster.handoff_bytes").inc(
+            sum(len(b) for b in rblobs))
+        return True
+
+    def take_drain_completions(self) -> List[ClusterResponse]:
+        """Responses that completed on a worker between its last poll
+        and its drain (banked by :meth:`drain_worker`) — collect them
+        like a step()'s return.  The controller forwards these to its
+        caller so a drain never swallows a finished request."""
+        out, self._drain_completed = self._drain_completed, []
+        return out
 
     # -- operator surface ---------------------------------------------------
 
@@ -577,9 +849,11 @@ class Router:
             "completed": self._completed_total,
             "requeued": self._requeued_total,
             "pools": {
-                "prefill": [{"addr": w.addr, "alive": w.alive}
+                "prefill": [{"addr": w.addr, "alive": w.alive,
+                             "draining": w.draining}
                             for w in self._prefill],
                 "decode": [{"addr": w.addr, "alive": w.alive,
+                            "draining": w.draining,
                             "stats": w.stats} for w in self._decode],
             },
             "wire_dtype": self.wire_dtype,
@@ -595,6 +869,11 @@ class Router:
             try:
                 reply, _ = w.rpc({"op": "stats"})
                 w.stats = reply.get("stats", {})
+                # a fresh snapshot REFLECTS the dispatches since the
+                # last refresh (they are in its queued/active now) —
+                # keeping the correction would double-count them and
+                # read the worker as saturated when it is not
+                w.dispatched_since_poll = 0
                 self._feed_pool(w.pool, True)
             except (WorkerDied, RuntimeError) as e:
                 self._feed_pool(w.pool, False, str(e))
@@ -612,12 +891,20 @@ class Router:
         policy is clever."""
         out: dict = {}
         queued = sum(len(q) for q in self._queues.values())
-        alive_d = [w for w in self._decode if w.alive]
-        alive_p = [w for w in self._prefill if w.alive]
+        # a draining worker is LEAVING: it takes no new work, so it
+        # contributes no capacity to the signal — an all-draining pool
+        # is an empty pool about to happen, which must read as "grow",
+        # never as idle headroom (ISSUE 15 edge case, tested)
+        alive_d = [w for w in self._decode
+                   if w.alive and not w.draining]
+        alive_p = [w for w in self._prefill
+                   if w.alive and not w.draining]
         # decode pool: headroom exhaustion or router backpressure says
-        # grow; broad idle headroom says shrink
-        headroom = sum(w.stats.get("free_block_headroom", 0)
-                       for w in alive_d)
+        # grow; broad idle headroom says shrink.  Headroom is measured
+        # in TOKENS ADMITTABLE (see _headroom_tokens: a byte-blind
+        # signal would over-spawn on quantized fleets; same conversion
+        # as dispatch ordering so the hint and _pick_decode agree).
+        headroom = sum(_headroom_tokens(w.stats) for w in alive_d)
         occ = [w.stats.get("active", 0) / w.stats["max_slots"]
                for w in alive_d if w.stats.get("max_slots")]
         mean_occ = sum(occ) / len(occ) if occ else 0.0
@@ -648,10 +935,14 @@ class Router:
                 d_hint = 1
                 violations.append(f"{cls}:tpot")
         out["decode"] = {"workers": len(alive_d), "hint": d_hint,
-                         "free_block_headroom": headroom,
+                         "headroom_tokens": headroom,
                          "mean_occupancy": round(mean_occ, 4),
-                         "router_queue": queued}
-        out["prefill"] = {"workers": len(alive_p), "hint": p_hint}
+                         "router_queue": queued,
+                         "draining": sum(1 for w in self._decode
+                                         if w.alive and w.draining)}
+        out["prefill"] = {"workers": len(alive_p), "hint": p_hint,
+                          "draining": sum(1 for w in self._prefill
+                                          if w.alive and w.draining)}
         if violations:
             out["slo_violations"] = violations
         _telemetry.gauge("cluster.scale_hint", {"pool": "decode"}).set(

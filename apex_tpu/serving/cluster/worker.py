@@ -91,10 +91,11 @@ class WorkerServer:
                  host: str = "127.0.0.1", port: int = 0,
                  max_slots: int = 4, max_len: Optional[int] = None,
                  cache_layout: str = "contiguous", block_size: int = 16,
-                 cache_dtype=None, top_k=None, top_p=None,
-                 vocab_limit=None, slo_targets=None,
+                 cache_dtype=None, cache_wire=None, top_k=None,
+                 top_p=None, vocab_limit=None, slo_targets=None,
                  scratch_layout: str = "paged",
-                 wire_dtype: str = "raw", seed: int = 0):
+                 wire_dtype: str = "raw", seed: int = 0,
+                 chunk_tokens: Optional[int] = None):
         if role not in ("prefill", "decode"):
             raise ValueError(f"role={role!r}: expected 'prefill' or "
                              "'decode'")
@@ -125,12 +126,17 @@ class WorkerServer:
         # engine request id -> (router rid, submit wall time)
         self._ridmap: Dict[int, tuple] = {}             # guarded-by: confined(serve-loop)
         self._outbox: List[dict] = []                   # guarded-by: confined(serve-loop)
+        # draining (ISSUE 15): set by the drain RPC — new decode work
+        # is refused while the pool member's state migrates out
+        self._draining = False                          # guarded-by: confined(serve-loop)
         if role == "decode":
             self.engine = ServingEngine(
                 params, cfg, max_slots=max_slots, max_len=self._max_len,
                 cache_layout=cache_layout, block_size=block_size,
-                cache_dtype=cache_dtype, top_k=top_k, top_p=top_p,
+                cache_dtype=cache_dtype, cache_wire=cache_wire,
+                top_k=top_k, top_p=top_p,
                 vocab_limit=vocab_limit, slo_targets=slo_targets,
+                chunk_tokens=chunk_tokens,
                 rng=jax.random.PRNGKey(seed))
         else:
             dt = cfg.compute_dtype if cache_dtype is None else cache_dtype
@@ -261,10 +267,61 @@ class WorkerServer:
             out, self._outbox = self._outbox, []
             return {"ok": True, "responses": out,
                     "stats": self._stats()}, []
+        if op == "drain":
+            return self._handle_drain()
         if op == "shutdown":
             self._stop = True
             return {"ok": True}, []
         return {"ok": False, "error": f"unknown op {op!r}"}, []
+
+    def _handle_drain(self):
+        """Lossless scale-down (ISSUE 15): stop admitting, then hand
+        EVERY request's state back to the router — live lanes as
+        migration records (cache token sequence + pending token +
+        remaining budget + per-token K/V on the RAW wire: a migration
+        must not change one token, so the compressed forms are not
+        offered here), queued requests as requeue rids, and any
+        completed-but-unpolled responses.  The engine is idle
+        afterwards; the router reaps the process once this returns."""
+        self._draining = True
+        if self.engine is None:
+            return {"ok": True, "live": [], "requeue": [],
+                    "responses": []}, []
+        live, requeue = self.engine.drain()
+        recs: List[dict] = []
+        blobs_out: List[bytes] = []
+        for rec in live:
+            kv_header, kv_blobs = encode_kv(
+                rec.pop("k"), rec.pop("v"), wire_dtype="raw")
+            rid, _t = self._ridmap.pop(rec["engine_rid"],
+                                       (rec["engine_rid"], 0.0))
+            recs.append({
+                "rid": rid,
+                "prompt": [int(t) for t in rec["prompt"]],
+                "first_token": rec["first_token"],
+                "done_tokens": rec["done_tokens"],
+                "max_new_tokens": rec["max_new_tokens"],
+                "temperature": rec["temperature"],
+                "eos_token_id": rec["eos_token_id"],
+                "slo_class": rec["slo_class"],
+                "prefill_ms": rec["prefill_ms"],
+                # source-leg accounting: the survivor's response
+                # covers only ITS leg, so the router stitches these
+                # onto the final numbers like the token prefix
+                "preemptions": rec["preemptions"],
+                "decode_polls": rec["decode_polls"],
+                "kv": kv_header,
+                "n_blobs": len(kv_blobs),
+            })
+            blobs_out.extend(kv_blobs)
+        requeue_rids = []
+        for req in requeue:
+            rid, _t = self._ridmap.pop(req.request_id,
+                                       (req.request_id, 0.0))
+            requeue_rids.append(rid)
+        out, self._outbox = self._outbox, []
+        return {"ok": True, "live": recs, "requeue": requeue_rids,
+                "responses": out}, blobs_out
 
     def _stats(self) -> dict:
         if self.engine is not None:
@@ -277,12 +334,14 @@ class WorkerServer:
                 "prefill_calls": self._exec.calls,
                 "scratch_layout": self._exec.scratch_layout,
                 "queued": 0, "queued_by_class": {},
-                "free_block_headroom": 1}
+                "free_block_headroom": 1, "headroom_tokens": 1}
 
     def _handle_prefill(self, header: dict):
         if self._exec is None:
             return {"ok": False,
                     "error": "prefill on a decode worker"}, []
+        if self._draining:
+            return {"ok": False, "error": "worker is draining"}, []
         import jax
         import jax.numpy as jnp
 
@@ -329,6 +388,12 @@ class WorkerServer:
         if self.engine is None:
             return {"ok": False,
                     "error": "decode on a prefill worker"}, []
+        if self._draining:
+            # the router marks a draining worker undispatchable before
+            # sending the drain RPC, so this is a crossed-wires guard,
+            # not a normal path — refuse deterministically (the router
+            # requeues the request, never loses it)
+            return {"ok": False, "error": "worker is draining"}, []
         k, v = decode_kv(header["kv"], blobs)
         prompt = np.asarray(header["prompt"], np.int32).reshape(-1)
         rid = header.get("rid")
@@ -415,7 +480,15 @@ def main(argv=None) -> int:
     ap.add_argument("--max-len", type=int, default=None)
     ap.add_argument("--cache-layout", default="contiguous",
                     choices=("contiguous", "paged"))
+    ap.add_argument("--cache-wire", default=None,
+                    choices=("native", "int8"),
+                    help="paged-pool at-rest form (ISSUE 14)")
     ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--chunk-tokens", type=int, default=None,
+                    help="chunked prefill (ISSUE 15): stream prompts "
+                         "longer than this through fixed-size chunk "
+                         "forwards interleaved with decode "
+                         "(APEX_TPU_CHUNK_TOKENS overrides)")
     ap.add_argument("--scratch-layout", default="paged",
                     choices=("contiguous", "paged"),
                     help="prefill scratch-cache layout (paged = the "
@@ -444,10 +517,12 @@ def main(argv=None) -> int:
         cache_layout=args.cache_layout, block_size=args.block_size,
         cache_dtype=(None if args.cache_dtype is None
                      else jnp.dtype(args.cache_dtype)),
+        cache_wire=args.cache_wire,
         top_k=args.top_k, top_p=args.top_p,
         vocab_limit=args.vocab_limit,
         scratch_layout=args.scratch_layout,
-        wire_dtype=args.wire_dtype, seed=args.seed)
+        wire_dtype=args.wire_dtype, seed=args.seed,
+        chunk_tokens=args.chunk_tokens)
     print(f"{READY_PREFIX} role={args.role} addr={server.addr} "
           f"metrics={metrics_url}", flush=True)
     try:

@@ -102,9 +102,11 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import time
+import warnings
 from collections import deque
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -112,7 +114,8 @@ import numpy as np
 
 from apex_tpu.models.config import TransformerConfig
 from apex_tpu.models.generate import (
-    _check_decode_cfg, decode_step, init_kv_cache, prefill, sample_logits)
+    _check_decode_cfg, decode_step, decode_verify, extract_kv,
+    init_kv_cache, prefill, sample_logits)
 from apex_tpu.models.speculative import resolve_spec, spec_round
 from apex_tpu.observability import metrics as _telemetry
 from apex_tpu.observability import span
@@ -240,6 +243,41 @@ class _Slot:
     # (ISSUE 8) one poll emits several tokens, so polls and tokens are
     # DIFFERENT numbers and Response.decode_steps reports this one
     decode_polls: int = 0
+    # chunked prefill (ISSUE 15): a lane admitted for a long prompt
+    # streams its prefill across polls — one chunk_tokens forward per
+    # step(), interleaved with everyone else's decode — and only joins
+    # the decode batch when the last chunk lands.  While prefilling,
+    # cache_len is the prefill progress (tokens written so far).
+    prefilling: bool = False
+    chunks_done: int = 0
+    chunks_total: int = 0
+    prefill_tokens: Optional[np.ndarray] = None
+
+
+def _resolve_chunk_tokens(value: Optional[int]) -> Optional[int]:
+    """The chunked-prefill knob: ``APEX_TPU_CHUNK_TOKENS`` beats the
+    caller's ``chunk_tokens=`` (positive int = chunk size, ``off``/``0``
+    = force monolithic); malformed values warn BY NAME and fall back to
+    the caller's value — the PR-5 probe-timeout override discipline."""
+    raw = os.environ.get("APEX_TPU_CHUNK_TOKENS")
+    if raw is not None:
+        if raw.strip().lower() in ("off", "0"):
+            return None
+        try:
+            n = int(raw)
+            if n < 1:
+                raise ValueError(raw)
+            return n
+        except ValueError:
+            warnings.warn(
+                f"APEX_TPU_CHUNK_TOKENS={raw!r} is malformed (expected "
+                "a positive int, or off/0 to disable); using the "
+                "caller's chunk_tokens", stacklevel=3)
+    if value is not None and int(value) < 1:
+        raise ValueError(
+            f"chunk_tokens={value} must be >= 1 (or None for "
+            "monolithic prefill)")
+    return None if value is None else int(value)
 
 
 class ServingEngine:
@@ -261,6 +299,23 @@ class ServingEngine:
     ``vocab_limit`` are engine-wide static sampling knobs (a jit
     recompile each — per-request values would retrace); temperature is
     per-request (a traced ``[max_slots]`` vector).
+
+    ``chunk_tokens`` (ISSUE 15) turns long-prompt admission into
+    CHUNKED prefill: a prompt longer than one chunk claims its lane
+    and blocks immediately, then streams its prefill one
+    ``chunk_tokens``-sized forward per :meth:`step`, interleaved with
+    the other lanes' decode (Sarathi-style mixed batching —
+    ``step_tokens = decode_lanes + chunk_tokens``), so one 32k prompt
+    bounds its co-residents' TPOT interference to one chunk forward
+    per poll instead of one monolithic prefill.  The first token is
+    sampled from the final chunk's last-token logits
+    (greedy-identical to monolithic prefill); a mid-prefill lane can
+    be preempted between chunks through the normal block-ledger path
+    (nothing delivered yet, so resume just replays the chunks);
+    chunk-written blocks are never prefix-shared (see
+    :meth:`_blocks_needed`).  ``APEX_TPU_CHUNK_TOKENS`` overrides the
+    knob at deploy time.  Composes with ``spec``: the lane joins the
+    speculative decode batch once its last chunk lands.
 
     ``spec`` (ISSUE 8) turns each poll into a speculative round
     (``"ngram"`` or a ``models.speculative.SpecConfig``): every live
@@ -287,6 +342,7 @@ class ServingEngine:
                  vocab_limit: Optional[int] = None,
                  slo_targets: Optional[dict] = None,
                  spec=None,
+                 chunk_tokens: Optional[int] = None,
                  rng: Optional[jax.Array] = None):
         _check_decode_cfg(cfg)
         if cache_layout not in ("contiguous", "paged"):
@@ -311,6 +367,15 @@ class ServingEngine:
         # admission worst case.
         self._spec = resolve_spec(spec)
         self._spec_ahead = 1 if self._spec is None else self._spec.k + 1
+        # chunked prefill (ISSUE 15): prompts longer than chunk_tokens
+        # stream their prefill across polls — one fixed-size chunk
+        # forward per step(), interleaved with the resident lanes'
+        # decode (Sarathi-style: step_tokens = decode_lanes +
+        # chunk_tokens) — so a long prompt admits immediately without
+        # stalling every co-resident TPOT for its whole prefill.
+        # APEX_TPU_CHUNK_TOKENS overrides the caller (deploy-time
+        # retuning without a code change); None/off = monolithic.
+        self.chunk_tokens = _resolve_chunk_tokens(chunk_tokens)
         if (cfg.position_embedding_type == "learned"
                 and self.max_len > cfg.max_position_embeddings):
             raise ValueError(
@@ -421,6 +486,8 @@ class ServingEngine:
                                           cache_layout == "paged",
                                           self._spec)
         self._sample_fn = _make_sample_fn(top_k, top_p, vocab_limit)
+        self._chunk_fn = (_make_chunk_fn(cfg, cache_layout == "paged")
+                          if self.chunk_tokens else None)
 
     # -- public API --------------------------------------------------------
 
@@ -544,8 +611,12 @@ class ServingEngine:
         return not self._queue and self._pool.n_active == 0
 
     def step(self) -> List[Response]:
-        """Admit what fits, decode one token for every live lane;
-        returns the requests completed by this step."""
+        """Admit what fits, run one prefill chunk if a lane is
+        mid-prefill (ISSUE 15), decode one token for every live lane;
+        returns the requests completed by this step.  The per-step
+        token budget is therefore ``decode_lanes + chunk_tokens``
+        (Sarathi-style mixed batching): a long prompt streams its
+        prefill across polls while everyone else keeps decoding."""
         completed = self._admit()
         # feed the stall detector HERE — after admission, before
         # decode.  This is the only point in the cycle where "queued
@@ -554,7 +625,10 @@ class ServingEngine:
         # for the NEXT step's admission (healthy continuous batching),
         # and before the first step a submit burst is just a queue.
         self._feed_queue_detector()
-        if self._pool.n_active:
+        if self.chunk_tokens:
+            completed.extend(self._prefill_chunk_once())
+        if any(st is not None and not st.prefilling
+               for st in self._slots):
             completed.extend(self._decode_once())
         self._set_gauges()
         return completed
@@ -599,8 +673,12 @@ class ServingEngine:
             "cache_bytes": self._cache_bytes,
             "sampling": dict(self._sampling),
             "spec_k": None if self._spec is None else self._spec.k,
+            "chunk_tokens": self.chunk_tokens,
+            "prefilling": sum(1 for st in self._slots
+                              if st is not None and st.prefilling),
         }
         if self._mgr is not None:
+            free_blocks = max(0, self._mgr.n_free - self.reserve_blocks)
             out.update({
                 "block_size": self.block_size,
                 "num_blocks": self.num_blocks,
@@ -608,12 +686,101 @@ class ServingEngine:
                 "blocks_in_use": self._mgr.n_in_use,
                 "prefix_shared_blocks": self._mgr.n_shared,
                 "preemptions": self._preempt_count,
-                "free_block_headroom": max(
-                    0, self._mgr.n_free - self.reserve_blocks),
+                "free_block_headroom": free_blocks,
+                # the capacity signal in TOKENS ADMITTABLE under the
+                # ACTIVE cache_wire form (ISSUE 15 satellite): an int8
+                # pool holds ~1.88x the blocks of a byte-matched native
+                # pool, and a consumer comparing pools by bytes (or by
+                # a block count at a different block_size) would
+                # systematically over-spawn on quantized fleets.
+                # Tokens are the one unit every pool form shares.
+                "headroom_tokens": free_blocks * self.block_size,
             })
         else:
             out["free_block_headroom"] = self._pool.n_free
+            # contiguous admission reserves a whole stripe per request
+            out["headroom_tokens"] = self._pool.n_free * self.max_len
         return out
+
+    def drain(self) -> Tuple[List[dict], List[Request]]:
+        """Lossless scale-down support (ISSUE 15): pop EVERY request
+        out of the engine → ``(live, requeue)``, leaving it idle.
+
+        ``live`` holds one record per decoding lane — everything a
+        survivor engine needs to continue the request EXACTLY where it
+        stopped: the token sequence the cache materialized (original
+        prompt + generated-so-far minus the pending token) as the
+        survivor's "prompt", the pending token as its ``first_token``,
+        the remaining generation budget, and the per-token K/V pulled
+        through :func:`~apex_tpu.models.generate.extract_kv` (block
+        tables dereferenced / stripe sliced; int8 pools dequantize to
+        float — the wire layer owns its own compression).  Feeding a
+        record into another engine's :meth:`submit_prefilled` (the
+        cluster drain path does it through the raw KV wire) continues
+        greedy token-identically to never having drained
+        (tests/test_serving_controller.py pins it).
+
+        ``requeue`` holds the requests with nothing to migrate — the
+        engine queue, plus lanes still mid-chunked-prefill (no token
+        delivered yet; replaying their prefill elsewhere loses
+        nothing) — as plain :class:`Request` objects ready for
+        re-submission."""
+        live: List[dict] = []
+        requeue: List[Request] = []
+        if self._mgr is not None:
+            # one host->device table upload for the whole drain — the
+            # ledger doesn't change until after extraction
+            cache = dict(self.cache,
+                         block_tables=jnp.asarray(self._tables))
+        else:
+            cache = self.cache
+        for slot in sorted(
+                self._pool.active,
+                key=lambda s: self._slots[s].request.request_id):
+            st = self._slots[slot]
+            req = st.request
+            if st.prefilling or not st.tokens:
+                requeue.append(req)
+            else:
+                k, v = extract_kv(cache, st.cache_len, row=slot)
+                live.append({
+                    "engine_rid": req.request_id,
+                    "prompt": np.concatenate(
+                        [req.prompt,
+                         np.asarray(st.tokens[:-1], np.int32)]),
+                    "orig_prompt_len": int(req.prompt.size),
+                    "done_tokens": list(st.tokens),
+                    "first_token": int(st.tokens[-1]),
+                    "max_new_tokens": (req.max_new_tokens
+                                       - len(st.tokens) + 1),
+                    "temperature": req.temperature,
+                    "eos_token_id": req.eos_token_id,
+                    "slo_class": req.slo_class,
+                    "preemptions": req.preemptions,
+                    "decode_polls": st.decode_polls,
+                    "prefill_ms": st.prefill_ms,
+                    "k": np.asarray(k),
+                    "v": np.asarray(v),
+                })
+            self._slots[slot] = None
+            self._pending[slot] = 0
+            self._temps[slot] = 0.0
+            if self._mgr is not None:
+                self._tables[slot, :] = self.num_blocks
+                self._mgr.free_all(st.blocks)
+            self._pool.release(slot)
+            _telemetry.counter("serving.drained").inc()
+            _telemetry.event("serving.request.drained",
+                             id=req.request_id,
+                             migrated=bool(not st.prefilling
+                                           and st.tokens))
+        while self._queue:
+            req = self._queue.popleft()
+            req.handoff = None     # its wire pages die with this engine
+            requeue.append(req)
+            _telemetry.counter("serving.drained").inc()
+        self._set_gauges()
+        return live, requeue
 
     # -- internals ---------------------------------------------------------
 
@@ -640,6 +807,19 @@ class ServingEngine:
                 self._mgr.n_shared)
             _telemetry.gauge("serving.cache_blocks_hw", tags).set(
                 self._blocks_hw)
+        if self.chunk_tokens:
+            # chunked-prefill progress (ISSUE 15): aggregate over the
+            # in-flight prefilling lanes — serve_dash renders the
+            # chunks-done/total column only when these gauges exist.
+            # ("progress" naming keeps the OpenMetrics render clear of
+            # the serving.prefill_chunks counter's `_total` suffix.)
+            pre = [st for st in self._slots
+                   if st is not None and st.prefilling]
+            _telemetry.gauge("serving.prefilling").set(len(pre))
+            _telemetry.gauge("serving.prefill_progress_done").set(
+                sum(st.chunks_done for st in pre))
+            _telemetry.gauge("serving.prefill_progress_total").set(
+                sum(st.chunks_total for st in pre))
 
     def _feed_queue_detector(self) -> None:
         """Anomaly feed for the queue detector (see step() for why the
@@ -667,13 +847,30 @@ class ServingEngine:
                 tokens[: full * self.block_size], self.block_size))
         return req._hash_cache[1], req._hash_cache[2]
 
+    def _chunked(self, req: Request) -> bool:
+        """Does this request admit through the chunked-prefill path?
+        Only prompts longer than one chunk (a short prompt IS one
+        chunk — the monolithic path is strictly better for it) and
+        never KV handoffs (their pages come off the wire, not from a
+        prefill)."""
+        if not self.chunk_tokens or req.handoff is not None:
+            return False
+        return (req.prompt.size + len(req.resume_tokens)
+                > self.chunk_tokens)
+
     def _blocks_needed(self, req: Request) -> int:
         """NEW blocks the request must allocate at admission (prefix
         hits against the published block table are free — they map, not
         allocate).  A KV-handoff request allocates everything fresh:
-        its pages are wire-derived, never shared."""
-        if req.handoff is not None:
-            return blocks_for(req.prompt.size, self.block_size)
+        its pages are wire-derived, never shared.  So does a CHUNKED
+        one: chunk-written K/V can differ from a monolithic writer's in
+        low-order bits (flash vs verify accumulation order), and the
+        content digests guarantee bit-identical physical pages — so
+        chunked pages neither map existing digests nor publish new
+        ones."""
+        if req.handoff is not None or self._chunked(req):
+            return blocks_for(req.prompt.size + len(req.resume_tokens),
+                              self.block_size)
         tokens, hashes = self._admission_state(req)
         need = blocks_for(tokens.size, self.block_size)
         for h in hashes:
@@ -844,6 +1041,8 @@ class ServingEngine:
         request carrying a KV handoff (``submit_prefilled``) skips the
         prefill forward entirely: its cache pages come off the wire,
         its first token from the remote sampler."""
+        if self._chunked(req):
+            return self._admit_one_chunked(req, slot)
         completed: List[Response] = []
         hashes: List[bytes] = []
         if self._mgr is not None and req.handoff is None:
@@ -965,6 +1164,147 @@ class ServingEngine:
             completed.append(self._complete(slot, done))
         return completed
 
+    # -- chunked prefill (ISSUE 15) ----------------------------------------
+
+    def _admit_one_chunked(self, req: Request, slot: int
+                           ) -> List[Response]:
+        """Admit a long prompt WITHOUT running its prefill: claim the
+        lane and (paged) every block the full prompt needs — the same
+        admission budget the monolithic path commits, so the
+        block-ledger arithmetic is unchanged — then mark the lane
+        ``prefilling``.  The prefill itself streams one chunk per
+        :meth:`step` (:meth:`_prefill_chunk_once`), interleaved with
+        the other lanes' decode; the first token is sampled from the
+        FINAL chunk's last-token logits, which are greedy-identical to
+        the monolithic prefill's (tests/test_serving_chunked.py).
+
+        Blocks are claimed fresh and never prefix-shared or published
+        (see :meth:`_blocks_needed`)."""
+        tokens = self._full_tokens(req)
+        n = int(tokens.size)
+        blocks: List[int] = []
+        if self._mgr is not None:
+            blocks, _wid, _sh = self._claim_blocks_fresh(n)
+        t0 = time.perf_counter()
+        if req.admitted_t == 0.0:
+            req.admitted_t = t0
+            req.queue_wait_s = t0 - req.submitted_t
+        try:
+            if self._mgr is not None:
+                self._tables[slot, :] = self.num_blocks
+                self._tables[slot, : len(blocks)] = blocks
+                self._blocks_hw = max(self._blocks_hw,
+                                      self._mgr.n_in_use)
+            # park the lane's device position at 0 so the masked decode
+            # rides it inertly until the first chunk stamps real
+            # progress (a stale position from the lane's previous
+            # occupant must not outlive the handover)
+            self.cache = dict(
+                self.cache, pos=self.cache["pos"].at[slot].set(0))
+            _telemetry.event("serving.request.chunk_admit",
+                             id=req.request_id, prompt_tokens=n,
+                             chunks=-(-n // self.chunk_tokens))
+        except Exception:
+            if self._mgr is not None:
+                self._mgr.free_all(blocks)
+                self._tables[slot, :] = self.num_blocks
+            raise
+        self._slots[slot] = _Slot(
+            request=req, tokens=[], prefill_ms=0.0, blocks=blocks,
+            cache_len=0, decode_polls=req.resume_polls,
+            prefilling=True, chunks_done=0,
+            chunks_total=-(-n // self.chunk_tokens),
+            prefill_tokens=tokens)
+        self._pending[slot] = 0
+        self._temps[slot] = 0.0
+        return []
+
+    def _prefill_chunk_once(self) -> List[Response]:
+        """Run ONE prefill chunk for the oldest prefilling lane — the
+        chunk half of the mixed step budget (``step_tokens =
+        decode_lanes + chunk_tokens``).  Oldest-first keeps chunk
+        completion FIFO, so a second long prompt queues its chunks
+        behind the first instead of both starving.  On the final chunk
+        the lane transitions to decoding: first token sampled from the
+        chunk's last-token logits, TTFT stamped, history row written
+        (spec), and the completion edges handled exactly as a
+        monolithic admission would."""
+        slots = [s for s in self._pool.active
+                 if self._slots[s] is not None
+                 and self._slots[s].prefilling]
+        if not slots:
+            return []
+        slot = min(slots,
+                   key=lambda s: self._slots[s].request.request_id)
+        st = self._slots[slot]
+        req = st.request
+        tokens = st.prefill_tokens
+        n = int(tokens.size)
+        lo = st.cache_len
+        hi = min(n, lo + self.chunk_tokens)
+        # ONE chunk shape for the engine's lifetime: tail chunks pad up
+        # (their padding writes drop past the table reach / sit past
+        # `new_pos`, invisible to every masked read)
+        chunk = pad_prompt(tokens[lo:hi], self.chunk_tokens)
+        t0 = time.perf_counter()
+        with span("serving.prefill_chunk"), \
+                compile_label("serving.prefill_chunk"):
+            if self._mgr is not None:
+                logits, self.cache = self._chunk_fn(
+                    self.params, self.cache,
+                    jnp.asarray(self._tables[slot]),
+                    jnp.asarray(chunk), jnp.int32(lo), jnp.int32(hi),
+                    jnp.int32(slot))
+            else:
+                logits, self.cache = self._chunk_fn(
+                    self.params, self.cache, jnp.asarray(chunk),
+                    jnp.int32(lo), jnp.int32(hi), jnp.int32(slot))
+            if hi >= n:
+                # final chunk: its last-REAL-token logits are the
+                # first-token logits (greedy-identical to monolithic
+                # prefill); sample while still inside the span so
+                # prefill cost accounting covers the whole admission
+                self._key, sub = jax.random.split(self._key)
+                first = self._sample_fn(
+                    logits[:, n - 1 - lo],
+                    jnp.asarray([req.temperature], jnp.float32), sub)
+                tok = int(np.asarray(first)[0])      # host sync
+        now = time.perf_counter()
+        st.prefill_ms += (now - t0) * 1e3
+        st.cache_len = hi
+        st.chunks_done += 1
+        _telemetry.counter("serving.prefill_chunks").inc()
+        if hi < n:
+            return []
+        # -- transition to decoding ------------------------------------
+        if req.first_token_t == 0.0:
+            req.first_token_t = now
+            _telemetry.event("serving.request.first_token",
+                             id=req.request_id, slo_class=req.slo_class)
+        if req.preempted_t:
+            req.preempt_overhead_s += now - req.preempted_t
+            req.preempted_t = 0.0
+        _telemetry.counter("serving.prefill_calls").inc()
+        _telemetry.histogram("serving.prefill_ms").observe(st.prefill_ms)
+        _telemetry.counter("serving.tokens_generated").inc()
+        if _telemetry.enabled():
+            sample_device_memory()
+        st.prefilling = False
+        st.prefill_tokens = None
+        st.tokens = list(req.resume_tokens) + [tok]
+        self._pending[slot] = tok
+        self._temps[slot] = req.temperature
+        if self._spec is not None:
+            row = np.zeros((self.max_len,), np.int32)
+            row[: n] = tokens
+            row[n] = tok
+            self._history = self._history.at[slot].set(jnp.asarray(row))
+            self._hist_len = self._hist_len.at[slot].set(n + 1)
+        done = self._finish_reason(st, tok)
+        if done:
+            return [self._complete(slot, done)]
+        return []
+
     # -- decode ------------------------------------------------------------
 
     def _youngest_slot(self) -> int:
@@ -1018,8 +1358,8 @@ class ServingEngine:
         mb = self._tables.shape[1]
         for slot in list(self._pool.active):
             st = self._slots[slot]
-            if st is None:                     # preempted this pass
-                continue
+            if st is None or st.prefilling:    # preempted this pass /
+                continue                       # blocks pre-claimed
             need = min(-(-(st.cache_len + self._spec_ahead)
                          // self.block_size), mb)
             while self._slots[slot] is st and len(st.blocks) < need:
@@ -1044,9 +1384,13 @@ class ServingEngine:
             self._ensure_tail_blocks()
             if not self._pool.n_active:        # everything preempted
                 return []
+        # prefilling lanes (ISSUE 15) ride the batch masked: position
+        # frozen, no emission — they join once their last chunk lands
         active = np.zeros((self.max_slots,), bool)
         for i, st in enumerate(self._slots):
-            active[i] = st is not None
+            active[i] = st is not None and not st.prefilling
+        if not active.any():                   # only prefilling lanes
+            return []
         t0 = time.perf_counter()
         self._key, sub = jax.random.split(self._key)
         em_host = acc_host = nxt_host = None
@@ -1086,7 +1430,7 @@ class ServingEngine:
         accepted = 0
         live = 0
         for slot, st in enumerate(self._slots):
-            if st is None:
+            if st is None or st.prefilling:
                 continue
             live += 1
             st.decode_polls += 1
@@ -1352,6 +1696,56 @@ def _make_decode_fn(cfg, top_k, top_p, vocab_limit, paged, spec=None):
         return nxt, cache
 
     return step_fn
+
+
+@functools.lru_cache(maxsize=None)
+def _make_chunk_fn(cfg, paged):
+    """One compiled chunked-prefill step (ISSUE 15), memoized on the
+    static knobs like :func:`_make_decode_fn`.  The chunk ``[m]``
+    appends at ``pos`` of lane ``slot`` and attends to the lane's
+    already-written KV prefix plus itself causally — the verification
+    forward (:func:`~apex_tpu.models.generate.decode_verify`) run
+    b=1 against the engine's cache, which reuses the existing write
+    edges in both layouts (paged: the table scatter, int8 scale cells
+    included; contiguous: the stripe scatter).  The engine pins the
+    chunk shape to ONE bucket (``chunk_tokens``, tail chunks padded),
+    so this is exactly one compile per engine lifetime.
+
+    ``new_pos`` is the host-known progress after this chunk (the real
+    token count, excluding tail padding): the lane's device position is
+    stamped here so the masked decode step, the dashboard, and the
+    eventual decode transition all see a consistent cache."""
+
+    if paged:
+        @functools.partial(jax.jit, donate_argnames=("cache",))
+        def chunk_fn(params, cache, table_row, chunk, pos, new_pos,
+                     slot):
+            sub = {kk: vv for kk, vv in cache.items() if kk != "pos"}
+            sub["pos"] = pos[None]
+            sub["block_tables"] = table_row[None]
+            logits, new = decode_verify(params, chunk[None], sub, cfg)
+            out = {kk: vv for kk, vv in new.items()
+                   if kk not in ("pos", "block_tables")}
+            out["pos"] = cache["pos"].at[slot].set(new_pos)
+            return logits, out
+
+        return chunk_fn
+
+    @functools.partial(jax.jit, donate_argnames=("cache",))
+    def chunk_fn(params, cache, chunk, pos, new_pos, slot):
+        k_row = jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1)
+        v_row = jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1)
+        sub = {"k": k_row, "v": v_row, "pos": pos[None]}
+        logits, new = decode_verify(params, chunk[None], sub, cfg)
+        return logits, {
+            "k": jax.lax.dynamic_update_slice_in_dim(
+                cache["k"], new["k"], slot, axis=1),
+            "v": jax.lax.dynamic_update_slice_in_dim(
+                cache["v"], new["v"], slot, axis=1),
+            "pos": cache["pos"].at[slot].set(new_pos),
+        }
+
+    return chunk_fn
 
 
 @functools.partial(jax.jit, donate_argnames=("cache",))

@@ -1121,6 +1121,350 @@ def bench_serve_trace(cache_layout="paged", wire_dtype="raw",
     return row
 
 
+def bench_chunked_starvation(platform="cpu"):
+    """The chunked-prefill interference gate (ISSUE 15): one long
+    prompt admitted into a pool of decoding lanes must not spike every
+    co-resident request's TPOT.
+
+    Three runs of the same engine geometry:
+
+    - ``baseline`` — the short-request stream alone (the no-long-prompt
+      TPOT floor);
+    - ``monolithic`` — a long prompt admitted mid-stream through the
+      one-shot prefill: every co-resident decode stalls for the whole
+      prefill forward (the unbounded spike this row documents);
+    - ``chunked`` — same trace with ``chunk_tokens`` set: the long
+      prompt streams its prefill one chunk per step, interleaved with
+      the shorts' decode.
+
+    The acceptance gate: chunked short-request TPOT p95 <= 2x the
+    baseline p95 (``tpot_gate_ok``) — each mixed step pays one chunk
+    forward on top of the decode, never the whole prompt.  Greedy
+    token-identity chunked-vs-monolithic rides every run
+    (``token_identical``)."""
+    from apex_tpu.models.transformer_lm import init_gpt_params
+    from apex_tpu.serving import ServingEngine
+
+    from apex_tpu.models.config import TransformerConfig
+
+    cfg = TransformerConfig(
+        num_layers=2, hidden_size=128, num_attention_heads=4,
+        vocab_size=256, max_position_embeddings=640,
+        compute_dtype=jnp.float32, remat=False)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(11)
+    chunk = 64
+    long_prompt, long_new = 448, 4
+    shorts = [dict(prompt=rng.randint(0, 256, (16,)),
+                   max_new_tokens=24, slo_class="standard")
+              for _ in range(3)]
+    long_req = dict(prompt=rng.randint(0, 256, (long_prompt,)),
+                    max_new_tokens=long_new, slo_class="batch")
+
+    def engine(chunk_tokens=None):
+        return ServingEngine(
+            params, cfg, max_slots=4, max_len=576,
+            cache_layout="paged", block_size=16,
+            chunk_tokens=chunk_tokens)
+
+    def drive(eng, with_long):
+        # shorts first (they claim lanes and start decoding), the long
+        # admitted mid-stream into the free lane — its prefill lands
+        # while every short is mid-decode, which is the starvation shape
+        for kw in shorts:
+            eng.submit(**{k: (v.copy() if hasattr(v, "copy") else v)
+                          for k, v in kw.items()})
+        for _ in range(2):
+            eng.step()
+        if with_long:
+            eng.submit(**dict(long_req, prompt=long_req["prompt"].copy()))
+        resps = []
+        while not eng.idle:
+            resps.extend(eng.step())
+        return resps
+
+    def tpot_p95(resps):
+        vals = [r.tpot_ms for r in resps
+                if r.slo_class == "standard" and r.tokens.size > 1]
+        return round(_pct_of(vals, .95), 4)
+
+    rows = {"backend": platform, "skipped": False,
+            "chunk_tokens": chunk, "long_prompt": long_prompt,
+            "short_requests": len(shorts)}
+    drive(engine(), False)                       # warmup compiles
+    rows["baseline_tpot_ms_p95"] = tpot_p95(drive(engine(), False))
+    mono = drive(engine(), True)
+    rows["monolithic_tpot_ms_p95"] = tpot_p95(mono)
+    drive(engine(chunk), True)                   # warmup chunk compile
+    chunked = drive(engine(chunk), True)
+    rows["chunked_tpot_ms_p95"] = tpot_p95(chunked)
+    base = max(rows["baseline_tpot_ms_p95"], 1e-9)
+    rows["monolithic_over_baseline"] = round(
+        rows["monolithic_tpot_ms_p95"] / base, 2)
+    rows["chunked_over_baseline"] = round(
+        rows["chunked_tpot_ms_p95"] / base, 2)
+    # THE GATE: chunking bounds the interference at 2x the
+    # no-long-prompt floor (the monolithic ratio is the documented
+    # spike it replaces)
+    rows["tpot_gate_ok"] = rows["chunked_over_baseline"] <= 2.0
+    rows["token_identical"] = (
+        sorted((r.request_id, tuple(r.tokens.tolist())) for r in mono)
+        == sorted((r.request_id, tuple(r.tokens.tolist()))
+                  for r in chunked))
+    return rows
+
+
+# the controller-trace engine geometry (larger than _TRACE_ENGINE so a
+# long prompt + chunking have room)
+_CTRL_ENGINE = dict(max_slots=3, max_len=96, block_size=8,
+                    chunk_tokens=16)
+
+
+def _diurnal_trace(rng, vocab, calm=6, crowd=10, tail=5):
+    """Diurnal + flash-crowd arrivals (ISSUE 15): a calm morning
+    stream, a near-simultaneous crowd volley (with two LONG batch
+    prompts riding it — the chunked-prefill stressor), then a long
+    calm tail that gives a scale-down its window.  All greedy so every
+    topology/knob cell must agree token-for-token."""
+    shapes = (("standard", 12, 8), ("interactive", 8, 6),
+              ("standard", 16, 6))
+    trace = []
+    t = 0.0
+    for i in range(calm):
+        cls, plen, new = shapes[i % len(shapes)]
+        trace.append((round(t, 4), dict(
+            prompt=rng.randint(0, vocab, (plen,)).tolist(),
+            max_new_tokens=new, temperature=0.0, slo_class=cls)))
+        t += float(rng.exponential(0.25))
+    # flash crowd: everything lands inside ~50 ms
+    for i in range(crowd):
+        if i % 5 == 4:
+            trace.append((round(t, 4), dict(
+                prompt=rng.randint(0, vocab, (80,)).tolist(),
+                max_new_tokens=6, temperature=0.0, slo_class="batch")))
+        else:
+            cls, plen, new = shapes[i % len(shapes)]
+            trace.append((round(t, 4), dict(
+                prompt=rng.randint(0, vocab, (plen,)).tolist(),
+                max_new_tokens=new, temperature=0.0, slo_class=cls)))
+        t += 0.005
+    # calm tail: sparse arrivals — the scale-down window
+    for i in range(tail):
+        cls, plen, new = shapes[i % len(shapes)]
+        t += float(rng.exponential(0.4)) + 0.2
+        trace.append((round(t, 4), dict(
+            prompt=rng.randint(0, vocab, (plen,)).tolist(),
+            max_new_tokens=new, temperature=0.0, slo_class=cls)))
+    return trace
+
+
+def _spawn_ctrl_workers(chunked, n_decode):
+    """Spawn 1 prefill + n decode workers with the controller-trace
+    geometry; returns (procs, prefill_addr, decode_addrs,
+    decode_flags)."""
+    from apex_tpu.serving.cluster.worker import spawn_worker
+
+    model_flags = []
+    for flag, key in (("--layers", "layers"), ("--hidden", "hidden"),
+                      ("--heads", "heads"), ("--vocab", "vocab"),
+                      ("--max-pos", "max_pos"), ("--seed", "seed")):
+        model_flags += [flag, str(_TRACE_MODEL[key])]
+    decode_flags = model_flags + [
+        "--max-slots", str(_CTRL_ENGINE["max_slots"]),
+        "--max-len", str(_CTRL_ENGINE["max_len"]),
+        "--cache-layout", "paged",
+        "--block-size", str(_CTRL_ENGINE["block_size"])]
+    if chunked:
+        decode_flags += ["--chunk-tokens",
+                         str(_CTRL_ENGINE["chunk_tokens"])]
+    prefill_flags = model_flags + [
+        "--max-len", str(_CTRL_ENGINE["max_len"])]
+    procs = []
+    pf_proc, pf_addr, _ = spawn_worker("prefill",
+                                       extra_args=prefill_flags)
+    procs.append(pf_proc)
+    dc_addrs = []
+    for _ in range(n_decode):
+        dc_proc, dc_addr, _ = spawn_worker("decode",
+                                           extra_args=decode_flags)
+        procs.append(dc_proc)
+        dc_addrs.append(dc_addr)
+    return procs, pf_addr, dc_addrs, decode_flags
+
+
+_TROUGH_S = 4.0     # the post-crowd diurnal trough both cells serve
+
+
+def _controller_cell(trace, chunked, controller):
+    """One cell of the on/off x on/off ablation: replay the diurnal
+    trace against the spawned-process topology, then serve the
+    post-crowd TROUGH (``_TROUGH_S`` of near-idle wall — the diurnal
+    valley, compressed).  BOTH cells start at peak provisioning (2
+    decode workers: what an operator without an autoscaler must run
+    all day); the controller cell lets the elastic loop act on
+    ``autoscale_signal`` — the sustained idle signal in the trough
+    DRAINS one decode worker losslessly and reaps it, so the cell's
+    chip-seconds (the integral of live workers over the whole window)
+    come in measurably under static provisioning at the same goodput.
+    Chip-seconds are honest spend: a draining worker counts until
+    reaped."""
+    import time as _time
+
+    from apex_tpu.serving.cluster import PoolController, Router
+    from apex_tpu.serving.cluster.worker import shutdown_worker
+
+    procs, pf_addr, dc_addrs, decode_flags = _spawn_ctrl_workers(
+        chunked, n_decode=2)
+    ctrl = None
+    router = None
+    try:
+        router = Router([pf_addr], dc_addrs)
+        # warmup: compile both workers' buckets before the clock runs
+        for t in trace[:2]:
+            router.submit(t[1]["prompt"], max_new_tokens=2)
+        router.run(max_wall_s=180)
+        on_step = None
+        if controller:
+            ctrl = PoolController(
+                router,
+                worker_flags={"decode": decode_flags},
+                min_decode=1, max_decode=2, min_prefill=1,
+                max_prefill=1, scale_up_after=2, scale_down_after=3,
+                cooldown_ticks=2, tick_interval_s=0.25)
+            ctrl.tick()          # open the chip-seconds clock at start
+            on_step = ctrl.maybe_tick
+        t0 = _time.perf_counter()
+        out = router.run_trace(trace, max_wall_s=600, on_step=on_step)
+        # the trough: sparse-to-zero arrivals.  The controller keeps
+        # ticking (this is where the scale-down fires); the static
+        # cell just burns its peak fleet.  Anchored at run_trace's
+        # RETURN, not the trace span — a loaded box that took longer
+        # than the span to drain the crowd must still get its full
+        # near-idle window, or the scale-down gate fails spuriously.
+        trough_deadline = _time.perf_counter() + _TROUGH_S
+        while _time.perf_counter() < trough_deadline:
+            out.extend(router.step())
+            if on_step is not None:
+                on_step()
+            # AFTER the tick: a drain fired by on_step banks any
+            # completed-but-unpolled responses, and missing them here
+            # would fail the zero-lost gate spuriously
+            out.extend(router.take_drain_completions())
+            _time.sleep(0.02)
+        wall = _time.perf_counter() - t0
+        if controller:
+            ctrl.tick()          # close the accrual window
+            out.extend(router.take_drain_completions())
+            st = ctrl.stats()
+            chip_s = st["chip_seconds"]
+            actions = [(a["action"], a["pool"])
+                       for a in st["actions"]]
+            drained = st["drained_requests"]
+        else:
+            chip_s = wall * (1 + len(dc_addrs))
+            actions, drained = [], 0
+        met = sum(1 for r in out if r.slo_met)
+        row = {
+            "wall_s": round(wall, 3),
+            "completed": len(out),
+            "submitted": len(trace),
+            "zero_lost": len(out) == len(trace),
+            "goodput_rate": round(met / max(len(out), 1), 4),
+            "chip_seconds": round(chip_s, 3),
+            "migrations": sum(r.migrations for r in out),
+            "requeues": sum(r.requeues for r in out),
+            "actions": actions,
+            "drained_requests": drained,
+            "slo": _slo_fields(out),
+            "tokens": [r.tokens.tolist() for r in sorted(
+                out, key=lambda r: r.request_id)],
+        }
+        return row
+    finally:
+        if ctrl is not None:
+            ctrl.close()
+        if router is not None:
+            try:
+                router.close(shutdown_workers=True)
+            except Exception:
+                pass
+        for proc in procs:
+            try:
+                shutdown_worker(proc)
+            except Exception:
+                proc.kill()
+
+
+def bench_serve_trace_controller(platform="cpu"):
+    """THE ISSUE 15 anchor: one diurnal + flash-crowd trace replayed
+    against the spawned-process cluster, controller on/off x chunked
+    prefill on/off.  Controller-off is static PEAK provisioning held
+    through the post-crowd trough (the fleet an operator without an
+    autoscaler must run); controller-on starts at the same peak and
+    lets the elastic loop act on ``autoscale_signal`` — the trough's
+    sustained idle signal drains one decode worker losslessly and
+    reaps it.  Gates: controller-on goodput >= off at measurably fewer
+    chip-seconds, zero requests lost across scale-down drains, and all
+    four cells token-identical (greedy — which subsumes
+    migrated-output identity on the raw wire; the deterministic
+    mid-flight migration pin lives in
+    tests/test_serving_controller.py).
+
+    What the chunked dimension measures HERE, honestly: in the
+    disaggregated topology decode pools receive already-prefilled KV
+    (``submit_prefilled``), which never takes the chunked path — the
+    chunked cells differ from the chunked-off cells only where a
+    preemption forces a local resume replay (that replay IS chunked),
+    so this axis pins "chunking changes nothing on the cluster path"
+    (token identity, no throughput regression), not the interference
+    bound.  The interference bound — the ISSUE 15 TPOT gate — is the
+    co-located engine's story and is measured by
+    ``bench_chunked_starvation`` on the same JSON line."""
+    rng = np.random.RandomState(23)
+    cfg = _trace_cfg()
+    trace = _diurnal_trace(rng, cfg.vocab_size)
+    rows = {"backend": platform, "skipped": False,
+            "requests": len(trace),
+            "trace_span_s": round(trace[-1][0], 3),
+            "chunk_tokens": _CTRL_ENGINE["chunk_tokens"],
+            # the chunked axis on the CLUSTER path covers only
+            # preempt->resume replays (decode pools inject prefilled
+            # KV); the TPOT interference gate lives in the
+            # chunked_starvation row of this same JSON line
+            "chunked_axis_note": "cluster decode pools receive "
+            "prefilled KV — chunking engages on resume replays only; "
+            "see chunked_starvation for the interference gate"}
+    cells = {}
+    for chunked in (False, True):
+        for controller in (False, True):
+            name = (f"chunked_{'on' if chunked else 'off'}"
+                    f"_controller_{'on' if controller else 'off'}")
+            try:
+                cells[name] = _controller_cell(trace, chunked,
+                                               controller)
+            except Exception as e:
+                cells[name] = {
+                    "error": f"{type(e).__name__}: {e}"[:200]}
+    token_sets = [c.pop("tokens") for c in cells.values()
+                  if "tokens" in c]
+    rows["token_identical_across_cells"] = (
+        len(token_sets) == 4
+        and all(t == token_sets[0] for t in token_sets[1:]))
+    rows.update(cells)
+    on = cells.get("chunked_on_controller_on", {})
+    off = cells.get("chunked_on_controller_off", {})
+    if "goodput_rate" in on and "goodput_rate" in off:
+        rows["goodput_ok"] = (on["goodput_rate"]
+                              >= off["goodput_rate"])
+        rows["chip_seconds_saved_frac"] = round(
+            1 - on["chip_seconds"] / max(off["chip_seconds"], 1e-9), 4)
+        rows["chip_seconds_ok"] = (on["chip_seconds"]
+                                   < off["chip_seconds"])
+        rows["zero_lost"] = (on.get("zero_lost", False)
+                             and off.get("zero_lost", False))
+    return rows
+
+
 def bench_resnet50(on_tpu):
     from apex_tpu.models.resnet import make_resnet_train_step, resnet50
 
@@ -1809,6 +2153,16 @@ def main():
              "identical numerics, not chip rates.  --cache-layout "
              "picks the decode pool layout(s)")
     parser.add_argument(
+        "--controller", action="store_true",
+        help="with --serve-trace: run ONLY the ISSUE 15 elastic-"
+             "controller ablation instead of the disaggregation rows "
+             "— the diurnal + flash-crowd trace, controller on/off x "
+             "chunked prefill on/off (goodput, p95 TTFT/TPOT, "
+             "chip-seconds, zero-lost drains), plus the chunked-"
+             "prefill starvation gate (one long prompt co-resident: "
+             "decode TPOT p95 with chunking <= 2x the no-long-prompt "
+             "baseline)")
+    parser.add_argument(
         "--wire-dtype", default="raw", metavar="DTYPES",
         help="comma list of KV handoff wire formats (raw, bf16, int8) "
              "for the --serve-trace rows; raw is the token-identity "
@@ -1866,6 +2220,9 @@ def main():
     if bad or not wire_dtypes:
         parser.error(f"--wire-dtype {args.wire_dtype!r}: expected a "
                      "comma list of raw, bf16, int8")
+    if args.controller and not args.serve_trace:
+        parser.error("--controller rides the serve-trace harness; "
+                     "pass --serve-trace --controller")
     if args.serve_trace:
         # the topology demo is CPU-pinned BEFORE backend init: both
         # topologies (and the spawned worker processes) must share one
@@ -1956,6 +2313,41 @@ def main():
             "backend": platform,
             "skipped": False,
             "details": rows,
+            "runtime": runtime_summary(),
+        }))
+        return
+    if args.serve_trace and args.controller:
+        details = {}
+        try:
+            details["chunked_starvation"] = bench_chunked_starvation(
+                platform=platform)
+        except Exception as e:
+            details["chunked_starvation"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        try:
+            details["controller_trace"] = bench_serve_trace_controller(
+                platform=platform)
+        except Exception as e:
+            details["controller_trace"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+        ct = details["controller_trace"]
+        if "error" in ct:
+            skipped = f"controller trace failed: {ct['error']}"
+        elif "chip_seconds_saved_frac" not in ct:
+            skipped = "controller cells incomplete: no chip-seconds " \
+                      "comparison"
+        else:
+            skipped = False
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "metric": "serve_trace_controller",
+            # headline: the chip-second fraction the elastic loop
+            # saved at >= static goodput over the diurnal window
+            "value": ct.get("chip_seconds_saved_frac", 0.0),
+            "unit": "frac",
+            "backend": platform,
+            "skipped": skipped,
+            "details": details,
             "runtime": runtime_summary(),
         }))
         return

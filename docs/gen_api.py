@@ -168,6 +168,9 @@ MODULES = [
      "serving.cluster.worker — prefill/decode pool members"),
     ("apex_tpu.serving.cluster.router", "serving",
      "serving.cluster.router — SLO-aware dispatch + requeue"),
+    ("apex_tpu.serving.cluster.controller", "serving",
+     "serving.cluster.controller — elastic pool controller "
+     "(spawn/drain on autoscale_signal)"),
     # data
     ("apex_tpu.data.image_folder", "data",
      "data.image_folder — file-backed input pipeline"),

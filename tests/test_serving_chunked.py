@@ -1,0 +1,368 @@
+"""Chunked prefill (ISSUE 15): the model-level primitive and the
+engine's mixed prefill/decode step batching.
+
+The acceptance pins: chunked-vs-monolithic prefill greedy
+token-identical on both cache layouts (first-token-identical on the
+int8 ``cache_wire`` pool), including across a mid-prefill
+preempt→resume and with speculative decoding enabled; one prefill
+chunk per engine step interleaved with co-resident decode; and the
+tokens-admittable headroom signal."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import observability as obs
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.generate import (
+    decode_step, init_kv_cache, prefill, prefill_chunked)
+from apex_tpu.models.transformer_lm import init_gpt_params
+from apex_tpu.serving import ServingEngine
+
+
+def _cfg(**kw):
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_position_embeddings", 128)
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("remat", False)
+    return TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _greedy_continue(params, cfg, logits, cache, steps=6):
+    """argmax continuation — the real token-identity check (cache
+    CONTENT equality is too strict: chunk vs flash accumulation order
+    may differ in low bits; what must not differ is the decode)."""
+    toks = []
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    toks.append(np.asarray(tok))
+    for _ in range(steps - 1):
+        logits, cache = decode_step(params, tok, cache, cfg)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        toks.append(np.asarray(tok))
+    return np.stack(toks, 1)
+
+
+class TestPrefillChunked:
+    @pytest.mark.parametrize("layout", ["contiguous", "paged"])
+    @pytest.mark.parametrize("chunk", [16, 13, 64])
+    def test_greedy_identical_to_monolithic(self, model, layout,
+                                            chunk):
+        """Dividing, non-dividing, and larger-than-prompt chunk sizes:
+        the final chunk's last-token logits ARE the first-token logits
+        and the greedy continuation is token-identical."""
+        cfg, params = model
+        rng = np.random.RandomState(0)
+        prompt = jnp.asarray(rng.randint(0, 128, (2, 37)))
+        kw = dict(cache_layout=layout, block_size=8)
+        c1 = init_kv_cache(cfg, 2, 60, **kw)
+        lg_m, cm = prefill(params, prompt, cfg, cache=c1)
+        c2 = init_kv_cache(cfg, 2, 60, **kw)
+        lg_c, cc = prefill_chunked(params, prompt, cfg,
+                                   chunk_tokens=chunk, cache=c2)
+        assert (np.asarray(jnp.argmax(lg_m, -1))
+                == np.asarray(jnp.argmax(lg_c, -1))).all()
+        gm = _greedy_continue(params, cfg, lg_m, cm)
+        gc = _greedy_continue(params, cfg, lg_c, cc)
+        assert (gm == gc).all()
+        assert (np.asarray(cc["pos"]) == 37).all()
+
+    @pytest.mark.parametrize("layout", ["contiguous", "paged"])
+    def test_ragged_rows_pick_their_own_last_token(self, model,
+                                                   layout):
+        """Rows whose prompt ends inside an EARLIER chunk must return
+        that chunk's logits row, and every row's continuation matches
+        the monolithic ragged prefill."""
+        cfg, params = model
+        rng = np.random.RandomState(1)
+        prompt = jnp.asarray(rng.randint(0, 128, (3, 37)))
+        lens = jnp.asarray([37, 20, 5], jnp.int32)
+        kw = dict(cache_layout=layout, block_size=8)
+        lg_m, cm = prefill(params, prompt, cfg, prompt_lens=lens,
+                           cache=init_kv_cache(cfg, 3, 60, **kw))
+        lg_c, cc = prefill_chunked(
+            params, prompt, cfg, chunk_tokens=16, prompt_lens=lens,
+            cache=init_kv_cache(cfg, 3, 60, **kw))
+        assert (np.asarray(jnp.argmax(lg_m, -1))
+                == np.asarray(jnp.argmax(lg_c, -1))).all()
+        gm = _greedy_continue(params, cfg, lg_m, cm)
+        gc = _greedy_continue(params, cfg, lg_c, cc)
+        assert (gm == gc).all()
+        assert np.asarray(cc["pos"]).tolist() == [37, 20, 5]
+
+    def test_int8_pool_first_token_identical(self, model):
+        """int8 cache_wire: the PR-14 contract — deterministic and
+        first-token-identical (later chunks read the quantized prefix,
+        so the trajectory beyond it carries the documented int8
+        divergence allowance)."""
+        cfg, params = model
+        rng = np.random.RandomState(2)
+        prompt = jnp.asarray(rng.randint(0, 128, (2, 37)))
+        kw = dict(cache_layout="paged", block_size=8,
+                  cache_wire="int8")
+        lg_m, _ = prefill(params, prompt, cfg,
+                          cache=init_kv_cache(cfg, 2, 60, **kw))
+        lg_c, _ = prefill_chunked(
+            params, prompt, cfg, chunk_tokens=16,
+            cache=init_kv_cache(cfg, 2, 60, **kw))
+        assert (np.asarray(jnp.argmax(lg_m, -1))
+                == np.asarray(jnp.argmax(lg_c, -1))).all()
+        # deterministic: a second chunked run is bitwise the first
+        lg_c2, _ = prefill_chunked(
+            params, prompt, cfg, chunk_tokens=16,
+            cache=init_kv_cache(cfg, 2, 60, **kw))
+        assert (np.asarray(lg_c) == np.asarray(lg_c2)).all()
+
+    def test_bad_args_raise(self, model):
+        cfg, params = model
+        prompt = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(ValueError, match="chunk_tokens"):
+            prefill_chunked(params, prompt, cfg, chunk_tokens=0)
+        with pytest.raises(ValueError, match="exceeds the cache"):
+            prefill_chunked(params, prompt, cfg, chunk_tokens=4,
+                            cache=init_kv_cache(cfg, 1, 4))
+
+
+def _reqs(rng, n_short=2, long_prompt=60):
+    reqs = [dict(prompt=rng.randint(0, 128, (long_prompt,)),
+                 max_new_tokens=8, slo_class="batch")]
+    reqs += [dict(prompt=rng.randint(0, 128, (7 + 3 * i,)),
+                  max_new_tokens=6) for i in range(n_short)]
+    return reqs
+
+
+def _run_engine(params, cfg, reqs, **kw):
+    eng = ServingEngine(params, cfg, **kw)
+    out = eng.run([dict(r, prompt=r["prompt"].copy()) for r in reqs])
+    return eng, out
+
+
+class TestEngineChunked:
+    @pytest.mark.parametrize("layout", ["contiguous", "paged"])
+    def test_greedy_identical_to_monolithic_engine(self, model,
+                                                   layout):
+        cfg, params = model
+        rng = np.random.RandomState(3)
+        reqs = _reqs(rng)
+        kw = dict(max_slots=3, max_len=96, cache_layout=layout)
+        if layout == "paged":
+            kw["block_size"] = 8
+        _, ref = _run_engine(params, cfg, reqs, **kw)
+        eng, out = _run_engine(params, cfg, reqs, chunk_tokens=16,
+                               **kw)
+        assert [r.tokens.tolist() for r in out] == [
+            r.tokens.tolist() for r in ref]
+        # the long prompt actually went through the chunked path
+        assert eng.stats()["chunk_tokens"] == 16
+
+    def test_spec_decode_composes(self, model):
+        """spec + chunked greedy == plain engine greedy: the lane
+        joins the speculative batch after its last chunk."""
+        cfg, params = model
+        rng = np.random.RandomState(4)
+        reqs = _reqs(rng)
+        kw = dict(max_slots=3, max_len=96, cache_layout="paged",
+                  block_size=8)
+        _, ref = _run_engine(params, cfg, reqs, **kw)
+        _, out = _run_engine(params, cfg, reqs, chunk_tokens=16,
+                             spec="ngram", **kw)
+        assert [r.tokens.tolist() for r in out] == [
+            r.tokens.tolist() for r in ref]
+
+    def test_int8_wire_first_token_identical(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(5)
+        reqs = _reqs(rng)
+        kw = dict(max_slots=3, max_len=96, cache_layout="paged",
+                  block_size=8, cache_wire="int8")
+        _, ref = _run_engine(params, cfg, reqs, **kw)
+        _, out = _run_engine(params, cfg, reqs, chunk_tokens=16, **kw)
+        assert [r.tokens.tolist()[0] for r in out] == [
+            r.tokens.tolist()[0] for r in ref]
+
+    def test_decode_progresses_between_chunks(self, model):
+        """The mixed-step property itself: while the long prompt is
+        mid-prefill, co-resident lanes keep emitting — a short request
+        FINISHES before the long one produces its first token."""
+        cfg, params = model
+        rng = np.random.RandomState(6)
+        eng = ServingEngine(params, cfg, max_slots=2, max_len=96,
+                            cache_layout="paged", block_size=8,
+                            chunk_tokens=8)
+        short = eng.submit(rng.randint(0, 128, (6,)),
+                           max_new_tokens=4)
+        eng.step()                       # short admits and decodes
+        long_rid = eng.submit(rng.randint(0, 128, (60,)),
+                              max_new_tokens=4)
+        order = []
+        while not eng.idle:
+            for r in eng.step():
+                order.append(r.request_id)
+        assert order.index(short) < order.index(long_rid)
+        # and the long prompt really streamed: >1 chunk counted
+        st = eng.stats()
+        assert st["prefilling"] == 0
+
+    def test_chunk_telemetry(self, model):
+        """serving.prefill_chunks counts every chunk; the progress
+        gauges exist (and drain to zero) on a chunked engine; exactly
+        one prefill_calls per request."""
+        cfg, params = model
+        reg = obs.configure()
+        try:
+            rng = np.random.RandomState(7)
+            reqs = _reqs(rng, n_short=1, long_prompt=40)
+            _, out = _run_engine(params, cfg, reqs, chunk_tokens=16,
+                                 max_slots=2, max_len=96,
+                                 cache_layout="paged", block_size=8)
+            assert len(out) == 2
+            recs = reg.snapshot()
+            chunks = sum(r["value"] for r in recs
+                         if r["kind"] == "counter"
+                         and r["name"] == "serving.prefill_chunks")
+            assert chunks == 3           # ceil(40/16)
+            calls = sum(r["value"] for r in recs
+                        if r["kind"] == "counter"
+                        and r["name"] == "serving.prefill_calls")
+            assert calls == 2
+            gauges = {r["name"]: r["value"] for r in recs
+                      if r["kind"] == "gauge"}
+            assert gauges.get("serving.prefilling") == 0
+            assert gauges.get("serving.prefill_progress_total") == 0
+        finally:
+            obs.shutdown()
+
+    def test_mid_prefill_preempt_resume_parity(self, model):
+        """A prefilling lane evicted between chunks (pool pressure)
+        resumes by replaying its chunks — greedy outputs identical to
+        a monolithic engine run of the same requests."""
+        cfg, params = model
+        rng = np.random.RandomState(8)
+        # tiny pool: the shorts' tail allocation must evict the
+        # youngest (the long, still prefilling) at least once
+        reqs = [dict(prompt=rng.randint(0, 128, (10,)),
+                     max_new_tokens=10) for _ in range(2)]
+        reqs.append(dict(prompt=rng.randint(0, 128, (40,)),
+                         max_new_tokens=4, slo_class="batch"))
+        kw = dict(max_slots=3, max_len=64, cache_layout="paged",
+                  block_size=4, num_blocks=20, reserve_blocks=0)
+        eng_ref, ref = _run_engine(params, cfg, reqs, **kw)
+        eng, out = _run_engine(params, cfg, reqs, chunk_tokens=8,
+                               **kw)
+        assert [r.tokens.tolist() for r in out] == [
+            r.tokens.tolist() for r in ref]
+        assert eng.stats()["preemptions"] >= 1
+
+    def test_short_prompts_keep_monolithic_path(self, model):
+        """Prompts <= chunk_tokens admit through the one-shot path
+        (prefix sharing stays available for them)."""
+        cfg, params = model
+        reg = obs.configure()
+        try:
+            eng = ServingEngine(params, cfg, max_slots=2, max_len=64,
+                                cache_layout="paged", block_size=8,
+                                chunk_tokens=32)
+            eng.submit(np.arange(1, 9), max_new_tokens=2)
+            while not eng.idle:
+                eng.step()
+            chunks = sum(r["value"] for r in reg.snapshot()
+                         if r["kind"] == "counter"
+                         and r["name"] == "serving.prefill_chunks")
+            assert chunks == 0
+        finally:
+            obs.shutdown()
+
+    def test_chunked_blocks_never_prefix_shared(self, model):
+        """Two identical long prompts through the chunked path share
+        nothing (chunk-written pages are digest-invisible by design)."""
+        cfg, params = model
+        rng = np.random.RandomState(9)
+        prompt = rng.randint(0, 128, (40,))
+        eng = ServingEngine(params, cfg, max_slots=2, max_len=64,
+                            cache_layout="paged", block_size=8,
+                            chunk_tokens=16)
+        eng.submit(prompt.copy(), max_new_tokens=24)
+        eng.submit(prompt.copy(), max_new_tokens=24)
+        for _ in range(6):
+            eng.step()
+        assert eng.stats()["prefix_shared_blocks"] == 0
+        while not eng.idle:
+            eng.step()
+
+
+class TestChunkKnob:
+    def test_env_override_beats_caller(self, model, monkeypatch):
+        cfg, params = model
+        monkeypatch.setenv("APEX_TPU_CHUNK_TOKENS", "24")
+        eng = ServingEngine(params, cfg, max_slots=1, max_len=64,
+                            chunk_tokens=8)
+        assert eng.chunk_tokens == 24
+        monkeypatch.setenv("APEX_TPU_CHUNK_TOKENS", "off")
+        eng = ServingEngine(params, cfg, max_slots=1, max_len=64,
+                            chunk_tokens=8)
+        assert eng.chunk_tokens is None
+
+    def test_env_malformed_warns_by_name(self, model, monkeypatch):
+        cfg, params = model
+        monkeypatch.setenv("APEX_TPU_CHUNK_TOKENS", "banana")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            eng = ServingEngine(params, cfg, max_slots=1, max_len=64,
+                                chunk_tokens=8)
+        assert eng.chunk_tokens == 8
+        assert any("APEX_TPU_CHUNK_TOKENS" in str(x.message)
+                   for x in w)
+
+    def test_invalid_caller_value_raises(self, model):
+        cfg, params = model
+        with pytest.raises(ValueError, match="chunk_tokens"):
+            ServingEngine(params, cfg, max_slots=1, max_len=64,
+                          chunk_tokens=0)
+
+
+class TestHeadroomTokens:
+    def test_paged_headroom_in_tokens(self, model):
+        cfg, params = model
+        eng = ServingEngine(params, cfg, max_slots=2, max_len=64,
+                            cache_layout="paged", block_size=8,
+                            reserve_blocks=1)
+        st = eng.stats()
+        assert st["headroom_tokens"] == st["free_block_headroom"] * 8
+
+    def test_contiguous_headroom_in_tokens(self, model):
+        cfg, params = model
+        eng = ServingEngine(params, cfg, max_slots=3, max_len=64)
+        assert eng.stats()["headroom_tokens"] == 3 * 64
+
+    def test_int8_pool_reports_more_tokens_at_matched_bytes(self,
+                                                            model):
+        """THE over-spawn fix: at matched pool bytes the int8 pool
+        genuinely admits ``2*dh/(dh+4)``x the tokens (~1.88x at the
+        serving dh=64, 1.6x at this test's dh=16) and headroom_tokens
+        says so — a byte-blind signal would read the two pools as
+        equal."""
+        cfg, params = model
+        kw = dict(max_slots=4, max_len=64, cache_layout="paged",
+                  block_size=8, cache_dtype=jnp.bfloat16,
+                  reserve_blocks=0)
+        native = ServingEngine(params, cfg, **kw)
+        quant = ServingEngine(params, cfg, cache_wire="int8", **kw)
+        # byte-parity default pools (the ISSUE 14 construction)
+        ratio = (quant.stats()["headroom_tokens"]
+                 / native.stats()["headroom_tokens"])
+        dh = cfg.kv_channels
+        expected = 2 * dh / (dh + 4)
+        assert ratio == pytest.approx(expected, rel=0.05)
+        assert ratio > 1.5

@@ -196,6 +196,8 @@ class TestRoutingPolicy:
 
         class _W:
             alive = True
+            draining = False
+            addr = "w0"
             stats = {"free_block_headroom": 5, "max_slots": 4,
                      "active": 1}
             in_flight = {}

@@ -1,0 +1,739 @@
+"""Elastic pool controller (ISSUE 15): hysteresis policy units, the
+autoscale_signal edge cases the controller now exercises, lossless
+drain migration, and the controller loop over real in-process
+workers."""
+
+import io
+import threading
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import observability as obs
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.transformer_lm import init_gpt_params
+from apex_tpu.serving import ServingEngine
+from apex_tpu.serving.cluster import (
+    PoolController, Router, WorkerServer)
+
+
+def _cfg(**kw):
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("remat", False)
+    return TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _start(server):
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return t
+
+
+# ---------------------------------------------------------------------------
+# stub-router policy units (no sockets, no jax state)
+# ---------------------------------------------------------------------------
+
+
+class _StubWorker:
+    def __init__(self, addr, pool):
+        self.addr = addr
+        self.pool = pool
+        self.alive = True
+        self.draining = False
+        self.in_flight = {}
+        self.stats = {"max_slots": 4, "active": 0,
+                      "headroom_tokens": 64, "block_size": 8}
+
+
+class _StubRouter:
+    """The surface PoolController touches, with a scripted signal."""
+
+    def __init__(self, hints):
+        self.hints = list(hints)          # per-tick decode hints
+        self._prefill = [_StubWorker("p0", "prefill")]
+        self._decode = [_StubWorker("d0", "decode")]
+        self.spawned = 0
+        self.drained = []
+
+    def _pool_list(self, pool):
+        return self._prefill if pool == "prefill" else self._decode
+
+    def scrape_stats(self):
+        pass
+
+    def autoscale_signal(self, fleet_summary=None):
+        hint = self.hints.pop(0) if self.hints else 0
+        return {"decode": {"hint": hint, "workers": len(self._decode)},
+                "prefill": {"hint": 0,
+                            "workers": len(self._prefill)}}
+
+    def add_worker(self, addr, pool):
+        self._pool_list(pool).append(_StubWorker(addr, pool))
+
+    def remove_worker(self, addr):
+        for pool in (self._prefill, self._decode):
+            for w in list(pool):
+                if w.addr == addr:
+                    pool.remove(w)
+
+    def drain_worker(self, addr):
+        self.drained.append(addr)
+        for w in self._decode:
+            if w.addr == addr:
+                w.draining = True
+        return {"migrated": 1, "requeued": 0, "completed": 0}
+
+
+def _stub_ctrl(hints, **kw):
+    router = _StubRouter(hints)
+    kw.setdefault("min_decode", 1)
+    kw.setdefault("max_decode", 3)
+    kw.setdefault("scale_up_after", 2)
+    kw.setdefault("scale_down_after", 2)
+    kw.setdefault("cooldown_ticks", 1)
+    kw.setdefault("tick_interval_s", 0.0)
+
+    def spawn(pool):
+        router.spawned += 1
+        return object(), f"new{router.spawned}"
+
+    ctrl = PoolController(router, spawn=spawn, **kw)
+    return router, ctrl
+
+
+class TestHysteresis:
+    def test_flapping_signal_never_acts(self):
+        """THE no-oscillation pin: a noisy window flipping
+        +1/0/+1/0/-1/0... moves nothing — every flap back to 0 resets
+        both streaks."""
+        router, ctrl = _stub_ctrl([1, 0, 1, 0, -1, 0, 1, 0, -1, 0])
+        for _ in range(10):
+            ctrl.tick()
+        assert ctrl.stats()["actions_taken"] == 0
+        assert router.spawned == 0 and router.drained == []
+
+    def test_sustained_up_spawns_once_then_cooldown(self):
+        router, ctrl = _stub_ctrl([1, 1, 1, 1, 1, 1],
+                                  cooldown_ticks=3)
+        acts = [ctrl.tick()["actions"] for _ in range(4)]
+        # tick 1: streak 1 -> nothing; tick 2: spawn; ticks 3-4 are
+        # inside the cooldown even though the hint stays +1
+        assert [len(a) for a in acts] == [0, 1, 0, 0]
+        assert router.spawned == 1
+        assert ctrl.stats()["last_action"]["action"] == "spawn"
+
+    def test_sustained_down_drains_and_reaps(self):
+        router, ctrl = _stub_ctrl([0, 0, -1, -1])
+        router.add_worker("d1", "decode")       # room to shrink
+        for _ in range(4):
+            ctrl.tick()
+        assert router.drained == ["d0"] or router.drained == ["d1"]
+        assert ctrl.stats()["drained_requests"] == 1
+        assert ctrl.stats()["pool_size"]["decode"] == 1
+
+    def test_bounds_respected(self):
+        # at max: a sustained up-signal takes no action
+        router, ctrl = _stub_ctrl([1] * 6, max_decode=1)
+        for _ in range(6):
+            ctrl.tick()
+        assert router.spawned == 0
+        # at min: a sustained down-signal takes no action
+        router, ctrl = _stub_ctrl([-1] * 6)
+        for _ in range(6):
+            ctrl.tick()
+        assert router.drained == []
+
+    def test_chip_seconds_accrue(self):
+        router, ctrl = _stub_ctrl([0] * 3)
+        ctrl.tick()
+        time.sleep(0.05)
+        ctrl.tick()
+        assert ctrl.stats()["chip_seconds"] > 0
+
+    def test_bad_knobs_raise(self):
+        with pytest.raises(ValueError, match="min pool"):
+            PoolController(_StubRouter([]), spawn=lambda p: None,
+                           min_decode=0)
+        with pytest.raises(ValueError, match="below min"):
+            PoolController(_StubRouter([]), spawn=lambda p: None,
+                           min_decode=2, max_decode=1)
+
+    def test_transient_spawn_failure_recorded_not_raised(self):
+        """A spawn that times out (worker never READY) must not unwind
+        the serving loop the controller rides on: the tick records a
+        spawn_failed action, cooldown applies, and the next sustained
+        streak retries."""
+        router = _StubRouter([1] * 6)
+        calls = []
+
+        def spawn(pool):
+            calls.append(pool)
+            raise RuntimeError("worker failed to become ready")
+
+        ctrl = PoolController(router, spawn=spawn, min_decode=1,
+                              max_decode=3, scale_up_after=2,
+                              cooldown_ticks=2, tick_interval_s=0.0)
+        for _ in range(6):
+            ctrl.tick()                  # must not raise
+        st = ctrl.stats()
+        fails = [a for a in st["actions"]
+                 if a["action"] == "spawn_failed"]
+        assert fails and "ready" in fails[0]["error"]
+        assert len(calls) == 2           # retried after the cooldown
+
+    def test_spawn_without_flags_or_hook_fails_loudly(self):
+        router = _StubRouter([1, 1, 1])
+        ctrl = PoolController(router, min_decode=1, max_decode=2,
+                              scale_up_after=2, cooldown_ticks=0,
+                              tick_interval_s=0.0)
+        ctrl.tick()
+        with pytest.raises(ValueError, match="worker_flags"):
+            ctrl.tick()
+
+
+# ---------------------------------------------------------------------------
+# autoscale_signal edge cases the controller exercises
+# ---------------------------------------------------------------------------
+
+
+def _bare_router(**kw):
+    from apex_tpu.serving.slo import resolve_slo_targets
+
+    r = object.__new__(Router)
+    r._prefill, r._decode = [], []
+    r._slo_targets = resolve_slo_targets(None)
+    r._caps = kw.get("queue_caps", {})
+    r._priority = ("interactive", "standard", "default", "batch")
+    r.wire_dtype = "raw"
+    r._max_worker_queue = 4
+    r._queues = {}
+    r._next_rid = 0
+    r._pf_rr = 0
+    r._last_decode_pick = None
+    r._requeued_total = 0
+    r._completed_total = 0
+    r._drain_completed = []
+    return r
+
+
+_SIG_N = [0]
+
+
+class _SigWorker:
+    def __init__(self, headroom=64, active=1, draining=False):
+        _SIG_N[0] += 1
+        self.addr = f"sig{_SIG_N[0]}"
+        self.alive = True
+        self.draining = draining
+        self.in_flight = {}
+        self.stats = {"headroom_tokens": headroom, "max_slots": 4,
+                      "active": active, "block_size": 8}
+
+
+class TestAutoscaleEdges:
+    def test_empty_fleet_summary(self):
+        """{} and None both degrade to live signals only."""
+        r = _bare_router()
+        r._decode = [_SigWorker()]
+        r._prefill = [_SigWorker()]
+        for fleet in (None, {}, {"sketches": {}}):
+            sig = r.autoscale_signal(fleet)
+            assert sig["decode"]["hint"] == 0
+            assert "slo_violations" not in sig
+
+    def test_single_class_traffic(self):
+        """One class queued deep enough trips the backpressure grow
+        signal; the per-class queue shape doesn't matter."""
+        r = _bare_router()
+        r._decode = [_SigWorker()]
+        r._prefill = [_SigWorker()]
+        for _ in range(5):
+            r.submit([1, 2], slo_class="standard")
+        sig = r.autoscale_signal()
+        assert sig["decode"]["hint"] == 1
+        assert sig["decode"]["router_queue"] == 5
+
+    def test_all_pools_draining_reads_as_grow(self):
+        """Every decode worker draining = an empty pool about to
+        happen: hint must be +1 (and never -1 'idle headroom')."""
+        r = _bare_router()
+        r._decode = [_SigWorker(draining=True),
+                     _SigWorker(draining=True)]
+        r._prefill = [_SigWorker()]
+        sig = r.autoscale_signal()
+        assert sig["decode"]["hint"] == 1
+        assert sig["decode"]["workers"] == 0
+        assert sig["decode"]["draining"] == 2
+
+    def test_headroom_counted_in_tokens(self):
+        """An int8-style worker advertising more headroom_tokens keeps
+        the fused signal from reading exhausted; a worker without the
+        key falls back to blocks x block_size."""
+        r = _bare_router()
+        old = _SigWorker()
+        del old.stats["headroom_tokens"]
+        old.stats["free_block_headroom"] = 4      # 4 * 8 = 32 tokens
+        r._decode = [old, _SigWorker(headroom=120)]
+        r._prefill = [_SigWorker()]
+        sig = r.autoscale_signal()
+        assert sig["decode"]["headroom_tokens"] == 152
+
+    def test_draining_worker_excluded_from_shrink_candidates(self):
+        """A draining worker's idle occupancy must not count toward
+        the shrink signal (it is already leaving)."""
+        r = _bare_router()
+        r._decode = [_SigWorker(active=2),
+                     _SigWorker(active=0, draining=True)]
+        r._prefill = [_SigWorker()]
+        sig = r.autoscale_signal()
+        # mean occupancy over NON-draining workers only: 2/4 = 0.5
+        assert sig["decode"]["mean_occupancy"] == 0.5
+        assert sig["decode"]["hint"] == 0
+
+
+# ---------------------------------------------------------------------------
+# drain migration over real sockets (the lossless scale-down pin)
+# ---------------------------------------------------------------------------
+
+
+def _pools(params, cfg, n_decode=2, **decode_kw):
+    decode_kw.setdefault("max_len", 32)
+    decode_kw.setdefault("cache_layout", "paged")
+    decode_kw.setdefault("block_size", 4)
+    decode_kw.setdefault("max_slots", 2)
+    servers = [WorkerServer("prefill", params, cfg, max_len=32)]
+    servers += [WorkerServer("decode", params, cfg, **decode_kw)
+                for _ in range(n_decode)]
+    for s in servers:
+        _start(s)
+    return servers
+
+
+class TestDrainMigration:
+    def test_mid_flight_drain_token_identical(self, model):
+        """THE ACCEPTANCE PIN: drain a decode worker while it holds
+        in-flight requests — every request completes on the survivor
+        with tokens IDENTICAL to a never-drained single engine (raw
+        wire), zero lost, migrations counted."""
+        cfg, params = model
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(0, 128, (4 + i,)) for i in range(6)]
+        # 40-token decodes keep lanes busy long enough for the drain
+        # to land mid-flight (the point of the test)
+        single = ServingEngine(params, cfg, max_slots=2, max_len=64,
+                               cache_layout="paged", block_size=4)
+        for p in prompts:
+            single.submit(p, max_new_tokens=40)
+        ref = {}
+        while not single.idle:
+            for r in single.step():
+                ref[tuple(r.prompt.tolist())] = r.tokens.tolist()
+
+        servers = _pools(params, cfg, max_len=64)
+        victim = servers[1]
+        router = Router([servers[0].addr],
+                        [servers[1].addr, servers[2].addr],
+                        max_worker_queue=3)
+        try:
+            for p in prompts:
+                router.submit(p, max_new_tokens=40)
+            out = []
+            deadline = time.time() + 60
+            victim_w = next(w for w in router._decode
+                            if w.addr == victim.addr)
+            while time.time() < deadline and not victim_w.in_flight:
+                out.extend(router.step())
+            assert victim_w.in_flight, "victim never got work"
+            # wait until the victim's ENGINE holds a live lane —
+            # scrape_stats refreshes stats WITHOUT draining
+            # completions, so the observation cannot race the poll
+            while (time.time() < deadline
+                   and victim_w.stats.get("active", 0) < 1):
+                router.scrape_stats()
+                time.sleep(0.005)
+            drained = router.drain_worker(victim.addr)
+            assert drained["migrated"] >= 1
+            out.extend(router.take_drain_completions())
+            router.remove_worker(victim.addr)
+            out.extend(router.run(max_wall_s=120))
+            got = {tuple(r.prompt.tolist()): r.tokens.tolist()
+                   for r in out}
+            assert got == ref              # zero lost, all exact
+            assert any(r.migrations > 0 for r in out)
+            assert all(r.pool == servers[2].addr for r in out
+                       if r.migrations)
+        finally:
+            router.close(shutdown_workers=True)
+            for s in servers:
+                s.stop()
+
+    def test_drain_requeues_engine_queued_requests(self, model):
+        """Requests still QUEUED inside the drained worker's engine
+        (admission-blocked) requeue at the router for a fresh dispatch
+        — nothing migrates for them, nothing is lost."""
+        cfg, params = model
+        rng = np.random.RandomState(12)
+        # 1-slot victim: dispatch two -> one live + one engine-queued
+        servers = _pools(params, cfg, n_decode=2, max_slots=1,
+                         max_len=64)
+        victim = servers[1]
+        router = Router([servers[0].addr],
+                        [servers[1].addr, servers[2].addr],
+                        max_worker_queue=3)
+        prompts = [rng.randint(0, 128, (5 + i,)) for i in range(4)]
+        single = ServingEngine(params, cfg, max_slots=1, max_len=64,
+                               cache_layout="paged", block_size=4)
+        for p in prompts:
+            single.submit(p, max_new_tokens=40)
+        ref = {}
+        while not single.idle:
+            for r in single.step():
+                ref[tuple(r.prompt.tolist())] = r.tokens.tolist()
+        try:
+            for p in prompts:
+                router.submit(p, max_new_tokens=40)
+            out = []
+            deadline = time.time() + 60
+            victim_w = next(w for w in router._decode
+                            if w.addr == victim.addr)
+            while (time.time() < deadline
+                   and len(victim_w.in_flight) < 2):
+                out.extend(router.step())
+            while (time.time() < deadline
+                   and victim_w.stats.get("active", 0) < 1):
+                router.scrape_stats()
+                time.sleep(0.005)
+            drained = router.drain_worker(victim.addr)
+            out.extend(router.take_drain_completions())
+            assert drained["requeued"] >= 1 or drained["migrated"] >= 1
+            router.remove_worker(victim.addr)
+            out.extend(router.run(max_wall_s=120))
+            got = {tuple(r.prompt.tolist()): r.tokens.tolist()
+                   for r in out}
+            assert got == ref
+        finally:
+            router.close(shutdown_workers=True)
+            for s in servers:
+                s.stop()
+
+    def test_double_migration_keeps_all_tokens(self, model):
+        """A request drained TWICE (A→B, then B→C) must stitch all
+        three legs — prior_tokens extends across migrations, never
+        overwrites (the truncation regression)."""
+        cfg, params = model
+        rng = np.random.RandomState(17)
+        prompt = rng.randint(0, 128, (6,))
+        single = ServingEngine(params, cfg, max_slots=2, max_len=64,
+                               cache_layout="paged", block_size=4)
+        single.submit(prompt, max_new_tokens=50)
+        ref = None
+        while not single.idle:
+            for r in single.step():
+                ref = r.tokens.tolist()
+
+        servers = _pools(params, cfg, n_decode=3, max_len=64)
+        router = Router([servers[0].addr],
+                        [s.addr for s in servers[1:]],
+                        max_worker_queue=3)
+        try:
+            router.submit(prompt, max_new_tokens=50)
+            out = []
+            deadline = time.time() + 60
+
+            def holder():
+                return next((w for w in router._decode
+                             if w.in_flight), None)
+
+            for _ in range(2):               # two successive drains
+                while time.time() < deadline and holder() is None:
+                    out.extend(router.step())
+                w = holder()
+                assert w is not None, "request never landed"
+                while (time.time() < deadline
+                       and w.stats.get("active", 0) < 1):
+                    router.scrape_stats()
+                    time.sleep(0.005)
+                drained = router.drain_worker(w.addr)
+                out.extend(router.take_drain_completions())
+                assert drained["migrated"] == 1
+                router.remove_worker(w.addr)
+            out.extend(router.run(max_wall_s=120))
+            (resp,) = out
+            assert resp.migrations == 2
+            assert resp.tokens.tolist() == ref
+        finally:
+            router.close(shutdown_workers=True)
+            for s in servers:
+                s.stop()
+
+    def test_drain_dead_worker_requeues_everything(self, model):
+        """A worker that dies before/at the drain RPC degrades to the
+        death path: everything requeues, nothing migrates, nothing is
+        lost."""
+        cfg, params = model
+        rng = np.random.RandomState(13)
+        servers = _pools(params, cfg)
+        victim = servers[1]
+        router = Router([servers[0].addr],
+                        [servers[1].addr, servers[2].addr],
+                        max_worker_queue=3)
+        prompts = [rng.randint(0, 128, (4 + i,)) for i in range(4)]
+        try:
+            for p in prompts:
+                router.submit(p, max_new_tokens=6)
+            out = []
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                out.extend(router.step())
+                victim_w = next(w for w in router._decode
+                                if w.addr == victim.addr)
+                if victim_w.in_flight:
+                    break
+            victim.stop()
+            time.sleep(0.15)
+            drained = router.drain_worker(victim.addr)
+            assert drained["migrated"] == 0
+            assert drained["requeued"] >= 1
+            router.remove_worker(victim.addr)
+            out.extend(router.run(max_wall_s=120))
+            assert len(out) == len(prompts)
+        finally:
+            router.close(shutdown_workers=True)
+            for s in servers:
+                s.stop()
+
+    def test_externally_draining_worker_refusal_requeues(self, model):
+        """A worker drain-flagged OUTSIDE this router (another router,
+        an operator) refuses decode dispatch with 'draining'; the
+        router must adopt the flag and requeue — never count the
+        request failed."""
+        cfg, params = model
+        servers = _pools(params, cfg, n_decode=2)
+        servers[1]._draining = True          # router does not know
+        router = Router([servers[0].addr],
+                        [servers[1].addr, servers[2].addr])
+        try:
+            rng = np.random.RandomState(21)
+            for i in range(3):
+                router.submit(rng.randint(0, 128, (4 + i,)),
+                              max_new_tokens=4)
+            out = router.run(max_wall_s=60)
+            assert len(out) == 3
+            assert all(r.pool == servers[2].addr for r in out)
+            flagged = next(w for w in router._decode
+                           if w.addr == servers[1].addr)
+            assert flagged.draining          # flag adopted
+        finally:
+            router.close(shutdown_workers=True)
+            for s in servers:
+                s.stop()
+
+    def test_add_worker_role_mismatch_refused(self, model):
+        cfg, params = model
+        servers = _pools(params, cfg, n_decode=1)
+        router = Router([servers[0].addr], [servers[1].addr])
+        try:
+            with pytest.raises(ValueError, match="role"):
+                router.add_worker(servers[0].addr, "decode")
+            # a correct add becomes dispatchable
+            extra = WorkerServer("decode", params, cfg, max_len=32,
+                                 cache_layout="paged", block_size=4,
+                                 max_slots=2)
+            _start(extra)
+            router.add_worker(extra.addr, "decode")
+            assert len(router._decode) == 2
+            router.remove_worker(extra.addr)
+            assert len(router._decode) == 1
+            extra.stop()
+        finally:
+            router.close(shutdown_workers=True)
+            for s in servers:
+                s.stop()
+
+
+# ---------------------------------------------------------------------------
+# the full loop over in-process workers
+# ---------------------------------------------------------------------------
+
+
+class TestControllerLoop:
+    def test_scale_up_under_backpressure_then_drain_idle(self, model):
+        """The closed loop end to end: a request flood trips the grow
+        signal (spawn via the hook), outputs stay token-identical to a
+        single engine, and the idle fleet drains back to min — with
+        chip-seconds accrued throughout."""
+        cfg, params = model
+        made = []
+
+        def mk_decode(_pool):
+            s = WorkerServer("decode", params, cfg, max_len=32,
+                             cache_layout="paged", block_size=4,
+                             max_slots=2)
+            _start(s)
+            made.append(s)
+            return s, s.addr
+
+        pf = WorkerServer("prefill", params, cfg, max_len=32)
+        _start(pf)
+        d0, _ = mk_decode("decode")
+        router = Router([pf.addr], [d0.addr], max_worker_queue=2)
+        ctrl = PoolController(router, spawn=mk_decode,
+                              min_decode=1, max_decode=2,
+                              scale_up_after=2, scale_down_after=2,
+                              cooldown_ticks=1, tick_interval_s=0.0)
+        rng = np.random.RandomState(14)
+        prompts = [rng.randint(0, 128, (4 + i,)) for i in range(8)]
+        single = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               cache_layout="paged", block_size=4)
+        for p in prompts:
+            single.submit(p, max_new_tokens=6)
+        ref = {}
+        while not single.idle:
+            for r in single.step():
+                ref[tuple(r.prompt.tolist())] = r.tokens.tolist()
+        try:
+            for p in prompts:
+                router.submit(p, max_new_tokens=6)
+            out = router.run(max_wall_s=120, on_step=ctrl.maybe_tick)
+            st = ctrl.stats()
+            assert {tuple(r.prompt.tolist()): r.tokens.tolist()
+                    for r in out} == ref
+            assert any(a["action"] == "spawn" for a in st["actions"])
+            # idle: sustained shrink drains back to min (a drain may
+            # already have fired in the run's quiet tail)
+            for _ in range(10):
+                ctrl.tick()
+            st = ctrl.stats()
+            assert st["pool_size"]["decode"] == 1
+            assert st["last_action"]["action"] == "drain"
+            assert st["chip_seconds"] > 0
+        finally:
+            ctrl.close()
+            router.close(shutdown_workers=True)
+            pf.stop()
+            for s in made:
+                s.stop()
+
+    def test_controller_telemetry_and_dash_row(self, model):
+        """controller.* series land in the registry; serve_dash
+        renders the controller row from a scrape carrying them and
+        hides it otherwise."""
+        import importlib.util
+        import os
+
+        cfg, params = model
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "serve_dash", os.path.join(repo, "tools",
+                                       "serve_dash.py"))
+        dash = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(dash)
+        om = dash.load_openmetrics_module()
+
+        reg = obs.configure(export_port=0)
+        try:
+            pf = WorkerServer("prefill", params, cfg, max_len=32)
+            _start(pf)
+            dc = WorkerServer("decode", params, cfg, max_len=32,
+                              cache_layout="paged", block_size=4,
+                              max_slots=2)
+            _start(dc)
+            router = Router([pf.addr], [dc.addr])
+            ctrl = PoolController(router, spawn=lambda p: (None, ""),
+                                  min_decode=1, max_decode=2,
+                                  tick_interval_s=0.0)
+            ctrl.tick()
+            out = io.StringIO()
+            snap = dash.one_frame(om, reg.exporter.url, out=out)
+            text = out.getvalue()
+            assert snap["controller_pools"] == {"decode": 1.0,
+                                                "prefill": 1.0}
+            assert "controller pools" in text
+            assert "decode:1" in text and "prefill:1" in text
+            router.close(shutdown_workers=True)
+            pf.stop()
+            dc.stop()
+        finally:
+            obs.shutdown()
+
+    def test_dash_rows_hidden_without_series(self, model):
+        """No controller, no chunked engine -> neither row renders."""
+        import importlib.util
+        import os
+
+        cfg, params = model
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "serve_dash", os.path.join(repo, "tools",
+                                       "serve_dash.py"))
+        dash = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(dash)
+        om = dash.load_openmetrics_module()
+        reg = obs.configure(export_port=0)
+        try:
+            eng = ServingEngine(params, cfg, max_slots=1, max_len=32)
+            eng.submit([1, 2, 3], max_new_tokens=2)
+            while not eng.idle:
+                eng.step()
+            out = io.StringIO()
+            snap = dash.one_frame(om, reg.exporter.url, out=out)
+            text = out.getvalue()
+            assert snap["controller_pools"] is None
+            assert snap["prefill_chunks_total"] is None
+            assert "controller pools" not in text
+            assert "prefill progress" not in text
+        finally:
+            obs.shutdown()
+
+    def test_dash_prefill_progress_row_renders(self, model):
+        """A chunked engine mid-prefill exports the progress gauges
+        and the dash renders the column."""
+        import importlib.util
+        import os
+
+        cfg, params = model
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "serve_dash", os.path.join(repo, "tools",
+                                       "serve_dash.py"))
+        dash = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(dash)
+        om = dash.load_openmetrics_module()
+        reg = obs.configure(export_port=0)
+        try:
+            rng = np.random.RandomState(15)
+            eng = ServingEngine(params, cfg, max_slots=2, max_len=64,
+                                cache_layout="paged", block_size=8,
+                                chunk_tokens=8)
+            eng.submit(rng.randint(0, 128, (40,)), max_new_tokens=2)
+            eng.step()                     # admit + first chunk only
+            out = io.StringIO()
+            snap = dash.one_frame(om, reg.exporter.url, out=out)
+            text = out.getvalue()
+            assert snap["prefilling"] == 1
+            assert snap["prefill_chunks_total"] == 5
+            assert snap["prefill_chunks_done"] >= 1
+            assert "prefill progress" in text
+            while not eng.idle:
+                eng.step()
+        finally:
+            obs.shutdown()

@@ -252,6 +252,48 @@ def test_spec_summary_fixture(report, tmp_path):
     assert partial["tokens_per_verify"] is None
 
 
+def test_controller_summary_fixture(report, tmp_path):
+    """ISSUE 15 satellite: the elastic-controller counters/gauges get
+    a derived view — actions by kind+pool, drained requests,
+    chip-seconds, final pool sizes — and an absent stream hides the
+    section."""
+    f = tmp_path / "ctrl.jsonl"
+    f.write_text(
+        '{"schema_version":3,"t":1,"type":"counter",'
+        '"name":"controller.actions","value":2,'
+        '"tags":{"action":"spawn","pool":"decode"}}\n'
+        '{"schema_version":3,"t":2,"type":"counter",'
+        '"name":"controller.actions","value":1,'
+        '"tags":{"action":"drain","pool":"decode"}}\n'
+        '{"schema_version":3,"t":3,"type":"counter",'
+        '"name":"controller.drained_requests","value":3}\n'
+        '{"schema_version":3,"t":4,"type":"gauge",'
+        '"name":"controller.chip_seconds","value":41.5}\n'
+        '{"schema_version":3,"t":5,"type":"gauge",'
+        '"name":"controller.pool_size","value":2,'
+        '"tags":{"pool":"decode"}}\n'
+        '{"schema_version":3,"t":6,"type":"gauge",'
+        '"name":"controller.pool_size","value":1,'
+        '"tags":{"pool":"prefill"}}\n')
+    summ = report.summarize(report.load_records([str(f)]))
+    ctrl = report.controller_summary(summ)
+    assert ctrl["spawns"] == 2
+    assert ctrl["drains"] == 1
+    assert ctrl["drained_requests"] == 3
+    assert ctrl["chip_seconds"] == 41.5
+    assert ctrl["pool_size_last"] == {"decode": 2.0, "prefill": 1.0}
+    out = io.StringIO()
+    report.print_report(summ, out=out)
+    text = out.getvalue()
+    assert "elastic pool controller" in text
+    assert "spawns 2" in text and "drains 1" in text
+    assert "chip-seconds 41.5" in text
+    assert "decode:2" in text
+    # a controller-free stream -> no section
+    assert report.controller_summary(
+        {"counters": {"serving.requests": 3.0}, "gauges": {}}) is None
+
+
 # -- aggregate_telemetry --window (ISSUE 9 satellite) ------------------------
 
 

@@ -48,6 +48,10 @@ percentiles, the autoscaling-signal substrate of ROADMAP item 4.
 Plus (ISSUE 9): a ``serve_trace`` stage replaying the bursty arrival
 trace against single-engine vs the two-process disaggregated topology
 (CPU-pinned by bench itself — topology cost, not chip rates).
+Plus (ISSUE 15): a ``serve_trace_controller`` stage — the diurnal +
+flash-crowd trace through the spawned-process cluster, elastic
+controller on/off x chunked prefill on/off, with the chunked-prefill
+starvation gate riding the same JSON line.
 
 The flat-Adam / LN / flash-s512 win-or-delete decisions fired on the
 2026-07-31 03:46 first contact (BASELINE.md round-5 note); the one
@@ -236,6 +240,16 @@ def main():
         "serve_trace", [sys.executable, "bench.py", "--serve-trace",
                         "--cache-layout", "paged"],
         timeout=1800)
+    # elastic controller + chunked prefill (ISSUE 15): the diurnal +
+    # flash-crowd trace, controller on/off x chunked on/off (goodput /
+    # p95 TTFT-TPOT / chip-seconds / zero-lost drains) plus the
+    # chunked-prefill starvation gate (decode TPOT p95 with one long
+    # prompt co-resident <= 2x the no-long-prompt baseline).
+    # Chip-free like serve_trace (bench CPU-pins the topology rows).
+    results["serve_trace_controller"] = _run(
+        "serve_trace_controller",
+        [sys.executable, "bench.py", "--serve-trace", "--controller"],
+        timeout=2400)
     results["bench_tp_overlap"] = _run(
         "bench_tp_overlap",
         [sys.executable, "bench.py", "--tp-overlap"], timeout=1800)

@@ -15,6 +15,11 @@ is actually operated on:
 - speculative-decoding accept rate (``generate.spec.*`` counters,
   ISSUE 8) when the engine runs with spec on — absent counters simply
   hide the row;
+- chunked-prefill progress (ISSUE 15: chunks done / total + lanes
+  still mid-prefill) when the engine runs with ``chunk_tokens`` on,
+  and the elastic-controller row (pool sizes, spawn/drain action
+  counts, drain-in-progress, chip-seconds) when the scraped process
+  runs a ``PoolController`` — both hidden when the series are absent;
 - per-SLO-class TTFT / TPOT p50 & p95 (computed from the exported
   native histogram buckets with the same nearest-rank algorithm the
   in-process sketch uses — the dashboard and the engine answer
@@ -130,6 +135,16 @@ def snapshot(om, parsed) -> dict:
     for name, labels, v in parsed["samples"]:
         if name == "cluster_queue_depth" and "slo_class" in labels:
             cluster_q[labels["slo_class"]] = v
+    # elastic-controller row (ISSUE 15): pool sizes + action counts by
+    # kind — present only on a process running a PoolController
+    ctrl_pools = {}
+    ctrl_actions = {}
+    for name, labels, v in parsed["samples"]:
+        if name == "controller_pool_size" and "pool" in labels:
+            ctrl_pools[labels["pool"]] = v
+        elif name == "controller_actions_total" and "action" in labels:
+            ctrl_actions[labels["action"]] = (
+                ctrl_actions.get(labels["action"], 0) + v)
     return {
         "occupancy": val("serving_slot_occupancy"),
         "queue_depth": val("serving_queue_depth"),
@@ -144,6 +159,18 @@ def snapshot(om, parsed) -> dict:
         "cluster_requeued": val("cluster_requeued_total"),
         "cluster_handoff_bytes": val("cluster_handoff_bytes_total"),
         "cluster_inflight": val("cluster_inflight"),
+        # chunked prefill (ISSUE 15): progress of the in-flight
+        # prefilling lanes — gauges exist only on a chunk_tokens
+        # engine, so the column renders conditionally
+        "prefilling": val("serving_prefilling"),
+        "prefill_chunks_done": val("serving_prefill_progress_done"),
+        "prefill_chunks_total": val("serving_prefill_progress_total"),
+        # elastic controller (ISSUE 15)
+        "controller_pools": ctrl_pools or None,
+        "controller_actions": ctrl_actions,
+        "controller_draining": val("controller_draining"),
+        "controller_drained": val("controller_drained_requests_total"),
+        "controller_chip_seconds": val("controller_chip_seconds"),
         "classes": rows,
     }
 
@@ -173,6 +200,26 @@ def render(snap: dict, health: str, url: str, out=None) -> None:
         p(f"  spec accept-rate {snap['spec_accept_rate']:.1%}   "
           f"verify passes "
           f"{_fmt(snap.get('spec_verify_calls'), '{:.0f}')}")
+    if snap.get("prefill_chunks_total") is not None:
+        # chunked-prefill progress (hidden on non-chunked engines):
+        # chunks done / total across the lanes still mid-prefill
+        p(f"  prefill progress "
+          f"{_fmt(snap.get('prefill_chunks_done'), '{:.0f}')}/"
+          f"{_fmt(snap['prefill_chunks_total'], '{:.0f}')} chunks   "
+          f"prefilling lanes "
+          f"{_fmt(snap.get('prefilling'), '{:.0f}')}")
+    if snap.get("controller_pools") is not None:
+        pools = "  ".join(f"{pool}:{int(v)}" for pool, v in
+                          sorted(snap["controller_pools"].items()))
+        acts = snap.get("controller_actions") or {}
+        act_s = ("spawn:" + str(int(acts.get("spawn", 0)))
+                 + " drain:" + str(int(acts.get("drain", 0))))
+        p(f"  controller pools {pools}   actions {act_s}   "
+          f"draining "
+          f"{_fmt(snap.get('controller_draining'), '{:.0f}')}   "
+          f"drained reqs "
+          f"{_fmt(snap.get('controller_drained'), '{:.0f}')}   "
+          f"chip-s {_fmt(snap.get('controller_chip_seconds'))}")
     if snap.get("cluster_queue_depth") is not None:
         depths = "  ".join(
             f"{cls}:{int(v)}" for cls, v in

@@ -502,6 +502,47 @@ def quantized_cache_summary(summary: dict) -> Optional[dict]:
     return out
 
 
+def controller_summary(summary: dict) -> Optional[dict]:
+    """Derived view of the elastic pool controller's telemetry
+    (``controller.*``, ISSUE 15): actions taken by kind and pool
+    (``controller.actions{action=,pool=}`` counters), requests drained
+    losslessly off scaled-down workers, chip-seconds consumed (the
+    integral of live workers over wall time — the number the diurnal
+    ablation trades against goodput), and the final pool sizes.  None
+    when the stream carries no controller series (static topologies,
+    pre-ISSUE-15 writers)."""
+    counters = summary["counters"]
+    gauges = summary["gauges"]
+    actions: Dict[Tuple[str, str], float] = {}
+    for name, val in counters.items():
+        if not name.startswith("controller.actions{"):
+            continue
+        inner = name[len("controller.actions{"):].rstrip("}")
+        tags = dict(p.split("=", 1) for p in inner.split(",") if "=" in p)
+        key = (tags.get("action", "?"), tags.get("pool", "?"))
+        actions[key] = actions.get(key, 0.0) + val
+    chip = gauges.get("controller.chip_seconds")
+    pool_sizes = {}
+    for name, vals in gauges.items():
+        if name.startswith("controller.pool_size{pool="):
+            pool = name[len("controller.pool_size{pool="):].rstrip("}")
+            pool_sizes[pool] = vals[-1]
+    if not (actions or chip or pool_sizes):
+        return None
+    return {
+        "actions": {f"{a}:{p}": v
+                    for (a, p), v in sorted(actions.items())},
+        "spawns": sum(v for (a, _p), v in actions.items()
+                      if a == "spawn"),
+        "drains": sum(v for (a, _p), v in actions.items()
+                      if a == "drain"),
+        "drained_requests": counters.get(
+            "controller.drained_requests", 0.0),
+        "chip_seconds": chip[-1] if chip else None,
+        "pool_size_last": pool_sizes or None,
+    }
+
+
 def print_report(summary: dict, out=None) -> None:
     out = sys.stdout if out is None else out
     if summary["unknown_schema"]:
@@ -660,6 +701,22 @@ def print_report(summary: dict, out=None) -> None:
                   f"{qcache['admission_multiple']:.3g}x "
                   f"({qcache['cheapest']} over {qcache['dearest']})",
                   file=out)
+    ctrl = controller_summary(summary)
+    if ctrl:
+        print("== elastic pool controller (controller.*) ==", file=out)
+        parts = [f"spawns {ctrl['spawns']:g}",
+                 f"drains {ctrl['drains']:g}",
+                 f"drained requests {ctrl['drained_requests']:g}"]
+        if ctrl["chip_seconds"] is not None:
+            parts.append(f"chip-seconds {ctrl['chip_seconds']:g}")
+        print("  " + "  ".join(parts), file=out)
+        for key, v in sorted(ctrl["actions"].items()):
+            print(f"    {key:<20} {v:g}", file=out)
+        if ctrl["pool_size_last"]:
+            sizes = "  ".join(
+                f"{pool}:{int(v)}" for pool, v in
+                sorted(ctrl["pool_size_last"].items()))
+            print(f"  final pool sizes {sizes}", file=out)
     serving = serving_summary(summary)
     if serving:
         print("== paged serving (serving.blocks_*) ==", file=out)
