@@ -96,6 +96,10 @@ ENV_REGISTRY: Dict[str, EnvVar] = dict([
     _v("APEX_TPU_GROUPED_MATMUL", "apex_tpu.ops.grouped_matmul",
        "docs/parallelism.md",
        "grouped (ragged expert) matmul routing (kernel|reference|auto)"),
+    _v("APEX_TPU_DECODE_FUSED", "apex_tpu.ops.decode_step",
+       "docs/inference.md",
+       "fused decode-layer megakernel routing "
+       "(kernel|reference|auto)"),
     _v("APEX_TPU_QUANT_MATMUL", "apex_tpu.ops.dense",
        "docs/inference.md",
        "weight-only int8 dense/grouped matmul routing "
@@ -105,6 +109,10 @@ ENV_REGISTRY: Dict[str, EnvVar] = dict([
        "docs/serving.md",
        "chunked-prefill chunk size override (positive int; off/0 "
        "forces monolithic prefill)"),
+    _v("APEX_TPU_COMPILE_CACHE", "apex_tpu.serving.compile_cache",
+       "docs/serving.md",
+       "persistent AOT compile-cache directory (engine default when "
+       "compile_cache_dir is not passed)"),
     # ---- training / parallel knobs -----------------------------------
     _v("APEX_TPU_ALLOW_FP16", "apex_tpu.amp.policy",
        "docs/amp.md", "permit raw fp16 on TPU (default maps to bf16)"),

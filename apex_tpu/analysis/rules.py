@@ -14,7 +14,8 @@ them):
 - ``APX103`` private-registry-global        (_REGISTRY is owner-private)
 - ``APX104`` module-level-exporter-import   (PR 7 lazy HTTP machinery)
 - ``APX105`` metric-prefix-helper           (moe./checkpoint./generate.spec.
-  accounting rides the module helpers on the same statement)
+  /serving.compile_cache./worker.ready_ms accounting rides the module
+  helpers on the same statement)
 - ``APX106`` ungated-memory-sample          (hot paths gate HBM sampling)
 - ``APX201`` unregistered-env-var           (PR 4 warn-by-name pattern,
   generalized: every APEX_TPU_* read is in analysis/env_registry.py)
@@ -296,7 +297,8 @@ class ExporterImportRule(Rule):
 class MetricPrefixRule(Rule):
     id = "APX105"
     name = "metric-prefix-helper"
-    description = ("moe.* / checkpoint.* / generate.spec.* metric "
+    description = ("moe.* / checkpoint.* / generate.spec.* / "
+                   "serving.compile_cache.* / worker.ready_ms metric "
                    "touches must ride the _telemetry helpers on the "
                    "same statement — a second access idiom forks the "
                    "accounting telemetry_report and the dryrun gates "
@@ -307,6 +309,11 @@ class MetricPrefixRule(Rule):
     PREFIXES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
         ("generate.spec.", ("counter",)),
         ("moe.", ("counter", "gauge")),
+        # ISSUE 17: the compile-cache hit/miss/load ledger and the
+        # worker READY gauge feed telemetry_report's
+        # compile_cache_summary — same one-accounting-path contract
+        ("serving.compile_cache.", ("counter", "histogram", "event")),
+        ("worker.ready_ms", ("gauge",)),
     ) + tuple((f"checkpoint.{n}", ("counter", "gauge")) for n in _CKPT)
 
     def _match(self, value: str) -> Optional[Tuple[str, Tuple[str, ...]]]:

@@ -311,14 +311,18 @@ def _vector_pos(cache: dict) -> jax.Array:
     return pos.astype(jnp.int32)
 
 
-def _decode_qkv(cfg, lp, x, pos, rope):
+def _decode_qkv(cfg, lp, x, pos, rope, rope_q: bool = True):
     """Shared pre-attention math (norm → qkv projection → GQA split →
     per-sequence rotary) for ``x`` [b, s, h] appended at per-sequence
     offsets ``pos`` [b] — token (i, j) sits at absolute position
     ``pos[i] + j`` (s=1 is the decode step, s=k+1 the speculative
     verify block): the contiguous and paged layer bodies differ only in
     where K/V land and how the cache is read, so this is ONE
-    implementation of everything before that fork."""
+    implementation of everything before that fork.
+
+    ``rope_q=False`` returns the query PRE-rope (K still ropes for the
+    cache write) — the fused decode layer (``ops/decode_step.py``)
+    applies the query rotation in-kernel."""
     from apex_tpu.ops.dense import quantized_matmul
 
     b, s = x.shape[0], x.shape[1]
@@ -341,17 +345,30 @@ def _decode_qkv(cfg, lp, x, pos, rope):
         cos, sin = rope          # [max_len, d]
         from apex_tpu.ops.rope import fused_apply_rotary_pos_emb_ragged
 
-        q = fused_apply_rotary_pos_emb_ragged(q, cos, sin, pos)
+        if rope_q:
+            q = fused_apply_rotary_pos_emb_ragged(q, cos, sin, pos)
         k = fused_apply_rotary_pos_emb_ragged(k, cos, sin, pos)
     return h, q, k, v
 
 
-def _decode_out(cfg, lp, x, h, ctx_flat):
-    """Shared post-attention math (output projection → residual →
-    MLP); ``ctx_flat`` [b, s, nh*dh] (s=1 decode, s=k+1 verify)."""
-    from apex_tpu.ops.dense import quantized_matmul
+def _decode_rope_rows(rope, pos):
+    """Gather each sequence's angle-table row for its decode position
+    (clamped like ``fused_apply_rotary_pos_emb_ragged``) → f32
+    ``(cos, sin)`` of ``[b, d]`` — the per-sequence rope operand of the
+    fused decode layer."""
+    if rope is None:
+        return None, None
+    cos, sin = rope
+    rows = jnp.clip(pos, 0, cos.shape[0] - 1)
+    return (jnp.take(cos.astype(jnp.float32), rows, axis=0),
+            jnp.take(sin.astype(jnp.float32), rows, axis=0))
 
-    a = quantized_matmul(ctx_flat, lp["proj_kernel"])
+
+def _decode_out_post(cfg, lp, x, h, a):
+    """Post-projection tail (bias → residual → MLP) shared by the
+    unfused path and the fused decode layer, whose kernel already owns
+    the projection GEMM; ``a`` [b, s, h_model] is the projected
+    attention output, bias not yet applied."""
     a = a + lp["proj_bias"].astype(x.dtype)
     res = h if cfg.apply_residual_connection_post_layernorm else x
     x = res + a
@@ -363,14 +380,45 @@ def _decode_out(cfg, lp, x, h, ctx_flat):
     return res + m
 
 
-def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
+def _decode_out(cfg, lp, x, h, ctx_flat):
+    """Shared post-attention math (output projection → residual →
+    MLP); ``ctx_flat`` [b, s, nh*dh] (s=1 decode, s=k+1 verify)."""
+    from apex_tpu.ops.dense import quantized_matmul
+
+    a = quantized_matmul(ctx_flat, lp["proj_kernel"])
+    return _decode_out_post(cfg, lp, x, h, a)
+
+
+def _stripe_block(total: int) -> int:
+    """Largest block size <= 128 dividing a contiguous stripe length
+    (preferring a sublane multiple) — lets the fused decode kernel view
+    the ``[b, T, g, dh]`` stripe as a linear ``[b·(T/bs), bs, g, dh]``
+    pool without copying a byte."""
+    cands = [d for d in range(1, min(total, 128) + 1) if total % d == 0]
+    mult8 = [d for d in cands if d % 8 == 0]
+    return max(mult8 or cands)
+
+
+def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope,
+                  decode_fused: str = "reference"):
     """One layer, one token, contiguous layout: x [b, 1, h] + cache
     slice [b, T, nh, dh]; ``pos`` [b] int32 — each sequence writes and
-    attends at its own offset."""
+    attends at its own offset.
+
+    ``decode_fused="kernel"`` runs rope + attention + output projection
+    as ONE fused kernel (``ops/decode_step.py``) over the stripe viewed
+    as a linear block pool; ``"reference"`` keeps the historical inline
+    dense math below bit-for-bit."""
+    from apex_tpu.ops.dense import is_quantized
+
     b = x.shape[0]
     nh = cfg.num_attention_heads
     dh = cfg.kv_channels
-    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope)
+    # quantized projection slabs stay on the unfused path — their
+    # in-kernel dequantizing matmul (ops/dense) owns the weight tiling
+    fuse = decode_fused == "kernel" and not is_quantized(
+        lp["proj_kernel"])
+    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope, rope_q=not fuse)
 
     # per-sequence scatter: row (i, pos[i]) only — O(b·nh·dh) written
     # per step, not a full-buffer select; out-of-bounds positions
@@ -379,6 +427,23 @@ def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
     b_idx = jnp.arange(b)
     cache_k = cache_k.at[b_idx, pos].set(k[:, 0].astype(cache_k.dtype))
     cache_v = cache_v.at[b_idx, pos].set(v[:, 0].astype(cache_v.dtype))
+    if fuse:
+        from apex_tpu.ops.decode_step import fused_decode_layer
+
+        T = cache_k.shape[1]
+        g = cfg.kv_groups
+        bs = _stripe_block(T)
+        nbl = T // bs
+        tables = (jnp.arange(b, dtype=jnp.int32)[:, None] * nbl
+                  + jnp.arange(nbl, dtype=jnp.int32)[None])
+        rope_cos, rope_sin = _decode_rope_rows(rope, pos)
+        a = fused_decode_layer(
+            q[:, 0], cache_k.reshape(b * nbl, bs, g, dh),
+            cache_v.reshape(b * nbl, bs, g, dh), tables, pos + 1,
+            lp["proj_kernel"], rope_cos=rope_cos, rope_sin=rope_sin,
+            backend="kernel")
+        return (_decode_out_post(cfg, lp, x, h, a[:, None]),
+                cache_k, cache_v)
     t_idx = jnp.arange(cache_k.shape[1])
 
     # dense attention over the (masked) cache; under GQA the query
@@ -401,24 +466,32 @@ def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope):
 
 
 def _layer_decode_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope,
-                        k_scale=None, v_scale=None):
+                        k_scale=None, v_scale=None,
+                        decode_fused: str = "reference"):
     """One layer, one token, paged layout: x [b, 1, h] + this layer's
     block pool [num_blocks, block_size, g, dh] + ``tables``
     [b, max_blocks].  The new K/V append to each sequence's tail block
-    (one-cell scatter through the table); attention runs the fused
-    ragged-paged kernel over the block list — the gathered cache never
-    materializes.
+    (one-cell scatter through the table); attention runs through the
+    fused decode layer (``ops/decode_step.py``) — ``decode_fused=
+    "kernel"`` is rope + paged attention + output projection as ONE
+    kernel with one VMEM residency, ``"reference"`` the exact
+    historical op sequence (ragged-paged kernel + XLA matmul); either
+    way the gathered cache never materializes.
 
     int8 pool (``k_scale``/``v_scale`` given, ISSUE 14): the append
     quantizes the fresh token per (sequence, group) and scatters wire +
     scale through the same table cell; the attention kernel dequantizes
     in-VMEM (scales ride the table-dereferenced DMA)."""
+    from apex_tpu.ops.dense import is_quantized
     from apex_tpu.ops.paged_attention import ragged_paged_attention
 
     b = x.shape[0]
     nh = cfg.num_attention_heads
     dh = cfg.kv_channels
-    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope)
+    # quantized projection slabs stay on the unfused path — their
+    # in-kernel dequantizing matmul (ops/dense) owns the weight tiling
+    fuse = not is_quantized(lp["proj_kernel"])
+    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope, rope_q=not fuse)
 
     nb, bs = cache_k.shape[0], cache_k.shape[1]
     mb = tables.shape[1]
@@ -442,6 +515,16 @@ def _layer_decode_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope,
         cache_v = cache_v.at[blk, off].set(
             v[:, 0].astype(cache_v.dtype), mode="drop")
 
+    if fuse:
+        from apex_tpu.ops.decode_step import fused_decode_layer
+
+        rope_cos, rope_sin = _decode_rope_rows(rope, pos)
+        a = fused_decode_layer(
+            q[:, 0], cache_k, cache_v, tables, pos + 1,
+            lp["proj_kernel"], rope_cos=rope_cos, rope_sin=rope_sin,
+            backend=decode_fused, k_scale=k_scale, v_scale=v_scale)
+        x = _decode_out_post(cfg, lp, x, h, a[:, None])
+        return x, cache_k, cache_v, k_scale, v_scale
     ctx = ragged_paged_attention(q[:, 0], cache_k, cache_v, tables,
                                  pos + 1, k_scale=k_scale,
                                  v_scale=v_scale)
@@ -451,15 +534,28 @@ def _layer_decode_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope,
 
 
 def decode_step(params: dict, token: jax.Array, cache: dict,
-                cfg: TransformerConfig):
+                cfg: TransformerConfig, *,
+                decode_fused: Optional[str] = None):
     """One decoding step: token [b] int32 at per-sequence position
     ``cache['pos']`` ([b] int32) → (logits [b, v], updated cache).
 
     The cache dict selects the layout: a ``block_tables`` entry means
     paged (pool ``[L, num_blocks, block_size, g, dh]``, tail-block
     append + the fused ragged-paged attention kernel); otherwise the
-    contiguous ``[L, b, max_len, g, dh]`` stripe layout."""
+    contiguous ``[L, b, max_len, g, dh]`` stripe layout.
+
+    ``decode_fused`` picks the fused decode-layer route
+    (``ops/decode_step.py``: rope + attention + output projection in
+    one kernel): ``"kernel"``/``"reference"`` pin, ``None``/``"auto"``
+    resolve ``APEX_TPU_DECODE_FUSED`` here and now — jitted callers
+    (``generate``, the serving engine) resolve the route ONCE outside
+    their jit and pass it as a static argument, because an env read at
+    trace time would freeze the first call's route into every cached
+    trace."""
+    from apex_tpu.ops.decode_step import route_decode_fused
+
     _check_decode_cfg(cfg)
+    decode_fused = route_decode_fused(decode_fused)
     cd = cfg.compute_dtype
     paged = "block_tables" in cache
     pos = _vector_pos(cache)
@@ -486,7 +582,8 @@ def decode_step(params: dict, token: jax.Array, cache: dict,
         def body(x, layer_in):
             lp, ck, cv, sk, sv = layer_in
             x, ck, cv, sk, sv = _layer_decode_paged(
-                cfg, lp, x, ck, cv, tables, pos, rope, sk, sv)
+                cfg, lp, x, ck, cv, tables, pos, rope, sk, sv,
+                decode_fused=decode_fused)
             return x, (ck, cv, sk, sv)
 
         x, (new_k, new_v, *new_scales) = jax.lax.scan(
@@ -498,7 +595,8 @@ def decode_step(params: dict, token: jax.Array, cache: dict,
         def body(x, layer_in):
             lp, ck, cv = layer_in
             x, ck, cv, _sk, _sv = _layer_decode_paged(
-                cfg, lp, x, ck, cv, tables, pos, rope)
+                cfg, lp, x, ck, cv, tables, pos, rope,
+                decode_fused=decode_fused)
             return x, (ck, cv)
 
         x, (new_k, new_v) = jax.lax.scan(
@@ -506,7 +604,8 @@ def decode_step(params: dict, token: jax.Array, cache: dict,
     else:
         def body(x, layer_in):
             lp, ck, cv = layer_in
-            x, ck, cv = _layer_decode(cfg, lp, x, ck, cv, pos, rope)
+            x, ck, cv = _layer_decode(cfg, lp, x, ck, cv, pos, rope,
+                                      decode_fused=decode_fused)
             return x, (ck, cv)
 
         x, (new_k, new_v) = jax.lax.scan(
@@ -997,11 +1096,12 @@ def sample_logits(logits, key, *, temperature: float = 0.0,
 @functools.partial(jax.jit, static_argnames=(
     "cfg", "max_new_tokens", "temperature", "top_k", "top_p",
     "vocab_limit", "eos_token_id", "cache_dtype", "cache_layout",
-    "block_size", "cache_wire"))
+    "block_size", "cache_wire", "decode_fused"))
 def _generate_impl(params, prompt, prompt_lens, rng, *, cfg,
                    max_new_tokens, temperature, top_k, top_p,
                    vocab_limit, eos_token_id, cache_dtype,
-                   cache_layout, block_size, cache_wire=None):
+                   cache_layout, block_size, cache_wire=None,
+                   decode_fused="reference"):
     """Prefill + while-loop decode; returns (tokens, realized steps)."""
     b, s = prompt.shape
     total = s + max_new_tokens
@@ -1044,7 +1144,8 @@ def _generate_impl(params, prompt, prompt_lens, rng, *, cfg,
         # frozen so they stop consuming slots
         prev = cache["pos"]
         logits, cache = decode_step(params, nxt.astype(prompt.dtype),
-                                    cache, cfg)
+                                    cache, cfg,
+                                    decode_fused=decode_fused)
         cache = dict(cache, pos=jnp.where(done, prev, cache["pos"]))
         return (i + 1, done, logits, tokens, cache, key)
 
@@ -1103,6 +1204,15 @@ def generate(
     (``models/speculative.py`` has the correctness argument); the
     realized ``generate.spec.{draft_tokens,accepted_tokens,
     verify_calls}`` counters land in telemetry when configured.
+
+    The decode layer routes through the FUSED decode step
+    (``ops/decode_step.py``: rope + attention + output projection in
+    one kernel, ``APEX_TPU_DECODE_FUSED=kernel|reference|auto``) —
+    greedy output is token-identical across routes on both layouts and
+    both ``cache_wire`` forms (tests/test_decode_fused.py pins it);
+    the route is resolved here, outside the jit, and threaded as a
+    static argument so env flips retrace instead of replaying a stale
+    trace.
 
     ``cache_layout="paged"`` runs the same prefill + while-loop decode
     over the block-pool cache (``block_size`` tokens per block, tables
@@ -1176,13 +1286,19 @@ def generate(
             _telemetry.counter("generate.spec.verify_calls").inc(
                 stats["verify_calls"])
         return tokens
+    # resolve the fused-decode route HERE, outside the jit: threading
+    # the resolved route through the static args keys the trace cache
+    # on it, so flipping APEX_TPU_DECODE_FUSED between calls retraces
+    # instead of replaying the first call's frozen route
+    from apex_tpu.ops.decode_step import route_decode_fused
+
     tokens, n_steps = _generate_impl(
         params, prompt, prompt_lens, rng, cfg=cfg,
         max_new_tokens=max_new_tokens, temperature=temperature,
         top_k=top_k, top_p=top_p, vocab_limit=vocab_limit,
         eos_token_id=eos_token_id, cache_dtype=cache_dtype,
         cache_layout=cache_layout, block_size=block_size,
-        cache_wire=cache_wire)
+        cache_wire=cache_wire, decode_fused=route_decode_fused(None))
     if _telemetry.enabled():
         # host-side counters (the jitted loop cannot emit); reading the
         # realized trip count syncs — acceptable when telemetry is on

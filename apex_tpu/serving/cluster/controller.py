@@ -15,10 +15,18 @@ trace did.  This module closes the loop (ISSUE 15, ROADMAP item 2):
   every action opens a ``cooldown_ticks`` refractory window.  A noisy
   signal flapping between +1 and 0 therefore never oscillates the
   fleet (tests/test_serving_controller.py pins it);
-- **scale-up** — spawn a new pool member (``spawn=`` hook; the default
-  runs :func:`~apex_tpu.serving.cluster.worker.spawn_worker` with the
-  controller's per-role CLI flags — a real OS process) and attach it
-  via :meth:`Router.add_worker`;
+- **scale-up** — DEFERRED-ATTACH by default (ISSUE 17): launch a new
+  pool member (:func:`~apex_tpu.serving.cluster.worker.
+  spawn_worker_async` with the controller's per-role CLI flags — a
+  real OS process) and return from the tick immediately; subsequent
+  ticks poll the child's READY line non-blocking and
+  :meth:`Router.add_worker` it the tick it reports in, so the
+  controller keeps draining and routing for the whole spawn warmup
+  (the flash-crowd window where blocking on a trace storm used to
+  freeze the loop).  A worker that dies before READY is reaped
+  without ever attaching.  ``defer_spawn=False`` restores the
+  blocking spawn (the bench ablation's baseline), and a legacy
+  ``spawn=`` hook is always synchronous (in-process test servers);
 - **scale-down** — LOSSLESS drain: pick the least-loaded member, stop
   admitting onto it, migrate every in-flight request's KV to a
   survivor through the bit-exact raw handoff wire
@@ -75,6 +83,15 @@ class PoolController:
     a ``poll`` method (an in-process test server) is reaped via its
     ``stop``/``close`` if present.
 
+    Scale-up is deferred-attach unless a ``spawn=`` hook is given or
+    ``defer_spawn=False`` (module doc): ``spawn_async(role)`` — default
+    :func:`~apex_tpu.serving.cluster.worker.spawn_worker_async` over
+    ``worker_flags`` — must return a handle with a non-blocking
+    ``poll() -> None|"ready"|"dead"`` plus ``addr``/``proc``/``error``
+    fields; pending handles are ticked each cycle and count toward
+    pool size (so a warming member is never double-spawned) and
+    chip-seconds (its chip burns from launch, not from attach).
+
     ``min_/max_`` bound each pool; ``scale_up_after`` /
     ``scale_down_after`` are the hysteresis streak lengths (down
     defaults slower than up: adding capacity late costs latency,
@@ -90,6 +107,9 @@ class PoolController:
 
     def __init__(self, router, *,
                  spawn: Optional[Callable] = None,
+                 spawn_async: Optional[Callable] = None,
+                 defer_spawn: bool = True,
+                 spawn_timeout_s: float = 120.0,
                  worker_flags: Optional[Dict[str, Sequence[str]]] = None,
                  min_prefill: int = 1, max_prefill: int = 2,
                  min_decode: int = 1, max_decode: int = 2,
@@ -97,6 +117,9 @@ class PoolController:
                  cooldown_ticks: int = 2,
                  tick_interval_s: float = 0.25,
                  fleet_summary=None):
+        if spawn is not None and spawn_async is not None:
+            raise ValueError("pass spawn= (blocking) OR spawn_async= "
+                             "(deferred-attach), not both")
         if min_prefill < 1 or min_decode < 1:
             raise ValueError("min pool sizes must be >= 1 (a pool "
                              "scaled to zero cannot serve anything)")
@@ -105,7 +128,14 @@ class PoolController:
         if scale_up_after < 1 or scale_down_after < 1:
             raise ValueError("hysteresis streaks must be >= 1")
         self._router = router
+        self._spawn_hook = spawn
         self._spawn = spawn or self._spawn_process
+        self._spawn_async = spawn_async
+        # deferred-attach is the default ONLY for the process spawn
+        # path — a legacy spawn= hook stays synchronous (in-process
+        # test servers have no READY handshake to poll)
+        self._defer = bool(defer_spawn) and spawn is None
+        self._spawn_timeout_s = float(spawn_timeout_s)
         self._worker_flags = {k: list(v)
                               for k, v in (worker_flags or {}).items()}
         self._bounds = {"prefill": (min_prefill, max_prefill),
@@ -118,6 +148,7 @@ class PoolController:
         # all controller state is confined to the loop that steps the
         # router (module-doc threading contract; APX502-armed)
         self._procs: Dict[str, object] = {}      # guarded-by: confined(controller-loop)
+        self._pending: Dict[str, List] = {p: [] for p in _POOLS}  # guarded-by: confined(controller-loop)
         self._up_streak = dict.fromkeys(_POOLS, 0)    # guarded-by: confined(controller-loop)
         self._down_streak = dict.fromkeys(_POOLS, 0)  # guarded-by: confined(controller-loop)
         self._cooldown = dict.fromkeys(_POOLS, 0)     # guarded-by: confined(controller-loop)
@@ -154,7 +185,11 @@ class PoolController:
         self._last_tick_t = now
         self._router.scrape_stats()
         sig = self._router.autoscale_signal(self._load_fleet())
-        actions: List[dict] = []
+        # deferred-attach (ISSUE 17): advance every pending spawn's
+        # READY handshake FIRST — non-blocking, so a warming worker
+        # costs this tick microseconds, and the attach happens the
+        # same cycle the child reports in
+        actions: List[dict] = self._poll_pending()
         for pool in _POOLS:
             hint = sig.get(pool, {}).get("hint", 0)
             if hint > 0:
@@ -172,7 +207,10 @@ class PoolController:
                 self._cooldown[pool] -= 1
                 continue
             lo, hi = self._bounds[pool]
-            size = self._pool_size(pool)
+            # a warming (pending-attach) member counts toward size:
+            # the hint persisting through its spawn must not stack a
+            # second spawn on top of the first
+            size = self._pool_size(pool) + len(self._pending[pool])
             act = None
             if (self._up_streak[pool] >= self._up_after
                     and size < hi):
@@ -206,6 +244,10 @@ class PoolController:
     # -- actions ------------------------------------------------------------
 
     def _scale_up(self, pool: str) -> dict:
+        if self._spawn_async is not None or self._defer:
+            launch = self._spawn_async or self._spawn_process_async
+            self._pending[pool].append(launch(pool))
+            return self._record("spawn_started", pool, "")
         handle, addr = self._spawn(pool)
         try:
             self._router.add_worker(addr, pool)
@@ -214,6 +256,43 @@ class PoolController:
             raise
         self._procs[addr] = handle
         return self._record("spawn", pool, addr)
+
+    def _poll_pending(self) -> List[dict]:
+        """Tick every pending spawn's non-blocking READY poll: attach
+        the ones that reported in, reap the ones that died before
+        READY (never attached, so nothing to drain), keep warming the
+        rest.  Runs every tick regardless of cooldown — an attach is
+        the COMPLETION of a past action, not a new one."""
+        acts: List[dict] = []
+        for pool in _POOLS:
+            still: List = []
+            for pw in self._pending[pool]:
+                state = pw.poll()
+                if state is None:
+                    still.append(pw)
+                    continue
+                if state == "ready":
+                    try:
+                        self._router.add_worker(pw.addr, pool)
+                    except Exception as e:   # noqa: BLE001 — tick survives
+                        self._reap(pw.proc)
+                        acts.append(self._record(
+                            "attach_failed", pool, pw.addr or "",
+                            error=str(e)[:200]))
+                        continue
+                    self._procs[pw.addr] = pw.proc
+                    extra = {}
+                    if getattr(pw, "ready_ms", None) is not None:
+                        extra["ready_ms"] = round(pw.ready_ms, 3)
+                    acts.append(self._record("attach", pool, pw.addr,
+                                             **extra))
+                else:                        # dead before READY
+                    self._reap(pw.proc)
+                    acts.append(self._record(
+                        "spawn_failed", pool, "",
+                        error=str(getattr(pw, "error", ""))[:200]))
+            self._pending[pool] = still
+        return acts
 
     def _scale_down(self, pool: str) -> Optional[dict]:
         victim = self._pick_victim(pool)
@@ -271,8 +350,10 @@ class PoolController:
                    if w.alive and not w.draining)
 
     def _n_workers(self) -> int:
-        return sum(1 for w in (self._router._prefill
-                               + self._router._decode) if w.alive)
+        # pending spawns burn their chip from launch, not from attach
+        return (sum(1 for w in (self._router._prefill
+                                + self._router._decode) if w.alive)
+                + sum(len(v) for v in self._pending.values()))
 
     def _load_fleet(self) -> Optional[dict]:
         src = self._fleet_summary
@@ -291,13 +372,24 @@ class PoolController:
     def _spawn_process(self, pool: str) -> Tuple[object, str]:
         from apex_tpu.serving.cluster.worker import spawn_worker
 
+        proc, addr, _metrics = spawn_worker(
+            pool, extra_args=self._pool_flags(pool),
+            timeout=self._spawn_timeout_s)
+        return proc, addr
+
+    def _spawn_process_async(self, pool: str):
+        from apex_tpu.serving.cluster.worker import spawn_worker_async
+
+        return spawn_worker_async(pool, extra_args=self._pool_flags(pool),
+                                  timeout=self._spawn_timeout_s)
+
+    def _pool_flags(self, pool: str) -> List[str]:
         flags = self._worker_flags.get(pool)
         if flags is None:
             raise ValueError(
                 f"no worker_flags[{pool!r}] configured and no spawn= "
                 "hook given — the controller cannot grow this pool")
-        proc, addr, _metrics = spawn_worker(pool, extra_args=flags)
-        return proc, addr
+        return flags
 
     @staticmethod
     def _reap(handle) -> None:
@@ -320,6 +412,22 @@ class PoolController:
         _telemetry.gauge("controller.draining").set(sum(
             1 for w in (self._router._prefill + self._router._decode)
             if w.alive and w.draining))
+        _telemetry.gauge("controller.pending_spawns").set(
+            sum(len(v) for v in self._pending.values()))
+        # per-pool warming countdown (ISSUE 17): the oldest pending
+        # spawn's age and its READY deadline — serve_dash renders the
+        # remaining-time row from these; 0/0 means nothing warming
+        for pool in _POOLS:
+            pend = [pw for pw in self._pending[pool]
+                    if hasattr(pw, "age_s")]
+            oldest = max(pend, key=lambda pw: pw.age_s, default=None)
+            _telemetry.gauge("controller.warming_age_s",
+                             {"pool": pool}).set(
+                round(oldest.age_s, 3) if oldest else 0.0)
+            _telemetry.gauge("controller.warming_timeout_s",
+                             {"pool": pool}).set(
+                getattr(oldest, "timeout_s", 0.0) or 0.0
+                if oldest else 0.0)
         _telemetry.gauge("controller.chip_seconds").set(
             round(self._chip_seconds, 3))
 
@@ -331,6 +439,15 @@ class PoolController:
         totals."""
         return {
             "pool_size": {p: self._pool_size(p) for p in _POOLS},
+            "pending_spawns": {p: len(self._pending[p])
+                               for p in _POOLS},
+            # the dashboard's "warming" rows: one per pending spawn,
+            # with how long it has been warming vs its READY deadline
+            "warming": [
+                {"pool": p, "age_s": round(pw.age_s, 3),
+                 "timeout_s": getattr(pw, "timeout_s", None)}
+                for p in _POOLS for pw in self._pending[p]
+                if hasattr(pw, "age_s")],
             "draining": sum(
                 1 for w in (self._router._prefill
                             + self._router._decode)
@@ -347,10 +464,12 @@ class PoolController:
         }
 
     def close(self, reap_spawned: bool = True) -> None:
-        """Reap every worker THIS controller spawned (pre-existing
-        pool members are the operator's)."""
+        """Reap every worker THIS controller spawned — attached or
+        still warming (pre-existing pool members are the operator's)."""
         if not reap_spawned:
             self._procs.clear()
+            for p in _POOLS:
+                self._pending[p] = []
             return
         while self._procs:
             _addr, handle = self._procs.popitem()
@@ -358,3 +477,10 @@ class PoolController:
                 self._reap(handle)
             except Exception:
                 pass
+        for p in _POOLS:
+            pending, self._pending[p] = self._pending[p], []
+            for pw in pending:
+                try:
+                    self._reap(getattr(pw, "proc", pw))
+                except Exception:
+                    pass

@@ -60,8 +60,8 @@ from apex_tpu.serving.cluster import protocol
 from apex_tpu.serving.cluster.handoff import (
     WIRE_DTYPES, decode_kv, encode_kv, wire_bytes)
 
-__all__ = ["WorkerServer", "spawn_worker", "shutdown_worker",
-           "READY_PREFIX"]
+__all__ = ["WorkerServer", "spawn_worker", "spawn_worker_async",
+           "PendingWorker", "shutdown_worker", "READY_PREFIX"]
 
 READY_PREFIX = "APEX_TPU_CLUSTER_WORKER ready"
 
@@ -95,7 +95,8 @@ class WorkerServer:
                  top_p=None, vocab_limit=None, slo_targets=None,
                  scratch_layout: str = "paged",
                  wire_dtype: str = "raw", seed: int = 0,
-                 chunk_tokens: Optional[int] = None):
+                 chunk_tokens: Optional[int] = None,
+                 compile_cache: Optional[str] = None):
         if role not in ("prefill", "decode"):
             raise ValueError(f"role={role!r}: expected 'prefill' or "
                              "'decode'")
@@ -137,6 +138,7 @@ class WorkerServer:
                 top_k=top_k, top_p=top_p,
                 vocab_limit=vocab_limit, slo_targets=slo_targets,
                 chunk_tokens=chunk_tokens,
+                compile_cache_dir=compile_cache,
                 rng=jax.random.PRNGKey(seed))
         else:
             dt = cfg.compute_dtype if cache_dtype is None else cache_dtype
@@ -501,8 +503,16 @@ def main(argv=None) -> int:
     ap.add_argument("--export-port", type=int, default=None,
                     help="also serve /metrics + /healthz on this "
                          "localhost port (0 = ephemeral)")
+    ap.add_argument("--compile-cache", default=None,
+                    help="persistent compile-cache directory "
+                         "(ISSUE 17): the decode engine loads its "
+                         "bucket-ladder executables from here instead "
+                         "of tracing, and AOT-warms the whole ladder "
+                         "before READY (APEX_TPU_COMPILE_CACHE is the "
+                         "env-level default)")
     args = ap.parse_args(argv)
 
+    t_start = time.perf_counter()
     metrics_url = ""
     if args.export_port is not None:
         from apex_tpu import observability as obs
@@ -522,9 +532,23 @@ def main(argv=None) -> int:
         vocab_limit=args.vocab_limit,
         scratch_layout=args.scratch_layout,
         wire_dtype=args.wire_dtype, seed=args.seed,
-        chunk_tokens=args.chunk_tokens)
+        chunk_tokens=args.chunk_tokens,
+        compile_cache=args.compile_cache)
+    if server.engine is not None and server.engine._compile_cache:
+        # AOT-warm the whole ladder BEFORE declaring READY: a primed
+        # cache dir turns this into a few deserialize calls, and the
+        # READY stamp below is what cold_vs_warm_start measures
+        from apex_tpu.serving.compile_cache import warmup_ladder
+
+        warmup_ladder(server.engine)
+    ready_ms = (time.perf_counter() - t_start) * 1e3
+    from apex_tpu.observability import metrics as _telemetry
+
+    _telemetry.gauge("worker.ready_ms").set(round(ready_ms, 3))
+    _telemetry.event("worker.ready", role=args.role,
+                     ready_ms=round(ready_ms, 3))
     print(f"{READY_PREFIX} role={args.role} addr={server.addr} "
-          f"metrics={metrics_url}", flush=True)
+          f"metrics={metrics_url} ready_ms={ready_ms:.0f}", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -537,12 +561,48 @@ def main(argv=None) -> int:
     return 0
 
 
-def spawn_worker(role: str, *, extra_args: Optional[List[str]] = None,
-                 timeout: float = 120.0, env: Optional[dict] = None):
-    """Start ``python -m apex_tpu.serving.cluster.worker`` as a child
-    process and block until its READY line → ``(Popen, addr,
-    metrics_url)``.  The caller owns the process (terminate it; the
-    soak test kills one on purpose)."""
+def _parse_ready(line: str):
+    """Pull ``(addr, metrics_url, ready_ms)`` out of a READY line.
+    Unknown key=value parts are ignored, so old drivers read new
+    workers (``ready_ms=`` arrived with ISSUE 17) and vice versa."""
+    addr = metrics = ready_ms = None
+    for part in line.split():
+        if part.startswith("addr="):
+            addr = part[5:]
+        elif part.startswith("metrics="):
+            metrics = part[8:] or None
+        elif part.startswith("ready_ms="):
+            try:
+                ready_ms = float(part[9:])
+            except ValueError:
+                pass
+    return addr, metrics, ready_ms
+
+
+def _attach_drain(proc) -> None:
+    """Keep draining the child's output: a full pipe buffer would block
+    the worker mid-decode (CPU donation warnings alone can fill 64 KB
+    over a long soak).  The tail stays inspectable for post-mortems."""
+    import collections
+    import threading
+
+    tail: collections.deque = collections.deque(maxlen=200)   # guarded-by: deque
+
+    def _drain():
+        for line in proc.stdout:
+            tail.append(line.rstrip())
+
+    drain = threading.Thread(target=_drain, daemon=True,
+                             name="apex-tpu-worker-drain")
+    drain.start()
+    proc.output_tail = tail
+    # the drain exits on stdout EOF (child death); shutdown_worker()
+    # is the join path — callers that kill the child directly should
+    # still reap proc.drain_thread
+    proc.drain_thread = drain
+
+
+def _spawn_proc(role: str, extra_args, env):
     import os
     import subprocess
     import sys
@@ -552,9 +612,18 @@ def spawn_worker(role: str, *, extra_args: Optional[List[str]] = None,
     child_env = dict(os.environ)
     if env:
         child_env.update(env)
-    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True,
                             env=child_env)
+
+
+def spawn_worker(role: str, *, extra_args: Optional[List[str]] = None,
+                 timeout: float = 120.0, env: Optional[dict] = None):
+    """Start ``python -m apex_tpu.serving.cluster.worker`` as a child
+    process and block until its READY line → ``(Popen, addr,
+    metrics_url)``.  The caller owns the process (terminate it; the
+    soak test kills one on purpose)."""
+    proc = _spawn_proc(role, extra_args, env)
     deadline = time.time() + timeout
     addr = metrics = None
     lines: List[str] = []
@@ -575,11 +644,7 @@ def spawn_worker(role: str, *, extra_args: Optional[List[str]] = None,
             continue
         lines.append(line.rstrip())
         if line.startswith(READY_PREFIX):
-            for part in line.split():
-                if part.startswith("addr="):
-                    addr = part[5:]
-                elif part.startswith("metrics="):
-                    metrics = part[8:] or None
+            addr, metrics, _ready_ms = _parse_ready(line)
             break
     if addr is None:
         proc.kill()
@@ -587,28 +652,81 @@ def spawn_worker(role: str, *, extra_args: Optional[List[str]] = None,
         raise RuntimeError(
             f"{role} worker failed to become ready in {timeout:.0f}s:"
             f"\n{tail}")
-
-    # keep draining the child's output: a full pipe buffer would block
-    # the worker mid-decode (CPU donation warnings alone can fill 64 KB
-    # over a long soak).  The tail stays inspectable for post-mortems.
-    import collections
-    import threading
-
-    tail: collections.deque = collections.deque(maxlen=200)   # guarded-by: deque
-
-    def _drain():
-        for line in proc.stdout:
-            tail.append(line.rstrip())
-
-    drain = threading.Thread(target=_drain, daemon=True,
-                             name="apex-tpu-worker-drain")
-    drain.start()
-    proc.output_tail = tail
-    # the drain exits on stdout EOF (child death); shutdown_worker()
-    # is the join path — callers that kill the child directly should
-    # still reap proc.drain_thread
-    proc.drain_thread = drain
+    _attach_drain(proc)
     return proc, addr, metrics
+
+
+class PendingWorker:
+    """One not-yet-READY worker child (``spawn_worker_async``): the
+    deferred-attach scale-up handle (ISSUE 17).  :meth:`poll` is
+    NON-BLOCKING — the controller ticks it from the router loop while
+    the child traces/loads its ladder, so a spawn never stalls
+    draining or routing.  States: ``None`` (still warming) →
+    ``"ready"`` (``addr``/``metrics``/``ready_ms`` populated, stdout
+    drain attached — hand ``proc`` to :func:`shutdown_worker` like a
+    blocking spawn's) or ``"dead"`` (``error`` holds the output tail;
+    the process is already killed/exited — reap with
+    :func:`shutdown_worker`)."""
+
+    def __init__(self, role: str, proc, timeout: float):
+        self.role = role
+        self.proc = proc
+        self.addr: Optional[str] = None
+        self.metrics: Optional[str] = None
+        self.ready_ms: Optional[float] = None
+        self.error: Optional[str] = None
+        self.timeout_s = float(timeout)
+        self._deadline = time.time() + timeout
+        self._t0 = time.perf_counter()
+        self._lines: List[str] = []     # guarded-by: confined(controller-loop)
+
+    @property
+    def age_s(self) -> float:
+        """Seconds since spawn — the dashboard's warming countdown."""
+        return time.perf_counter() - self._t0
+
+    def poll(self) -> Optional[str]:
+        """Advance the handshake without blocking: consume whatever
+        stdout the child has produced, return ``"ready"`` / ``"dead"``
+        / ``None`` (still warming)."""
+        if self.addr is not None:
+            return "ready"
+        if self.error is not None:
+            return "dead"
+        while True:
+            r, _w, _x = select.select([self.proc.stdout], [], [], 0)
+            if not r:
+                break
+            line = self.proc.stdout.readline()
+            if not line:                       # EOF: child exiting
+                break
+            self._lines.append(line.rstrip())
+            if line.startswith(READY_PREFIX):
+                self.addr, self.metrics, self.ready_ms = \
+                    _parse_ready(line)
+                _attach_drain(self.proc)
+                return "ready"
+        if self.proc.poll() is not None:
+            self.error = ("worker died before READY:\n"
+                          + "\n".join(self._lines[-20:]))
+            return "dead"
+        if time.time() > self._deadline:
+            self.proc.kill()
+            self.error = (f"{self.role} worker not READY in "
+                          f"{self.timeout_s:.0f}s")
+            return "dead"
+        return None
+
+
+def spawn_worker_async(role: str, *,
+                       extra_args: Optional[List[str]] = None,
+                       timeout: float = 120.0,
+                       env: Optional[dict] = None) -> PendingWorker:
+    """Start a worker child WITHOUT waiting for its READY line —
+    returns immediately with a :class:`PendingWorker` the caller polls
+    (the controller's deferred-attach scale-up path)."""
+    return PendingWorker(role, _spawn_proc(role, extra_args, env),
+                         timeout)
 
 
 def shutdown_worker(proc, timeout: float = 10.0) -> None:

@@ -121,8 +121,10 @@ from apex_tpu.observability import metrics as _telemetry
 from apex_tpu.observability import span
 from apex_tpu.observability.device import (
     compile_label, sample_device_memory)
+from apex_tpu.ops.decode_step import route_decode_fused
 from apex_tpu.serving.batching import (
     SlotPool, default_buckets, pad_prompt, pick_bucket)
+from apex_tpu.serving.compile_cache import CompileCache
 from apex_tpu.serving.paged_cache import (
     BlockManager, blocks_for, init_paged_pool, paged_insert_prefill,
     paged_insert_prefill_q, prefix_block_hashes, resolve_cache_wire)
@@ -343,6 +345,7 @@ class ServingEngine:
                  slo_targets: Optional[dict] = None,
                  spec=None,
                  chunk_tokens: Optional[int] = None,
+                 compile_cache_dir: Optional[str] = None,
                  rng: Optional[jax.Array] = None):
         _check_decode_cfg(cfg)
         if cache_layout not in ("contiguous", "paged"):
@@ -482,12 +485,23 @@ class ServingEngine:
         # overlaid with the caller's overrides; completions are judged
         # into serving.goodput.{met,missed} and the SLO detector
         self._slo_targets = resolve_slo_targets(slo_targets)
+        # fused decode-layer routing (ISSUE 17) is resolved ONCE here
+        # and threaded as a static into the memoized step builders: an
+        # env flip mid-lifetime must never silently replay a stale
+        # trace compiled for the other path
+        self._decode_fused = route_decode_fused(None)
         self._decode_fn = _make_decode_fn(cfg, top_k, top_p, vocab_limit,
                                           cache_layout == "paged",
-                                          self._spec)
+                                          self._spec, self._decode_fused)
         self._sample_fn = _make_sample_fn(top_k, top_p, vocab_limit)
         self._chunk_fn = (_make_chunk_fn(cfg, cache_layout == "paged")
                           if self.chunk_tokens else None)
+        # persistent compile cache (ISSUE 17): executables load from
+        # disk instead of tracing; APEX_TPU_COMPILE_CACHE is the
+        # deploy-time default when the caller passes no directory
+        cc_dir = (compile_cache_dir
+                  or os.environ.get("APEX_TPU_COMPILE_CACHE") or None)
+        self._compile_cache = CompileCache(cc_dir) if cc_dir else None
 
     # -- public API --------------------------------------------------------
 
@@ -676,6 +690,9 @@ class ServingEngine:
             "chunk_tokens": self.chunk_tokens,
             "prefilling": sum(1 for st in self._slots
                               if st is not None and st.prefilling),
+            "decode_fused": self._decode_fused,
+            "compile_cache": (None if self._compile_cache is None
+                              else self._compile_cache.stats()),
         }
         if self._mgr is not None:
             free_blocks = max(0, self._mgr.n_free - self.reserve_blocks)
@@ -982,6 +999,57 @@ class ServingEngine:
             raise
         return blocks, list(blocks), 0
 
+    # -- persistent compile cache routing (ISSUE 17) -----------------------
+
+    def _cc_parts(self, **extra) -> dict:
+        """The engine-level static identity every persistent-compile-
+        cache key carries: wire/layout/spec/chunk/fusion knobs plus
+        per-site extras (the prompt bucket).  Mesh geometry and the
+        code-version digest are appended by ``CompileCache`` itself."""
+        return dict(cache_wire=self.cache_wire,
+                    cache_layout=self.cache_layout, spec=self._spec,
+                    chunk_tokens=self.chunk_tokens,
+                    decode_fused=self._decode_fused, **extra)
+
+    def _cc(self, name: str, jitfn, args: tuple, static=None, **parts):
+        """Route one jitted call through the persistent compile cache
+        when one is configured.  ``args`` are the dynamic positionals
+        (what the AOT executable is called with); ``static`` holds
+        keyword-only ``static_argnames`` that exist at lowering but are
+        baked in at call time.  Without a cache — or when the loaded
+        executable rejects the arguments before running (an aval drift
+        the key missed; donation has not happened yet at that point) —
+        the plain jit call runs and hits jax's in-memory cache."""
+        static = static or {}
+        if self._compile_cache is not None:
+            fn = self._compile_cache.load_or_compile(
+                name, jitfn, args, static,
+                key_parts=self._cc_parts(**parts))
+            if fn is not None:
+                try:
+                    return fn(*args)
+                except Exception:
+                    pass
+        return jitfn(*args, **static)
+
+    def _cc_prefill(self, padded, lens, bucket: int):
+        """The prefill edge's cache routing — special-cased because its
+        static ``cfg`` rides in a POSITIONAL slot, so the AOT call
+        drops it while the jit fallback keeps it."""
+        if self._compile_cache is not None:
+            fn = self._compile_cache.load_or_compile(
+                "prefill", prefill, (self.params, padded, self.cfg),
+                dict(prompt_lens=lens, max_len=bucket,
+                     cache_dtype=self._cache_dtype),
+                key_parts=self._cc_parts(bucket=bucket))
+            if fn is not None:
+                try:
+                    return fn(self.params, padded, prompt_lens=lens)
+                except Exception:
+                    pass
+        return prefill(self.params, padded, self.cfg, prompt_lens=lens,
+                       max_len=bucket, cache_dtype=self._cache_dtype)
+
     def _insert_prefill_kv(self, slot: int, bucket: int,
                            write_ids: List[int], ks, vs, n: int) -> None:
         """THE one insert edge for a freshly admitted request's K/V
@@ -994,27 +1062,31 @@ class ServingEngine:
                           self.num_blocks, np.int32)
             wid[: len(write_ids)] = write_ids
             if self.cache_wire == "int8":
-                k, v, sk, sv = paged_insert_prefill_q(
-                    self.cache["k"], self.cache["v"],
-                    self.cache["k_scale"], self.cache["v_scale"],
-                    ks, vs, jnp.asarray(wid), jnp.int32(n),
-                    block_size=self.block_size)
+                k, v, sk, sv = self._cc(
+                    "paged_insert_prefill_q", paged_insert_prefill_q,
+                    (self.cache["k"], self.cache["v"],
+                     self.cache["k_scale"], self.cache["v_scale"],
+                     ks, vs, jnp.asarray(wid), jnp.int32(n)),
+                    dict(block_size=self.block_size), bucket=bucket)
                 self.cache = {
                     "k": k, "v": v, "k_scale": sk, "v_scale": sv,
                     "pos": self.cache["pos"].at[slot].set(n),
                 }
             else:
-                k, v = paged_insert_prefill(
-                    self.cache["k"], self.cache["v"], ks, vs,
-                    jnp.asarray(wid), jnp.int32(n),
-                    block_size=self.block_size)
+                k, v = self._cc(
+                    "paged_insert_prefill", paged_insert_prefill,
+                    (self.cache["k"], self.cache["v"], ks, vs,
+                     jnp.asarray(wid), jnp.int32(n)),
+                    dict(block_size=self.block_size), bucket=bucket)
                 self.cache = {
                     "k": k, "v": v,
                     "pos": self.cache["pos"].at[slot].set(n),
                 }
         else:
-            self.cache = _insert_slot(self.cache, ks, vs,
-                                      jnp.int32(slot), jnp.int32(n))
+            self.cache = self._cc(
+                "_insert_slot", _insert_slot,
+                (self.cache, ks, vs, jnp.int32(slot), jnp.int32(n)),
+                bucket=bucket)
 
     def _inject_handoff(self, req: Request, slot: int, bucket: int,
                         write_ids: List[int], n: int) -> int:
@@ -1083,16 +1155,16 @@ class ServingEngine:
                         compile_label("serving.prefill"):
                     padded = jnp.asarray(pad_prompt(tokens, bucket)[None])
                     lens = jnp.asarray([n], jnp.int32)
-                    logits, small = prefill(
-                        self.params, padded, self.cfg, prompt_lens=lens,
-                        max_len=bucket, cache_dtype=self._cache_dtype)
+                    logits, small = self._cc_prefill(padded, lens,
+                                                     bucket)
                     self._insert_prefill_kv(slot, bucket, write_ids,
                                             small["k"], small["v"], n)
                     self._key, sub = jax.random.split(self._key)
-                    first = self._sample_fn(
-                        logits,
-                        jnp.asarray([req.temperature], jnp.float32),
-                        sub)
+                    first = self._cc(
+                        "sample", self._sample_fn,
+                        (logits,
+                         jnp.asarray([req.temperature], jnp.float32),
+                         sub))
                     tok = int(np.asarray(first)[0])      # host sync
             if self._mgr is not None:
                 self._tables[slot, :] = self.num_blocks
@@ -1250,24 +1322,27 @@ class ServingEngine:
         with span("serving.prefill_chunk"), \
                 compile_label("serving.prefill_chunk"):
             if self._mgr is not None:
-                logits, self.cache = self._chunk_fn(
-                    self.params, self.cache,
-                    jnp.asarray(self._tables[slot]),
-                    jnp.asarray(chunk), jnp.int32(lo), jnp.int32(hi),
-                    jnp.int32(slot))
+                logits, self.cache = self._cc(
+                    "chunk", self._chunk_fn,
+                    (self.params, self.cache,
+                     jnp.asarray(self._tables[slot]),
+                     jnp.asarray(chunk), jnp.int32(lo), jnp.int32(hi),
+                     jnp.int32(slot)))
             else:
-                logits, self.cache = self._chunk_fn(
-                    self.params, self.cache, jnp.asarray(chunk),
-                    jnp.int32(lo), jnp.int32(hi), jnp.int32(slot))
+                logits, self.cache = self._cc(
+                    "chunk", self._chunk_fn,
+                    (self.params, self.cache, jnp.asarray(chunk),
+                     jnp.int32(lo), jnp.int32(hi), jnp.int32(slot)))
             if hi >= n:
                 # final chunk: its last-REAL-token logits are the
                 # first-token logits (greedy-identical to monolithic
                 # prefill); sample while still inside the span so
                 # prefill cost accounting covers the whole admission
                 self._key, sub = jax.random.split(self._key)
-                first = self._sample_fn(
-                    logits[:, n - 1 - lo],
-                    jnp.asarray([req.temperature], jnp.float32), sub)
+                first = self._cc(
+                    "sample", self._sample_fn,
+                    (logits[:, n - 1 - lo],
+                     jnp.asarray([req.temperature], jnp.float32), sub))
                 tok = int(np.asarray(first)[0])      # host sync
         now = time.perf_counter()
         st.prefill_ms += (now - t0) * 1e3
@@ -1406,19 +1481,24 @@ class ServingEngine:
                          jnp.asarray(self._temps),
                          jnp.asarray(active), sub]
                 (em, n_acc, self.cache, self._history,
-                 self._hist_len) = self._decode_fn(*args)
+                 self._hist_len) = self._cc("decode", self._decode_fn,
+                                            tuple(args))
                 em_host = np.asarray(em)             # host sync
                 acc_host = np.asarray(n_acc)
             elif self._mgr is not None:
-                nxt, self.cache = self._decode_fn(
-                    self.params, self.cache, jnp.asarray(self._tables),
-                    jnp.asarray(self._pending),
-                    jnp.asarray(self._temps), jnp.asarray(active), sub)
+                nxt, self.cache = self._cc(
+                    "decode", self._decode_fn,
+                    (self.params, self.cache, jnp.asarray(self._tables),
+                     jnp.asarray(self._pending),
+                     jnp.asarray(self._temps), jnp.asarray(active),
+                     sub))
                 nxt_host = np.asarray(nxt)           # host sync
             else:
-                nxt, self.cache = self._decode_fn(
-                    self.params, self.cache, jnp.asarray(self._pending),
-                    jnp.asarray(self._temps), jnp.asarray(active), sub)
+                nxt, self.cache = self._cc(
+                    "decode", self._decode_fn,
+                    (self.params, self.cache, jnp.asarray(self._pending),
+                     jnp.asarray(self._temps), jnp.asarray(active),
+                     sub))
                 nxt_host = np.asarray(nxt)           # host sync
         dt = time.perf_counter() - t0
         _telemetry.counter("serving.decode_steps").inc()
@@ -1591,7 +1671,8 @@ def _make_sample_fn(top_k, top_p, vocab_limit):
 
 
 @functools.lru_cache(maxsize=None)
-def _make_decode_fn(cfg, top_k, top_p, vocab_limit, paged, spec=None):
+def _make_decode_fn(cfg, top_k, top_p, vocab_limit, paged, spec=None,
+                    decode_fused: str = "reference"):
     """One compiled decode+sample step for the engine's lifetime —
     memoized on the static knobs so engines sharing a config (tests,
     multi-engine processes) share the XLA compile too.
@@ -1670,7 +1751,8 @@ def _make_decode_fn(cfg, top_k, top_p, vocab_limit, paged, spec=None):
         def step_fn(params, cache, tables, tokens, temps, active, key):
             prev_pos = cache["pos"]
             logits, new = decode_step(
-                params, tokens, dict(cache, block_tables=tables), cfg)
+                params, tokens, dict(cache, block_tables=tables), cfg,
+                decode_fused=decode_fused)
             # free lanes ride along: frozen position + sentinel table
             # rows (writes drop), so they can't corrupt live blocks.
             # Key-generic rebuild so the int8 pool's scale arrays ride
@@ -1687,7 +1769,8 @@ def _make_decode_fn(cfg, top_k, top_p, vocab_limit, paged, spec=None):
     @functools.partial(jax.jit, donate_argnames=("cache",))
     def step_fn(params, cache, tokens, temps, active, key):
         prev_pos = cache["pos"]
-        logits, cache = decode_step(params, tokens, cache, cfg)
+        logits, cache = decode_step(params, tokens, cache, cfg,
+                                    decode_fused=decode_fused)
         # free slots ride along; freezing their position keeps their
         # lane from walking off the cache during long droughts
         cache = dict(cache, pos=jnp.where(active, cache["pos"], prev_pos))

@@ -57,6 +57,9 @@ MODULES = [
      "ops.paged_attention — ragged paged-attention decode kernel"),
     ("apex_tpu.ops.fused_sampling", "ops",
      "ops.fused_sampling — fused temperature/top-k/top-p/sample kernel"),
+    ("apex_tpu.ops.decode_step", "ops",
+     "ops.decode_step — fused decode-layer megakernel "
+     "(rope + paged attention + projection)"),
     # comm
     ("apex_tpu.comm", "comm",
      "apex_tpu.comm — compressed gradient collectives"),
@@ -158,6 +161,9 @@ MODULES = [
      "serving.paged_cache — block pool, block tables, prefix sharing"),
     ("apex_tpu.serving.slo", "serving",
      "serving.slo — SLO classes, TTFT/TPOT deadlines, goodput judge"),
+    ("apex_tpu.serving.compile_cache", "serving",
+     "serving.compile_cache — persistent AOT executables + warmup "
+     "ladder"),
     ("apex_tpu.serving.cluster", "serving",
      "serving.cluster — disaggregated prefill/decode tier"),
     ("apex_tpu.serving.cluster.protocol", "serving",

@@ -737,3 +737,247 @@ class TestControllerLoop:
                 eng.step()
         finally:
             obs.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# deferred-attach scale-up (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    """Reap target for a pending spawn: the controller must call
+    stop() on a handle it gives up on."""
+
+    def __init__(self):
+        self.stopped = False
+
+    def stop(self):
+        self.stopped = True
+
+
+class _FakePending:
+    """A spawn_worker_async handle with a scripted READY handshake:
+    poll() returns None for ``ready_after - 1`` calls, then "ready"
+    (or "dead" when ``die``)."""
+
+    def __init__(self, addr, ready_after=2, die=False):
+        self.role = "decode"
+        self.proc = _FakeProc()
+        self.addr = None
+        self.metrics = None
+        self.ready_ms = None
+        self.error = None
+        self.timeout_s = 120.0
+        self._final_addr = addr
+        self._ready_after = int(ready_after)
+        self._die = die
+        self.polls = 0
+        self._t0 = time.perf_counter()
+
+    @property
+    def age_s(self):
+        return time.perf_counter() - self._t0
+
+    def poll(self):
+        self.polls += 1
+        if self.polls < self._ready_after:
+            return None
+        if self._die:
+            self.error = "worker exploded before READY"
+            return "dead"
+        self.addr = self._final_addr
+        self.ready_ms = 1234.5
+        return "ready"
+
+
+def _async_ctrl(hints, pendings, **kw):
+    """_stub_ctrl's deferred twin: spawn_async= hands out scripted
+    pending handles instead of blocking on a READY line."""
+    router = _StubRouter(hints)
+    kw.setdefault("min_decode", 1)
+    kw.setdefault("max_decode", 3)
+    kw.setdefault("scale_up_after", 2)
+    kw.setdefault("scale_down_after", 2)
+    kw.setdefault("cooldown_ticks", 1)
+    kw.setdefault("tick_interval_s", 0.0)
+    queue = list(pendings)
+    launched = []
+
+    def spawn_async(pool):
+        pw = queue.pop(0)
+        launched.append(pw)
+        return pw
+
+    ctrl = PoolController(router, spawn_async=spawn_async, **kw)
+    return router, ctrl, launched
+
+
+class TestDeferredAttach:
+    def test_spawn_started_then_attach_with_ready_ms(self):
+        """The tentpole pin: _scale_up returns IMMEDIATELY with a
+        spawn_started record; the attach lands on a LATER tick, as its
+        own action, carrying the worker-reported ready_ms."""
+        pw = _FakePending("new1", ready_after=3)
+        router, ctrl, _ = _async_ctrl([1] * 8, [pw])
+        ctrl.tick()
+        sig = ctrl.tick()                 # streak=2 -> spawn_started
+        acts = [a["action"] for a in sig["actions"]]
+        assert acts == ["spawn_started"]
+        assert len(router._decode) == 1   # nothing attached yet
+        # warming: polled once per tick until READY on the 3rd poll
+        attach = None
+        for _ in range(4):
+            sig = ctrl.tick()
+            got = [a for a in sig["actions"] if a["action"] == "attach"]
+            if got:
+                attach = got[0]
+                break
+        assert attach is not None and attach["addr"] == "new1"
+        assert attach["ready_ms"] == 1234.5
+        assert [w.addr for w in router._decode] == ["d0", "new1"]
+        assert ctrl.stats()["pending_spawns"]["decode"] == 0
+
+    def test_tick_never_blocks_on_spawn(self):
+        """A pending handle that NEVER reports READY must not stall
+        the loop: every tick completes and keeps polling."""
+        pw = _FakePending("never", ready_after=10 ** 9)
+        router, ctrl, _ = _async_ctrl([1] * 6, [pw])
+        t0 = time.perf_counter()
+        for _ in range(6):
+            ctrl.tick()
+        assert time.perf_counter() - t0 < 1.0
+        assert pw.polls >= 4              # polled every tick post-spawn
+        st = ctrl.stats()
+        assert st["pending_spawns"]["decode"] == 1
+        assert st["warming"] and st["warming"][0]["pool"] == "decode"
+        assert st["warming"][0]["timeout_s"] == 120.0
+
+    def test_pending_counts_toward_size_no_double_spawn(self):
+        """The hint persisting through a slow warmup must not stack a
+        second spawn: warming members count toward the pool bound."""
+        slow = _FakePending("slow", ready_after=10 ** 9)
+        spare = _FakePending("spare")
+        router, ctrl, launched = _async_ctrl(
+            [1] * 10, [slow, spare], max_decode=2)
+        for _ in range(10):
+            ctrl.tick()
+        assert len(launched) == 1         # size 1 live + 1 pending = hi
+        assert ctrl.stats()["pending_spawns"]["decode"] == 1
+
+    def test_dead_before_ready_reaped_never_attached(self):
+        pw = _FakePending("doa", ready_after=2, die=True)
+        router, ctrl, _ = _async_ctrl([1] * 8, [pw])
+        ctrl.tick()
+        ctrl.tick()                       # spawn_started
+        failed = None
+        for _ in range(3):
+            sig = ctrl.tick()
+            got = [a for a in sig["actions"]
+                   if a["action"] == "spawn_failed"]
+            if got:
+                failed = got[0]
+                break
+        assert failed is not None
+        assert "exploded" in failed["error"]
+        assert pw.proc.stopped            # reaped
+        assert [w.addr for w in router._decode] == ["d0"]
+        assert ctrl.stats()["pending_spawns"]["decode"] == 0
+
+    def test_pending_burns_chip_seconds_from_launch(self):
+        pw = _FakePending("warm", ready_after=10 ** 9)
+        router, ctrl, _ = _async_ctrl([1] * 4, [pw])
+        for _ in range(3):
+            ctrl.tick()
+            time.sleep(0.02)
+        before = ctrl.stats()["chip_seconds"]
+        time.sleep(0.02)
+        ctrl.tick()
+        after = ctrl.stats()["chip_seconds"]
+        # 2 live workers + 1 pending: the pending one's chip counts,
+        # so the per-tick increment covers 3 members, not 2
+        assert after - before > 0.02 * 3 * 0.9
+
+    def test_legacy_spawn_hook_stays_synchronous(self):
+        """A spawn= hook (in-process test servers, no READY line to
+        poll) must keep the blocking semantics: the worker is attached
+        in the SAME tick, recorded as "spawn"."""
+        router, ctrl = _stub_ctrl([1] * 2)
+        ctrl.tick()
+        sig = ctrl.tick()
+        assert [a["action"] for a in sig["actions"]] == ["spawn"]
+        assert len(router._decode) == 2
+
+    def test_defer_spawn_false_restores_blocking_process_path(
+            self, monkeypatch):
+        """defer_spawn=False (the bench baseline) routes _scale_up
+        through the blocking _spawn_process."""
+        router = _StubRouter([1] * 2)
+        calls = []
+        ctrl = PoolController(
+            router, defer_spawn=False,
+            worker_flags={"decode": ["--flag"]},
+            min_decode=1, max_decode=3, scale_up_after=2,
+            scale_down_after=2, cooldown_ticks=1, tick_interval_s=0.0)
+        monkeypatch.setattr(
+            ctrl, "_spawn",
+            lambda pool: calls.append(pool) or (_FakeProc(), "blk1"))
+        ctrl.tick()
+        sig = ctrl.tick()
+        assert calls == ["decode"]
+        assert [a["action"] for a in sig["actions"]] == ["spawn"]
+
+    def test_spawn_and_spawn_async_together_rejected(self):
+        router = _StubRouter([])
+        with pytest.raises(ValueError, match="not both"):
+            PoolController(router, spawn=lambda p: None,
+                           spawn_async=lambda p: None)
+
+    def test_close_reaps_pending(self):
+        pw = _FakePending("warm", ready_after=10 ** 9)
+        router, ctrl, _ = _async_ctrl([1] * 2, [pw])
+        ctrl.tick()
+        ctrl.tick()                       # spawn_started
+        ctrl.close()
+        assert pw.proc.stopped
+        assert ctrl.stats()["pending_spawns"]["decode"] == 0
+
+    def test_dash_warming_row_renders_then_hides(self):
+        """ISSUE 17 satellite: a pending spawn exports the per-pool
+        warming gauges and serve_dash renders the READY countdown
+        row; after the attach the gauges zero and the row hides."""
+        import importlib.util
+        import os
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "serve_dash", os.path.join(repo, "tools",
+                                       "serve_dash.py"))
+        dash = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(dash)
+        om = dash.load_openmetrics_module()
+        reg = obs.configure(export_port=0)
+        try:
+            pw = _FakePending("new1", ready_after=4)
+            router, ctrl, _ = _async_ctrl([1] * 8, [pw])
+            ctrl.tick()
+            ctrl.tick()                   # spawn_started -> warming
+            time.sleep(0.05)
+            ctrl.tick()                   # refresh the age gauge
+            out = io.StringIO()
+            snap = dash.one_frame(om, reg.exporter.url, out=out)
+            text = out.getvalue()
+            assert snap["controller_pending"] == 1
+            w = snap["controller_warming"]["decode"]
+            assert w["timeout_s"] == 120.0 and w["age_s"] > 0
+            assert "warming decode" in text
+            assert "READY deadline in" in text
+            for _ in range(4):            # poll to READY + attach
+                ctrl.tick()
+            out = io.StringIO()
+            snap = dash.one_frame(om, reg.exporter.url, out=out)
+            assert snap["controller_pending"] == 0
+            assert snap["controller_warming"] is None
+            assert "warming decode" not in out.getvalue()
+        finally:
+            obs.shutdown()

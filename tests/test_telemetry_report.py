@@ -651,3 +651,69 @@ def test_quantized_cache_summary_from_stream(report, tmp_path):
     # a pre-ISSUE-14 stream -> no section
     assert report.quantized_cache_summary(
         {"gauges": {"serving.blocks_in_use": [1.0]}}) is None
+
+
+def test_compile_cache_summary_from_stream(report, tmp_path):
+    """ISSUE 17 satellite: the persistent compile-cache ledger gets a
+    derived view — hit rate over load_or_compile calls, load-wall
+    p50/p95 against the cumulative compile.ms ledger, warmup-ladder
+    runs, and the worker READY wall — and an absent stream hides the
+    section."""
+    f = tmp_path / "cc.jsonl"
+    f.write_text(
+        '{"schema_version":3,"t":1,"type":"counter",'
+        '"name":"serving.compile_cache.hits","value":9}\n'
+        '{"schema_version":3,"t":2,"type":"counter",'
+        '"name":"serving.compile_cache.misses","value":3}\n'
+        '{"schema_version":3,"t":3,"type":"observe",'
+        '"name":"serving.compile_cache.load_ms","value":4.0}\n'
+        '{"schema_version":3,"t":4,"type":"observe",'
+        '"name":"serving.compile_cache.load_ms","value":6.0}\n'
+        '{"schema_version":3,"t":5,"type":"observe",'
+        '"name":"serving.compile_cache.load_ms","value":20.0}\n'
+        '{"schema_version":3,"t":6,"type":"counter",'
+        '"name":"compile.count","value":3}\n'
+        '{"schema_version":3,"t":7,"type":"counter",'
+        '"name":"compile.ms","value":5400.0}\n'
+        '{"schema_version":3,"t":8,"type":"event",'
+        '"name":"serving.compile_cache.warmup","value":1}\n'
+        '{"schema_version":3,"t":9,"type":"gauge",'
+        '"name":"worker.ready_ms","value":6200.0}\n'
+        '{"schema_version":3,"t":10,"type":"gauge",'
+        '"name":"worker.ready_ms","value":1800.0}\n')
+    summ = report.summarize(report.load_records([str(f)]))
+    cc = report.compile_cache_summary(summ)
+    assert cc["hits"] == 9 and cc["misses"] == 3
+    assert abs(cc["hit_rate"] - 0.75) < 1e-9
+    # nearest-rank over [4, 6, 20]: p50 = 6, p95 = 20
+    assert cc["load_ms"] == {"p50": 6.0, "p95": 20.0, "count": 3}
+    assert cc["compile_count"] == 3
+    assert cc["compile_ms_total"] == 5400.0
+    assert cc["warmups"] == 1
+    assert cc["ready_ms"]["count"] == 2
+    assert cc["ready_ms"]["last"] == 1800.0
+    assert cc["ready_ms"]["max"] == 6200.0
+    out = io.StringIO()
+    report.print_report(summ, out=out)
+    text = out.getvalue()
+    assert "compile cache (serving.compile_cache.*)" in text
+    assert "hit rate 0.75" in text
+    assert "warmup ladders 1" in text
+    assert "load ms p50 6" in text
+    assert "XLA compiles 3" in text
+    assert "worker READY ms last 1800" in text
+
+
+def test_compile_cache_summary_ready_only_and_absent(report):
+    """A stream holding only worker.ready_ms (no-cache worker) still
+    gets the READY row; a cache-free, READY-free stream hides the
+    section entirely."""
+    ready_only = report.compile_cache_summary({
+        "counters": {}, "spans": {}, "events": {},
+        "gauges": {"worker.ready_ms": [2500.0]}})
+    assert ready_only["hit_rate"] is None
+    assert ready_only["load_ms"] is None
+    assert ready_only["ready_ms"]["last"] == 2500.0
+    assert report.compile_cache_summary(
+        {"counters": {"serving.requests": 4.0}, "spans": {},
+         "events": {}, "gauges": {}}) is None

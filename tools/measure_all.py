@@ -52,6 +52,12 @@ Plus (ISSUE 15): a ``serve_trace_controller`` stage — the diurnal +
 flash-crowd trace through the spawned-process cluster, elastic
 controller on/off x chunked prefill on/off, with the chunked-prefill
 starvation gate riding the same JSON line.
+Plus (ISSUE 17): a ``bench_decode_fused`` stage (reference decode
+layer vs the one-launch fused megakernel — per-token ms + the
+op/launch structural ledger), a ``cold_vs_warm_start`` stage (decode
+worker READY ms with an empty vs primed compile cache; gate warm <=
+0.4x cold), and the deferred-attach spawn-mode cells riding the
+``serve_trace_controller`` JSON line.
 
 The flat-Adam / LN / flash-s512 win-or-delete decisions fired on the
 2026-07-31 03:46 first contact (BASELINE.md round-5 note); the one
@@ -217,6 +223,15 @@ def main():
         "bench_cache_dtype", [sys.executable, "bench.py", "--decode",
                               "--cache-dtype", "bf16,int8"],
         timeout=3600)
+    # fused decode-layer megakernel (ISSUE 17): reference composition
+    # vs the one-launch fused kernel — per-token ms per route plus the
+    # per-layer op/launch structural ledger.  On the chip the ms
+    # column is the fusion win; the row carries backend/skipped so a
+    # CPU fallback run self-describes as interpreter-timed
+    results["bench_decode_fused"] = _run(
+        "bench_decode_fused", [sys.executable, "bench.py", "--decode",
+                               "--decode-fused", "off,on"],
+        timeout=3600)
     # TP comm overlap (ISSUE 5): the ring collective-matmul off/on
     # ablation rows, then the tp_overlap dryrun parity phase alone on
     # the 8-virtual-device mesh (overlapped == monolithic fwd+bwd and
@@ -250,6 +265,15 @@ def main():
         "serve_trace_controller",
         [sys.executable, "bench.py", "--serve-trace", "--controller"],
         timeout=2400)
+    # persistent compile cache (ISSUE 17): decode-worker READY time
+    # with an empty cache dir (cold: trace + AOT-compile the bucket
+    # ladder) vs the same dir primed (warm: deserialize) — the
+    # worker-internal ready_ms ratio, gate warm <= 0.4x cold.
+    # CPU-pinned by bench itself (a spawned worker could not attach
+    # the claimed chip), so chip-free like serve_trace.
+    results["cold_vs_warm_start"] = _run(
+        "cold_vs_warm_start",
+        [sys.executable, "bench.py", "--cold-start"], timeout=1800)
     results["bench_tp_overlap"] = _run(
         "bench_tp_overlap",
         [sys.executable, "bench.py", "--tp-overlap"], timeout=1800)

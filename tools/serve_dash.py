@@ -18,8 +18,11 @@ is actually operated on:
 - chunked-prefill progress (ISSUE 15: chunks done / total + lanes
   still mid-prefill) when the engine runs with ``chunk_tokens`` on,
   and the elastic-controller row (pool sizes, spawn/drain action
-  counts, drain-in-progress, chip-seconds) when the scraped process
-  runs a ``PoolController`` — both hidden when the series are absent;
+  counts, drain-in-progress, chip-seconds — plus, with ISSUE 17's
+  deferred-attach spawns, a ``warming`` row per pool showing how long
+  the pending worker has been coming up vs its READY deadline) when
+  the scraped process runs a ``PoolController`` — both hidden when
+  the series are absent;
 - per-SLO-class TTFT / TPOT p50 & p95 (computed from the exported
   native histogram buckets with the same nearest-rank algorithm the
   in-process sketch uses — the dashboard and the engine answer
@@ -139,12 +142,24 @@ def snapshot(om, parsed) -> dict:
     # kind — present only on a process running a PoolController
     ctrl_pools = {}
     ctrl_actions = {}
+    ctrl_warming: Dict[str, dict] = {}
     for name, labels, v in parsed["samples"]:
         if name == "controller_pool_size" and "pool" in labels:
             ctrl_pools[labels["pool"]] = v
         elif name == "controller_actions_total" and "action" in labels:
             ctrl_actions[labels["action"]] = (
                 ctrl_actions.get(labels["action"], 0) + v)
+        elif name == "controller_warming_age_s" and "pool" in labels:
+            ctrl_warming.setdefault(labels["pool"], {})["age_s"] = v
+        elif (name == "controller_warming_timeout_s"
+              and "pool" in labels):
+            ctrl_warming.setdefault(labels["pool"],
+                                    {})["timeout_s"] = v
+    # both series read 0 when nothing is warming in that pool (the
+    # deadline is only exported while a spawn is pending, so a
+    # just-launched worker whose age still rounds to 0 keeps its row)
+    ctrl_warming = {p: w for p, w in ctrl_warming.items()
+                    if w.get("age_s") or w.get("timeout_s")}
     return {
         "occupancy": val("serving_slot_occupancy"),
         "queue_depth": val("serving_queue_depth"),
@@ -168,6 +183,9 @@ def snapshot(om, parsed) -> dict:
         # elastic controller (ISSUE 15)
         "controller_pools": ctrl_pools or None,
         "controller_actions": ctrl_actions,
+        # deferred-attach spawns (ISSUE 17): the "warming" worker row
+        "controller_pending": val("controller_pending_spawns"),
+        "controller_warming": ctrl_warming or None,
         "controller_draining": val("controller_draining"),
         "controller_drained": val("controller_drained_requests_total"),
         "controller_chip_seconds": val("controller_chip_seconds"),
@@ -220,6 +238,23 @@ def render(snap: dict, health: str, url: str, out=None) -> None:
           f"drained reqs "
           f"{_fmt(snap.get('controller_drained'), '{:.0f}')}   "
           f"chip-s {_fmt(snap.get('controller_chip_seconds'))}")
+    if snap.get("controller_pending"):
+        # deferred-attach spawns still warming (ISSUE 17): one row per
+        # pool with a pending worker — age vs its READY deadline, so
+        # the operator sees the countdown instead of a silent gap
+        # between the spawn action and the attach
+        for pool, w in sorted((snap.get("controller_warming")
+                               or {}).items()):
+            age = w.get("age_s")
+            deadline = w.get("timeout_s")
+            left = (f"READY deadline in {deadline - age:.1f}s"
+                    if deadline and age is not None
+                    else "no deadline")
+            p(f"  warming {pool}: spawned {_fmt(age)}s ago — {left}")
+        if not snap.get("controller_warming"):
+            p(f"  warming "
+              f"{_fmt(snap['controller_pending'], '{:.0f}')} "
+              "spawn(s) (no age series in this scrape)")
     if snap.get("cluster_queue_depth") is not None:
         depths = "  ".join(
             f"{cls}:{int(v)}" for cls, v in

@@ -37,7 +37,10 @@ prints:
   paged serving engine (``serving.blocks_*`` +
   ``serving.preemptions`` → block-pool high-water, preemption rate,
   prefix-share ratio), async checkpointing (``checkpoint.*`` →
-  save/restore ms p50/p95, bytes, overlap ratio, rollback count), and
+  save/restore ms p50/p95, bytes, overlap ratio, rollback count), the
+  persistent AOT compile cache (``serving.compile_cache.*`` +
+  ``worker.ready_ms`` → hit rate, load p50/p95 vs the ``compile.ms``
+  ledger, worker READY wall), and
   the Tier-B jaxpr audit (``audit.*`` → per-entry-point
   census-vs-counter deltas — accounting drift visible in reports, not
   just in the static_audit CI gate), and the Tier-C concurrency
@@ -543,6 +546,43 @@ def controller_summary(summary: dict) -> Optional[dict]:
     }
 
 
+def compile_cache_summary(summary: dict) -> Optional[dict]:
+    """Derived view of the persistent AOT compile cache (ISSUE 17):
+    hit rate over ``load_or_compile`` calls
+    (``serving.compile_cache.{hits,misses}``), the cache-load wall
+    p50/p95 (``serving.compile_cache.load_ms`` — what a warm start
+    pays per executable) against the cumulative XLA compile ledger
+    (``compile.count`` / ``compile.ms``, PR 4's jax.monitoring
+    mirror — what every miss costs), warmup-ladder runs, and the
+    worker READY wall (``worker.ready_ms`` gauge — one sample per
+    worker process, so count ≈ workers in the stream).  None when the
+    stream carries no compile-cache or READY series (engines without
+    ``compile_cache_dir``, pre-ISSUE-17 writers)."""
+    counters = summary["counters"]
+    hits = counters.get("serving.compile_cache.hits", 0.0)
+    misses = counters.get("serving.compile_cache.misses", 0.0)
+    ready = summary["gauges"].get("worker.ready_ms")
+    if not (hits or misses or ready):
+        return None
+    load = sorted(summary["spans"].get(
+        "serving.compile_cache.load_ms") or [])
+    calls = hits + misses
+    return {
+        "hits": hits,
+        "misses": misses,
+        "hit_rate": (hits / calls) if calls else None,
+        "load_ms": ({"p50": _pct(load, 0.50), "p95": _pct(load, 0.95),
+                     "count": len(load)} if load else None),
+        "compile_count": counters.get("compile.count", 0.0),
+        "compile_ms_total": counters.get("compile.ms", 0.0),
+        "warmups": summary["events"].get(
+            "serving.compile_cache.warmup", 0),
+        "ready_ms": ({"count": len(ready), "last": ready[-1],
+                      "min": min(ready), "max": max(ready)}
+                     if ready else None),
+    }
+
+
 def print_report(summary: dict, out=None) -> None:
     out = sys.stdout if out is None else out
     if summary["unknown_schema"]:
@@ -717,6 +757,28 @@ def print_report(summary: dict, out=None) -> None:
                 f"{pool}:{int(v)}" for pool, v in
                 sorted(ctrl["pool_size_last"].items()))
             print(f"  final pool sizes {sizes}", file=out)
+    cc = compile_cache_summary(summary)
+    if cc:
+        print("== compile cache (serving.compile_cache.*) ==", file=out)
+        line = f"  hits {cc['hits']:g}  misses {cc['misses']:g}"
+        if cc["hit_rate"] is not None:
+            line += f" -> hit rate {cc['hit_rate']:.3g}"
+        if cc["warmups"]:
+            line += f"  (warmup ladders {cc['warmups']:g})"
+        print(line, file=out)
+        if cc["load_ms"]:
+            ld = cc["load_ms"]
+            print(f"  load ms p50 {ld['p50']:.4g}  p95 {ld['p95']:.4g}  "
+                  f"(n={ld['count']})", file=out)
+        if cc["compile_count"]:
+            print(f"  XLA compiles {cc['compile_count']:g} -> "
+                  f"{cc['compile_ms_total']:g} ms total (what each "
+                  "miss costs; loads bypass this ledger)", file=out)
+        if cc["ready_ms"]:
+            r = cc["ready_ms"]
+            print(f"  worker READY ms last {r['last']:g}  min "
+                  f"{r['min']:g}  max {r['max']:g}  "
+                  f"(n={r['count']} workers)", file=out)
     serving = serving_summary(summary)
     if serving:
         print("== paged serving (serving.blocks_*) ==", file=out)
