@@ -113,6 +113,14 @@ ENV_REGISTRY: Dict[str, EnvVar] = dict([
        "docs/serving.md",
        "persistent AOT compile-cache directory (engine default when "
        "compile_cache_dir is not passed)"),
+    _v("APEX_TPU_HOST_TIER_BYTES", "apex_tpu.serving.host_tier",
+       "docs/serving.md",
+       "host-DRAM KV offload tier capacity (bytes, 256m/2g suffixes; "
+       "off/0 disables)"),
+    _v("APEX_TPU_HOST_TIER_WIRE", "apex_tpu.serving.host_tier",
+       "docs/serving.md",
+       "host-tier at-rest codec (raw|int8; raw keeps digest parking "
+       "bitwise)"),
     # ---- training / parallel knobs -----------------------------------
     _v("APEX_TPU_ALLOW_FP16", "apex_tpu.amp.policy",
        "docs/amp.md", "permit raw fp16 on TPU (default maps to bf16)"),

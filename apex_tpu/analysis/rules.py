@@ -298,7 +298,8 @@ class MetricPrefixRule(Rule):
     id = "APX105"
     name = "metric-prefix-helper"
     description = ("moe.* / checkpoint.* / generate.spec.* / "
-                   "serving.compile_cache.* / worker.ready_ms metric "
+                   "serving.compile_cache.* / serving.host_tier.* / "
+                   "cluster.prefix_affinity_* / worker.ready_ms metric "
                    "touches must ride the _telemetry helpers on the "
                    "same statement — a second access idiom forks the "
                    "accounting telemetry_report and the dryrun gates "
@@ -314,6 +315,12 @@ class MetricPrefixRule(Rule):
         # compile_cache_summary — same one-accounting-path contract
         ("serving.compile_cache.", ("counter", "histogram", "event")),
         ("worker.ready_ms", ("gauge",)),
+        # ISSUE 18: the hierarchical-KV ledger (hit/miss/eviction
+        # counters, bytes/pages gauges, page-in/out sketches) and the
+        # router's prefix-affinity counter feed telemetry_report's
+        # host_tier_summary — same one-accounting-path contract
+        ("serving.host_tier.", ("counter", "gauge", "sketch")),
+        ("cluster.prefix_affinity_", ("counter",)),
     ) + tuple((f"checkpoint.{n}", ("counter", "gauge")) for n in _CKPT)
 
     def _match(self, value: str) -> Optional[Tuple[str, Tuple[str, ...]]]:

@@ -47,6 +47,7 @@ path, the ``tools/`` discipline.)
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import socket
 import time
@@ -131,6 +132,33 @@ class _Pending:
     # response covers only its own leg)
     prior_preemptions: int = 0
     prior_decode_steps: int = 0
+    # (block_size, chunk_tokens) -> hex16 chain digests of the prompt
+    # (ISSUE 18): memoized so prefix-affinity scoring hashes each
+    # prompt once per pool geometry, not once per candidate worker
+    digest_memo: Dict[tuple, List[str]] = dataclasses.field(
+        default_factory=dict)
+
+
+def _prompt_digests(prompt, block_size: int,
+                    chunk_tokens: int) -> List[str]:
+    """hex16 chained digests of every full block of ``prompt``, in the
+    namespace the worker would PUBLISH them under (ISSUE 18) — the
+    chunk salt when the worker would chunk this prompt, the flash salt
+    otherwise.  A router-side mirror of
+    :func:`apex_tpu.serving.paged_cache.prefix_block_hashes` (chained
+    SHA-256 over int64 token bytes) kept jax-free by the module
+    docstring's data-path contract — the router never imports the
+    serving stack to score a dispatch."""
+    tokens = np.asarray(prompt, np.int64).reshape(-1)
+    n = int(tokens.size)
+    h = (b"chunk:%d" % chunk_tokens
+         if chunk_tokens and n > chunk_tokens else b"")
+    out: List[str] = []
+    for i in range(n // block_size):
+        blk = tokens[i * block_size: (i + 1) * block_size]
+        h = hashlib.sha256(h + blk.tobytes()).digest()
+        out.append(h.hex()[:16])
+    return out
 
 
 def _headroom_tokens(stats: dict) -> float:
@@ -420,11 +448,51 @@ class Router:
         self._pf_rr += 1
         return w
 
-    def _pick_decode(self) -> Optional[_Worker]:
-        """The decode worker with the most free-block headroom whose
-        internal queue is below the router's per-worker cap — the
-        admission signal :meth:`ServingEngine.stats` exports for
-        exactly this choice.  ``None`` = every worker is saturated
+    @staticmethod
+    def _affinity(pend: _Pending, w: _Worker) -> int:
+        """Prefix-cache affinity of one request against one worker's
+        digest inventory (ISSUE 18): the deepest chain digest of the
+        prompt that the worker reports resident, in blocks, weighted
+        by tier — x2 for HBM (a hit is a zero-copy ``share_prefix``)
+        vs x1 for host (a hit still pays the page-in scatter).  A
+        chain digest at depth ``i`` proves blocks ``0..i`` all match,
+        so depth alone is the score — no per-block set intersection.
+        Workers that predate the inventory (or contiguous layouts)
+        score 0 and fall through to pure headroom ordering."""
+        inv = w.stats.get("digest_inventory")
+        if not inv:
+            return 0
+        bs = int(inv.get("block_size") or 0)
+        if bs < 1:
+            return 0
+        key = (bs, int(inv.get("chunk_tokens") or 0))
+        chain = pend.digest_memo.get(key)
+        if chain is None:
+            chain = _prompt_digests(pend.prompt, key[0], key[1])
+            pend.digest_memo[key] = chain
+        score = 0
+        for tier, weight in (("hbm", 2), ("host", 1)):
+            heads = inv.get(tier)
+            if not heads:
+                continue
+            heads = set(heads)
+            for i in range(len(chain) - 1, -1, -1):
+                if chain[i] in heads:
+                    score = max(score, (i + 1) * weight)
+                    break
+        return score
+
+    def _pick_decode(self, pend: Optional[_Pending] = None
+                     ) -> Optional[_Worker]:
+        """The decode worker already holding the request's prefix
+        (longest digest-prefix match x tier weight, ISSUE 18), then by
+        most free-block headroom below the router's per-worker queue
+        cap — the admission signals :meth:`ServingEngine.stats`
+        exports for exactly this choice.  Affinity ranks BEFORE
+        headroom: landing repeat-prefix traffic on the worker holding
+        the pages converts its prefill into a ``share_prefix`` (or a
+        host page-in), which COSTS less headroom than a fresh prefill
+        anywhere else would.  ``None`` = every worker is saturated
         (backpressure: the request stays queued at the ROUTER, where
         class priority still applies — parking it on a worker's FIFO
         would forfeit the interactive-ahead-of-batch property)."""
@@ -450,11 +518,14 @@ class Router:
             # pool).
             unit = (w.stats.get("block_size")
                     or w.stats.get("max_len", 1))
-            key = (_headroom_tokens(w.stats)
+            key = (self._affinity(pend, w) if pend is not None else 0,
+                   _headroom_tokens(w.stats)
                    - w.dispatched_since_poll * unit,
                    -backlog)
             if best_key is None or key > best_key:
                 best, best_key = w, key
+        if best is not None and best_key[0] > 0:
+            _telemetry.counter("cluster.prefix_affinity_hits").inc()
         return best
 
     def _dispatch(self) -> None:
@@ -462,7 +533,11 @@ class Router:
             cls = self._next_class()
             if cls is None:
                 return
-            target = self._pick_decode()
+            # peek the head request BEFORE picking the decode target:
+            # the pick is prefix-affinity-aware (ISSUE 18), so it needs
+            # the prompt it is placing
+            pend = self._queues[cls][0]
+            target = self._pick_decode(pend)
             if target is None:
                 # work is queued and nowhere to put it.  Saturated
                 # workers are backpressure (healthy); ZERO live
@@ -472,7 +547,6 @@ class Router:
                     self._feed_pool("decode", False,
                                     "no live decode workers")
                 return
-            pend = self._queues[cls][0]
             pf = self._pick_prefill()
             if pf is None:
                 self._feed_pool("prefill", False,
@@ -523,6 +597,7 @@ class Router:
                     "prompt": [int(t) for t in pend.prompt],
                     "first_token": int(reply["first_token"]),
                     "prefill_ms": pend.prefill_ms,
+                    "prefill_pages": bool(reply.get("prefill_pages")),
                     "kv": reply["kv"],
                     "slo_class": pend.slo_class,
                     **pend.kwargs,
@@ -905,6 +980,15 @@ class Router:
         # signal would over-spawn on quantized fleets; same conversion
         # as dispatch ordering so the hint and _pick_decode agree).
         headroom = sum(_headroom_tokens(w.stats) for w in alive_d)
+        # host-tier headroom (ISSUE 18): free host-DRAM across the
+        # pool.  Not admission capacity (lanes live in HBM), but it
+        # changes what HBM exhaustion COSTS — with parking room, a
+        # preemption resumes via page-in instead of replaying its
+        # prefill, so exhaustion with an empty router queue is
+        # tolerable where it would otherwise demand growth.
+        host_free = sum(
+            w.stats.get("host_tier", {}).get("free_bytes", 0)
+            for w in alive_d)
         occ = [w.stats.get("active", 0) / w.stats["max_slots"]
                for w in alive_d if w.stats.get("max_slots")]
         mean_occ = sum(occ) / len(occ) if occ else 0.0
@@ -912,6 +996,11 @@ class Router:
         if not alive_d or headroom == 0 or queued > 2 * max(
                 len(alive_d), 1):
             d_hint = 1
+            if (alive_d and queued == 0 and headroom == 0
+                    and host_free > 0):
+                # exhausted HBM but nothing queued and room to park:
+                # preemptions degrade to cheap page-in resumes — hold
+                d_hint = 0
         elif mean_occ < 0.2 and queued == 0 and len(alive_d) > 1:
             d_hint = -1
         p_hint = 0
@@ -936,6 +1025,7 @@ class Router:
                 violations.append(f"{cls}:tpot")
         out["decode"] = {"workers": len(alive_d), "hint": d_hint,
                          "headroom_tokens": headroom,
+                         "host_tier_free_bytes": host_free,
                          "mean_occupancy": round(mean_occ, 4),
                          "router_queue": queued,
                          "draining": sum(1 for w in self._decode

@@ -96,7 +96,8 @@ class WorkerServer:
                  scratch_layout: str = "paged",
                  wire_dtype: str = "raw", seed: int = 0,
                  chunk_tokens: Optional[int] = None,
-                 compile_cache: Optional[str] = None):
+                 compile_cache: Optional[str] = None,
+                 host_tier_bytes=None, host_tier_wire=None):
         if role not in ("prefill", "decode"):
             raise ValueError(f"role={role!r}: expected 'prefill' or "
                              "'decode'")
@@ -138,6 +139,8 @@ class WorkerServer:
                 top_k=top_k, top_p=top_p,
                 vocab_limit=vocab_limit, slo_targets=slo_targets,
                 chunk_tokens=chunk_tokens,
+                host_tier_bytes=host_tier_bytes,
+                host_tier_wire=host_tier_wire,
                 compile_cache_dir=compile_cache,
                 rng=jax.random.PRNGKey(seed))
         else:
@@ -381,9 +384,13 @@ class WorkerServer:
                                         wire_dtype=wire_dtype)
         ms = (time.perf_counter() - t0) * 1e3
         ex.calls += 1
+        # prefill_pages marks the payload as fresh whole-prompt prefill
+        # output (never decode-written drain records) — the decode side
+        # may publish raw-wire pages under the flash digest namespace
         return {"ok": True, "first_token": tok, "n": n,
                 "prefill_ms": round(ms, 3),
                 "handoff_bytes": wire_bytes(kv_blobs),
+                "prefill_pages": True,
                 "kv": kv_header}, kv_blobs
 
     def _handle_decode(self, header: dict, blobs: List[bytes]):
@@ -399,13 +406,19 @@ class WorkerServer:
         k, v = decode_kv(header["kv"], blobs)
         prompt = np.asarray(header["prompt"], np.int32).reshape(-1)
         rid = header.get("rid")
+        # only raw-wire fresh-prefill pages are bit-identical to a local
+        # flash prefill (the digest contract is bitwise page identity);
+        # drain-migration records omit prefill_pages and stay private
+        shareable = (bool(header.get("prefill_pages"))
+                     and header["kv"].get("wire_dtype") == "raw")
         eng_rid = self.engine.submit_prefilled(
             prompt, k, v, int(header["first_token"]),
             max_new_tokens=int(header.get("max_new_tokens", 32)),
             temperature=float(header.get("temperature", 0.0)),
             eos_token_id=header.get("eos_token_id"),
             slo_class=str(header.get("slo_class", "default")),
-            prefill_ms=float(header.get("prefill_ms", 0.0)))
+            prefill_ms=float(header.get("prefill_ms", 0.0)),
+            shareable=shareable)
         self._ridmap[eng_rid] = (rid if rid is not None else eng_rid,
                                  time.time())
         return {"ok": True, "accepted": True, "engine_rid": eng_rid}, []
@@ -503,6 +516,17 @@ def main(argv=None) -> int:
     ap.add_argument("--export-port", type=int, default=None,
                     help="also serve /metrics + /healthz on this "
                          "localhost port (0 = ephemeral)")
+    ap.add_argument("--host-tier-bytes", default=None,
+                    help="host-DRAM KV offload tier capacity (ISSUE "
+                         "18): preempted/evicted pages park here and "
+                         "resume via page-in instead of prefill "
+                         "replay; accepts 256m/2g suffixes "
+                         "(APEX_TPU_HOST_TIER_BYTES overrides; "
+                         "0/off disables)")
+    ap.add_argument("--host-tier-wire", default=None,
+                    choices=("raw", "int8"),
+                    help="host-tier at-rest codec "
+                         "(APEX_TPU_HOST_TIER_WIRE overrides)")
     ap.add_argument("--compile-cache", default=None,
                     help="persistent compile-cache directory "
                          "(ISSUE 17): the decode engine loads its "
@@ -533,6 +557,8 @@ def main(argv=None) -> int:
         scratch_layout=args.scratch_layout,
         wire_dtype=args.wire_dtype, seed=args.seed,
         chunk_tokens=args.chunk_tokens,
+        host_tier_bytes=args.host_tier_bytes,
+        host_tier_wire=args.host_tier_wire,
         compile_cache=args.compile_cache)
     if server.engine is not None and server.engine._compile_cache:
         # AOT-warm the whole ladder BEFORE declaring READY: a primed

@@ -125,9 +125,14 @@ from apex_tpu.ops.decode_step import route_decode_fused
 from apex_tpu.serving.batching import (
     SlotPool, default_buckets, pad_prompt, pick_bucket)
 from apex_tpu.serving.compile_cache import CompileCache
+from apex_tpu.serving.host_tier import (
+    DIGEST_INVENTORY_N, HostTier, resolve_host_tier_bytes,
+    resolve_host_tier_wire)
 from apex_tpu.serving.paged_cache import (
-    BlockManager, blocks_for, init_paged_pool, paged_insert_prefill,
-    paged_insert_prefill_q, prefix_block_hashes, resolve_cache_wire)
+    BlockManager, blocks_for, chunk_salt, dequantize_kv,
+    gather_block_kv, gather_block_scales, init_paged_pool,
+    paged_insert_prefill, paged_insert_prefill_q, prefix_block_hashes,
+    resolve_cache_wire)
 from apex_tpu.serving.slo import judge as _judge_slo
 from apex_tpu.serving.slo import resolve_slo_targets
 from apex_tpu.serving.slo import tpot_ms as _tpot_ms
@@ -175,12 +180,14 @@ class Request:
     # poll count survives preempt→resume (the resumed slot continues
     # counting from here); Response.decode_steps reports the total
     resume_polls: int = 0
-    # memoized (token_count, full_tokens, prefix_block_hashes) for the
-    # paged admission path: _blocks_needed runs every step() while the
-    # head request waits on the block budget, and _claim_blocks needs
-    # the same tokens + digests at admission — concatenate and hash
-    # once per resume state, not per poll (token count only grows, so
-    # it keys the cache)
+    # memoized (token_count, salt, full_tokens, prefix_block_hashes)
+    # for the paged admission path: populated ONCE at submit (ISSUE 18
+    # — a fresh submit used to recompute the digests on every
+    # admission retry) and invalidated only by resume growth or a
+    # namespace flip (a resume can cross the chunked threshold).
+    # _blocks_needed polls this every step() while the head request
+    # waits on the block budget, _claim_blocks reuses it at admission,
+    # and the host tier keys its digest entries off the same chain.
     _hash_cache: Optional[tuple] = dataclasses.field(
         default=None, repr=False)
     # cluster KV handoff (ISSUE 9): ``(k, v, first_token, prefill_ms)``
@@ -190,6 +197,12 @@ class Request:
     # which reproduces the same K/V bit-for-bit for a raw-wire handoff).
     handoff: Optional[tuple] = dataclasses.field(
         default=None, repr=False)
+    # ISSUE 18: a raw-wire handoff of FRESH prefill pages is bitwise
+    # identical to local flash prefill, so its blocks may map and
+    # publish flash-namespace digests; every other handoff (compressed
+    # wire, drain-migration records carrying decode-written tokens)
+    # keeps the no-alias rule and claims fresh unpublished blocks.
+    handoff_shareable: bool = False
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -254,6 +267,12 @@ class _Slot:
     chunks_done: int = 0
     chunks_total: int = 0
     prefill_tokens: Optional[np.ndarray] = None
+    # chunk-aligned digest publication (ISSUE 18): the full prompt's
+    # chunk-namespace chain digests, and how many leading blocks have
+    # been published so far (shared/page-in blocks count as published
+    # at admission; computed blocks publish as their chunk lands)
+    digests: Optional[List[bytes]] = None
+    published_upto: int = 0
 
 
 def _resolve_chunk_tokens(value: Optional[int]) -> Optional[int]:
@@ -345,6 +364,8 @@ class ServingEngine:
                  slo_targets: Optional[dict] = None,
                  spec=None,
                  chunk_tokens: Optional[int] = None,
+                 host_tier_bytes: Optional[int] = None,
+                 host_tier_wire: Optional[str] = None,
                  compile_cache_dir: Optional[str] = None,
                  rng: Optional[jax.Array] = None):
         _check_decode_cfg(cfg)
@@ -440,7 +461,22 @@ class ServingEngine:
             # released lane can never touch a reassigned block
             self._tables = np.full((self.max_slots, mb), self.num_blocks,
                                    np.int32)
+            # hierarchical KV (ISSUE 18): the bounded host-DRAM page
+            # store behind the BlockManager — preempted requests park
+            # their pages here (resume = page-in, not prefill replay)
+            # and cold published prefixes park by chain digest on
+            # their last HBM decref.  APEX_TPU_HOST_TIER_BYTES /
+            # APEX_TPU_HOST_TIER_WIRE override the caller.
+            hb = resolve_host_tier_bytes(host_tier_bytes)
+            self._host = (HostTier(
+                hb, wire=resolve_host_tier_wire(host_tier_wire),
+                block_size=self.block_size) if hb else None)
         else:
+            if resolve_host_tier_bytes(host_tier_bytes):
+                raise ValueError(
+                    "host_tier_bytes needs cache_layout='paged' — the "
+                    "offload tier parks paged blocks (ISSUE 18)")
+            self._host = None
             self.cache = init_kv_cache(cfg, self.max_slots, self.max_len,
                                        cache_dtype=cache_dtype)
             self._mgr = None
@@ -522,6 +558,11 @@ class ServingEngine:
                 f"({self.max_len}); raise max_len or shorten the request")
         pick_bucket(req.prompt.size, self._submit_buckets)  # validate early
         self._check_pool_budget(req)
+        if self._mgr is not None:
+            # digests once, at submit (ISSUE 18): the admission loop,
+            # the claim path and the host tier all reuse this chain —
+            # a budget-blocked head request must never rehash per poll
+            self._admission_state(req)
         self._next_id += 1
         req.submitted_t = time.perf_counter()
         self._queue.append(req)
@@ -540,7 +581,8 @@ class ServingEngine:
                          temperature: float = 0.0,
                          eos_token_id: Optional[int] = None,
                          slo_class: str = "default",
-                         prefill_ms: float = 0.0) -> int:
+                         prefill_ms: float = 0.0,
+                         shareable: bool = False) -> int:
         """Queue a request whose prefill already happened ELSEWHERE —
         the decode half of prefill/decode disaggregation (ISSUE 9).
 
@@ -557,11 +599,19 @@ class ServingEngine:
         the remote measurement, carried onto the Response so per-request
         accounting stays meaningful.
 
-        Injected blocks are never prefix-shared or published: their
-        content is wire-derived (possibly quantized), so the chained
-        content digests of locally computed pages must not alias them.
-        If the request is later preempted the handoff is dropped and
-        resume replays through the local prefill path."""
+        Injected blocks are never prefix-shared or published by
+        default: their content is wire-derived (possibly quantized), so
+        the chained content digests of locally computed pages must not
+        alias them.  ``shareable=True`` (ISSUE 18) opts a handoff INTO
+        the flash digest namespace — valid ONLY for raw-wire handoffs
+        of fresh prefill pages, which round-trip bit-exactly and are
+        therefore bitwise identical to local flash prefill; the caller
+        (the cluster decode worker, reading the handoff header) owns
+        that judgment.  A shareable handoff maps already-published
+        prefix blocks instead of rewriting them and publishes its own
+        full prompt blocks for later sharers.  If the request is later
+        preempted the handoff is dropped and resume replays through
+        the local prefill path."""
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
                       request_id=self._next_id, slo_class=str(slo_class))
@@ -582,6 +632,9 @@ class ServingEngine:
                 f"this engine's cache geometry {want} — refusing to "
                 "reinterpret a foreign handoff")
         req.handoff = (k, v, int(first_token), float(prefill_ms))
+        req.handoff_shareable = bool(shareable)
+        if self._mgr is not None and req.handoff_shareable:
+            self._admission_state(req)      # digests once, at submit
         self._next_id += 1
         req.submitted_t = time.perf_counter()
         self._queue.append(req)
@@ -712,7 +765,24 @@ class ServingEngine:
                 # systematically over-spawn on quantized fleets.
                 # Tokens are the one unit every pool form shares.
                 "headroom_tokens": free_blocks * self.block_size,
+                # the count-bounded digest-inventory summary (ISSUE
+                # 18): newest-N chain heads per tier as 64-bit hex
+                # prefixes — enough for the router's longest-prefix
+                # affinity scoring (collision-rare suffices: the score
+                # only picks a worker, it never maps a page)
+                "digest_inventory": {
+                    "block_size": self.block_size,
+                    "chunk_tokens": self.chunk_tokens,
+                    "hbm": [h.hex()[:16] for h in
+                            self._mgr.newest_digests(
+                                DIGEST_INVENTORY_N)],
+                    "host": ([h.hex()[:16] for h in
+                              self._host.newest_digests()]
+                             if self._host is not None else []),
+                },
             })
+            if self._host is not None:
+                out["host_tier"] = self._host.stats()
         else:
             out["free_block_headroom"] = self._pool.n_free
             # contiguous admission reserves a whole stripe per request
@@ -850,19 +920,25 @@ class ServingEngine:
 
     def _admission_state(self, req: Request):
         """(full token array, prefix digests) for the request's current
-        resume state, memoized on the Request (invalidated by growth —
-        a resume's token count is strictly larger than the state it was
-        computed at).  _blocks_needed polls this every step() while the
+        resume state, memoized on the Request — populated at submit,
+        invalidated only by resume growth or a digest-namespace flip
+        (a resume can cross the chunked threshold, and chunk-written
+        pages hash under :func:`~apex_tpu.serving.paged_cache.
+        chunk_salt`).  _blocks_needed polls this every step() while the
         head request waits on the block budget, so neither the
         prompt+resume concatenation nor the digests may be per-poll
         work."""
         n = req.prompt.size + len(req.resume_tokens)
-        if req._hash_cache is None or req._hash_cache[0] != n:
+        salt = (chunk_salt(self.chunk_tokens) if self._chunked(req)
+                else b"")
+        if (req._hash_cache is None or req._hash_cache[0] != n
+                or req._hash_cache[1] != salt):
             tokens = self._full_tokens(req)
             full = n // self.block_size
-            req._hash_cache = (n, tokens, prefix_block_hashes(
-                tokens[: full * self.block_size], self.block_size))
-        return req._hash_cache[1], req._hash_cache[2]
+            req._hash_cache = (n, salt, tokens, prefix_block_hashes(
+                tokens[: full * self.block_size], self.block_size,
+                salt=salt))
+        return req._hash_cache[2], req._hash_cache[3]
 
     def _chunked(self, req: Request) -> bool:
         """Does this request admit through the chunked-prefill path?
@@ -875,21 +951,71 @@ class ServingEngine:
         return (req.prompt.size + len(req.resume_tokens)
                 > self.chunk_tokens)
 
+    def _host_resumable(self, req: Request) -> bool:
+        """Can this admission skip prefill entirely and page its K/V
+        back in from the host tier?  True for a preempted request whose
+        materialized pages (``cache_len = prompt + generated - 1`` — the
+        pending token's KV was never written) are still parked."""
+        return (self._host is not None and req.handoff is None
+                and bool(req.resume_tokens)
+                and self._host.has_request(
+                    req.request_id,
+                    req.prompt.size + len(req.resume_tokens) - 1))
+
+    def _chunk_share_plan(self, n: int, hashes: List[bytes]) -> int:
+        """How many LEADING full blocks of a chunked admission can map
+        (HBM) or page in (host tier) published chunk-namespace digests
+        instead of running their chunks.  Sharing is whole-chunk
+        granular: the chunk forward writes contiguous ``[lo, hi)``
+        spans, so a partially shared chunk would still have to run —
+        and every sharer must start its chunk grid at the same aligned
+        ``lo`` the producer used, or the flash accumulation phase (and
+        hence the page bits) would differ.  Requires ``chunk_tokens %
+        block_size == 0`` (otherwise chunk boundaries cut blocks and no
+        aligned grid exists), and always leaves the FINAL chunk to run:
+        its last-real-token logits sample the first token."""
+        ct, bs = self.chunk_tokens, self.block_size
+        if ct % bs:
+            return 0
+        bpc = ct // bs
+        max_chunks = min(n // ct, -(-n // ct) - 1)
+        lead = 0
+        for c in range(max_chunks):
+            chunk_hashes = hashes[c * bpc:(c + 1) * bpc]
+            if len(chunk_hashes) < bpc:
+                break
+            if not all(self._mgr.lookup_prefix(h) is not None
+                       or (self._host is not None
+                           and self._host.has_block(h))
+                       for h in chunk_hashes):
+                break
+            lead += bpc
+        return lead
+
     def _blocks_needed(self, req: Request) -> int:
         """NEW blocks the request must allocate at admission (prefix
-        hits against the published block table are free — they map, not
-        allocate).  A KV-handoff request allocates everything fresh:
-        its pages are wire-derived, never shared.  So does a CHUNKED
-        one: chunk-written K/V can differ from a monolithic writer's in
-        low-order bits (flash vs verify accumulation order), and the
-        content digests guarantee bit-identical physical pages — so
-        chunked pages neither map existing digests nor publish new
-        ones."""
-        if req.handoff is not None or self._chunked(req):
-            return blocks_for(req.prompt.size + len(req.resume_tokens),
-                              self.block_size)
+        hits against the published HBM block table are free — they map,
+        not allocate; host-tier digest hits still allocate, their bytes
+        just arrive by page-in scatter instead of compute).  A page-in
+        resume covers its materialized ``n - 1`` tokens fresh; so does
+        a KV handoff, UNLESS the worker marked it shareable (raw wire,
+        fresh prefill pages — bitwise identical to local flash prefill,
+        so the flash-namespace digests apply).  A CHUNKED admission
+        shares only leading whole chunks in the chunk namespace
+        (:meth:`_chunk_share_plan`): chunk-written K/V can differ from
+        a monolithic writer's in low-order bits (flash accumulation
+        phase), and the content digests guarantee bit-identical
+        physical pages only within a writer class."""
+        n = req.prompt.size + len(req.resume_tokens)
+        bs = self.block_size
+        if self._host_resumable(req):
+            return blocks_for(n - 1, bs)
+        if req.handoff is not None and not req.handoff_shareable:
+            return blocks_for(n, bs)
         tokens, hashes = self._admission_state(req)
-        need = blocks_for(tokens.size, self.block_size)
+        need = blocks_for(n, bs)
+        if self._chunked(req):
+            hashes = hashes[: self._chunk_share_plan(n, hashes)]
         for h in hashes:
             if self._mgr.lookup_prefix(h) is not None:
                 need -= 1
@@ -919,7 +1045,16 @@ class ServingEngine:
                     and self._mgr.n_free < (self._blocks_needed(req)
                                             + self.reserve_blocks)):
                 # budget miss: wait for completions (or a preemption)
-                # to return blocks — lanes alone don't admit
+                # to return blocks — lanes alone don't admit.  Use the
+                # wait: decode the head request's parked host-tier
+                # pages into a staging copy NOW (the
+                # copy_to_host_async-style overlap) so the eventual
+                # page-in resume never waits on the wire decode.
+                if (self._host is not None and req.resume_tokens
+                        and req.handoff is None):
+                    self._host.prefetch_request(
+                        req.request_id,
+                        req.prompt.size + len(req.resume_tokens) - 1)
                 break
             self._queue.popleft()
             slot = self._pool.claim()
@@ -949,13 +1084,20 @@ class ServingEngine:
         """Map/allocate the block list for ``tokens`` (``hashes`` =
         its full-block prefix digests): full blocks come from the
         prefix-hash table when published (refcounted share — their
-        pages are NOT rewritten), everything else allocates fresh.
-        Returns (blocks, write_ids, shared_count); raises RuntimeError
-        on pool exhaustion with everything already unwound."""
+        pages are NOT rewritten); a digest that misses HBM but is
+        parked in the host tier allocates fresh, publishes, and rides
+        back in by page-in scatter (also excluded from the prefill
+        write — the raw host wire restores bitwise what the prefill
+        would have written); everything else allocates fresh.  Returns
+        (blocks, write_ids, shared_count, page_ins) where ``page_ins``
+        is ``[(block, (k, v)), ...]`` for :meth:`_page_in_blocks`;
+        raises RuntimeError on pool exhaustion with everything already
+        unwound."""
         n = tokens.size
         bs = self.block_size
         blocks: List[int] = []
         write_ids: List[int] = []
+        page_ins: List[tuple] = []
         shared = 0
         try:
             for h in hashes:
@@ -965,12 +1107,21 @@ class ServingEngine:
                     write_ids.append(self.num_blocks)   # don't rewrite
                     shared += 1
                     continue
+                hit = None
+                if self._host is not None and self._host.has_block(h):
+                    # has_block first so peek's hit/miss accounting
+                    # only sees digests that were actually parked
+                    hit = self._host.peek_block(h)
                 blk = self._mgr.alloc()
                 if blk is None:
                     raise RuntimeError("block pool exhausted mid-admit")
                 self._mgr.publish_prefix(h, blk)
                 blocks.append(blk)
-                write_ids.append(blk)
+                if hit is not None:
+                    write_ids.append(self.num_blocks)   # page-in writes
+                    page_ins.append((blk, hit))
+                else:
+                    write_ids.append(blk)
             if n % bs:
                 blk = self._mgr.alloc()                 # private tail
                 if blk is None:
@@ -980,13 +1131,14 @@ class ServingEngine:
         except Exception:
             self._mgr.free_all(blocks)
             raise
-        return blocks, write_ids, shared
+        return blocks, write_ids, shared, page_ins
 
     def _claim_blocks_fresh(self, n_tokens: int):
         """Allocate ``blocks_for(n_tokens)`` fresh blocks (no prefix
-        mapping, no publishing) — the KV-handoff admission form: every
-        page is written from the wire.  Same unwind contract as
-        :meth:`_claim_blocks`."""
+        mapping, no publishing) — the admission form for wire-derived
+        pages that must never alias the digest namespace (non-shareable
+        KV handoffs, page-in resumes whose pages carry decode-written
+        tokens).  Same unwind contract as :meth:`_claim_blocks`."""
         blocks: List[int] = []
         try:
             for _ in range(blocks_for(n_tokens, self.block_size)):
@@ -997,7 +1149,96 @@ class ServingEngine:
         except Exception:
             self._mgr.free_all(blocks)
             raise
-        return blocks, list(blocks), 0
+        return blocks, list(blocks), 0, []
+
+    def _claim_blocks_chunked(self, n: int, hashes: List[bytes]):
+        """Block claim for a chunked admission: the leading whole-chunk
+        run of published chunk-namespace digests maps (HBM share) or
+        pages in (host tier); everything after allocates fresh and
+        publishes one block at a time as its chunk lands
+        (:meth:`_publish_chunk_blocks`).  Returns (blocks, shared,
+        page_ins, lo) where ``lo`` is the chunk-aligned prefill start
+        (shared chunks are skipped entirely — the compute win chunked
+        sharing exists for).  Same unwind contract as
+        :meth:`_claim_blocks`."""
+        bs = self.block_size
+        lead = self._chunk_share_plan(n, hashes)
+        blocks: List[int] = []
+        page_ins: List[tuple] = []
+        shared = 0
+        try:
+            for h in hashes[:lead]:
+                blk = self._mgr.share_prefix(h)
+                if blk is not None:
+                    blocks.append(blk)
+                    shared += 1
+                    continue
+                hit = (self._host.peek_block(h)
+                       if self._host is not None else None)
+                if hit is None:
+                    # the plan saw this digest moments ago and nothing
+                    # mutates either tier between plan and claim
+                    # (engine-loop confined) — unwind loudly rather
+                    # than page garbage in
+                    raise RuntimeError(
+                        "host-tier digest vanished mid-claim")
+                blk = self._mgr.alloc()
+                if blk is None:
+                    raise RuntimeError("block pool exhausted mid-admit")
+                self._mgr.publish_prefix(h, blk)
+                blocks.append(blk)
+                page_ins.append((blk, hit))
+            for _ in range(len(blocks), blocks_for(n, bs)):
+                blk = self._mgr.alloc()
+                if blk is None:
+                    raise RuntimeError("block pool exhausted mid-admit")
+                blocks.append(blk)
+        except Exception:
+            self._mgr.free_all(blocks)
+            raise
+        return blocks, shared, page_ins, lead * bs
+
+    def _page_in_blocks(self, slot: int, page_ins: List[tuple]) -> None:
+        """Scatter host-tier digest pages into their freshly published
+        HBM blocks through THE one insert edge at
+        ``bucket=block_size`` — one compile covers every page-in, and
+        int8 pools requantize through the same write path prefill uses
+        (requantization is idempotent, so the pool bytes match a
+        prefill-written page exactly).  The transient ``pos`` stamp the
+        insert leaves is harmless: every caller re-stamps the lane
+        position afterward."""
+        if not page_ins:
+            return
+        t0 = time.perf_counter()
+        bs = self.block_size
+        L, g, dh = (self.cfg.num_layers, self.cfg.kv_groups,
+                    self.cfg.kv_channels)
+        # ONE batched scatter for every paged-in block: the insert
+        # maps token-chunk i to write_ids[i] and each page carries
+        # exactly its own block's tokens, so HBM-shared blocks
+        # interleaved in token space don't split the batch.  The
+        # bucket pads to the next power-of-two block count — a
+        # logarithmic compile ladder instead of one dispatch per page
+        # (n masks the padding; write_ids pads with UNMAPPED).
+        m = len(page_ins)
+        cap = 1
+        while cap < m:
+            cap *= 2
+        bucket = cap * bs
+        ks = np.zeros((L, 1, bucket, g, dh), dtype=self._cache_dtype)
+        vs = np.zeros_like(ks)
+        for i, (_blk, (k, v)) in enumerate(page_ins):
+            ks[:, 0, i * bs:(i + 1) * bs] = np.asarray(
+                k, dtype=self._cache_dtype).reshape(L, bs, g, dh)
+            vs[:, 0, i * bs:(i + 1) * bs] = np.asarray(
+                v, dtype=self._cache_dtype).reshape(L, bs, g, dh)
+        self._insert_prefill_kv(slot, bucket,
+                                [blk for blk, _kv in page_ins],
+                                jnp.asarray(ks), jnp.asarray(vs),
+                                m * bs)
+        _telemetry.counter("serving.host_tier.page_ins").inc(m)
+        _telemetry.sketch("serving.host_tier.page_in_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
 
     # -- persistent compile cache routing (ISSUE 17) -----------------------
 
@@ -1112,12 +1353,28 @@ class ServingEngine:
         allocations unwind HERE, closest to where they happen).  A
         request carrying a KV handoff (``submit_prefilled``) skips the
         prefill forward entirely: its cache pages come off the wire,
-        its first token from the remote sampler."""
+        its first token from the remote sampler.  A preempted request
+        whose pages are still parked in the host tier skips it too —
+        resume becomes a page-in (:meth:`_admit_one_paged_in`), even
+        for prompts that would otherwise replay chunked."""
+        if (self._host is not None and req.handoff is None
+                and req.resume_tokens):
+            n_kv = req.prompt.size + len(req.resume_tokens) - 1
+            kv = self._host.take_request(req.request_id, n_kv)
+            if kv is not None:
+                return self._admit_one_paged_in(req, slot, *kv)
+            # parked pages evicted (or never fit): fall through to a
+            # prefill replay.  take_request counted the miss; this
+            # counter is the replay half of the resume-vs-replay ratio
+            _telemetry.counter("serving.host_tier.replays").inc()
         if self._chunked(req):
             return self._admit_one_chunked(req, slot)
         completed: List[Response] = []
         hashes: List[bytes] = []
-        if self._mgr is not None and req.handoff is None:
+        page_ins: List[tuple] = []
+        shareable = (self._mgr is not None
+                     and (req.handoff is None or req.handoff_shareable))
+        if shareable:
             tokens, hashes = self._admission_state(req)
         else:
             tokens = self._full_tokens(req)
@@ -1127,11 +1384,15 @@ class ServingEngine:
         write_ids: List[int] = []
         shared = 0
         if self._mgr is not None:
-            if req.handoff is not None:
-                blocks, write_ids, shared = self._claim_blocks_fresh(n)
+            if shareable:
+                # prefill admissions AND shareable raw-wire handoffs
+                # map/publish flash-namespace digests (their pages are
+                # bitwise what local flash prefill writes)
+                blocks, write_ids, shared, page_ins = \
+                    self._claim_blocks(tokens, hashes)
             else:
-                blocks, write_ids, shared = self._claim_blocks(
-                    tokens, hashes)
+                blocks, write_ids, shared, page_ins = \
+                    self._claim_blocks_fresh(n)
         t0 = time.perf_counter()
         if req.admitted_t == 0.0:
             # first admission only: queue wait ends the moment the
@@ -1142,6 +1403,13 @@ class ServingEngine:
             req.admitted_t = t0
             req.queue_wait_s = t0 - req.submitted_t
         try:
+            if page_ins:
+                # restore host-parked digest pages first (disjoint
+                # blocks from every write below; the final insert
+                # re-stamps pos)
+                with span("serving.host_page_in"), \
+                        compile_label("serving.prefill"):
+                    self._page_in_blocks(slot, page_ins)
             if req.handoff is not None:
                 with span("serving.kv_inject"), \
                         compile_label("serving.prefill"):
@@ -1236,6 +1504,74 @@ class ServingEngine:
             completed.append(self._complete(slot, done))
         return completed
 
+    def _admit_one_paged_in(self, req: Request, slot: int,
+                            k: np.ndarray, v: np.ndarray
+                            ) -> List[Response]:
+        """Re-admit a preempted request from its host-tier parked pages:
+        claim fresh blocks (the pages carry decode-written tokens —
+        never digest-shareable, the handoff no-alias rule), scatter the
+        parked K/V back through THE one insert edge, and put the lane
+        straight back into decode behind its pending token.  NO prefill
+        forward runs and NO token is sampled: the preempted lane
+        already held its pending token (``resume_tokens[-1]``), whose
+        KV the next decode step writes — exactly the state the lane was
+        preempted in.  For the raw host wire the round trip is bitwise,
+        so greedy continuation is token-identical to the never-preempted
+        run (the kv_tier dryrun phase pins this)."""
+        n_kv = req.prompt.size + len(req.resume_tokens) - 1
+        bucket = pick_bucket(n_kv, self.buckets)
+        blocks, write_ids, _sh, _pi = self._claim_blocks_fresh(n_kv)
+        t0 = time.perf_counter()
+        try:
+            with span("serving.host_page_in"), \
+                    compile_label("serving.prefill"):
+                shape = (self.cfg.num_layers, 1, bucket,
+                         self.cfg.kv_groups, self.cfg.kv_channels)
+                k_pad = np.zeros(shape, dtype=self._cache_dtype)
+                v_pad = np.zeros(shape, dtype=self._cache_dtype)
+                k_pad[:, 0, :n_kv] = np.asarray(
+                    k, dtype=self._cache_dtype)
+                v_pad[:, 0, :n_kv] = np.asarray(
+                    v, dtype=self._cache_dtype)
+                self._insert_prefill_kv(slot, bucket, write_ids,
+                                        jnp.asarray(k_pad),
+                                        jnp.asarray(v_pad), n_kv)
+            self._tables[slot, :] = self.num_blocks
+            self._tables[slot, : len(blocks)] = blocks
+            self._blocks_hw = max(self._blocks_hw,
+                                  self._mgr.n_in_use)
+            now = time.perf_counter()
+            ms = (now - t0) * 1e3
+            if req.preempted_t:
+                # the preemption cycle closes here — no replay ran, so
+                # its whole cost is requeue wait + this page-in
+                req.preempt_overhead_s += now - req.preempted_t
+                req.preempted_t = 0.0
+            _telemetry.counter("serving.host_tier.resumes").inc()
+            _telemetry.sketch("serving.host_tier.page_in_ms").observe(
+                ms)
+            if _telemetry.enabled():
+                sample_device_memory()
+            st = _Slot(request=req, tokens=list(req.resume_tokens),
+                       prefill_ms=ms, blocks=blocks, cache_len=n_kv,
+                       decode_polls=req.resume_polls)
+        except Exception:
+            self._mgr.free_all(blocks)
+            self._tables[slot, :] = self.num_blocks
+            raise
+        self._slots[slot] = st
+        tok = int(req.resume_tokens[-1])
+        self._pending[slot] = tok
+        self._temps[slot] = req.temperature
+        if self._spec is not None:
+            tokens = self._full_tokens(req)
+            n = int(tokens.size)
+            row = np.zeros((self.max_len,), np.int32)
+            row[: n] = tokens
+            self._history = self._history.at[slot].set(jnp.asarray(row))
+            self._hist_len = self._hist_len.at[slot].set(n)
+        return []
+
     # -- chunked prefill (ISSUE 15) ----------------------------------------
 
     def _admit_one_chunked(self, req: Request, slot: int
@@ -1250,13 +1586,22 @@ class ServingEngine:
         FINAL chunk's last-token logits, which are greedy-identical to
         the monolithic prefill's (tests/test_serving_chunked.py).
 
-        Blocks are claimed fresh and never prefix-shared or published
-        (see :meth:`_blocks_needed`)."""
+        Chunk-namespace digest sharing (ISSUE 18): leading whole-chunk
+        runs whose chain digests are already published map from HBM or
+        page in from the host tier (:meth:`_claim_blocks_chunked`) and
+        their chunks never run; every other full block publishes its
+        digest as its chunk lands (:meth:`_publish_chunk_blocks`)."""
         tokens = self._full_tokens(req)
         n = int(tokens.size)
         blocks: List[int] = []
+        hashes: List[bytes] = []
+        page_ins: List[tuple] = []
+        shared = 0
+        lo = 0
         if self._mgr is not None:
-            blocks, _wid, _sh = self._claim_blocks_fresh(n)
+            _tok, hashes = self._admission_state(req)
+            blocks, shared, page_ins, lo = self._claim_blocks_chunked(
+                n, hashes)
         t0 = time.perf_counter()
         if req.admitted_t == 0.0:
             req.admitted_t = t0
@@ -1267,15 +1612,18 @@ class ServingEngine:
                 self._tables[slot, : len(blocks)] = blocks
                 self._blocks_hw = max(self._blocks_hw,
                                       self._mgr.n_in_use)
-            # park the lane's device position at 0 so the masked decode
-            # rides it inertly until the first chunk stamps real
-            # progress (a stale position from the lane's previous
-            # occupant must not outlive the handover)
+                self._page_in_blocks(slot, page_ins)
+            # park the lane's device position at the share boundary so
+            # the masked decode rides it inertly until the first chunk
+            # stamps real progress (a stale position from the lane's
+            # previous occupant must not outlive the handover)
             self.cache = dict(
-                self.cache, pos=self.cache["pos"].at[slot].set(0))
+                self.cache, pos=self.cache["pos"].at[slot].set(lo))
             _telemetry.event("serving.request.chunk_admit",
                              id=req.request_id, prompt_tokens=n,
-                             chunks=-(-n // self.chunk_tokens))
+                             chunks=-(-(n - lo) // self.chunk_tokens),
+                             shared_blocks=shared,
+                             paged_in_blocks=len(page_ins))
         except Exception:
             if self._mgr is not None:
                 self._mgr.free_all(blocks)
@@ -1283,10 +1631,14 @@ class ServingEngine:
             raise
         self._slots[slot] = _Slot(
             request=req, tokens=[], prefill_ms=0.0, blocks=blocks,
-            cache_len=0, decode_polls=req.resume_polls,
+            cache_len=lo, shared_blocks=shared,
+            decode_polls=req.resume_polls,
             prefilling=True, chunks_done=0,
-            chunks_total=-(-n // self.chunk_tokens),
-            prefill_tokens=tokens)
+            chunks_total=-(-(n - lo) // self.chunk_tokens),
+            prefill_tokens=tokens,
+            digests=(hashes if self._mgr is not None else None),
+            published_upto=(lo // self.block_size
+                            if self._mgr is not None else 0))
         self._pending[slot] = 0
         self._temps[slot] = 0.0
         return []
@@ -1348,6 +1700,8 @@ class ServingEngine:
         st.prefill_ms += (now - t0) * 1e3
         st.cache_len = hi
         st.chunks_done += 1
+        if self._mgr is not None and st.digests is not None:
+            self._publish_chunk_blocks(st, hi)
         _telemetry.counter("serving.prefill_chunks").inc()
         if hi < n:
             return []
@@ -1380,6 +1734,23 @@ class ServingEngine:
             return [self._complete(slot, done)]
         return []
 
+    def _publish_chunk_blocks(self, st: _Slot, hi: int) -> None:
+        """Publish every newly FULL block's chunk-namespace digest the
+        moment its chunk lands (ISSUE 18 — chunked prefill used to
+        publish nothing, so the hottest shared prefixes arriving
+        chunked never shared).  First publisher wins: a digest another
+        lane already published keeps pointing at that lane's block and
+        this lane's copy stays private — re-publishing under
+        last-writer-wins would orphan the other block's entry while
+        both are live.  Publication happens AFTER the chunk's device
+        write (the pages are materialized), so a digest can never name
+        a garbage page."""
+        full = min(hi // self.block_size, len(st.digests))
+        for b in range(st.published_upto, full):
+            if self._mgr.lookup_prefix(st.digests[b]) is None:
+                self._mgr.publish_prefix(st.digests[b], st.blocks[b])
+        st.published_upto = max(st.published_upto, full)
+
     # -- decode ------------------------------------------------------------
 
     def _youngest_slot(self) -> int:
@@ -1389,13 +1760,74 @@ class ServingEngine:
         return max(self._pool.active,
                    key=lambda s: self._slots[s].request.request_id)
 
+    def _host_park_digests(self, blocks: List[int]) -> None:
+        """Cold-prefix eviction edge (ISSUE 18): gather and park —
+        digest-keyed — every block in ``blocks`` that is published and
+        about to DIE with this release (refcount 1; blocks other
+        tables still share stay HBM-resident and need no parking).
+        One batched gather covers all victims; raw host wire only
+        (``put_block`` refuses otherwise — a digest hit maps pages
+        with no token re-check, so only a bit-exact wire may alias the
+        digest namespace).  Must run BEFORE ``free_all``: it needs the
+        refcounts and the pool pages intact."""
+        if self._host is None or self._host.wire != "raw":
+            return
+        victims = []
+        for blk in blocks:
+            h = self._mgr.digest_of(blk)
+            if h is None or self._mgr.refcount(blk) != 1:
+                continue
+            if self._host.has_block(h):
+                continue      # already parked; content is immutable
+            victims.append((h, blk))
+        if not victims:
+            return
+        ids = [blk for _, blk in victims]
+        k, v = gather_block_kv(self.cache["k"], self.cache["v"], ids)
+        if "k_scale" in self.cache:
+            # int8 pool: park the dequantized float pages — page-in
+            # requantizes through the one insert edge, and
+            # requantization idempotence makes the pool bytes match
+            sk = gather_block_scales(self.cache["k_scale"], ids)
+            sv = gather_block_scales(self.cache["v_scale"], ids)
+            k = dequantize_kv(k, sk)
+            v = dequantize_kv(v, sv)
+        k = np.asarray(k)
+        v = np.asarray(v)
+        bs = self.block_size
+        for i, (h, _blk) in enumerate(victims):
+            self._host.put_block(h, k[:, i * bs:(i + 1) * bs],
+                                 v[:, i * bs:(i + 1) * bs])
+
+    def _host_park(self, slot: int, st: _Slot) -> None:
+        """Page the preemption victim out to the host tier BEFORE its
+        blocks are freed: dying published blocks keyed by chain digest
+        (cold-prefix eviction), plus — for a decoding lane — the
+        request's materialized tokens keyed by (request, token count)
+        so re-admission is a page-in, not a prefill replay.  A
+        mid-prefill lane has no pending token to resume behind;
+        re-admission restarts its chunk stream, where the digests
+        parked here let the finished chunks page back in."""
+        self._host_park_digests(st.blocks)
+        if st.prefilling or st.cache_len < 1:
+            return
+        k, v = extract_kv(
+            dict(self.cache, block_tables=jnp.asarray(self._tables)),
+            st.cache_len, row=slot)
+        self._host.put_request(st.request.request_id, st.cache_len,
+                               np.asarray(k), np.asarray(v))
+
     def _preempt(self, slot: int) -> None:
-        """Evict one live request: free its blocks (decref — shared
-        prefix blocks survive under their other owners), park its
-        progress on the Request, requeue it at the FRONT (it resumes as
-        soon as the budget allows, replaying prompt+generated through
-        the batched flash prefill), release the lane."""
+        """Evict one live request: park its pages in the host tier when
+        one is configured (resume becomes a page-in), free its blocks
+        (decref — shared prefix blocks survive under their other
+        owners), park its progress on the Request, requeue it at the
+        FRONT (it resumes as soon as the budget allows, replaying
+        prompt+generated through the batched flash prefill if its
+        parked pages were evicted), release the lane."""
         st = self._slots[slot]
+        if self._host is not None:
+            self._host_park(slot, st)
         self._slots[slot] = None
         self._pending[slot] = 0
         self._temps[slot] = 0.0
@@ -1404,10 +1836,12 @@ class ServingEngine:
         self._pool.release(slot)
         req = st.request
         req.resume_tokens = list(st.tokens)
-        # an injected handoff dies with its blocks: resume replays
-        # prompt+generated through the LOCAL prefill path (bit-identical
-        # K/V for a raw-wire handoff, so greedy parity survives)
+        # an injected handoff dies with its blocks: resume pages the
+        # parked copy back in, or replays prompt+generated through the
+        # LOCAL prefill path (bit-identical K/V for a raw-wire handoff,
+        # so greedy parity survives)
         req.handoff = None
+        req.handoff_shareable = False
         req.preemptions += 1
         req.resume_polls = st.decode_polls
         # the overhead clock: runs from here until the resume prefill
@@ -1565,6 +1999,11 @@ class ServingEngine:
         self._slots[slot] = None
         self._temps[slot] = 0.0
         if self._mgr is not None:
+            if self._host is not None:
+                # completion is the other cold-prefix eviction edge: a
+                # published block whose last sharer finishes would be
+                # gone — park it digest-keyed first
+                self._host_park_digests(st.blocks)
             self._tables[slot, :] = self.num_blocks
             self._mgr.free_all(st.blocks)
         self._pool.release(slot)

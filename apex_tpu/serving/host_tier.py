@@ -1,0 +1,336 @@
+"""Host-DRAM KV offload tier: the second level of the hierarchical
+paged cache (ISSUE 18).
+
+HBM is the scarcest resource in the fleet, and before this tier a
+paged KV block was binary — resident or gone: preemption threw the
+victim's pages away and resume replayed the whole prefill, and a cold
+prefix's pages vanished the moment their last HBM sharer completed.
+:class:`HostTier` is a bounded host-memory LRU page store behind the
+``BlockManager`` ledger that catches both:
+
+- **preemption parking** — the engine gathers the victim's pages
+  (``gather_block_kv``, int8 pools dequantized through
+  ``gather_block_scales``), serializes them through the SAME codec the
+  cluster KV handoff uses (``cluster/handoff.py``'s
+  :func:`~apex_tpu.serving.cluster.handoff.encode_kv`, ``raw`` or
+  ``int8`` block-scaled wire) and parks them keyed by
+  ``(request_id, materialized_tokens)``.  Resume becomes a *page-in* —
+  one jitted scatter through the existing bucket-shaped insert path —
+  instead of a full prefill replay; for the raw wire the round trip is
+  bitwise, so greedy continuation is token-identical.
+- **cold-prefix eviction** — when the last HBM reference to a
+  *published* block drops, the engine parks that page keyed by its
+  chain digest (raw wire only: digest hits map pages with no token
+  re-check, so only a bit-exact wire may alias the digest namespace).
+  A later admission whose digest misses HBM but hits here pages the
+  block back in and republishes it, so a digest can be HBM-resident,
+  host-resident, or both — the cross-tier half of the refcount/
+  eviction ledger.
+
+The store is strictly bounded (``capacity_bytes``; the
+``APEX_TPU_HOST_TIER_BYTES`` deploy knob): inserts evict
+least-recently-used entries until the new entry fits, and an entry
+larger than the whole budget is refused (counted, never stored).
+
+Telemetry (no-op unless ``observability.configure`` ran):
+``serving.host_tier.bytes`` / ``serving.host_tier.pages`` gauges,
+``serving.host_tier.{hits,misses,evictions}`` counters, and the
+``serving.host_tier.{page_in_ms,page_out_ms}`` mergeable sketches —
+the family ``tools/telemetry_report.py``'s host-tier summary and the
+serve_dash host-tier row read.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import warnings
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.observability import metrics as _telemetry
+from apex_tpu.serving.cluster.handoff import (
+    decode_kv, encode_kv, wire_bytes)
+from apex_tpu.serving.paged_cache import blocks_for
+
+__all__ = ["HOST_TIER_WIRES", "HostTier", "resolve_host_tier_bytes",
+           "resolve_host_tier_wire"]
+
+# The offload serializer reuses the cluster handoff codec; bf16 is
+# deliberately absent — it buys neither the bitwise resume contract
+# (raw) nor the 4x compression (int8).
+HOST_TIER_WIRES = ("raw", "int8")
+
+# Newest-N bound on the digest-inventory summary a worker piggybacks
+# on its poll reply (count-bounded by contract: the poll RPC must stay
+# cheap no matter how many prefixes are live).
+DIGEST_INVENTORY_N = 32
+
+
+def _parse_bytes(text: str) -> int:
+    """A byte count as a plain int or with a binary-unit suffix
+    (``64k`` / ``256m`` / ``2g``); raises ValueError otherwise."""
+    s = text.strip().lower()
+    mult = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}.get(s[-1:], 1)
+    if mult != 1:
+        s = s[:-1]
+    n = int(s) * mult
+    if n < 1:
+        raise ValueError(text)
+    return n
+
+
+def resolve_host_tier_bytes(value) -> Optional[int]:
+    """The host-tier capacity knob: ``APEX_TPU_HOST_TIER_BYTES`` beats
+    the caller's ``host_tier_bytes=`` (positive byte count — plain int
+    or ``256m``/``2g``-suffixed string — = capacity, ``off``/``0`` =
+    tier disabled); malformed env values warn BY NAME and fall back to
+    the caller's value — the ``APEX_TPU_CHUNK_TOKENS`` override
+    discipline."""
+    raw = os.environ.get("APEX_TPU_HOST_TIER_BYTES")
+    if raw is not None:
+        if raw.strip().lower() in ("off", "0"):
+            return None
+        try:
+            return _parse_bytes(raw)
+        except ValueError:
+            warnings.warn(
+                f"APEX_TPU_HOST_TIER_BYTES={raw!r} is malformed "
+                "(expected a positive byte count like 268435456 or "
+                "256m, or off/0 to disable); using the caller's "
+                "host_tier_bytes", stacklevel=3)
+    if value is None:
+        return None
+    if isinstance(value, str):
+        if value.strip().lower() in ("off", "0"):
+            return None
+        return _parse_bytes(value)
+    if int(value) < 1:
+        raise ValueError(
+            f"host_tier_bytes={value} must be >= 1 (or None to "
+            "disable the host tier)")
+    return int(value)
+
+
+def resolve_host_tier_wire(value: Optional[str]) -> str:
+    """The offload wire knob: ``APEX_TPU_HOST_TIER_WIRE`` beats the
+    caller's ``host_tier_wire=`` (``raw`` = bitwise page round trips,
+    ``int8`` = ~4x denser parking that decodes-but-may-diverge);
+    malformed values warn BY NAME and fall back."""
+    raw = os.environ.get("APEX_TPU_HOST_TIER_WIRE")
+    if raw is not None:
+        wire = raw.strip().lower()
+        if wire in HOST_TIER_WIRES:
+            return wire
+        warnings.warn(
+            f"APEX_TPU_HOST_TIER_WIRE={raw!r} is malformed (expected "
+            f"one of {HOST_TIER_WIRES}); using the caller's "
+            "host_tier_wire", stacklevel=3)
+    wire = "raw" if value is None else str(value)
+    if wire not in HOST_TIER_WIRES:
+        raise ValueError(
+            f"host_tier_wire={value!r}: expected one of "
+            f"{HOST_TIER_WIRES}")
+    return wire
+
+
+class _Entry:
+    """One parked page set: the encoded wire form plus an optional
+    prefetch-decoded staging copy (``ServingEngine`` decodes a
+    budget-blocked head request's pages AHEAD of re-admission so the
+    page-in scatter never waits on the wire decode)."""
+
+    __slots__ = ("header", "blobs", "nbytes", "pages", "staged")
+
+    def __init__(self, header: dict, blobs: List[bytes], pages: int):
+        self.header = header
+        self.blobs = blobs
+        self.nbytes = wire_bytes(blobs)
+        self.pages = pages
+        self.staged: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+
+class HostTier:
+    """Bounded host-DRAM LRU page store keyed by (request, tokens) for
+    preemption parking and by chain digest for cold-prefix eviction.
+
+    Single-thread confined like the ``BlockManager`` ledger it extends:
+    the owning engine is only ever stepped from one thread."""
+
+    def __init__(self, capacity_bytes: int, *, wire: str = "raw",
+                 block_size: int = 16):
+        if capacity_bytes < 1:
+            raise ValueError(
+                f"capacity_bytes={capacity_bytes} must be >= 1")
+        if wire not in HOST_TIER_WIRES:
+            raise ValueError(
+                f"wire={wire!r}: expected one of {HOST_TIER_WIRES}")
+        self.capacity_bytes = int(capacity_bytes)
+        self.wire = wire
+        self.block_size = int(block_size)
+        self._lru: "OrderedDict[tuple, _Entry]" = OrderedDict()  # guarded-by: confined(engine-loop)
+        self._bytes = 0                 # guarded-by: confined(engine-loop)
+        self._pages = 0                 # guarded-by: confined(engine-loop)
+        self._hits = 0                  # guarded-by: confined(engine-loop)
+        self._misses = 0                # guarded-by: confined(engine-loop)
+        self._evictions = 0             # guarded-by: confined(engine-loop)
+
+    # -- store internals ----------------------------------------------------
+
+    def _evict_until(self, need: int) -> None:
+        while self._lru and self._bytes + need > self.capacity_bytes:
+            _, old = self._lru.popitem(last=False)
+            self._bytes -= old.nbytes
+            self._pages -= old.pages
+            self._evictions += 1
+            _telemetry.counter("serving.host_tier.evictions").inc()
+        self._set_gauges()
+
+    def _put(self, key: tuple, k, v) -> bool:
+        t0 = time.perf_counter()
+        k = np.asarray(k)
+        v = np.asarray(v)
+        header, blobs = encode_kv(k, v, wire_dtype=self.wire)
+        entry = _Entry(header, blobs,
+                       pages=blocks_for(k.shape[1], self.block_size))
+        if entry.nbytes > self.capacity_bytes:
+            # one page set larger than the whole budget: refuse (an
+            # insert that immediately evicts itself is just churn)
+            self._evictions += 1
+            _telemetry.counter("serving.host_tier.evictions").inc()
+            return False
+        old = self._lru.pop(key, None)
+        if old is not None:
+            self._bytes -= old.nbytes
+            self._pages -= old.pages
+        self._evict_until(entry.nbytes)
+        self._lru[key] = entry
+        self._bytes += entry.nbytes
+        self._pages += entry.pages
+        self._set_gauges()
+        _telemetry.sketch("serving.host_tier.page_out_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
+        return True
+
+    def _get(self, key: tuple, *, pop: bool
+             ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        entry = self._lru.get(key)
+        if entry is None:
+            self._misses += 1
+            _telemetry.counter("serving.host_tier.misses").inc()
+            return None
+        self._hits += 1
+        _telemetry.counter("serving.host_tier.hits").inc()
+        if entry.staged is not None:
+            out = entry.staged
+        else:
+            out = decode_kv(entry.header, entry.blobs)
+        if pop:
+            del self._lru[key]
+            self._bytes -= entry.nbytes
+            self._pages -= entry.pages
+            self._set_gauges()
+        else:
+            self._lru.move_to_end(key)
+        return out
+
+    def _set_gauges(self) -> None:
+        _telemetry.gauge("serving.host_tier.bytes").set(self._bytes)
+        _telemetry.gauge("serving.host_tier.pages").set(self._pages)
+
+    # -- request parking (preempt -> page-in resume) ------------------------
+
+    def put_request(self, request_id: int, n_tokens: int, k, v) -> bool:
+        """Park a preempted request's materialized pages (``k``/``v``
+        per-token float ``[L, n_tokens, g, dh]``).  Returns False when
+        the page set exceeds the whole budget."""
+        return self._put(("req", int(request_id), int(n_tokens)), k, v)
+
+    def has_request(self, request_id: int, n_tokens: int) -> bool:
+        return ("req", int(request_id), int(n_tokens)) in self._lru
+
+    def take_request(self, request_id: int, n_tokens: int
+                     ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Pop + decode a parked request's pages for page-in resume, or
+        None (evicted / never parked — the caller replays prefill).
+        Counts one hit or miss either way: the hit rate IS the
+        resume-vs-replay ratio."""
+        return self._get(("req", int(request_id), int(n_tokens)),
+                         pop=True)
+
+    def drop_request(self, request_id: int, n_tokens: int) -> None:
+        """Discard a parked request without hit/miss accounting (the
+        request completed or left this engine another way)."""
+        entry = self._lru.pop(("req", int(request_id), int(n_tokens)),
+                              None)
+        if entry is not None:
+            self._bytes -= entry.nbytes
+            self._pages -= entry.pages
+            self._set_gauges()
+
+    def prefetch_request(self, request_id: int, n_tokens: int) -> bool:
+        """Decode a parked request's wire bytes into a staged copy
+        AHEAD of re-admission (the ``copy_to_host_async``-style
+        overlap): the engine calls this while the request waits at the
+        queue head on the block budget, so the eventual
+        :meth:`take_request` returns pre-decoded arrays and the
+        page-in scatter never waits on the wire decode."""
+        entry = self._lru.get(("req", int(request_id), int(n_tokens)))
+        if entry is None or entry.staged is not None:
+            return False
+        entry.staged = decode_kv(entry.header, entry.blobs)
+        _telemetry.counter("serving.host_tier.prefetches").inc()
+        return True
+
+    # -- digest parking (cold-prefix eviction -> republish) -----------------
+
+    def put_block(self, digest: bytes, k, v) -> bool:
+        """Park one evicted published block's pages ``[L, block_size,
+        g, dh]`` under its chain digest.  Raw wire only by contract —
+        a digest hit maps pages with no token re-check, so only a
+        bit-exact wire may alias the digest namespace (the handoff
+        no-alias rule, extended across tiers)."""
+        if self.wire != "raw":
+            return False
+        return self._put(("digest", bytes(digest)), k, v)
+
+    def has_block(self, digest: bytes) -> bool:
+        return ("digest", bytes(digest)) in self._lru
+
+    def peek_block(self, digest: bytes
+                   ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Decode a parked block WITHOUT removing it (page-in keeps the
+        host copy: the digest becomes resident in both tiers until the
+        LRU ages it out)."""
+        return self._get(("digest", bytes(digest)), pop=False)
+
+    # -- inventory / accounting ---------------------------------------------
+
+    def newest_digests(self, limit: int = DIGEST_INVENTORY_N
+                       ) -> List[bytes]:
+        """The newest ``limit`` host-resident chain digests, newest
+        first — the host half of the digest-inventory summary the
+        prefix-cache-aware router scores against."""
+        if limit <= 0:
+            return []
+        out = [key[1] for key in self._lru if key[0] == "digest"]
+        out = out[-limit:]
+        out.reverse()
+        return out
+
+    def stats(self) -> Dict[str, int]:
+        """Snapshot for ``ServingEngine.stats()`` → the worker poll
+        reply → the router's host-tier headroom accounting."""
+        return {
+            "capacity_bytes": self.capacity_bytes,
+            "bytes": self._bytes,
+            "free_bytes": max(0, self.capacity_bytes - self._bytes),
+            "pages": self._pages,
+            "entries": len(self._lru),
+            "hits": self._hits,
+            "misses": self._misses,
+            "evictions": self._evictions,
+            "wire": self.wire,
+        }

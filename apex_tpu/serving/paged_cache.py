@@ -55,8 +55,9 @@ import numpy as np
 
 from apex_tpu.models.config import TransformerConfig
 
-__all__ = ["BlockManager", "CACHE_WIRES", "blocks_for", "dequantize_kv",
-           "gather_block_kv", "init_paged_pool", "paged_insert_prefill",
+__all__ = ["BlockManager", "CACHE_WIRES", "blocks_for", "chunk_salt",
+           "dequantize_kv", "gather_block_kv", "gather_block_scales",
+           "init_paged_pool", "paged_insert_prefill",
            "paged_insert_prefill_q", "prefix_block_hashes",
            "quantize_kv", "resolve_cache_wire", "scatter_kv_quantized"]
 
@@ -169,8 +170,8 @@ def scatter_kv_quantized(pool_k, pool_v, k_scale, v_scale, k, v, idx):
             v_scale.at[idx].set(sv, mode="drop"))
 
 
-def prefix_block_hashes(tokens: np.ndarray,
-                        block_size: int) -> List[bytes]:
+def prefix_block_hashes(tokens: np.ndarray, block_size: int,
+                        salt: bytes = b"") -> List[bytes]:
     """Chained content digests of every FULL block of ``tokens``.
 
     ``digest(block i)`` covers tokens ``[0, (i+1)·block_size)`` via
@@ -180,15 +181,34 @@ def prefix_block_hashes(tokens: np.ndarray,
     digest is chained SHA-256, not Python's 64-bit ``hash()``: sharing
     maps another request's physical K/V on a key hit with no token
     re-comparison, so the key must be collision-proof, not merely
-    collision-rare."""
+    collision-rare.
+
+    ``salt`` seeds the chain and NAMESPACES the digests (ISSUE 18):
+    pages written by different writers are only bit-identical within a
+    writer class — monolithic flash prefill (and raw-wire handoffs of
+    flash pages, which round-trip bit-exactly) publish under the empty
+    salt, while chunk-written pages publish under
+    :func:`chunk_salt`, because chunk-vs-flash accumulation differs in
+    low-order bits and the digest contract is *bitwise* page identity,
+    not merely token identity."""
     tokens = np.asarray(tokens, np.int64).reshape(-1)
     out: List[bytes] = []
-    h = b""
+    h = bytes(salt)
     for i in range(tokens.size // block_size):
         blk = tokens[i * block_size: (i + 1) * block_size]
         h = hashlib.sha256(h + blk.tobytes()).digest()
         out.append(h)
     return out
+
+
+def chunk_salt(chunk_tokens: int) -> bytes:
+    """The digest namespace for chunk-written pages: chunk forwards at
+    the same ``chunk_tokens`` (chunk-aligned boundaries from position
+    0) are bitwise deterministic across writers, so they may share with
+    each other — but never with flash-written pages (different
+    accumulation order) or with a different chunk size (different
+    boundary phase)."""
+    return b"chunk:%d" % int(chunk_tokens)
 
 
 class BlockManager:
@@ -215,6 +235,11 @@ class BlockManager:
         self._ref: Dict[int, int] = {}                  # guarded-by: confined(engine-loop)
         self._hash_to_block: Dict[bytes, int] = {}      # guarded-by: confined(engine-loop)
         self._block_to_hash: Dict[int, bytes] = {}      # guarded-by: confined(engine-loop)
+        # publication recency (ISSUE 18): insertion-ordered digest set,
+        # newest at the end — the count-bounded digest-inventory
+        # summary a cluster worker piggybacks on its poll reply reads
+        # the newest-N chain heads from here
+        self._pub_order: Dict[bytes, None] = {}         # guarded-by: confined(engine-loop)
 
     # -- allocation ---------------------------------------------------------
 
@@ -243,6 +268,7 @@ class BlockManager:
         h = self._block_to_hash.pop(blk, None)
         if h is not None and self._hash_to_block.get(h) == blk:
             del self._hash_to_block[h]
+            self._pub_order.pop(h, None)
         self._free.append(blk)
         return True
 
@@ -274,6 +300,29 @@ class BlockManager:
             raise ValueError(f"block {blk} is not allocated")
         self._hash_to_block[chain_hash] = blk
         self._block_to_hash[blk] = chain_hash
+        self._pub_order.pop(chain_hash, None)
+        self._pub_order[chain_hash] = None      # newest at the end
+
+    def digest_of(self, blk: int) -> Optional[bytes]:
+        """The chain digest ``blk`` is CURRENTLY published under, or
+        None (private block, or superseded by a last-writer-wins
+        republish).  The engine's cross-tier eviction edge (ISSUE 18)
+        reads this to decide which dying pages are worth parking in
+        the host tier by digest."""
+        h = self._block_to_hash.get(blk)
+        if h is not None and self._hash_to_block.get(h) == blk:
+            return h
+        return None
+
+    def newest_digests(self, limit: int) -> List[bytes]:
+        """The newest ``limit`` published chain digests, newest first —
+        the HBM half of the count-bounded digest-inventory summary the
+        prefix-cache-aware router scores against (ISSUE 18)."""
+        if limit <= 0:
+            return []
+        out = list(self._pub_order.keys())[-limit:]
+        out.reverse()
+        return out
 
     def ensure_private(self, blk: int) -> Tuple[Optional[int], bool]:
         """Copy-on-write edge: return a block safe to WRITE.
@@ -333,6 +382,20 @@ def gather_block_kv(pool_k, pool_v, block_ids):
     k = jnp.take(pool_k, ids, axis=1).reshape(L, ids.shape[0] * bs, g, dh)
     v = jnp.take(pool_v, ids, axis=1).reshape(L, ids.shape[0] * bs, g, dh)
     return k, v
+
+
+def gather_block_scales(scale_pool, block_ids):
+    """The scale-pool analog of :func:`gather_block_kv` for int8 pools:
+    dereference an ordered block list into token-major scales
+    ``[L, len(block_ids)·block_size, kv_groups]`` so a host-tier
+    page-out (ISSUE 18) can dequantize exactly the pages it gathers."""
+    ids = jnp.asarray(block_ids, jnp.int32)
+    if ids.ndim != 1:
+        raise ValueError(
+            f"block_ids must be a 1-D block list, got shape {ids.shape}")
+    L, _, bs, g = scale_pool.shape
+    return jnp.take(scale_pool, ids, axis=1).reshape(
+        L, ids.shape[0] * bs, g)
 
 
 @functools.partial(jax.jit, donate_argnames=("pool_k", "pool_v"),

@@ -1365,6 +1365,154 @@ def bench_chunked_starvation(platform="cpu"):
     return rows
 
 
+def bench_host_tier_ablation(platform="cpu", modes=("off", "on")):
+    """Hierarchical KV cache ablation (ISSUE 18): the host-DRAM
+    offload tier off vs on, under the two traces it exists for.
+
+    - **starvation mix** — a pool sized to preempt the youngest of
+      three co-resident requests: with the tier OFF the preempted
+      request re-admits through a full prefill replay; ON it resumes
+      via a raw-wire page-in (one jitted scatter).  The row reports
+      the preempted requests' preempt-overhead p95 per mode and the
+      acceptance ratio (``resume_over_replay_overhead`` — the page-in
+      must beat the forward pass it replaces), plus greedy
+      token-identity across modes (the raw wire is bitwise, so the
+      tier must be numerically invisible).
+    - **shared-system-prompt trace** — sequential arrivals sharing a
+      64-token system prefix, admitted chunked so every full chunk's
+      digest publishes: OFF, each arrival re-prefills the cold prefix
+      (the pool freed it at completion); ON, the parked digests page
+      back in and only the private tail prefills.  The row reports
+      TTFT p95 per mode and the host-tier hit ledger.
+
+    CPU-pinned like the serve-trace rows; every row carries backend/
+    skipped so a smoke run self-describes."""
+    from apex_tpu.models.config import TransformerConfig
+    from apex_tpu.models.transformer_lm import init_gpt_params
+    from apex_tpu.serving import ServingEngine
+
+    cfg = TransformerConfig(
+        num_layers=2, hidden_size=128, num_attention_heads=4,
+        vocab_size=256, max_position_embeddings=256,
+        compute_dtype=jnp.float32, remat=False)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(18)
+    tier_kw = {"off": {}, "on": {"host_tier_bytes": 1 << 26}}
+
+    # -- starvation mix: preemption -> resume-vs-replay --------------
+    starve = [dict(prompt=rng.randint(0, 256, (64,)),
+                   max_new_tokens=24) for _ in range(3)]
+
+    def starve_engine(mode):
+        # 18 blocks of 8 admit two 64-token prompts (16 blocks) but
+        # cannot hold both grown to 88 tokens (22): the youngest
+        # preempts mid-decode and re-admits
+        return ServingEngine(
+            params, cfg, max_slots=3, max_len=160,
+            prompt_buckets=(64,), cache_layout="paged", block_size=8,
+            num_blocks=18, reserve_blocks=0, **tier_kw[mode])
+
+    def drive(eng, reqs):
+        return eng.run([{k: (v.copy() if hasattr(v, "copy") else v)
+                         for k, v in r.items()} for r in reqs])
+
+    for mode in dict.fromkeys(modes):            # warmup compiles —
+        drive(starve_engine(mode), starve)       # incl. the page-in
+                                                 # scatter (on only)
+    rows = {"backend": platform, "skipped": False,
+            "modes": list(modes)}
+    starve_rows, tokens_by_mode = {}, {}
+    for mode in modes:
+        eng = starve_engine(mode)
+        resps = drive(eng, starve)
+        overhead = sorted(r.preempt_overhead_ms for r in resps
+                          if r.preemptions)
+        st = eng.stats()
+        row = {"preemptions": st["preemptions"],
+               "preempted_requests": len(overhead),
+               "preempt_overhead_ms_p95": round(
+                   _pct_of(overhead, .95), 4) if overhead else None,
+               # per preemption CYCLE: the tier makes each cycle so
+               # cheap the scheduler may churn through more of them,
+               # so per-request totals compare unlike counts — the
+               # resume-vs-replay question is what ONE re-admission
+               # costs
+               "preempt_overhead_ms_per_cycle": round(
+                   sum(overhead) / st["preemptions"], 4)
+               if st["preemptions"] else None,
+               "tpot_ms_p95": round(_pct_of(
+                   [r.tpot_ms for r in resps if r.tokens.size > 1],
+                   .95), 4),
+               "blocks_leaked": st["blocks_in_use"]}
+        if mode == "on":
+            ht = st.get("host_tier") or {}
+            row["host_resumes"] = ht.get("hits", 0)
+            row["host_misses"] = ht.get("misses", 0)
+        starve_rows[mode] = row
+        tokens_by_mode[mode] = sorted(
+            (r.request_id, tuple(r.tokens.tolist())) for r in resps)
+    rows["starvation"] = starve_rows
+    if len(modes) == 2:
+        rows["token_identical"] = (
+            tokens_by_mode[modes[0]] == tokens_by_mode[modes[1]])
+        off_oh = starve_rows["off"].get("preempt_overhead_ms_per_cycle")
+        on_oh = starve_rows["on"].get("preempt_overhead_ms_per_cycle")
+        if off_oh and on_oh:
+            # THE GATE: one page-in resume must beat the one prefill
+            # replay it displaces
+            rows["resume_over_replay_overhead"] = round(
+                on_oh / off_oh, 3)
+            rows["resume_beats_replay"] = on_oh <= off_oh
+
+    # -- shared-system-prompt trace: cold-prefix page-in -------------
+    system = rng.randint(0, 256, (64,))
+    shared_reqs = [dict(prompt=np.concatenate(
+        [system, rng.randint(0, 256, (8,))]).astype(np.int32),
+        max_new_tokens=8) for _ in range(4)]
+
+    def shared_engine(mode):
+        return ServingEngine(
+            params, cfg, max_slots=2, max_len=96,
+            prompt_buckets=(72,), cache_layout="paged", block_size=8,
+            chunk_tokens=16, **tier_kw[mode])
+
+    for mode in dict.fromkeys(modes):
+        # warmup: chunk ladder + (on) the digest page-in path — the
+        # second sequential request is the one that pages in
+        weng = shared_engine(mode)
+        for r in shared_reqs[:2]:
+            drive(weng, [r])
+    shared_rows = {}
+    for mode in modes:
+        eng = shared_engine(mode)
+        ttfts, all_tokens = [], []
+        # sequential arrivals: the prefix is COLD between requests —
+        # exactly the trace where only a parked copy can share it
+        for r in shared_reqs:
+            resps = drive(eng, [r])
+            ttfts += [x.ttft_ms for x in resps]
+            all_tokens += [tuple(x.tokens.tolist()) for x in resps]
+        st = eng.stats()
+        row = {"ttft_ms_p95": round(_pct_of(sorted(ttfts), .95), 4),
+               "blocks_leaked": st["blocks_in_use"]}
+        if mode == "on":
+            ht = st.get("host_tier") or {}
+            row["host_hits"] = ht.get("hits", 0)
+            row["host_pages_parked"] = ht.get("pages", 0)
+        shared_rows[mode] = {**row, "tokens": hash(tuple(all_tokens))}
+    rows["shared_prompt"] = shared_rows
+    if len(modes) == 2:
+        rows["shared_token_identical"] = (
+            shared_rows[modes[0]]["tokens"]
+            == shared_rows[modes[1]]["tokens"])
+        rows["shared_ttft_on_over_off"] = round(
+            shared_rows["on"]["ttft_ms_p95"]
+            / max(shared_rows["off"]["ttft_ms_p95"], 1e-9), 3)
+    for m in shared_rows.values():
+        m.pop("tokens", None)
+    return rows
+
+
 # the controller-trace engine geometry (larger than _TRACE_ENGINE so a
 # long prompt + chunking have room)
 _CTRL_ENGINE = dict(max_slots=3, max_len=96, block_size=8,
@@ -2532,6 +2680,15 @@ def main():
              "cold).  CPU-pinned like --serve-trace (the spawned "
              "worker could not attach an already-claimed chip)")
     parser.add_argument(
+        "--host-tier", default=None, metavar="MODES",
+        help="comma list of off, on: with --decode, run ONLY the "
+             "hierarchical KV cache ablation (bench_host_tier_ablation "
+             "— the preemption starvation mix, resume-from-host-tier "
+             "vs prefill-replay overhead + greedy token identity, and "
+             "the shared-system-prompt trace where cold prefixes page "
+             "back in from host DRAM; ISSUE 18) instead of the full "
+             "inference matrix")
+    parser.add_argument(
         "--spec", default=None, metavar="SPECS",
         help="comma list of speculative-decoding modes (off, ngram): "
              "with --decode, run ONLY the spec ablation rows "
@@ -2567,6 +2724,21 @@ def main():
                          "rows")
         if args.spec is not None or args.cache_dtype is not None:
             parser.error("--decode-fused is its own ablation; run "
+                         "--spec/--cache-dtype as separate "
+                         "invocations")
+    host_modes = None
+    if args.host_tier is not None:
+        host_modes = tuple(
+            m.strip() for m in args.host_tier.split(",") if m.strip())
+        bad = [m for m in host_modes if m not in ("off", "on")]
+        if bad or not host_modes:
+            parser.error(f"--host-tier {args.host_tier!r}: expected a "
+                         "comma list of off, on")
+        if not args.decode:
+            parser.error("--host-tier only applies to the --decode "
+                         "rows")
+        if args.spec is not None or args.cache_dtype is not None:
+            parser.error("--host-tier is its own ablation; run "
                          "--spec/--cache-dtype as separate "
                          "invocations")
     spec_modes = None
@@ -2805,6 +2977,36 @@ def main():
             "backend": platform,
             "skipped": skipped,
             "details": {"decode_fused_ablation": rows},
+            "runtime": runtime_summary(),
+        }))
+        return
+    if args.decode and host_modes:
+        try:
+            rows = bench_host_tier_ablation(platform=platform,
+                                            modes=host_modes)
+        except Exception as e:
+            rows = {"error": f"{type(e).__name__}: {e}"[:200]}
+        # a single-mode run measures no resume-vs-replay ratio: the
+        # headline carries a machine-readable caveat rather than a
+        # 0.0 that reads as "page-in is free"
+        if "error" in rows:
+            skipped = f"bench_host_tier failed: {rows['error']}"
+        elif "resume_over_replay_overhead" not in rows:
+            skipped = ("single-mode run: no resume-vs-replay ratio "
+                       "(pass --host-tier off,on)")
+        else:
+            skipped = False
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "metric": "host_tier_kv_offload_ablation",
+            # headline: preempt-overhead p95 with the tier on over
+            # off — the ISSUE 18 gate is <= 1.0 (page-in resume beats
+            # the prefill replay it displaces)
+            "value": rows.get("resume_over_replay_overhead", 0.0),
+            "unit": "x",
+            "backend": platform,
+            "skipped": skipped,
+            "details": {"host_tier_ablation": rows},
             "runtime": runtime_summary(),
         }))
         return

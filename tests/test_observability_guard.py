@@ -165,6 +165,21 @@ def test_checkpoint_metrics_use_the_helpers_only():
         "statement:\n" + _fmt(offenders))
 
 
+def test_host_tier_metrics_use_the_helpers_only():
+    """Every ``serving.host_tier.*`` / ``cluster.prefix_affinity_*``
+    metric touch in ``apex_tpu/`` must go through the ``_telemetry``
+    helpers on the same statement (ISSUE 18): the hit/miss/eviction
+    ledger feeds telemetry_report's host-tier summary and the
+    ``kv_tier`` dryrun census, so a second access idiom would fork the
+    accounting."""
+    offenders = (_findings("APX105", "'serving.host_tier.")
+                 + _findings("APX105", "'cluster.prefix_affinity_"))
+    assert not offenders, (
+        "serving.host_tier.* / cluster.prefix_affinity_* metrics must "
+        "be accessed via _telemetry.counter/gauge/sketch(...) on the "
+        "same statement:\n" + _fmt(offenders))
+
+
 def test_guard_patterns_actually_match():
     """The guard is only as good as its rules: each must flag its own
     anti-pattern and pass the clean twin (a regression here silently
@@ -205,6 +220,23 @@ def test_guard_patterns_actually_match():
     # span names (checkpoint.save) are not in the guarded set
     assert not _fixture_findings(
         "APX105", 'reg.observe_span("checkpoint.save", bg_s)\n')
+    # ISSUE 18: the hierarchical-KV ledger and the router's
+    # prefix-affinity counter are guarded the same way
+    assert _fixture_findings(
+        "APX105", 'reg.counter("serving.host_tier.hits").inc()\n')
+    assert not _fixture_findings(
+        "APX105", '_telemetry.counter("serving.host_tier.hits").inc()\n')
+    assert not _fixture_findings(
+        "APX105", '_telemetry.gauge("serving.host_tier.bytes").set(b)\n')
+    assert not _fixture_findings(
+        "APX105",
+        '_telemetry.sketch("serving.host_tier.page_in_ms")'
+        '.observe(ms)\n')
+    assert _fixture_findings(
+        "APX105", 'reg.counter("cluster.prefix_affinity_hits").inc()\n')
+    assert not _fixture_findings(
+        "APX105",
+        '_telemetry.counter("cluster.prefix_affinity_hits").inc()\n')
     # APX103
     assert _fixture_findings("APX103", "from x import _REGISTRY\n")
     assert _fixture_findings("APX103", "v = _REGISTRY\n")

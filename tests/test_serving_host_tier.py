@@ -1,0 +1,679 @@
+"""Hierarchical KV cache (ISSUE 18): host-DRAM offload tier,
+chunk-aligned prefix digests, and prefix-cache-aware routing.
+
+Pins the cross-tier ledger invariants: evict→page-in round trips are
+bitwise on the raw wire (both compute dtypes, both pool forms), the
+int8 wire decodes within the PR 14 block-scale contract, refcounts
+never leak across evict/preempt/resume/handoff interleavings, and the
+router's affinity scoring mirrors the engine's digest namespaces
+exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.generate import (
+    extract_kv, generate, init_kv_cache, prefill)
+from apex_tpu.models.transformer_lm import init_gpt_params
+from apex_tpu.serving import ServingEngine
+from apex_tpu.serving.cluster.handoff import decode_kv, encode_kv
+from apex_tpu.serving.host_tier import (
+    HostTier, resolve_host_tier_bytes, resolve_host_tier_wire)
+from apex_tpu.serving.paged_cache import chunk_salt, prefix_block_hashes
+
+
+def _cfg(**kw):
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("remat", False)
+    return TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _rand_kv(rng, n_tokens, dtype=np.float32, layers=2, g=4, dh=16):
+    k = rng.standard_normal((layers, n_tokens, g, dh)).astype(dtype)
+    v = rng.standard_normal((layers, n_tokens, g, dh)).astype(dtype)
+    return k, v
+
+
+class TestResolveKnobs:
+    def test_env_beats_caller(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_HOST_TIER_BYTES", "4096")
+        assert resolve_host_tier_bytes(None) == 4096
+        assert resolve_host_tier_bytes(1) == 4096
+        monkeypatch.setenv("APEX_TPU_HOST_TIER_WIRE", "int8")
+        assert resolve_host_tier_wire("raw") == "int8"
+
+    def test_off_and_zero_disable(self, monkeypatch):
+        for off in ("off", "0", " OFF "):
+            monkeypatch.setenv("APEX_TPU_HOST_TIER_BYTES", off)
+            assert resolve_host_tier_bytes(1 << 20) is None
+
+    def test_malformed_warns_by_name_and_falls_back(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_HOST_TIER_BYTES", "lots")
+        with pytest.warns(UserWarning,
+                          match="APEX_TPU_HOST_TIER_BYTES"):
+            assert resolve_host_tier_bytes(2048) == 2048
+        monkeypatch.setenv("APEX_TPU_HOST_TIER_WIRE", "bf16")
+        with pytest.warns(UserWarning,
+                          match="APEX_TPU_HOST_TIER_WIRE"):
+            assert resolve_host_tier_wire("int8") == "int8"
+
+    def test_suffixed_byte_counts(self, monkeypatch):
+        """The worker CLI ships strings: plain ints and 256m/2g-style
+        binary suffixes both resolve, env or caller."""
+        monkeypatch.setenv("APEX_TPU_HOST_TIER_BYTES", "256m")
+        assert resolve_host_tier_bytes(None) == 256 << 20
+        monkeypatch.delenv("APEX_TPU_HOST_TIER_BYTES")
+        assert resolve_host_tier_bytes("2g") == 2 << 30
+        assert resolve_host_tier_bytes("64K") == 64 << 10
+        assert resolve_host_tier_bytes("4096") == 4096
+        assert resolve_host_tier_bytes("off") is None
+        with pytest.raises(ValueError):
+            resolve_host_tier_bytes("lots")
+
+    def test_caller_validation(self):
+        with pytest.raises(ValueError, match="host_tier_bytes"):
+            resolve_host_tier_bytes(0)
+        with pytest.raises(ValueError, match="host_tier_wire"):
+            resolve_host_tier_wire("fp8")
+        assert resolve_host_tier_bytes(None) is None
+        assert resolve_host_tier_wire(None) == "raw"
+
+
+class TestHostTierStore:
+    def test_request_round_trip_bitwise_raw(self):
+        rng = np.random.default_rng(0)
+        tier = HostTier(1 << 22, wire="raw", block_size=4)
+        for dtype in (np.float32, "bfloat16"):
+            dt = jnp.dtype(dtype)
+            k, v = _rand_kv(rng, 7)
+            k, v = (np.asarray(jnp.asarray(k, dt)),
+                    np.asarray(jnp.asarray(v, dt)))
+            assert tier.put_request(1, 7, k, v)
+            assert tier.has_request(1, 7)
+            k2, v2 = tier.take_request(1, 7)
+            assert k2.dtype == k.dtype and not tier.has_request(1, 7)
+            np.testing.assert_array_equal(k2, k)
+            np.testing.assert_array_equal(v2, v)
+
+    def test_int8_wire_bounded_by_block_scale_contract(self):
+        """PR 14 contract: the int8 wire quantizes per block with
+        scale = maxabs/127, so the decode error is bounded by half a
+        quantization step per element."""
+        rng = np.random.default_rng(1)
+        tier = HostTier(1 << 22, wire="int8", block_size=4)
+        k, v = _rand_kv(rng, 16)
+        assert tier.put_request(2, 16, k, v)
+        k2, v2 = tier.take_request(2, 16)
+        for got, want in ((k2, k), (v2, v)):
+            got = np.asarray(got, np.float32)
+            # per-wire-block maxabs bounds the step; one global bound
+            # using the tensor max is looser but still tight enough to
+            # catch a broken codec
+            step = np.abs(want).max() / 127.0
+            assert np.abs(got - want).max() <= step * 0.5 + 1e-7
+
+    def test_lru_bytes_bound_and_eviction_counting(self):
+        rng = np.random.default_rng(2)
+        k, v = _rand_kv(rng, 4)
+        one = 2 * k.nbytes                      # bytes per entry
+        tier = HostTier(int(one * 2.5), wire="raw", block_size=4)
+        for rid in range(3):
+            assert tier.put_request(rid, 4, k, v)
+        st = tier.stats()
+        assert st["bytes"] <= tier.capacity_bytes
+        assert st["entries"] == 2 and st["evictions"] == 1
+        assert not tier.has_request(0, 4)       # oldest evicted
+        assert tier.has_request(1, 4) and tier.has_request(2, 4)
+        # a miss is counted; the evicted request falls back to replay
+        assert tier.take_request(0, 4) is None
+        assert tier.stats()["misses"] == 1
+
+    def test_oversize_refused_not_stored(self):
+        rng = np.random.default_rng(3)
+        k, v = _rand_kv(rng, 32)
+        tier = HostTier(k.nbytes // 2, wire="raw", block_size=4)
+        assert not tier.put_request(9, 32, k, v)
+        st = tier.stats()
+        assert st["entries"] == 0 and st["bytes"] == 0
+        assert st["evictions"] == 1             # refusal is counted
+
+    def test_digest_parking_raw_wire_only(self):
+        rng = np.random.default_rng(4)
+        k, v = _rand_kv(rng, 4)
+        raw = HostTier(1 << 22, wire="raw", block_size=4)
+        assert raw.put_block(b"d" * 32, k, v)
+        assert raw.has_block(b"d" * 32)
+        k2, v2 = raw.peek_block(b"d" * 32)      # peek keeps the copy
+        np.testing.assert_array_equal(k2, k)
+        assert raw.has_block(b"d" * 32)
+        assert raw.newest_digests() == [b"d" * 32]
+        # the no-alias rule across tiers: an int8 tier refuses the
+        # digest namespace entirely (digest hits skip token re-checks)
+        q = HostTier(1 << 22, wire="int8", block_size=4)
+        assert not q.put_block(b"d" * 32, k, v)
+        assert not q.has_block(b"d" * 32)
+
+    def test_prefetch_stages_decode_ahead(self):
+        rng = np.random.default_rng(5)
+        k, v = _rand_kv(rng, 6)
+        tier = HostTier(1 << 22, wire="raw", block_size=4)
+        tier.put_request(3, 6, k, v)
+        assert tier.prefetch_request(3, 6)
+        assert not tier.prefetch_request(3, 6)  # already staged
+        k2, _v2 = tier.take_request(3, 6)
+        np.testing.assert_array_equal(k2, k)
+        assert not tier.prefetch_request(4, 4)  # absent: no-op
+
+
+def _preempting_engine(params, cfg, **kw):
+    """6 blocks of 4 and two 6-token prompts decoding 10: both admit,
+    both outgrow the pool mid-decode — the youngest gets preempted
+    (the TestPreemption geometry, with the offload tier switched on)."""
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("prompt_buckets", (8,))
+    kw.setdefault("cache_layout", "paged")
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 6)
+    kw.setdefault("reserve_blocks", 0)
+    return ServingEngine(params, cfg, **kw)
+
+
+class TestPageInResume:
+    def test_resume_is_page_in_not_replay_fp32(self, model):
+        """THE ACCEPTANCE PIN: with the tier on, a preempted request
+        resumes by paging its raw-wire copy back in — greedy output
+        stays token-identical to never being preempted, and the
+        hit-rate counters show resume, not replay."""
+        from apex_tpu.observability import metrics as telemetry
+
+        cfg, params = model
+        rng = np.random.RandomState(7)
+        p1 = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+        p2 = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+        reg = telemetry.configure()
+        try:
+            engine = _preempting_engine(params, cfg,
+                                        host_tier_bytes=1 << 24)
+            resps = engine.run([dict(prompt=p1, max_new_tokens=10),
+                                dict(prompt=p2, max_new_tokens=10)])
+            assert reg.counter("serving.preemptions").value >= 1
+            assert reg.counter("serving.host_tier.resumes").value >= 1
+            assert reg.counter("serving.host_tier.replays").value == 0
+            for r, p in zip(resps, (p1, p2)):
+                solo = np.asarray(generate(
+                    params, jnp.asarray(p[None]), cfg,
+                    max_new_tokens=10))[0, 6:]
+                np.testing.assert_array_equal(
+                    r.tokens, solo, err_msg=f"request {r.request_id}")
+            assert engine.idle
+            assert engine.stats()["blocks_in_use"] == 0
+            assert engine._mgr.n_in_use == 0
+            # no parked request copy survives its own resume
+            assert not [key for key in engine._host._lru
+                        if key[0] == "req"]
+        finally:
+            telemetry.shutdown()
+
+    @pytest.mark.parametrize("compute,wire", [
+        ("float32", "int8"),
+        ("bfloat16", "native"),
+        ("bfloat16", "int8"),
+    ])
+    def test_resume_matches_replay_across_pool_forms(
+            self, compute, wire):
+        """Raw-wire parking is bitwise at the POOL level on every
+        compute dtype × pool form: the int8 pool dequantizes for
+        parking and requantizes on page-in, and requantization is
+        idempotent — so a paged-in engine continues token-identically
+        to an identical engine that replays prefill instead."""
+        cfg = _cfg(compute_dtype=jnp.dtype(compute))
+        params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.RandomState(9)
+        reqs = [dict(prompt=rng.randint(0, cfg.vocab_size, (6,))
+                     .astype(np.int32), max_new_tokens=8)
+                for _ in range(2)]
+        kw = {} if wire == "native" else {"cache_wire": wire}
+        base = _preempting_engine(params, cfg, **kw)
+        want = base.run([dict(r) for r in reqs])
+        tiered = _preempting_engine(params, cfg,
+                                    host_tier_bytes=1 << 24, **kw)
+        got = tiered.run([dict(r) for r in reqs])
+        assert base.stats()["preemptions"] >= 1
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(
+                g.tokens, w.tokens, err_msg=f"request {g.request_id}")
+        assert tiered.idle and tiered._mgr.n_in_use == 0
+
+    def test_int8_wire_resume_decodes_and_completes(self, model):
+        """The compressed wire decodes-but-may-diverge (PR 14): the
+        run must complete every request with full token counts and a
+        leak-free ledger; token identity is only the raw wire's
+        contract."""
+        cfg, params = model
+        rng = np.random.RandomState(13)
+        engine = _preempting_engine(params, cfg,
+                                    host_tier_bytes=1 << 24,
+                                    host_tier_wire="int8")
+        resps = engine.run(
+            [dict(prompt=rng.randint(0, cfg.vocab_size, (6,))
+                  .astype(np.int32), max_new_tokens=10)
+             for _ in range(2)])
+        assert engine.stats()["preemptions"] >= 1
+        assert all(r.tokens.size == 10 for r in resps)
+        assert engine.idle and engine._mgr.n_in_use == 0
+        assert engine.stats()["host_tier"]["wire"] == "int8"
+
+    def test_page_in_failure_unwinds_and_replays(self, model):
+        """The _admit unwind pattern, page-in edition: an insert raise
+        mid-page-in frees the claimed blocks and keeps the request at
+        the queue front; the retry degrades to a prefill replay (the
+        parked copy was popped by take) and still serves full
+        output."""
+        from apex_tpu.observability import metrics as telemetry
+
+        cfg, params = model
+        rng = np.random.RandomState(8)
+        reg = telemetry.configure()
+        try:
+            engine = _preempting_engine(params, cfg,
+                                        host_tier_bytes=1 << 24)
+            for _ in range(2):
+                engine.submit(rng.randint(0, cfg.vocab_size, (8,)),
+                              max_new_tokens=12)
+            engine._admit()
+            resps = []
+            while not engine.stats()["queued"]:
+                resps.extend(engine.step())    # drive to a preemption
+            real_insert = engine._insert_prefill_kv
+            boom = {"armed": True}
+
+            def flaky_insert(*a, **k):
+                if boom["armed"]:
+                    boom["armed"] = False
+                    raise RuntimeError("injected page-in failure")
+                return real_insert(*a, **k)
+
+            engine._insert_prefill_kv = flaky_insert
+            with pytest.raises(RuntimeError, match="page-in"):
+                while True:
+                    resps.extend(engine.step())
+            # nothing leaked, nothing dropped
+            assert engine._mgr.n_in_use <= 4   # only the live lane
+            assert engine.stats()["queued"] == 1
+            resps.extend(engine.run([]))
+            assert sorted(r.request_id for r in resps) == [0, 1]
+            assert all(r.tokens.size == 12 for r in resps)
+            assert engine._mgr.n_in_use == 0
+            # the lost parked copy shows up as a replay, honestly
+            assert reg.counter("serving.host_tier.replays").value >= 1
+        finally:
+            telemetry.shutdown()
+
+
+class TestChunkAlignedDigests:
+    def test_chunked_admissions_publish_and_share(self, model):
+        """PR 15's follow-up closed: every full block-aligned chunk's
+        digest publishes as it lands, so a second chunked admission of
+        the same prompt shares the leading whole chunks instead of
+        re-prefilling them — and stays greedy-identical."""
+        cfg, params = model
+        rng = np.random.RandomState(21)
+        prompt = rng.randint(0, cfg.vocab_size, (20,)).astype(np.int32)
+        want = np.asarray(generate(
+            params, jnp.asarray(prompt[None]), cfg,
+            max_new_tokens=6))[0, 20:]
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=40,
+                               prompt_buckets=(8, 24),
+                               cache_layout="paged", block_size=4,
+                               chunk_tokens=8)
+        engine.submit(prompt, max_new_tokens=6)
+        # land request 0's chunks (publication happens per chunk)
+        engine.step()                           # admits + first chunk
+        while engine.stats()["prefilling"]:
+            engine.step()
+        inv = engine.stats()["digest_inventory"]
+        assert inv["chunk_tokens"] == 8 and inv["hbm"]
+        engine.submit(prompt, max_new_tokens=6)
+        done = engine.run([])
+        # 2 whole chunks = 4 blocks shared (the final chunk always
+        # runs so the sharer samples its own first token)
+        assert engine.stats().get("preemptions", 0) == 0
+        shared = max(r.request_id for r in done)  # both completed
+        assert shared == 1
+        for r in done:
+            np.testing.assert_array_equal(
+                r.tokens, want, err_msg=f"request {r.request_id}")
+        assert engine._mgr.n_in_use == 0
+
+    def test_chunk_share_counts_blocks(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(22)
+        prompt = rng.randint(0, cfg.vocab_size, (20,)).astype(np.int32)
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=40,
+                               prompt_buckets=(8, 24),
+                               cache_layout="paged", block_size=4,
+                               chunk_tokens=8)
+        engine.submit(prompt, max_new_tokens=12)
+        engine.step()
+        while engine.stats()["prefilling"]:
+            engine.step()
+        engine.submit(prompt, max_new_tokens=12)
+        saw_shared = 0
+        while not engine.idle:
+            engine.step()
+            saw_shared = max(saw_shared,
+                             engine.stats()["prefix_shared_blocks"])
+        assert saw_shared >= 4      # 2 whole chunks x (8/4) blocks
+        assert engine._mgr.n_in_use == 0
+
+    def test_chunk_digests_namespace_separate_from_flash(self):
+        toks = np.arange(16, dtype=np.int32)
+        flash = prefix_block_hashes(toks, 4)
+        chunk = prefix_block_hashes(toks, 4, salt=chunk_salt(8))
+        assert len(flash) == len(chunk) == 4
+        assert all(a != b for a, b in zip(flash, chunk))
+
+    def test_cold_chunk_prefix_pages_in_from_host(self, model):
+        """The cross-tier chunk path: a completed chunked request's
+        published digests park in the host tier; a later identical
+        prompt pages the leading chunks back in instead of
+        re-prefilling them."""
+        from apex_tpu.observability import metrics as telemetry
+
+        cfg, params = model
+        rng = np.random.RandomState(23)
+        prompt = rng.randint(0, cfg.vocab_size, (20,)).astype(np.int32)
+        want = np.asarray(generate(
+            params, jnp.asarray(prompt[None]), cfg,
+            max_new_tokens=6))[0, 20:]
+        reg = telemetry.configure()
+        try:
+            engine = ServingEngine(params, cfg, max_slots=2,
+                                   max_len=40, prompt_buckets=(8, 24),
+                                   cache_layout="paged", block_size=4,
+                                   chunk_tokens=8,
+                                   host_tier_bytes=1 << 24)
+            first = engine.run([dict(prompt=prompt, max_new_tokens=6)])
+            # the cold prefix now lives ONLY in the host tier
+            assert engine.stats()["blocks_in_use"] == 0
+            assert engine.stats()["host_tier"]["pages"] >= 4
+            assert engine.stats()["digest_inventory"]["host"]
+            second = engine.run([dict(prompt=prompt,
+                                      max_new_tokens=6)])
+            assert reg.counter(
+                "serving.host_tier.page_ins").value >= 4
+            for r in first + second:
+                np.testing.assert_array_equal(
+                    r.tokens, want, err_msg=f"request {r.request_id}")
+            assert engine._mgr.n_in_use == 0
+        finally:
+            telemetry.shutdown()
+
+
+def _make_handoff(params, cfg, prompt, bucket=8):
+    """A raw-wire fresh-prefill handoff, exactly as the prefill worker
+    builds one (paged scratch, wire round trip)."""
+    from apex_tpu.serving.batching import pad_prompt
+
+    n = int(prompt.size)
+    scratch = init_kv_cache(cfg, 1, bucket,
+                            cache_dtype=cfg.compute_dtype,
+                            cache_layout="paged", block_size=4)
+    logits, cache = prefill(
+        params, jnp.asarray(pad_prompt(prompt, bucket)[None]), cfg,
+        prompt_lens=jnp.asarray([n], np.int32), cache=scratch)
+    k, v = extract_kv(cache, n, row=0)
+    header, blobs = encode_kv(np.asarray(k), np.asarray(v),
+                              wire_dtype="raw")
+    k2, v2 = decode_kv(header, blobs)
+    return k2, v2, int(np.argmax(np.asarray(logits)[0]))
+
+
+class TestShareableHandoff:
+    def test_shareable_handoff_publishes_and_shares(self, model):
+        """A raw-wire fresh-prefill handoff is bit-identical to a
+        local flash prefill, so ``submit_prefilled(shareable=True)``
+        publishes under the flash namespace — a second identical
+        handoff shares the pages and decodes identically."""
+        cfg, params = model
+        rng = np.random.RandomState(31)
+        prompt = rng.randint(0, cfg.vocab_size, (7,)).astype(np.int32)
+        k, v, first = _make_handoff(params, cfg, prompt)
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               prompt_buckets=(8,),
+                               cache_layout="paged", block_size=4)
+        engine.submit_prefilled(prompt, k, v, first,
+                                max_new_tokens=12, shareable=True)
+        engine._admit()
+        assert engine.stats()["digest_inventory"]["hbm"]
+        engine.submit_prefilled(prompt, k, v, first,
+                                max_new_tokens=12, shareable=True)
+        saw_shared = 0
+        resps = []
+        while not engine.idle:
+            resps.extend(engine.step())
+            saw_shared = max(saw_shared,
+                             engine.stats()["prefix_shared_blocks"])
+        assert saw_shared >= 1      # 7 tokens -> 1 full shared block
+        assert len(resps) == 2
+        np.testing.assert_array_equal(resps[0].tokens, resps[1].tokens)
+        assert engine._mgr.n_in_use == 0
+
+    def test_unshareable_handoff_stays_private(self, model):
+        cfg, params = model
+        rng = np.random.RandomState(32)
+        prompt = rng.randint(0, cfg.vocab_size, (7,)).astype(np.int32)
+        k, v, first = _make_handoff(params, cfg, prompt)
+        engine = ServingEngine(params, cfg, max_slots=2, max_len=32,
+                               prompt_buckets=(8,),
+                               cache_layout="paged", block_size=4)
+        engine.submit_prefilled(prompt, k, v, first, max_new_tokens=4)
+        engine._admit()
+        assert not engine.stats()["digest_inventory"]["hbm"]
+        assert engine.run([])[0].tokens.size == 4
+
+
+def _bare_router(**kw):
+    from apex_tpu.serving.cluster.router import Router
+    from apex_tpu.serving.slo import resolve_slo_targets
+
+    r = object.__new__(Router)
+    r._prefill, r._decode = [], []
+    r._slo_targets = resolve_slo_targets(None)
+    r._caps = kw.get("queue_caps", {})
+    r._priority = ("interactive", "standard", "default", "batch")
+    r.wire_dtype = "raw"
+    r._max_worker_queue = 4
+    r._queues = {}
+    r._next_rid = 0
+    r._pf_rr = 0
+    r._last_decode_pick = None
+    r._requeued_total = 0
+    r._completed_total = 0
+    r._drain_completed = []
+    return r
+
+
+class _InvWorker:
+    _n = [0]
+
+    def __init__(self, headroom=64, hbm=(), host=(), block_size=4,
+                 chunk_tokens=None, host_free=None):
+        self._n[0] += 1
+        self.addr = f"inv{self._n[0]}"
+        self.alive, self.draining = True, False
+        self.in_flight = {}
+        self.dispatched_since_poll = 0
+        self.stats = {"headroom_tokens": headroom, "max_slots": 4,
+                      "active": 1, "queued": 0, "block_size": block_size,
+                      "digest_inventory": {
+                          "block_size": block_size,
+                          "chunk_tokens": chunk_tokens,
+                          "hbm": list(hbm), "host": list(host)}}
+        if host_free is not None:
+            self.stats["host_tier"] = {"free_bytes": host_free,
+                                       "bytes": 0}
+
+
+class TestPrefixAffinityRouting:
+    def _digests(self, prompt, block_size=4, chunk_tokens=None):
+        salt = (chunk_salt(chunk_tokens)
+                if chunk_tokens and len(prompt) > chunk_tokens else b"")
+        return [h.hex()[:16] for h in prefix_block_hashes(
+            np.asarray(prompt, np.int32), block_size, salt=salt)]
+
+    def test_router_digests_mirror_engine_namespaces(self):
+        from apex_tpu.serving.cluster.router import _prompt_digests
+
+        prompt = list(range(1, 21))
+        assert _prompt_digests(prompt, 4, 0) == self._digests(prompt)
+        # a prompt the worker would chunk hashes in the chunk namespace
+        assert (_prompt_digests(prompt, 4, 8)
+                == self._digests(prompt, chunk_tokens=8))
+        # and one shorter than chunk_tokens stays in the flash one
+        short = prompt[:6]
+        assert _prompt_digests(short, 4, 8) == self._digests(short)
+
+    def test_affinity_beats_headroom(self):
+        from apex_tpu.observability import metrics as telemetry
+
+        prompt = list(range(1, 21))
+        holder = _InvWorker(headroom=8, hbm=self._digests(prompt))
+        bigger = _InvWorker(headroom=640)
+        reg = telemetry.configure()
+        try:
+            r = _bare_router()
+            r._decode = [bigger, holder]
+            r.submit(prompt, max_new_tokens=4)
+            pend = r._queues["default"][0]
+            assert r._pick_decode(pend) is holder
+            assert reg.counter(
+                "cluster.prefix_affinity_hits").value == 1
+            # no affinity anywhere -> headroom ordering, no hit count
+            r2 = _bare_router()
+            r2._decode = [bigger, _InvWorker(headroom=8)]
+            r2.submit(list(range(50, 70)), max_new_tokens=4)
+            assert r2._pick_decode(r2._queues["default"][0]) is bigger
+            assert reg.counter(
+                "cluster.prefix_affinity_hits").value == 1
+        finally:
+            telemetry.shutdown()
+
+    def test_hbm_outweighs_host_at_equal_depth(self):
+        prompt = list(range(1, 21))
+        digs = self._digests(prompt)
+        hbm_holder = _InvWorker(headroom=8, hbm=[digs[-1]])
+        host_holder = _InvWorker(headroom=640, host=[digs[-1]])
+        r = _bare_router()
+        r._decode = [host_holder, hbm_holder]
+        r.submit(prompt, max_new_tokens=4)
+        pend = r._queues["default"][0]
+        assert r._pick_decode(pend) is hbm_holder
+        # chain depth: a deeper host match beats a shallow HBM one
+        # (5 blocks x1 > 2 blocks x2)
+        deep_host = _InvWorker(headroom=8, host=[digs[4]])
+        shallow_hbm = _InvWorker(headroom=640, hbm=[digs[1]])
+        r2 = _bare_router()
+        r2._decode = [shallow_hbm, deep_host]
+        r2.submit(prompt, max_new_tokens=4)
+        assert r2._pick_decode(r2._queues["default"][0]) is deep_host
+
+    def test_workers_without_inventory_fall_back(self):
+        class _Legacy(_InvWorker):
+            def __init__(self):
+                super().__init__(headroom=128)
+                del self.stats["digest_inventory"]
+
+        r = _bare_router()
+        legacy, small = _Legacy(), _InvWorker(headroom=16)
+        r._decode = [small, legacy]
+        r.submit([1, 2, 3], max_new_tokens=4)
+        assert r._pick_decode(r._queues["default"][0]) is legacy
+
+    def test_scale_hint_host_tier_awareness(self):
+        """Exhausted HBM with an empty router queue and free host-DRAM
+        reads as HOLD (preemptions degrade to cheap page-ins), while
+        the same exhaustion without the tier still reads grow."""
+        r = _bare_router()
+        r._decode = [_InvWorker(headroom=0)]
+        r._prefill = [_InvWorker()]
+        assert r.autoscale_signal()["decode"]["hint"] == 1
+        r2 = _bare_router()
+        r2._decode = [_InvWorker(headroom=0, host_free=1 << 20)]
+        r2._prefill = [_InvWorker()]
+        sig = r2.autoscale_signal()
+        assert sig["decode"]["hint"] == 0
+        assert sig["decode"]["host_tier_free_bytes"] == 1 << 20
+        # queued work still demands growth, tier or no tier
+        for _ in range(9):
+            r2.submit([1, 2], max_new_tokens=2)
+        assert r2.autoscale_signal()["decode"]["hint"] == 1
+
+
+class TestServeDashHostTierRow:
+    def test_dash_renders_host_tier_row_from_live_exporter(
+            self, model):
+        """ISSUE 18 satellite: the dashboard surfaces the per-pool
+        host-tier row (parked footprint, hit rate, resumes/replays)
+        when the serving.host_tier.* families are present — and hides
+        it when the tier is off."""
+        import importlib.util
+        import io
+        import os
+
+        import apex_tpu.observability as obs
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "serve_dash", os.path.join(repo, "tools", "serve_dash.py"))
+        dash = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(dash)
+        om = dash.load_openmetrics_module()
+
+        cfg, params = model
+        rng = np.random.RandomState(41)
+        reg = obs.configure(export_port=0)
+        try:
+            engine = _preempting_engine(params, cfg,
+                                        host_tier_bytes=1 << 24)
+            engine.run([dict(prompt=rng.randint(0, cfg.vocab_size,
+                                                (6,)).astype(np.int32),
+                             max_new_tokens=10) for _ in range(2)])
+            assert reg.counter("serving.host_tier.resumes").value >= 1
+            out = io.StringIO()
+            snap = dash.one_frame(om, reg.exporter.url, out=out)
+            assert snap["host_tier_bytes"] is not None
+            assert snap["host_tier_resumes"] >= 1
+            text = out.getvalue()
+            assert "host tier" in text and "resumes" in text
+        finally:
+            obs.shutdown()
+        # tier off: families absent, row hidden
+        reg = obs.configure(export_port=0)
+        try:
+            engine = _preempting_engine(params, cfg)
+            engine.run([dict(prompt=rng.randint(0, cfg.vocab_size,
+                                                (6,)).astype(np.int32),
+                             max_new_tokens=4)])
+            out = io.StringIO()
+            snap = dash.one_frame(om, reg.exporter.url, out=out)
+            assert snap["host_tier_bytes"] is None
+            assert "host tier" not in out.getvalue()
+        finally:
+            obs.shutdown()

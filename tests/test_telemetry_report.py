@@ -717,3 +717,103 @@ def test_compile_cache_summary_ready_only_and_absent(report):
     assert report.compile_cache_summary(
         {"counters": {"serving.requests": 4.0}, "spans": {},
          "events": {}, "gauges": {}}) is None
+
+
+def test_host_tier_summary_from_stream(report, tmp_path):
+    """ISSUE 18 satellite: the host-DRAM KV tier gets a derived view —
+    take-side hit rate, the resume-vs-replay split of re-admissions,
+    parked-bytes/pages high-water, page-in latency from the mergeable
+    sketch, and fleet prefix-affinity routing hits."""
+    import json
+
+    sk_mod = report._load_sketch_module()
+    sk = sk_mod.LogBucketSketch()
+    for v in (2.0, 3.0, 9.0):
+        sk.observe(v)
+    recs = [
+        {"type": "counter", "name": "serving.host_tier.hits",
+         "value": 6},
+        {"type": "counter", "name": "serving.host_tier.misses",
+         "value": 2},
+        {"type": "counter", "name": "serving.host_tier.evictions",
+         "value": 1},
+        {"type": "counter", "name": "serving.host_tier.page_ins",
+         "value": 8},
+        {"type": "counter", "name": "serving.host_tier.resumes",
+         "value": 3},
+        {"type": "counter", "name": "serving.host_tier.replays",
+         "value": 1},
+        {"type": "counter", "name": "cluster.prefix_affinity_hits",
+         "value": 5},
+        {"type": "gauge", "name": "serving.host_tier.bytes",
+         "value": 1024.0},
+        {"type": "gauge", "name": "serving.host_tier.bytes",
+         "value": 4096.0},
+        {"type": "gauge", "name": "serving.host_tier.bytes",
+         "value": 2048.0},
+        {"type": "gauge", "name": "serving.host_tier.pages",
+         "value": 4.0},
+        {"type": "sketch", "name": "serving.host_tier.page_in_ms",
+         "value": sk.to_dict()},
+    ]
+    f = tmp_path / "ht.jsonl"
+    f.write_text("".join(
+        json.dumps(dict(r, schema_version=3, t=i)) + "\n"
+        for i, r in enumerate(recs)))
+    summ = report.summarize(report.load_records([str(f)]))
+    ht = report.host_tier_summary(summ)
+    assert ht["hits"] == 6 and ht["misses"] == 2
+    assert abs(ht["hit_rate"] - 0.75) < 1e-9
+    assert ht["resumes"] == 3 and ht["replays"] == 1
+    assert abs(ht["resume_ratio"] - 0.75) < 1e-9
+    assert ht["bytes_high_water"] == 4096.0
+    assert ht["pages_high_water"] == 4.0
+    assert ht["page_ins"] == 8 and ht["evictions"] == 1
+    assert ht["prefix_affinity_hits"] == 5
+    assert ht["page_in_ms"]["count"] == 3
+    out = io.StringIO()
+    report.print_report(summ, out=out)
+    text = out.getvalue()
+    assert "host-DRAM KV tier (serving.host_tier.*)" in text
+    assert "hit rate 0.75" in text
+    assert "resume ratio 0.75" in text
+    assert "page-in ms p50" in text
+    assert "prefix-affinity routed dispatches 5" in text
+
+
+def test_host_tier_summary_absent_without_series(report):
+    """A stream with no host-tier series (tier off, older writers)
+    hides the section entirely."""
+    assert report.host_tier_summary(
+        {"counters": {"serving.requests": 4.0}, "spans": {},
+         "events": {}, "gauges": {}}) is None
+
+
+def test_host_tier_page_in_sketch_merges_across_hosts(
+        aggregate, tmp_path):
+    """ISSUE 18 satellite: serving.host_tier.page_in_ms rides the
+    generic sketch-merge path — two hosts' cumulative flushes fold
+    into one exact fleet quantile summary."""
+    import json
+
+    sk_mod = aggregate.load_sketch_module()
+
+    def seg(values):
+        sk = sk_mod.LogBucketSketch()
+        for v in values:
+            sk.observe(v)
+        return (json.dumps({"type": "meta", "schema_version": 3})
+                + "\n"
+                + json.dumps({"type": "sketch",
+                              "name": "serving.host_tier.page_in_ms",
+                              "value": sk.to_dict()}) + "\n")
+
+    a = tmp_path / "host_a.jsonl"
+    b = tmp_path / "host_b.jsonl"
+    a.write_text(seg([1.0, 2.0, 4.0]))
+    b.write_text(seg([8.0, 16.0]))
+    agg = aggregate.aggregate(
+        aggregate.load_records([str(a), str(b)]))
+    s = agg["sketches"]["serving.host_tier.page_in_ms"]
+    assert s["count"] == 5
+    assert s["max"] >= 16.0

@@ -223,6 +223,25 @@ def main():
         "bench_cache_dtype", [sys.executable, "bench.py", "--decode",
                               "--cache-dtype", "bf16,int8"],
         timeout=3600)
+    # hierarchical KV cache (ISSUE 18): host-DRAM offload tier off vs
+    # on — preemption starvation mix (resume-from-host-tier overhead
+    # vs the prefill replay it displaces + greedy token identity) and
+    # the shared-system-prompt trace (cold prefixes page back in from
+    # host DRAM instead of re-prefilling).  Chip-free numerics: the
+    # raw wire is bitwise, so the row gates on identity + overhead
+    results["bench_host_tier"] = _run(
+        "bench_host_tier", [sys.executable, "bench.py", "--decode",
+                            "--host-tier", "off,on"],
+        timeout=1800)
+    # ...then the kv_tier dryrun phase: page-in resume + chunk-digest
+    # page-in token-identical to the solo generate() oracle, and the
+    # cross-tier refcount census (zero HBM blocks in use, no
+    # per-request host copies, byte ledger exact) at idle
+    results["dryrun_kv_tier"] = _run(
+        "dryrun_kv_tier",
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        env_extra={"APEX_TPU_DRYRUN_PHASE": "kv_tier"}, timeout=1800)
     # fused decode-layer megakernel (ISSUE 17): reference composition
     # vs the one-launch fused kernel — per-token ms per route plus the
     # per-layer op/launch structural ledger.  On the chip the ms

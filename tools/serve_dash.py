@@ -180,6 +180,17 @@ def snapshot(om, parsed) -> dict:
         "prefilling": val("serving_prefilling"),
         "prefill_chunks_done": val("serving_prefill_progress_done"),
         "prefill_chunks_total": val("serving_prefill_progress_total"),
+        # hierarchical KV cache (ISSUE 18): host-DRAM offload tier —
+        # gauges exist only on an engine with host_tier_bytes set, so
+        # the row renders conditionally per pool
+        "host_tier_bytes": val("serving_host_tier_bytes"),
+        "host_tier_pages": val("serving_host_tier_pages"),
+        "host_tier_hits": val("serving_host_tier_hits_total"),
+        "host_tier_misses": val("serving_host_tier_misses_total"),
+        "host_tier_resumes": val("serving_host_tier_resumes_total"),
+        "host_tier_replays": val("serving_host_tier_replays_total"),
+        "prefix_affinity_hits": val(
+            "cluster_prefix_affinity_hits_total"),
         # elastic controller (ISSUE 15)
         "controller_pools": ctrl_pools or None,
         "controller_actions": ctrl_actions,
@@ -226,6 +237,22 @@ def render(snap: dict, health: str, url: str, out=None) -> None:
           f"{_fmt(snap['prefill_chunks_total'], '{:.0f}')} chunks   "
           f"prefilling lanes "
           f"{_fmt(snap.get('prefilling'), '{:.0f}')}")
+    if snap.get("host_tier_bytes") is not None:
+        # host-DRAM KV tier (ISSUE 18): parked footprint + take-side
+        # hit accounting; resumes/replays splits re-admissions into
+        # page-ins vs prefill replays
+        hits = snap.get("host_tier_hits") or 0.0
+        misses = snap.get("host_tier_misses") or 0.0
+        rate = (f"{hits / (hits + misses):.0%}"
+                if (hits or misses) else "-")
+        p(f"  host tier {_fmt(snap['host_tier_bytes'], '{:.0f}')}B / "
+          f"{_fmt(snap.get('host_tier_pages'), '{:.0f}')} pages   "
+          f"hit rate {rate}   resumes "
+          f"{_fmt(snap.get('host_tier_resumes'), '{:.0f}')}   "
+          f"replays {_fmt(snap.get('host_tier_replays'), '{:.0f}')}")
+    if snap.get("prefix_affinity_hits"):
+        p(f"  prefix-affinity dispatches "
+          f"{_fmt(snap['prefix_affinity_hits'], '{:.0f}')}")
     if snap.get("controller_pools") is not None:
         pools = "  ".join(f"{pool}:{int(v)}" for pool, v in
                           sorted(snap["controller_pools"].items()))

@@ -583,6 +583,49 @@ def compile_cache_summary(summary: dict) -> Optional[dict]:
     }
 
 
+def host_tier_summary(summary: dict) -> Optional[dict]:
+    """Derived view of the hierarchical KV cache's host-DRAM tier
+    (ISSUE 18): take-side hit rate over parked-page lookups
+    (``serving.host_tier.{hits,misses}``), the resume-vs-replay ratio
+    (paged-in resumptions over prefill replays — the fraction of
+    re-admissions the tier turned into a scatter instead of a forward
+    pass), page-in latency p50/p95 from the mergeable
+    ``serving.host_tier.page_in_ms`` sketch, the parked-bytes
+    high-water mark, and fleet prefix-affinity routing hits
+    (``cluster.prefix_affinity_hits``).  None when the stream carries
+    no host-tier series (tier off, pre-ISSUE-18 writers)."""
+    counters = summary["counters"]
+    gauges = summary["gauges"]
+    hits = counters.get("serving.host_tier.hits", 0.0)
+    misses = counters.get("serving.host_tier.misses", 0.0)
+    hbytes = gauges.get("serving.host_tier.bytes")
+    if not (hits or misses or hbytes):
+        return None
+    sketches = summary.get("sketches") or {}
+    resumes = counters.get("serving.host_tier.resumes", 0.0)
+    replays = counters.get("serving.host_tier.replays", 0.0)
+    readmits = resumes + replays
+    lookups = hits + misses
+    return {
+        "hits": hits,
+        "misses": misses,
+        "hit_rate": (hits / lookups) if lookups else None,
+        "evictions": counters.get("serving.host_tier.evictions", 0.0),
+        "page_ins": counters.get("serving.host_tier.page_ins", 0.0),
+        "prefetches": counters.get("serving.host_tier.prefetches", 0.0),
+        "resumes": resumes,
+        "replays": replays,
+        "resume_ratio": (resumes / readmits) if readmits else None,
+        "bytes_high_water": max(hbytes) if hbytes else 0.0,
+        "pages_high_water": max(
+            gauges.get("serving.host_tier.pages") or [0.0]),
+        "page_in_ms": sketches.get("serving.host_tier.page_in_ms"),
+        "page_out_ms": sketches.get("serving.host_tier.page_out_ms"),
+        "prefix_affinity_hits": counters.get(
+            "cluster.prefix_affinity_hits", 0.0),
+    }
+
+
 def print_report(summary: dict, out=None) -> None:
     out = sys.stdout if out is None else out
     if summary["unknown_schema"]:
@@ -779,6 +822,33 @@ def print_report(summary: dict, out=None) -> None:
             print(f"  worker READY ms last {r['last']:g}  min "
                   f"{r['min']:g}  max {r['max']:g}  "
                   f"(n={r['count']} workers)", file=out)
+    ht = host_tier_summary(summary)
+    if ht:
+        print("== host-DRAM KV tier (serving.host_tier.*) ==", file=out)
+        line = f"  hits {ht['hits']:g}  misses {ht['misses']:g}"
+        if ht["hit_rate"] is not None:
+            line += f" -> hit rate {ht['hit_rate']:.3g}"
+        if ht["evictions"]:
+            line += f"  evictions {ht['evictions']:g}"
+        print(line, file=out)
+        if ht["resume_ratio"] is not None:
+            print(f"  resumes {ht['resumes']:g} / replays "
+                  f"{ht['replays']:g} -> resume ratio "
+                  f"{ht['resume_ratio']:.3g} (1.0 = every re-admission "
+                  "was a page-in, no prefill replayed)", file=out)
+        print(f"  parked high-water {ht['bytes_high_water']:g} B / "
+              f"{ht['pages_high_water']:g} pages  page-ins "
+              f"{ht['page_ins']:g}  prefetches {ht['prefetches']:g}",
+              file=out)
+        for label, key in (("page-in", "page_in_ms"),
+                           ("page-out", "page_out_ms")):
+            s = ht[key]
+            if s:
+                print(f"  {label} ms p50 {s['p50']:.4g}  p95 "
+                      f"{s['p95']:.4g}  (n={s['count']})", file=out)
+        if ht["prefix_affinity_hits"]:
+            print(f"  prefix-affinity routed dispatches "
+                  f"{ht['prefix_affinity_hits']:g}", file=out)
     serving = serving_summary(summary)
     if serving:
         print("== paged serving (serving.blocks_*) ==", file=out)
