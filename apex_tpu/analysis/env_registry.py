@@ -121,6 +121,11 @@ ENV_REGISTRY: Dict[str, EnvVar] = dict([
        "docs/serving.md",
        "host-tier at-rest codec (raw|int8; raw keeps digest parking "
        "bitwise)"),
+    _v("APEX_TPU_ADAPTER_POOL_BYTES", "apex_tpu.serving.adapter_pool",
+       "docs/serving.md",
+       "HBM budget for the LoRA adapter slab pool (bytes, 256m/2g "
+       "suffixes; admission blocks when a request's adapter cannot "
+       "fit)"),
     # ---- training / parallel knobs -----------------------------------
     _v("APEX_TPU_ALLOW_FP16", "apex_tpu.amp.policy",
        "docs/amp.md", "permit raw fp16 on TPU (default maps to bf16)"),

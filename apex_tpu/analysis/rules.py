@@ -299,11 +299,12 @@ class MetricPrefixRule(Rule):
     name = "metric-prefix-helper"
     description = ("moe.* / checkpoint.* / generate.spec.* / "
                    "serving.compile_cache.* / serving.host_tier.* / "
-                   "cluster.prefix_affinity_* / worker.ready_ms metric "
-                   "touches must ride the _telemetry helpers on the "
-                   "same statement — a second access idiom forks the "
-                   "accounting telemetry_report and the dryrun gates "
-                   "read")
+                   "serving.adapter.* / cluster.prefix_affinity_* / "
+                   "cluster.adapter_affinity_* / worker.ready_ms "
+                   "metric touches must ride the _telemetry helpers "
+                   "on the same statement — a second access idiom "
+                   "forks the accounting telemetry_report and the "
+                   "dryrun gates read")
 
     _CKPT = ("saves", "bytes", "restores", "rollbacks", "overlap_ratio")
     # prefix -> allowed _telemetry helper attributes
@@ -321,6 +322,12 @@ class MetricPrefixRule(Rule):
         # host_tier_summary — same one-accounting-path contract
         ("serving.host_tier.", ("counter", "gauge", "sketch")),
         ("cluster.prefix_affinity_", ("counter",)),
+        # ISSUE 20: the adapter-pool ledger (hit/miss/eviction
+        # counters, residency gauges) and the router's
+        # adapter-affinity counter feed telemetry_report's
+        # adapter_summary — same one-accounting-path contract
+        ("serving.adapter.", ("counter", "gauge")),
+        ("cluster.adapter_affinity_", ("counter",)),
     ) + tuple((f"checkpoint.{n}", ("counter", "gauge")) for n in _CKPT)
 
     def _match(self, value: str) -> Optional[Tuple[str, Tuple[str, ...]]]:

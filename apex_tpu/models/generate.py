@@ -311,7 +311,29 @@ def _vector_pos(cache: dict) -> jax.Array:
     return pos.astype(jnp.int32)
 
 
-def _decode_qkv(cfg, lp, x, pos, rope, rope_q: bool = True):
+def _lora_operands(lora, m: int = 1):
+    """Resolve the optional per-request LoRA bundle (``{"idx": [b] int32
+    slot ids, "slabs": {target: {"a": [L, G, in, r], "b": [L, G, r,
+    out]}}}``, ISSUE 20) into forward operands: the slab pytree (leading
+    layer axis — scanned beside the base layer stack) and the sort plan
+    over the forward's ``b * m`` rows.  A verify block's token (i, j)
+    flattens row-major, so each sequence's slot id repeats m ways.  Both
+    the ids and the plan are traced — one compiled step serves every
+    adapter mix."""
+    if lora is None:
+        return None, None
+    from apex_tpu.models.lora import lora_plan
+
+    slabs = lora["slabs"]
+    n_slots = next(iter(slabs.values()))["a"].shape[1]
+    idx = lora["idx"].astype(jnp.int32)
+    if m > 1:
+        idx = jnp.repeat(idx, m)
+    return slabs, lora_plan(idx, n_slots)
+
+
+def _decode_qkv(cfg, lp, x, pos, rope, rope_q: bool = True, ll=None,
+                plan=None):
     """Shared pre-attention math (norm → qkv projection → GQA split →
     per-sequence rotary) for ``x`` [b, s, h] appended at per-sequence
     offsets ``pos`` [b] — token (i, j) sits at absolute position
@@ -335,6 +357,11 @@ def _decode_qkv(cfg, lp, x, pos, rope, rope_q: bool = True):
     # dequantizing matmul so decode reads int8 weight bytes
     qkv = quantized_matmul(h, lp["qkv_kernel"]) + lp["qkv_bias"].astype(
         x.dtype)
+    if ll is not None and "qkv" in ll:
+        from apex_tpu.models.lora import batched_lora_delta
+
+        qkv = qkv + batched_lora_delta(h, ll["qkv"]["a"],
+                                       ll["qkv"]["b"], plan)
     if cfg.is_gqa:
         from apex_tpu.models.transformer_lm import split_qkv_gqa
         q, k, v = split_qkv_gqa(cfg, qkv, b, s, nh)
@@ -364,7 +391,7 @@ def _decode_rope_rows(rope, pos):
             jnp.take(sin.astype(jnp.float32), rows, axis=0))
 
 
-def _decode_out_post(cfg, lp, x, h, a):
+def _decode_out_post(cfg, lp, x, h, a, ll=None, plan=None):
     """Post-projection tail (bias → residual → MLP) shared by the
     unfused path and the fused decode layer, whose kernel already owns
     the projection GEMM; ``a`` [b, s, h_model] is the projected
@@ -375,18 +402,28 @@ def _decode_out_post(cfg, lp, x, h, a):
     h = apply_norm(cfg, x, lp["ln2_scale"], lp["ln2_bias"])
     from apex_tpu.models.transformer_lm import _mlp, single_device_ctx
 
-    m = _mlp(cfg, lp, h, single_device_ctx())
+    if ll is not None and ("fc1" in ll or "fc2" in ll):
+        from apex_tpu.models.lora import lora_mlp
+
+        m = lora_mlp(cfg, lp, h, ll, plan)
+    else:
+        m = _mlp(cfg, lp, h, single_device_ctx())
     res = h if cfg.apply_residual_connection_post_layernorm else x
     return res + m
 
 
-def _decode_out(cfg, lp, x, h, ctx_flat):
+def _decode_out(cfg, lp, x, h, ctx_flat, ll=None, plan=None):
     """Shared post-attention math (output projection → residual →
     MLP); ``ctx_flat`` [b, s, nh*dh] (s=1 decode, s=k+1 verify)."""
     from apex_tpu.ops.dense import quantized_matmul
 
     a = quantized_matmul(ctx_flat, lp["proj_kernel"])
-    return _decode_out_post(cfg, lp, x, h, a)
+    if ll is not None and "proj" in ll:
+        from apex_tpu.models.lora import batched_lora_delta
+
+        a = a + batched_lora_delta(ctx_flat, ll["proj"]["a"],
+                                   ll["proj"]["b"], plan)
+    return _decode_out_post(cfg, lp, x, h, a, ll=ll, plan=plan)
 
 
 def _stripe_block(total: int) -> int:
@@ -400,7 +437,7 @@ def _stripe_block(total: int) -> int:
 
 
 def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope,
-                  decode_fused: str = "reference"):
+                  decode_fused: str = "reference", ll=None, plan=None):
     """One layer, one token, contiguous layout: x [b, 1, h] + cache
     slice [b, T, nh, dh]; ``pos`` [b] int32 — each sequence writes and
     attends at its own offset.
@@ -415,10 +452,13 @@ def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope,
     nh = cfg.num_attention_heads
     dh = cfg.kv_channels
     # quantized projection slabs stay on the unfused path — their
-    # in-kernel dequantizing matmul (ops/dense) owns the weight tiling
-    fuse = decode_fused == "kernel" and not is_quantized(
-        lp["proj_kernel"])
-    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope, rope_q=not fuse)
+    # in-kernel dequantizing matmul (ops/dense) owns the weight tiling;
+    # LoRA lanes likewise — the fused kernel owns the projection GEMM,
+    # and the per-row delta must land on its output
+    fuse = (decode_fused == "kernel" and ll is None
+            and not is_quantized(lp["proj_kernel"]))
+    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope, rope_q=not fuse,
+                             ll=ll, plan=plan)
 
     # per-sequence scatter: row (i, pos[i]) only — O(b·nh·dh) written
     # per step, not a full-buffer select; out-of-bounds positions
@@ -461,13 +501,15 @@ def _layer_decode(cfg, lp, x, cache_k, cache_v, pos, rope,
     ctxv = jnp.einsum("bgrqt,btgd->bqgrd", p.astype(cache_v.dtype),
                       cache_v,
                       preferred_element_type=jnp.float32).astype(x.dtype)
-    x = _decode_out(cfg, lp, x, h, ctxv.reshape(b, 1, nh * dh))
+    x = _decode_out(cfg, lp, x, h, ctxv.reshape(b, 1, nh * dh),
+                    ll=ll, plan=plan)
     return x, cache_k, cache_v
 
 
 def _layer_decode_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope,
                         k_scale=None, v_scale=None,
-                        decode_fused: str = "reference"):
+                        decode_fused: str = "reference", ll=None,
+                        plan=None):
     """One layer, one token, paged layout: x [b, 1, h] + this layer's
     block pool [num_blocks, block_size, g, dh] + ``tables``
     [b, max_blocks].  The new K/V append to each sequence's tail block
@@ -489,9 +531,11 @@ def _layer_decode_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope,
     nh = cfg.num_attention_heads
     dh = cfg.kv_channels
     # quantized projection slabs stay on the unfused path — their
-    # in-kernel dequantizing matmul (ops/dense) owns the weight tiling
-    fuse = not is_quantized(lp["proj_kernel"])
-    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope, rope_q=not fuse)
+    # in-kernel dequantizing matmul (ops/dense) owns the weight tiling;
+    # LoRA lanes likewise (the fused kernel owns the projection GEMM)
+    fuse = ll is None and not is_quantized(lp["proj_kernel"])
+    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope, rope_q=not fuse,
+                             ll=ll, plan=plan)
 
     nb, bs = cache_k.shape[0], cache_k.shape[1]
     mb = tables.shape[1]
@@ -529,15 +573,23 @@ def _layer_decode_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope,
                                  pos + 1, k_scale=k_scale,
                                  v_scale=v_scale)
     x = _decode_out(cfg, lp, x, h,
-                    ctx.astype(x.dtype).reshape(b, 1, nh * dh))
+                    ctx.astype(x.dtype).reshape(b, 1, nh * dh),
+                    ll=ll, plan=plan)
     return x, cache_k, cache_v, k_scale, v_scale
 
 
 def decode_step(params: dict, token: jax.Array, cache: dict,
                 cfg: TransformerConfig, *,
-                decode_fused: Optional[str] = None):
+                decode_fused: Optional[str] = None, lora=None):
     """One decoding step: token [b] int32 at per-sequence position
     ``cache['pos']`` ([b] int32) → (logits [b, v], updated cache).
+
+    ``lora`` (ISSUE 20): ``{"idx": [b] int32 slot ids, "slabs":
+    stacked adapter factors}`` — per-row low-rank deltas added at each
+    target matmul via the ragged grouped-matmul path
+    (``models/lora.py``); slot 0 rows are computed delta-free.  LoRA
+    lanes run the unfused reference attention route (the fused kernel
+    owns the projection GEMM the delta must land on).
 
     The cache dict selects the layout: a ``block_tables`` entry means
     paged (pool ``[L, num_blocks, block_size, g, dh]``, tail-block
@@ -573,43 +625,47 @@ def decode_step(params: dict, token: jax.Array, cache: dict,
         rope = rope_cos_sin(max_pos, cfg.kv_channels)
 
     # one compiled layer body scanned over the stacked layer params
-    # (transformer_backbone's shape — compile time constant in depth)
+    # (transformer_backbone's shape — compile time constant in depth).
+    # LoRA slabs ride the scan xs beside the base layers (None — an
+    # empty pytree — when absent, so the no-adapter trace is unchanged)
+    slabs, plan = _lora_operands(lora)
     quant = "k_scale" in cache
     new_scales = None
     if paged and quant:
         tables = cache["block_tables"].astype(jnp.int32)
 
         def body(x, layer_in):
-            lp, ck, cv, sk, sv = layer_in
+            lp, ck, cv, sk, sv, ll = layer_in
             x, ck, cv, sk, sv = _layer_decode_paged(
                 cfg, lp, x, ck, cv, tables, pos, rope, sk, sv,
-                decode_fused=decode_fused)
+                decode_fused=decode_fused, ll=ll, plan=plan)
             return x, (ck, cv, sk, sv)
 
         x, (new_k, new_v, *new_scales) = jax.lax.scan(
             body, x, (params["layers"], cache["k"], cache["v"],
-                      cache["k_scale"], cache["v_scale"]))
+                      cache["k_scale"], cache["v_scale"], slabs))
     elif paged:
         tables = cache["block_tables"].astype(jnp.int32)
 
         def body(x, layer_in):
-            lp, ck, cv = layer_in
+            lp, ck, cv, ll = layer_in
             x, ck, cv, _sk, _sv = _layer_decode_paged(
                 cfg, lp, x, ck, cv, tables, pos, rope,
-                decode_fused=decode_fused)
+                decode_fused=decode_fused, ll=ll, plan=plan)
             return x, (ck, cv)
 
         x, (new_k, new_v) = jax.lax.scan(
-            body, x, (params["layers"], cache["k"], cache["v"]))
+            body, x, (params["layers"], cache["k"], cache["v"], slabs))
     else:
         def body(x, layer_in):
-            lp, ck, cv = layer_in
+            lp, ck, cv, ll = layer_in
             x, ck, cv = _layer_decode(cfg, lp, x, ck, cv, pos, rope,
-                                      decode_fused=decode_fused)
+                                      decode_fused=decode_fused,
+                                      ll=ll, plan=plan)
             return x, (ck, cv)
 
         x, (new_k, new_v) = jax.lax.scan(
-            body, x, (params["layers"], cache["k"], cache["v"]))
+            body, x, (params["layers"], cache["k"], cache["v"], slabs))
 
     x = apply_norm(cfg, x, params["final_ln"]["scale"],
                    params["final_ln"]["bias"])
@@ -624,7 +680,7 @@ def decode_step(params: dict, token: jax.Array, cache: dict,
     return logits, cache
 
 
-def _verify_attention(cfg, x, h, lp, q, kk, vv, pos):
+def _verify_attention(cfg, x, h, lp, q, kk, vv, pos, ll=None, plan=None):
     """Dense masked attention of ``m`` appended query tokens over a
     gathered/contiguous cache view ``kk``/``vv`` [b, T, g, dh]: query
     ``j`` of sequence ``i`` sees positions ``t <= pos[i] + j`` — the
@@ -646,28 +702,31 @@ def _verify_attention(cfg, x, h, lp, q, kk, vv, pos):
     p = jax.nn.softmax(s, axis=-1)
     ctxv = jnp.einsum("bgrqt,btgd->bqgrd", p.astype(vv.dtype), vv,
                       preferred_element_type=jnp.float32).astype(x.dtype)
-    return _decode_out(cfg, lp, x, h, ctxv.reshape(b, m, nh * dh))
+    return _decode_out(cfg, lp, x, h, ctxv.reshape(b, m, nh * dh),
+                       ll=ll, plan=plan)
 
 
-def _layer_verify(cfg, lp, x, cache_k, cache_v, pos, rope):
+def _layer_verify(cfg, lp, x, cache_k, cache_v, pos, rope, ll=None,
+                  plan=None):
     """One layer, ``m`` appended tokens, contiguous layout: x [b, m, h]
     + cache slice [b, T, nh, dh]; writes land at rows
     ``(i, pos[i]+j)`` (out-of-bounds writes drop — rejected tails past
     the stripe are rolled back by the caller's position decrement)."""
     b, m = x.shape[0], x.shape[1]
-    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope)
+    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope, ll=ll, plan=plan)
     b_idx = jnp.arange(b)[:, None]
     wpos = pos[:, None] + jnp.arange(m, dtype=jnp.int32)[None]
     cache_k = cache_k.at[b_idx, wpos].set(
         k.astype(cache_k.dtype), mode="drop")
     cache_v = cache_v.at[b_idx, wpos].set(
         v.astype(cache_v.dtype), mode="drop")
-    x = _verify_attention(cfg, x, h, lp, q, cache_k, cache_v, pos)
+    x = _verify_attention(cfg, x, h, lp, q, cache_k, cache_v, pos,
+                          ll=ll, plan=plan)
     return x, cache_k, cache_v
 
 
 def _layer_verify_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope,
-                        k_scale=None, v_scale=None):
+                        k_scale=None, v_scale=None, ll=None, plan=None):
     """One layer, ``m`` appended tokens, paged layout: the new K/V
     scatter through the block tables (cells ``(tables[i, p//bs],
     p % bs)``, unmapped entries drop), then attention runs over the
@@ -681,7 +740,7 @@ def _layer_verify_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope,
     wire cells and scale cells are overwritten together by the next
     append)."""
     b, m = x.shape[0], x.shape[1]
-    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope)
+    h, q, k, v = _decode_qkv(cfg, lp, x, pos, rope, ll=ll, plan=plan)
     nb, bs = cache_k.shape[0], cache_k.shape[1]
     mb = tables.shape[1]
     wpos = pos[:, None] + jnp.arange(m, dtype=jnp.int32)[None]  # [b, m]
@@ -709,14 +768,20 @@ def _layer_verify_paged(cfg, lp, x, cache_k, cache_v, tables, pos, rope,
 
         kk = dequantize_kv(kk, k_scale[tbl].reshape(b, mb * bs, -1))
         vv = dequantize_kv(vv, v_scale[tbl].reshape(b, mb * bs, -1))
-    x = _verify_attention(cfg, x, h, lp, q, kk, vv, pos)
+    x = _verify_attention(cfg, x, h, lp, q, kk, vv, pos, ll=ll,
+                          plan=plan)
     return x, cache_k, cache_v, k_scale, v_scale
 
 
 def decode_verify(params: dict, tokens: jax.Array, cache: dict,
-                  cfg: TransformerConfig):
+                  cfg: TransformerConfig, *, lora=None):
     """Verification forward: ``m`` tokens per sequence in ONE batched
     pass → (logits [b, m, v], cache with ``pos`` advanced by m).
+
+    ``lora`` (ISSUE 20): same bundle as ``decode_step`` — each
+    sequence's slot id applies to all m of its rows, so a LoRA-serving
+    engine's spec-verify (and its verify-based adapter prefill) runs
+    the same per-row deltas as its decode steps.
 
     ``tokens`` [b, m] append at each sequence's ``cache['pos']``; token
     (i, j) lands at absolute position ``pos[i]+j``, attends to the
@@ -753,39 +818,42 @@ def decode_verify(params: dict, tokens: jax.Array, cache: dict,
             max_pos = cache["k"].shape[2]
         rope = rope_cos_sin(max_pos, cfg.kv_channels)
 
+    slabs, plan = _lora_operands(lora, m=m)
     quant = "k_scale" in cache
     new_scales = None
     if paged and quant:
         tables = cache["block_tables"].astype(jnp.int32)
 
         def body(x, layer_in):
-            lp, ck, cv, sk, sv = layer_in
+            lp, ck, cv, sk, sv, ll = layer_in
             x, ck, cv, sk, sv = _layer_verify_paged(
-                cfg, lp, x, ck, cv, tables, pos, rope, sk, sv)
+                cfg, lp, x, ck, cv, tables, pos, rope, sk, sv,
+                ll=ll, plan=plan)
             return x, (ck, cv, sk, sv)
 
         x, (new_k, new_v, *new_scales) = jax.lax.scan(
             body, x, (params["layers"], cache["k"], cache["v"],
-                      cache["k_scale"], cache["v_scale"]))
+                      cache["k_scale"], cache["v_scale"], slabs))
     elif paged:
         tables = cache["block_tables"].astype(jnp.int32)
 
         def body(x, layer_in):
-            lp, ck, cv = layer_in
+            lp, ck, cv, ll = layer_in
             x, ck, cv, _sk, _sv = _layer_verify_paged(
-                cfg, lp, x, ck, cv, tables, pos, rope)
+                cfg, lp, x, ck, cv, tables, pos, rope, ll=ll, plan=plan)
             return x, (ck, cv)
 
         x, (new_k, new_v) = jax.lax.scan(
-            body, x, (params["layers"], cache["k"], cache["v"]))
+            body, x, (params["layers"], cache["k"], cache["v"], slabs))
     else:
         def body(x, layer_in):
-            lp, ck, cv = layer_in
-            x, ck, cv = _layer_verify(cfg, lp, x, ck, cv, pos, rope)
+            lp, ck, cv, ll = layer_in
+            x, ck, cv = _layer_verify(cfg, lp, x, ck, cv, pos, rope,
+                                      ll=ll, plan=plan)
             return x, (ck, cv)
 
         x, (new_k, new_v) = jax.lax.scan(
-            body, x, (params["layers"], cache["k"], cache["v"]))
+            body, x, (params["layers"], cache["k"], cache["v"], slabs))
     x = apply_norm(cfg, x, params["final_ln"]["scale"],
                    params["final_ln"]["bias"])
     logits = jnp.einsum(

@@ -1,0 +1,300 @@
+"""Multi-tenant LoRA adapters: per-request low-rank deltas over a
+frozen base model (ISSUE 20, ROADMAP item 2).
+
+Millions of users means thousands of fine-tunes, not one model.  A LoRA
+adapter is a pair of low-rank factors per target matmul — ``W' = W +
+(alpha / r) * A @ B`` with ``A [in, r]``, ``B [r, out]`` — and the
+serving question is how a heterogeneous batch (every lane a different
+adapter) shares one decode step.  Two ways to apply one:
+
+- **merge** (:func:`merge_lora`): fold the delta into the base kernels
+  and serve the merged tree.  Zero per-token overhead, but the whole
+  batch is pinned to ONE adapter, the base must stay float (an int8
+  slab cannot absorb a float delta without requantization error), and
+  switching adapters costs a full weight-set swap.  This is the
+  numerics *reference* the batched path is pinned against.
+- **batched** (:func:`batched_lora_delta`): keep the base frozen
+  (optionally int8 — the delta rides beside it, never through it),
+  stack the resident adapters' factors into ``[G, in, r]`` /
+  ``[G, r, out]`` slabs, sort the batch rows by adapter slot
+  (:func:`lora_plan`), and run the ragged grouped matmul of
+  :mod:`~apex_tpu.ops.grouped_matmul` over the sorted rows — the
+  S-LoRA computation, on the same window-offsets primitive the MoE
+  ragged path uses.  Rows with no adapter (slot 0) sort BEFORE the
+  window start (``offsets[0]``) where the grouped matmul leaves them
+  exactly zero: the no-adapter majority of a mixed batch is computed
+  for free, not through a zero-weight group.
+
+The slot index per row is a *traced* vector (the serving engine's
+``_temps`` pattern), so one compiled decode step serves every adapter
+mix — compile keys never fork per adapter.  Slabs are float32 by
+convention (adapters stay float over any base form; rank is small, the
+delta FLOPs are ~``(in + out) * r`` per row per target against the
+base's ``in * out``).
+
+Geometry (matching ``transformer_lm.init_gpt_params``): targets are
+``qkv`` ``[h, p + 2*kv]``, ``proj`` ``[p, h]``, ``fc1`` ``[h, f]`` (or
+the paired swiglu ``[h, 2, f]``, carried flattened as ``[h, 2f]`` in
+the B factor and reshaped at apply/merge time), ``fc2`` ``[f, h]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.dense import is_quantized
+from apex_tpu.ops.grouped_matmul import grouped_matmul
+
+__all__ = ["LoRAAdapter", "TARGETS", "target_shapes", "init_lora_adapter",
+           "adapter_bytes", "merge_lora", "stack_adapter_slabs",
+           "lora_plan", "batched_lora_delta", "lora_mlp"]
+
+# target matmul name -> the layer-param kernel it shadows
+TARGETS = ("qkv", "proj", "fc1", "fc2")
+_KERNEL_OF = {"qkv": "qkv_kernel", "proj": "proj_kernel",
+              "fc1": "fc1_kernel", "fc2": "fc2_kernel"}
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAAdapter:
+    """One adapter: per-target ``A [L, in, r]`` / ``B [L, r, out]``
+    factor stacks (leading layer axis, like the base layer stack) plus
+    the static rank/alpha.  Registered as a pytree (rank/alpha are aux
+    data) so an adapter jits, donates, and ``device_put``s like any
+    parameter tree.  ``out`` is flattened for multi-axis kernels (the
+    swiglu paired fc1): apply/merge reshape against the base kernel."""
+
+    rank: int
+    alpha: float
+    a: Dict[str, jax.Array]
+    b: Dict[str, jax.Array]
+
+    @property
+    def targets(self) -> Tuple[str, ...]:
+        return tuple(t for t in TARGETS if t in self.a)
+
+    @property
+    def scaling(self) -> float:
+        return float(self.alpha) / float(self.rank)
+
+
+def _lora_flatten(ad):
+    keys = tuple(sorted(ad.a))
+    children = tuple(ad.a[k] for k in keys) + tuple(ad.b[k] for k in keys)
+    return children, (ad.rank, ad.alpha, keys)
+
+
+def _lora_unflatten(aux, children):
+    rank, alpha, keys = aux
+    n = len(keys)
+    return LoRAAdapter(rank=rank, alpha=alpha,
+                       a=dict(zip(keys, children[:n])),
+                       b=dict(zip(keys, children[n:])))
+
+
+jax.tree_util.register_pytree_node(
+    LoRAAdapter, _lora_flatten, _lora_unflatten)
+
+
+def target_shapes(cfg) -> Dict[str, Tuple[int, int]]:
+    """``target -> (in_dim, out_dim_flat)`` for one layer of ``cfg``
+    (the swiglu paired fc1's trailing ``[2, f]`` flattens to ``2f``)."""
+    h = cfg.hidden_size
+    p = cfg.projection_size
+    kv = cfg.kv_projection_size
+    f = cfg.ffn_hidden_size
+    fc1_out = 2 * f if cfg.activation == "swiglu" else f
+    return {"qkv": (h, p + 2 * kv), "proj": (p, h),
+            "fc1": (h, fc1_out), "fc2": (f, h)}
+
+
+def init_lora_adapter(rng: jax.Array, cfg, *, rank: int = 8,
+                      alpha: Optional[float] = None,
+                      targets: Sequence[str] = TARGETS,
+                      b_std: float = 0.0,
+                      dtype=jnp.float32) -> LoRAAdapter:
+    """Fresh adapter for ``cfg``: ``A ~ N(0, 1/r)``, ``B`` zero (the
+    standard identity-at-init) — pass ``b_std > 0`` for a *non-trivial*
+    adapter (tests and benches need deltas that change tokens).
+    ``alpha`` defaults to ``rank`` (scaling 1)."""
+    if rank < 1:
+        raise ValueError(f"rank={rank}: need a positive LoRA rank")
+    targets = tuple(targets)
+    unknown = [t for t in targets if t not in TARGETS]
+    if unknown:
+        raise ValueError(f"unknown LoRA targets {unknown}; expected a "
+                         f"subset of {TARGETS}")
+    shapes = target_shapes(cfg)
+    L = cfg.num_layers
+    keys = jax.random.split(rng, 2 * max(len(targets), 1))
+    a, b = {}, {}
+    for i, t in enumerate(targets):
+        d_in, d_out = shapes[t]
+        a[t] = (jax.random.normal(keys[2 * i], (L, d_in, rank),
+                                  jnp.float32) / rank ** 0.5).astype(dtype)
+        bk = jax.random.normal(keys[2 * i + 1], (L, rank, d_out),
+                               jnp.float32) * b_std
+        b[t] = bk.astype(dtype)
+    return LoRAAdapter(rank=int(rank),
+                       alpha=float(rank if alpha is None else alpha),
+                       a=a, b=b)
+
+
+def adapter_bytes(adapter: LoRAAdapter) -> int:
+    """Device bytes one adapter occupies (both factors, all targets,
+    all layers) — the unit the pool's byte bound divides by."""
+    return int(sum(x.size * x.dtype.itemsize
+                   for x in jax.tree_util.tree_leaves(adapter)))
+
+
+def merge_lora(params: dict, cfg, adapter: LoRAAdapter) -> dict:
+    """The per-request merged-weights reference: a NEW params tree with
+    each target kernel replaced by ``W + scaling * A @ B`` (reshaped to
+    the kernel's layout).  Requires float target kernels — an int8 slab
+    cannot absorb a float delta; the batched path exists precisely so a
+    quantized base never has to."""
+    layers = dict(params["layers"])
+    for t in adapter.targets:
+        kname = _KERNEL_OF[t]
+        w = layers[kname]
+        if is_quantized(w):
+            raise ValueError(
+                f"merge_lora: base kernel {kname!r} is int8-quantized; "
+                "merging needs a float base — serve the adapter through "
+                "the batched path instead")
+        delta = jnp.einsum("lir,lro->lio",
+                           adapter.a[t].astype(jnp.float32),
+                           adapter.b[t].astype(jnp.float32))
+        delta = (adapter.scaling * delta).reshape(w.shape)
+        layers[kname] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def stack_adapter_slabs(adapters: Sequence[Optional[LoRAAdapter]],
+                        cfg) -> Dict[str, Dict[str, jax.Array]]:
+    """Stack ``G`` adapters into the grouped-matmul slab form:
+    ``target -> {"a": [L, G, in, r], "b": [L, G, r, out]}`` with the
+    alpha/rank scaling folded into ``b`` (one place, once).  ``None``
+    entries become zero factors (an empty pool slot contributes a zero
+    delta if a stale index ever lands on it).  All non-None adapters
+    must agree on rank, targets, and geometry — the slab is one array
+    per target, so heterogeneous ranks would need per-slot padding the
+    pool deliberately refuses (register-time validation beats a silent
+    perf cliff)."""
+    live = [a for a in adapters if a is not None]
+    if not live:
+        raise ValueError("stack_adapter_slabs: no adapters")
+    rank = live[0].rank
+    targets = live[0].targets
+    for a in live[1:]:
+        if a.rank != rank or a.targets != targets:
+            raise ValueError(
+                f"heterogeneous adapters: rank/targets "
+                f"({a.rank}, {a.targets}) vs ({rank}, {targets})")
+    shapes = target_shapes(cfg)
+    L = cfg.num_layers
+    out: Dict[str, Dict[str, jax.Array]] = {}
+    for t in targets:
+        d_in, d_out = shapes[t]
+        a_stack, b_stack = [], []
+        for ad in adapters:
+            if ad is None:
+                a_stack.append(jnp.zeros((L, d_in, rank), jnp.float32))
+                b_stack.append(jnp.zeros((L, rank, d_out), jnp.float32))
+            else:
+                a_stack.append(ad.a[t].astype(jnp.float32))
+                b_stack.append(ad.b[t].astype(jnp.float32)
+                               * ad.scaling)
+        # [L, G, in, r] / [L, G, r, out]: layer leading so the decode
+        # scan slices per-layer slabs exactly like the base kernels
+        out[t] = {"a": jnp.stack(a_stack, axis=1),
+                  "b": jnp.stack(b_stack, axis=1)}
+    return out
+
+
+def lora_plan(idx: jax.Array, n_slots: int) -> Dict[str, jax.Array]:
+    """Sort plan for one batch: ``idx`` ``[N]`` int32 per-row slot ids
+    (0 = no adapter, ``s`` in ``[1, n_slots]`` = slab ``s - 1``) →
+    ``{"order": [N], "offsets": [n_slots + 1]}``.  ``order`` is the
+    stable sort-by-slot permutation; ``offsets`` are the grouped-matmul
+    segment bounds, with the slot-0 rows packed BEFORE ``offsets[0]``
+    — outside the window, where :func:`grouped_matmul` returns exactly
+    zero (the free no-adapter path).  Everything is traced: one
+    compiled step per shape, any adapter mix."""
+    idx = idx.astype(jnp.int32)
+    order = jnp.argsort(idx, stable=True)
+    counts = jnp.bincount(idx, length=n_slots + 1)
+    offsets = jnp.cumsum(counts).astype(jnp.int32)
+    return {"order": order, "offsets": offsets}
+
+
+def batched_lora_delta(x: jax.Array, a_slab: jax.Array,
+                       b_slab: jax.Array,
+                       plan: Dict[str, jax.Array]) -> jax.Array:
+    """Heterogeneous-adapter delta for one target matmul: ``x``
+    ``[..., in]`` (leading dims flattened to the plan's ``N`` rows) →
+    ``scaling * x @ A[slot] @ B[slot]`` per row, ``[..., out]``, zero
+    for slot-0 rows.  Two ragged grouped matmuls over the sorted rows,
+    then the inverse permutation — the S-LoRA fast path.  The rank-r
+    bottleneck keeps this ~``(in + out) * r`` FLOPs/row against the
+    base matmul's ``in * out``."""
+    shape = x.shape
+    n = 1
+    for d in shape[:-1]:
+        n *= d
+    xs = x.reshape(n, shape[-1])[plan["order"]].astype(a_slab.dtype)
+    mid = grouped_matmul(xs, a_slab, plan["offsets"])
+    out = grouped_matmul(mid.astype(b_slab.dtype), b_slab,
+                         plan["offsets"])
+    delta = jnp.zeros_like(out).at[plan["order"]].set(out)
+    return delta.reshape(shape[:-1] + (b_slab.shape[-1],)).astype(x.dtype)
+
+
+def lora_mlp(cfg, lp: dict, x: jax.Array, ll: dict, plan: dict):
+    """``transformer_lm._mlp`` (single-device form) with fc1/fc2 LoRA
+    deltas spliced in at the two matmul seams.  The fc1 delta lands
+    BEFORE the activation (it changes the activation's input — a
+    post-hoc add would be a different function); the swiglu paired
+    ``[b, s, 2, f]`` layout takes the flattened delta reshaped.  Kept
+    beside the slab machinery so the decode path has one lora-aware
+    MLP, not a fork per call site."""
+    from apex_tpu.ops.dense import quantized_matmul
+    from apex_tpu.ops.swiglu import fused_bias_swiglu_paired
+
+    w1 = lp["fc1_kernel"]
+    d1 = (batched_lora_delta(x, ll["fc1"]["a"], ll["fc1"]["b"], plan)
+          if "fc1" in ll else None)
+    if cfg.activation == "swiglu":
+        if is_quantized(w1):
+            y = quantized_matmul(x, w1)               # [b, s, 2, f]
+        else:
+            y = jnp.einsum("bsh,hcf->bscf", x, w1.astype(x.dtype))
+        if d1 is not None:
+            y = y + d1.reshape(y.shape)
+        y = fused_bias_swiglu_paired(y, lp["fc1_bias"].astype(x.dtype))
+    else:
+        if is_quantized(w1):
+            y = quantized_matmul(x, w1)
+        else:
+            y = x @ w1.astype(x.dtype)
+        if d1 is not None:
+            y = y + d1.reshape(y.shape)
+        y = y + lp["fc1_bias"].astype(x.dtype)
+        y = jax.nn.gelu(
+            y.astype(jnp.float32),
+            approximate=cfg.activation == "gelu_tanh").astype(x.dtype)
+    w2 = lp["fc2_kernel"]
+    if is_quantized(w2):
+        out = quantized_matmul(y, w2)
+    else:
+        out = y @ w2.astype(x.dtype)
+    if "fc2" in ll:
+        out = out + batched_lora_delta(y, ll["fc2"]["a"],
+                                       ll["fc2"]["b"], plan)
+    return out + lp["fc2_bias"].astype(x.dtype)

@@ -157,18 +157,36 @@ def ngram_draft(tokens: jax.Array, lens: jax.Array, *, k: int,
     return jnp.take_along_axis(tokens, gidx, axis=1).astype(jnp.int32)
 
 
-def _spec_probs(logits, temperature, top_k, top_p, vocab_limit):
+def _spec_probs(logits, temperature, top_k, top_p, vocab_limit,
+                token_mask=None):
     """Per-position target distributions [b, m, v] for acceptance: the
     SAME filter chain the sampler applies (``filter_logits``), so the
     accept/resample arithmetic runs against exactly the distribution a
     non-speculative step would have sampled from.  Greedy rows
     (temperature 0) become one-hot argmax — under which the generic
-    rejection rule degenerates to exact token matching."""
+    rejection rule degenerates to exact token matching.
+
+    ``token_mask`` (constrained decoding, ISSUE 20): bool ``[v]`` or
+    per-row ``[b, v]``, applied BEFORE the filters — the same masked
+    target a non-speculative constrained step samples from.  A drafted
+    token outside the mask gets target probability 0 and is rejected
+    outright, and the correction draw comes from the masked leftover —
+    acceptance stays exact against constrained autoregression with no
+    drafter cooperation required."""
     b, m, v = logits.shape
     flat = logits.reshape(b * m, v)
     if vocab_limit is not None:
         over = jnp.arange(v) >= vocab_limit
         flat = jnp.where(over[None], _NEG_INF, flat)
+    if token_mask is not None:
+        mask = token_mask
+        if mask.ndim == 1:
+            mask = mask[None]
+        else:
+            # per-row [b, v] masks repeat across the row's m verify
+            # positions (one request, one constraint)
+            mask = jnp.repeat(mask, m, axis=0)
+        flat = jnp.where(mask, flat, _NEG_INF)
     onehot = jax.nn.one_hot(jnp.argmax(flat, axis=-1), v,
                             dtype=jnp.float32)
     if hasattr(temperature, "ndim") and getattr(temperature, "ndim", 0):
@@ -237,7 +255,8 @@ def _accept(draft, probs, q_probs, key):
 
 
 def spec_round(params, cfg, cache, nxt, tokens, lens, key, *, spec,
-               temperature, top_k=None, top_p=None, vocab_limit=None):
+               temperature, top_k=None, top_p=None, vocab_limit=None,
+               token_mask=None, lora=None):
     """One draft → verify → accept round (the shared core of
     ``generate(spec=...)``'s jitted loop and the serving engine's
     jitted multi-token step).
@@ -264,8 +283,9 @@ def spec_round(params, cfg, cache, nxt, tokens, lens, key, *, spec,
     prev_pos = cache["pos"]
     seq = jnp.concatenate([nxt[:, None].astype(jnp.int32), draft],
                           axis=1)
-    logits, cache = decode_verify(params, seq, cache, cfg)
-    probs = _spec_probs(logits, temperature, top_k, top_p, vocab_limit)
+    logits, cache = decode_verify(params, seq, cache, cfg, lora=lora)
+    probs = _spec_probs(logits, temperature, top_k, top_p, vocab_limit,
+                        token_mask=token_mask)
     n_acc, y = _accept(draft, probs, q_probs, key)
     # candidate emission: draft prefix with y scattered at column n_acc
     em = jnp.concatenate([draft, draft[:, -1:]], axis=1)

@@ -53,7 +53,8 @@ from jax.experimental import pallas as pl
 from apex_tpu.ops._pallas_utils import LANES as _LANES
 from apex_tpu.utils.registry import on_tpu
 
-__all__ = ["fused_sample", "filter_logits", "sample_reference"]
+__all__ = ["fused_sample", "filter_logits", "sample_reference",
+           "apply_token_mask"]
 
 _NEG_INF = -1e30
 # bisection trip count: each iteration halves the value interval, so 64
@@ -108,15 +109,35 @@ def _mask_vocab(logits, vocab_limit):
     return jnp.where(over[None], _NEG_INF, logits)
 
 
+def apply_token_mask(logits, token_mask):
+    """Constrained decoding (ISSUE 20): zero out disallowed tokens
+    BEFORE any temperature/top-k/top-p work.  ``token_mask`` is a bool
+    ``[v]`` (one constraint for the whole batch) or ``[b, v]``
+    (per-row, the serving engine's per-request JSON-mode masks), True =
+    allowed.  Masking ahead of the filters is what keeps the filtered
+    distribution a proper renormalization of the allowed set — masking
+    after top-k could leave fewer than k live tokens of the ALLOWED
+    set and silently sharpen the draw."""
+    if token_mask is None:
+        return logits
+    mask = token_mask
+    if mask.ndim == 1:
+        mask = mask[None]
+    return jnp.where(mask, logits, _NEG_INF)
+
+
 def sample_reference(logits, key, *, temperature=0.0,
                      top_k: Optional[int] = None,
                      top_p: Optional[float] = None,
-                     vocab_limit: Optional[int] = None):
+                     vocab_limit: Optional[int] = None,
+                     token_mask=None):
     """The XLA composition (numerics oracle): bit-identical to the
     historical ``sample_logits`` for a scalar ``temperature`` and to
     the serving engine's mixed-temperature sampler for a ``[b]``
-    vector, given the same key."""
-    logits = _mask_vocab(logits, vocab_limit)
+    vector, given the same key (and, with ``token_mask=None``, to the
+    pre-constrained-decoding sampler exactly)."""
+    logits = apply_token_mask(_mask_vocab(logits, vocab_limit),
+                              token_mask)
     if not (hasattr(temperature, "ndim") and temperature.ndim):
         # static scalar: greedy short-circuits ALL filtering work — the
         # cutoffs cannot change the argmax (tests pin the equivalence)
@@ -186,9 +207,14 @@ def _sampling_kernel(top_k, top_p, n_valid, *refs):
 
     if top_k is not None and top_k < n_valid:
         # k-th largest by bisection: the largest t with
-        # count(y >= t) >= k is exactly the k-th value
-        lo0 = jnp.min(jnp.where(valid, y, m))
+        # count(y >= t) >= k is exactly the k-th value.  The range must
+        # span only LIVE entries (the nucleus branch's discipline): a
+        # token mask leaves -1e30 holes inside the vocab window, and a
+        # range that wide turns 64 halvings into a useless resolution —
+        # the cutoff would never resolve between finite logits and the
+        # filter silently keeps the whole allowed set
         hi0 = jnp.max(y)
+        lo0 = jnp.min(jnp.where(y > _NEG_INF / 2, y, hi0))
 
         def kth_body(_, carry):
             lo, hi = carry
@@ -306,6 +332,7 @@ def fused_sample(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     vocab_limit: Optional[int] = None,
+    token_mask=None,
     backend: Optional[str] = None,
 ) -> jax.Array:
     """Sample next tokens ``[b]`` from ``logits`` ``[b, v]`` with the
@@ -325,10 +352,16 @@ def fused_sample(
     historical ``sample_logits`` given the same key; the kernel path
     selects the same support (greedy rows exactly) but draws through an
     in-kernel counter-based generator, so its parity is distributional
-    (χ² — tests/test_fused_sampling.py)."""
+    (χ² — tests/test_fused_sampling.py).
+
+    ``token_mask``: optional bool ``[v]`` / ``[b, v]`` allowed-token
+    mask (constrained decoding, e.g. a JSON-mode token set), applied
+    before every filter on BOTH paths — the kernel sees pre-masked
+    logits, so its bisection cutoffs resolve over the allowed set."""
     if top_k is not None and top_k < 1:
         raise ValueError(
             f"top_k={top_k}: pass None (not 0) to disable the cutoff")
+    logits = apply_token_mask(logits, token_mask)
     static_temp = not (hasattr(temperature, "ndim")
                       and getattr(temperature, "ndim", 0))
     if static_temp and float(temperature) < 0:

@@ -1,0 +1,339 @@
+"""Refcounted HBM slab pool for LoRA adapters (ISSUE 20).
+
+The batched LoRA decode path (:mod:`apex_tpu.models.lora`) consumes
+stacked ``[L, G, in, r]`` / ``[L, G, r, out]`` factor slabs and a
+per-lane *slot index*.  This pool owns those slabs with the
+``paged_cache.py`` ledger discipline:
+
+- **register** an adapter by id (host-side catalog; geometry validated
+  against the pool's first adapter — the slab is one array per target,
+  so rank/target mixes are refused at the door, not discovered as a
+  shape error inside a jitted step);
+- **acquire** at admission: a resident adapter's slot is a refcount
+  bump; a miss pages the factors into a free slot — evicting the
+  least-recently-used ZERO-REF resident when the pool is full — and
+  returns ``None`` when every slot is pinned by a live lane (admission
+  blocks; refs are held only by active lanes, so the engine's normal
+  completion/preemption flow guarantees progress);
+- **release** at completion/preemption/drain: at zero refs the adapter
+  stays resident (warm for the next burst — this is what the router's
+  adapter-affinity scoring is steering toward) and becomes evictable.
+
+Slot count is STATIC after the first build: the slab arrays keep one
+shape, the per-lane index is a traced vector, and compile keys never
+fork per adapter.  The byte bound (``pool_bytes`` /
+``APEX_TPU_ADAPTER_POOL_BYTES``, suffix parsing shared with the
+host-tier knob) divides by the uniform per-adapter footprint to fix
+the slot count; ``slots=`` pins it directly.
+
+The ledger is a true partition: every slot is exactly one of free,
+pinned (refs > 0), or evictable (resident at zero refs) — ``census()``
+asserts it, and the serving tests churn it through eviction, preempt,
+and drain.
+
+Telemetry (``serving.adapter.*``, no-op unless configured):
+``serving.adapter.{hits,misses,evictions}`` counters,
+``serving.adapter.{resident,bytes}`` gauges.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from apex_tpu.observability import metrics as _telemetry
+from apex_tpu.serving.host_tier import _parse_bytes
+
+__all__ = ["AdapterPool", "resolve_adapter_pool_bytes"]
+
+
+def resolve_adapter_pool_bytes(value) -> Optional[int]:
+    """The adapter-pool capacity knob: ``APEX_TPU_ADAPTER_POOL_BYTES``
+    beats the caller's ``pool_bytes=`` (positive byte count — plain int
+    or ``256m``/``2g``-suffixed string; ``off``/``0`` = no byte bound);
+    malformed env values warn BY NAME and fall back to the caller's
+    value — the ``APEX_TPU_HOST_TIER_BYTES`` override discipline."""
+    raw = os.environ.get("APEX_TPU_ADAPTER_POOL_BYTES")
+    if raw is not None:
+        if raw.strip().lower() in ("off", "0"):
+            return None
+        try:
+            return _parse_bytes(raw)
+        except ValueError:
+            warnings.warn(
+                f"APEX_TPU_ADAPTER_POOL_BYTES={raw!r} is malformed "
+                "(expected a positive byte count like 268435456 or "
+                "256m, or off/0 for no byte bound); using the "
+                "caller's pool_bytes", stacklevel=3)
+    if value is None:
+        return None
+    if isinstance(value, str):
+        if value.strip().lower() in ("off", "0"):
+            return None
+        return _parse_bytes(value)
+    if int(value) < 1:
+        raise ValueError(
+            f"pool_bytes={value} must be >= 1 (or None for no byte "
+            "bound)")
+    return int(value)
+
+
+class AdapterPool:
+    """Refcounted LRU slab pool over ``G`` adapter slots (see module
+    doc).  ``slots=`` pins the slot count; otherwise ``pool_bytes``
+    (env-overridable) divides by the per-adapter footprint at first
+    build; with neither, the pool defaults to 8 slots."""
+
+    DEFAULT_SLOTS = 8
+    # count bound on the resident-id inventory a worker piggybacks on
+    # its poll reply (the digest-inventory discipline: the control
+    # plane stays cheap no matter how many adapters are registered)
+    INVENTORY_N = 64
+
+    def __init__(self, cfg, *, slots: Optional[int] = None,
+                 pool_bytes=None):
+        if slots is not None and int(slots) < 1:
+            raise ValueError(f"slots={slots}: need >= 1 adapter slots")
+        self.cfg = cfg
+        self._slots_arg = None if slots is None else int(slots)
+        self._pool_bytes = resolve_adapter_pool_bytes(pool_bytes)
+        # host-side catalog: adapter_id -> LoRAAdapter
+        self._registry: Dict[int, object] = {}     # guarded-by: confined(engine-loop)
+        self._adapter_bytes: Optional[int] = None
+        # device slabs, built lazily at first acquire (slot count needs
+        # the per-adapter footprint); shape static afterwards
+        self._slabs = None                         # guarded-by: confined(engine-loop)
+        self.n_slots: Optional[int] = None
+        self._slot_of: Dict[int, int] = {}         # adapter_id -> slot
+        self._ids: List[Optional[int]] = []        # slot -> adapter_id
+        self._refs: List[int] = []                 # slot -> live lanes
+        # zero-ref residents in LRU order (evictable set)
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- catalog ------------------------------------------------------------
+
+    def register(self, adapter_id: int, adapter) -> None:
+        """Catalog one adapter under a positive integer id (0 is the
+        reserved no-adapter id).  Geometry must match the pool's first
+        adapter; re-registering an id replaces its factors only while
+        the adapter is NOT resident (a resident swap would silently
+        change live lanes' weights)."""
+        from apex_tpu.models.lora import adapter_bytes
+
+        aid = int(adapter_id)
+        if aid < 1:
+            raise ValueError(
+                f"adapter_id={adapter_id}: ids start at 1 (0 is the "
+                "no-adapter sentinel)")
+        if self._registry:
+            ref = next(iter(self._registry.values()))
+            if (adapter.rank != ref.rank
+                    or adapter.targets != ref.targets):
+                raise ValueError(
+                    f"adapter {aid}: rank/targets ({adapter.rank}, "
+                    f"{adapter.targets}) do not match the pool's "
+                    f"({ref.rank}, {ref.targets}) — one slab per "
+                    "target means uniform geometry")
+        if aid in self._slot_of:
+            raise ValueError(
+                f"adapter {aid} is resident; evict it (drop all refs "
+                "and let LRU churn it out) before re-registering")
+        self._registry[aid] = adapter
+        if self._adapter_bytes is None:
+            self._adapter_bytes = adapter_bytes(adapter)
+
+    def registered(self, adapter_id: int) -> bool:
+        return int(adapter_id) in self._registry
+
+    # -- slab build ---------------------------------------------------------
+
+    def _resolve_slots(self) -> int:
+        if self._slots_arg is not None:
+            return self._slots_arg
+        if self._pool_bytes is not None:
+            per = self._adapter_bytes or 1
+            n = self._pool_bytes // per
+            if n < 1:
+                raise ValueError(
+                    f"APEX_TPU_ADAPTER_POOL_BYTES/pool_bytes "
+                    f"({self._pool_bytes}) is smaller than one "
+                    f"adapter ({per} bytes) — the pool cannot hold "
+                    "anything")
+            return int(n)
+        return self.DEFAULT_SLOTS
+
+    def _build(self) -> None:
+        from apex_tpu.models.lora import stack_adapter_slabs
+
+        self.n_slots = self._resolve_slots()
+        self._ids = [None] * self.n_slots
+        self._refs = [0] * self.n_slots
+        # zero-filled slabs via one template adapter (None slots)
+        template = next(iter(self._registry.values()))
+        self._slabs = stack_adapter_slabs(
+            [None] * (self.n_slots - 1) + [template], self.cfg)
+        # slot n_slots-1 holds real factors from the template; wipe it
+        # back to zero by scattering zeros (uniform build path)
+        self._scatter(self.n_slots - 1, None)
+
+    def _scatter(self, slot: int, adapter) -> None:
+        """Write one slot of every slab (zeros when ``adapter`` is
+        ``None``) — a host-driven ``.at[:, slot].set`` per factor, the
+        page-in cost an admission miss pays."""
+        import jax.numpy as jnp
+
+        for t, pair in self._slabs.items():
+            for fk in ("a", "b"):
+                arr = pair[fk]
+                if adapter is None:
+                    val = jnp.zeros(arr.shape[:1] + arr.shape[2:],
+                                    arr.dtype)
+                else:
+                    val = getattr(adapter, fk)[t].astype(arr.dtype)
+                    if fk == "b":
+                        val = val * adapter.scaling
+                pair[fk] = arr.at[:, slot].set(val)
+
+    # -- the ledger ---------------------------------------------------------
+
+    def acquire(self, adapter_id: int) -> Optional[int]:
+        """Pin one adapter for a lane → its 1-based lane slab index
+        (``slot + 1``; 0 stays the traced no-adapter id), or ``None``
+        when every slot is pinned (the caller blocks admission).
+        Unregistered ids raise — submit validates, so this firing
+        means a bookkeeping bug, not user input."""
+        aid = int(adapter_id)
+        if aid == 0:
+            return 0
+        if aid not in self._registry:
+            raise KeyError(f"adapter {aid} is not registered")
+        if self._slabs is None:
+            self._build()
+        slot = self._slot_of.get(aid)
+        if slot is not None:
+            self._refs[slot] += 1
+            self._lru.pop(aid, None)
+            self.hits += 1
+            _telemetry.counter("serving.adapter.hits").inc()
+            self._set_gauges()
+            return slot + 1
+        self.misses += 1
+        _telemetry.counter("serving.adapter.misses").inc()
+        slot = self._free_slot()
+        if slot is None:
+            return None
+        self._scatter(slot, self._registry[aid])
+        self._ids[slot] = aid
+        self._slot_of[aid] = slot
+        self._refs[slot] = 1
+        self._set_gauges()
+        return slot + 1
+
+    def _free_slot(self) -> Optional[int]:
+        for s, aid in enumerate(self._ids):
+            if aid is None:
+                return s
+        if self._lru:
+            victim, _ = self._lru.popitem(last=False)
+            s = self._slot_of.pop(victim)
+            self._ids[s] = None
+            self._refs[s] = 0
+            self.evictions += 1
+            _telemetry.counter("serving.adapter.evictions").inc()
+            return s
+        return None                    # every slot pinned: block
+
+    def release(self, adapter_id: int) -> None:
+        """Drop one lane's pin; at zero refs the adapter becomes
+        LRU-evictable but stays resident (warm)."""
+        aid = int(adapter_id)
+        if aid == 0:
+            return
+        slot = self._slot_of.get(aid)
+        if slot is None or self._refs[slot] < 1:
+            raise RuntimeError(
+                f"release of adapter {aid} without a matching acquire "
+                "— the refcount ledger is corrupt")
+        self._refs[slot] -= 1
+        if self._refs[slot] == 0:
+            self._lru[aid] = None
+        self._set_gauges()
+
+    # -- read side ----------------------------------------------------------
+
+    def slabs(self):
+        """The device slab dict the decode step consumes (built on
+        first use so an all-base workload never allocates it)."""
+        if self._slabs is None:
+            if not self._registry:
+                raise RuntimeError(
+                    "AdapterPool.slabs() before any register()")
+            self._build()
+        return self._slabs
+
+    def resident_ids(self) -> List[int]:
+        """Resident adapter ids (pinned + warm), count-bounded — the
+        inventory a decode worker piggybacks on its poll reply for the
+        router's adapter-affinity scoring."""
+        ids = [aid for aid in self._ids if aid is not None]
+        return ids[:self.INVENTORY_N]
+
+    def census(self) -> dict:
+        """Ledger partition check: every slot is exactly one of free /
+        pinned / evictable, and the evictable set mirrors the LRU.
+        Raises on any violation (the dryrun gate calls this after
+        churn); returns the counts."""
+        free = pinned = evictable = 0
+        for s, aid in enumerate(self._ids):
+            if aid is None:
+                if self._refs[s] != 0:
+                    raise AssertionError(
+                        f"slot {s}: free but refs={self._refs[s]}")
+                free += 1
+            elif self._refs[s] > 0:
+                if aid in self._lru:
+                    raise AssertionError(
+                        f"adapter {aid}: pinned AND evictable")
+                pinned += 1
+            else:
+                if aid not in self._lru:
+                    raise AssertionError(
+                        f"adapter {aid}: zero refs but not in the "
+                        "LRU order")
+                evictable += 1
+        if evictable != len(self._lru):
+            raise AssertionError(
+                f"LRU holds {len(self._lru)} ids but {evictable} "
+                "slots are evictable")
+        if free + pinned + evictable != (self.n_slots or 0):
+            raise AssertionError("slot classes do not partition")
+        return {"free": free, "pinned": pinned,
+                "evictable": evictable}
+
+    def stats(self) -> dict:
+        resident = [aid for aid in self._ids if aid is not None]
+        return {
+            "slots": self.n_slots or 0,
+            "registered": len(self._registry),
+            "resident": len(resident),
+            "resident_ids": self.resident_ids(),
+            "pinned_refs": sum(self._refs),
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "adapter_bytes": self._adapter_bytes or 0,
+            "pool_bytes": ((self.n_slots or 0)
+                           * (self._adapter_bytes or 0)),
+        }
+
+    def _set_gauges(self) -> None:
+        _telemetry.gauge("serving.adapter.resident").set(
+            sum(1 for aid in self._ids if aid is not None))
+        _telemetry.gauge("serving.adapter.bytes").set(
+            sum(1 for aid in self._ids if aid is not None)
+            * (self._adapter_bytes or 0))

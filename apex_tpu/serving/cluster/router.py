@@ -295,12 +295,16 @@ class Router:
     def submit(self, prompt, *, max_new_tokens: int = 32,
                temperature: float = 0.0,
                eos_token_id: Optional[int] = None,
-               slo_class: str = "default") -> int:
+               slo_class: str = "default",
+               adapter_id: int = 0) -> int:
         """Admit one request → rid, or raise :class:`RouterBusy` when
         the class's router queue is at its cap (shed load explicitly;
         the caller decides whether to retry, downgrade the class, or
         surface a 429)."""
         slo_class = str(slo_class)
+        adapter_id = int(adapter_id)
+        if adapter_id < 0:
+            raise ValueError("adapter_id must be >= 0")
         q = self._queues.setdefault(slo_class, deque())
         cap = self._caps.get(slo_class)
         if cap is not None and len(q) >= cap:
@@ -318,7 +322,8 @@ class Router:
             rid=rid, prompt=prompt,
             kwargs=dict(max_new_tokens=int(max_new_tokens),
                         temperature=float(temperature),
-                        eos_token_id=eos_token_id),
+                        eos_token_id=eos_token_id,
+                        adapter_id=adapter_id),
             slo_class=slo_class, submitted_t=time.perf_counter())
         q.append(pend)
         self._set_gauges()
@@ -482,6 +487,20 @@ class Router:
                     break
         return score
 
+    @staticmethod
+    def _adapter_affinity(pend: _Pending, w: _Worker) -> int:
+        """Adapter-residency affinity (ISSUE 20): 1 when the worker's
+        adapter pool reports the request's LoRA adapter resident (the
+        slab is already in HBM — dispatch skips a slab upload and a
+        possible eviction), else 0.  Base requests (adapter_id 0) and
+        workers that predate the inventory score 0 and fall through to
+        prefix affinity / headroom ordering."""
+        aid = pend.kwargs.get("adapter_id", 0)
+        if not aid:
+            return 0
+        inv = w.stats.get("adapter_pool") or {}
+        return 1 if aid in (inv.get("resident_ids") or ()) else 0
+
     def _pick_decode(self, pend: Optional[_Pending] = None
                      ) -> Optional[_Worker]:
         """The decode worker already holding the request's prefix
@@ -518,13 +537,20 @@ class Router:
             # pool).
             unit = (w.stats.get("block_size")
                     or w.stats.get("max_len", 1))
-            key = (self._affinity(pend, w) if pend is not None else 0,
+            # adapter affinity outranks prefix affinity: a slab miss
+            # stalls ADMISSION (upload + possible eviction churn) while
+            # a prefix miss only costs a redundant prefill
+            key = (self._adapter_affinity(pend, w)
+                   if pend is not None else 0,
+                   self._affinity(pend, w) if pend is not None else 0,
                    _headroom_tokens(w.stats)
                    - w.dispatched_since_poll * unit,
                    -backlog)
             if best_key is None or key > best_key:
                 best, best_key = w, key
         if best is not None and best_key[0] > 0:
+            _telemetry.counter("cluster.adapter_affinity_hits").inc()
+        if best is not None and best_key[1] > 0:
             _telemetry.counter("cluster.prefix_affinity_hits").inc()
         return best
 
@@ -560,6 +586,7 @@ class Router:
                     "op": "prefill",
                     "prompt": [int(t) for t in pend.prompt],
                     "temperature": pend.kwargs["temperature"],
+                    "adapter_id": pend.kwargs.get("adapter_id", 0),
                     "wire_dtype": self.wire_dtype,
                 })
             except WorkerDied as e:
@@ -881,6 +908,7 @@ class Router:
                 "max_new_tokens": int(rec["max_new_tokens"]),
                 "temperature": float(rec.get("temperature", 0.0)),
                 "eos_token_id": rec.get("eos_token_id"),
+                "adapter_id": int(rec.get("adapter_id", 0)),
             }, rblobs)
         except WorkerDied as e:
             self._feed_pool("decode", False, str(e))

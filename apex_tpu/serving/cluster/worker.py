@@ -61,9 +61,28 @@ from apex_tpu.serving.cluster.handoff import (
     WIRE_DTYPES, decode_kv, encode_kv, wire_bytes)
 
 __all__ = ["WorkerServer", "spawn_worker", "spawn_worker_async",
-           "PendingWorker", "shutdown_worker", "READY_PREFIX"]
+           "PendingWorker", "shutdown_worker", "build_adapter_suite",
+           "READY_PREFIX"]
 
 READY_PREFIX = "APEX_TPU_CLUSTER_WORKER ready"
+
+
+def build_adapter_suite(cfg, n: int, seed: int = 0, rank: int = 8):
+    """Deterministic LoRA adapters 1..n from ``(seed, geometry)`` —
+    the same contract :func:`_build_model` keeps for the base weights
+    (ISSUE 20): every pool member (and the single-engine baseline in
+    bench/tests) materializes IDENTICAL adapters from a few integers,
+    so no slab ever ships over the wire.  ``b_std > 0`` makes the
+    deltas behaviourally visible (a zero-init B is a no-op adapter and
+    would pin nothing)."""
+    import jax
+
+    from apex_tpu.models.lora import init_lora_adapter
+
+    return {aid: init_lora_adapter(
+                jax.random.PRNGKey(seed * 100_003 + aid), cfg,
+                rank=rank, b_std=0.02)
+            for aid in range(1, int(n) + 1)}
 
 
 @dataclasses.dataclass
@@ -82,6 +101,13 @@ class _PrefillExec:
     sample_fn: object
     key: object
     calls: int = 0
+    # multi-tenant LoRA (ISSUE 20): the deterministic adapter suite
+    # and a per-adapter single-entry slab cache (lane 0 = base rides
+    # alongside, so the SAME ragged-grouped-matmul trace family the
+    # decode engine runs covers the prefill forward too — a raw-wire
+    # adapter handoff continues bit-exactly)
+    adapters: dict = dataclasses.field(default_factory=dict)
+    slab_cache: dict = dataclasses.field(default_factory=dict)
 
 
 class WorkerServer:
@@ -97,7 +123,9 @@ class WorkerServer:
                  wire_dtype: str = "raw", seed: int = 0,
                  chunk_tokens: Optional[int] = None,
                  compile_cache: Optional[str] = None,
-                 host_tier_bytes=None, host_tier_wire=None):
+                 host_tier_bytes=None, host_tier_wire=None,
+                 adapters: int = 0,
+                 adapter_pool_bytes=None):
         if role not in ("prefill", "decode"):
             raise ValueError(f"role={role!r}: expected 'prefill' or "
                              "'decode'")
@@ -131,7 +159,22 @@ class WorkerServer:
         # draining (ISSUE 15): set by the drain RPC — new decode work
         # is refused while the pool member's state migrates out
         self._draining = False                          # guarded-by: confined(serve-loop)
+        # multi-tenant LoRA (ISSUE 20): both roles grow the SAME
+        # deterministic suite from (seed, geometry) — the decode side
+        # registers it on a refcounted HBM slab pool behind its
+        # engine, the prefill side keeps per-adapter single-entry
+        # slabs for its stateless forward
+        self.n_adapters = int(adapters)
+        suite = (build_adapter_suite(cfg, self.n_adapters, seed)
+                 if self.n_adapters else {})
         if role == "decode":
+            pool = None
+            if suite:
+                from apex_tpu.serving.adapter_pool import AdapterPool
+
+                pool = AdapterPool(cfg, pool_bytes=adapter_pool_bytes)
+                for aid, ad in suite.items():
+                    pool.register(aid, ad)
             self.engine = ServingEngine(
                 params, cfg, max_slots=max_slots, max_len=self._max_len,
                 cache_layout=cache_layout, block_size=block_size,
@@ -142,6 +185,7 @@ class WorkerServer:
                 host_tier_bytes=host_tier_bytes,
                 host_tier_wire=host_tier_wire,
                 compile_cache_dir=compile_cache,
+                adapter_pool=pool,
                 rng=jax.random.PRNGKey(seed))
         else:
             dt = cfg.compute_dtype if cache_dtype is None else cache_dtype
@@ -151,7 +195,8 @@ class WorkerServer:
                 cache_dtype=jnp.dtype(dt),
                 scratch_layout=scratch_layout, block_size=block_size,
                 sample_fn=_make_sample_fn(top_k, top_p, vocab_limit),
-                key=jax.random.PRNGKey(seed))
+                key=jax.random.PRNGKey(seed),
+                adapters=suite)
         self._listener = socket.socket(socket.AF_INET,
                                        socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET,
@@ -309,6 +354,7 @@ class WorkerServer:
                 "temperature": rec["temperature"],
                 "eos_token_id": rec["eos_token_id"],
                 "slo_class": rec["slo_class"],
+                "adapter_id": rec.get("adapter_id", 0),
                 "prefill_ms": rec["prefill_ms"],
                 # source-leg accounting: the survivor's response
                 # covers only ITS leg, so the router stitches these
@@ -358,6 +404,12 @@ class WorkerServer:
         prompt = np.asarray(header["prompt"], np.int32).reshape(-1)
         if prompt.size < 1:
             return {"ok": False, "error": "empty prompt"}, []
+        adapter_id = int(header.get("adapter_id", 0))
+        if adapter_id and adapter_id not in ex.adapters:
+            return {"ok": False,
+                    "error": f"adapter_id={adapter_id} not in this "
+                             f"worker's suite (--adapters "
+                             f"{len(ex.adapters)})"}, []
         temperature = float(header.get("temperature", 0.0))
         wire_dtype = header.get("wire_dtype", self.wire_dtype)
         n = int(prompt.size)
@@ -365,7 +417,24 @@ class WorkerServer:
         bucket = pick_bucket(n, ex.buckets)
         padded = jnp.asarray(pad_prompt(prompt, bucket)[None])
         lens = jnp.asarray([n], jnp.int32)
-        if ex.scratch_layout == "paged":
+        if adapter_id:
+            # LoRA prefill (ISSUE 20): the verification forward with
+            # the adapter's delta folded in — the SAME traced family
+            # the decode engine's adapter admission runs, so the
+            # raw-wire handoff continues bit-exactly.  Contiguous
+            # scratch regardless of scratch_layout: adapter pages are
+            # never digest-shareable, so the block-table extraction
+            # path buys nothing here.
+            from apex_tpu.models.generate import decode_verify
+
+            scratch = init_kv_cache(ex.cfg, 1, bucket,
+                                    cache_dtype=ex.cache_dtype)
+            logits, cache = decode_verify(
+                ex.params, padded, scratch, ex.cfg,
+                lora={"idx": jnp.ones((1,), jnp.int32),
+                      "slabs": self._adapter_slabs(adapter_id)})
+            logits = logits[:, n - 1]
+        elif ex.scratch_layout == "paged":
             scratch = init_kv_cache(
                 ex.cfg, 1, bucket, cache_dtype=ex.cache_dtype,
                 cache_layout="paged", block_size=ex.block_size)
@@ -386,12 +455,25 @@ class WorkerServer:
         ex.calls += 1
         # prefill_pages marks the payload as fresh whole-prompt prefill
         # output (never decode-written drain records) — the decode side
-        # may publish raw-wire pages under the flash digest namespace
+        # may publish raw-wire pages under the flash digest namespace.
+        # Adapter pages never qualify: their content is tenant-specific.
         return {"ok": True, "first_token": tok, "n": n,
                 "prefill_ms": round(ms, 3),
                 "handoff_bytes": wire_bytes(kv_blobs),
-                "prefill_pages": True,
+                "prefill_pages": adapter_id == 0,
                 "kv": kv_header}, kv_blobs
+
+    def _adapter_slabs(self, adapter_id: int):
+        """Single-adapter slab stack for the prefill forward (lane 0 =
+        base, lane 1 = the adapter), built once per adapter and cached
+        — the stack itself is host work the hot path must not repeat."""
+        ex = self._exec
+        if adapter_id not in ex.slab_cache:
+            from apex_tpu.models.lora import stack_adapter_slabs
+
+            ex.slab_cache[adapter_id] = stack_adapter_slabs(
+                [ex.adapters[adapter_id]], ex.cfg)
+        return ex.slab_cache[adapter_id]
 
     def _handle_decode(self, header: dict, blobs: List[bytes]):
         if self.engine is None:
@@ -406,11 +488,14 @@ class WorkerServer:
         k, v = decode_kv(header["kv"], blobs)
         prompt = np.asarray(header["prompt"], np.int32).reshape(-1)
         rid = header.get("rid")
+        adapter_id = int(header.get("adapter_id", 0))
         # only raw-wire fresh-prefill pages are bit-identical to a local
         # flash prefill (the digest contract is bitwise page identity);
-        # drain-migration records omit prefill_pages and stay private
+        # drain-migration records omit prefill_pages and stay private.
+        # Adapter pages are tenant-specific — never shareable.
         shareable = (bool(header.get("prefill_pages"))
-                     and header["kv"].get("wire_dtype") == "raw")
+                     and header["kv"].get("wire_dtype") == "raw"
+                     and adapter_id == 0)
         eng_rid = self.engine.submit_prefilled(
             prompt, k, v, int(header["first_token"]),
             max_new_tokens=int(header.get("max_new_tokens", 32)),
@@ -418,7 +503,7 @@ class WorkerServer:
             eos_token_id=header.get("eos_token_id"),
             slo_class=str(header.get("slo_class", "default")),
             prefill_ms=float(header.get("prefill_ms", 0.0)),
-            shareable=shareable)
+            shareable=shareable, adapter_id=adapter_id)
         self._ridmap[eng_rid] = (rid if rid is not None else eng_rid,
                                  time.time())
         return {"ok": True, "accepted": True, "engine_rid": eng_rid}, []
@@ -527,6 +612,15 @@ def main(argv=None) -> int:
                     choices=("raw", "int8"),
                     help="host-tier at-rest codec "
                          "(APEX_TPU_HOST_TIER_WIRE overrides)")
+    ap.add_argument("--adapters", type=int, default=0,
+                    help="register this many synthetic LoRA adapters "
+                         "(ISSUE 20): ids 1..N; prefill workers keep "
+                         "per-adapter G=1 slabs, decode workers pool "
+                         "them for heterogeneous batched decode")
+    ap.add_argument("--adapter-pool-bytes", default=None,
+                    help="HBM budget for the decode-side adapter slab "
+                         "pool; accepts 256m/2g suffixes "
+                         "(APEX_TPU_ADAPTER_POOL_BYTES overrides)")
     ap.add_argument("--compile-cache", default=None,
                     help="persistent compile-cache directory "
                          "(ISSUE 17): the decode engine loads its "
@@ -559,7 +653,9 @@ def main(argv=None) -> int:
         chunk_tokens=args.chunk_tokens,
         host_tier_bytes=args.host_tier_bytes,
         host_tier_wire=args.host_tier_wire,
-        compile_cache=args.compile_cache)
+        compile_cache=args.compile_cache,
+        adapters=args.adapters,
+        adapter_pool_bytes=args.adapter_pool_bytes)
     if server.engine is not None and server.engine._compile_cache:
         # AOT-warm the whole ladder BEFORE declaring READY: a primed
         # cache dir turns this into a few deserialize calls, and the

@@ -116,6 +116,7 @@ from apex_tpu.models.config import TransformerConfig
 from apex_tpu.models.generate import (
     _check_decode_cfg, decode_step, decode_verify, extract_kv,
     init_kv_cache, prefill, sample_logits)
+from apex_tpu.ops.fused_sampling import apply_token_mask
 from apex_tpu.models.speculative import resolve_spec, spec_round
 from apex_tpu.observability import metrics as _telemetry
 from apex_tpu.observability import span
@@ -203,6 +204,22 @@ class Request:
     # wire, drain-migration records carrying decode-written tokens)
     # keeps the no-alias rule and claims fresh unpublished blocks.
     handoff_shareable: bool = False
+    # multi-tenant LoRA (ISSUE 20): id of the adapter this request
+    # decodes through, 0 = base model.  The id indexes the engine's
+    # AdapterPool; admission pins a slab lane for the request's whole
+    # residency and the decode step folds the lane's low-rank delta in
+    # via ragged grouped matmuls — the base weights never change.
+    adapter_id: int = 0
+    # constrained decoding (ISSUE 20 satellite): boolean [vocab] mask,
+    # True = token allowed.  Applied to the logits BEFORE temperature /
+    # top-k / top-p in every sampling site (prefill sample, decode
+    # step, spec draft+verify), so greedy and sampled paths agree.
+    token_mask: Optional[np.ndarray] = dataclasses.field(
+        default=None, repr=False)
+    # the 1-based AdapterPool lane acquire() pinned for this request
+    # (0 = no ref held) — release paths key off it, never off
+    # adapter_id alone, so double-release is structurally impossible
+    _lane: int = dataclasses.field(default=0, repr=False)
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -216,6 +233,16 @@ class Request:
                 f"temperature={self.temperature}: negative temperatures "
                 "would silently invert the distribution; pass 0 for "
                 "greedy or a positive value")
+        if self.adapter_id < 0:
+            raise ValueError(
+                f"adapter_id={self.adapter_id} must be >= 0 (0 = base)")
+        if self.token_mask is not None:
+            self.token_mask = np.asarray(self.token_mask,
+                                         bool).reshape(-1)
+            if not self.token_mask.any():
+                raise ValueError(
+                    "token_mask allows no tokens — sampling would "
+                    "degenerate to argmax over -inf")
 
 
 @dataclasses.dataclass
@@ -367,6 +394,8 @@ class ServingEngine:
                  host_tier_bytes: Optional[int] = None,
                  host_tier_wire: Optional[str] = None,
                  compile_cache_dir: Optional[str] = None,
+                 adapter_pool=None,
+                 token_masks: bool = False,
                  rng: Optional[jax.Array] = None):
         _check_decode_cfg(cfg)
         if cache_layout not in ("contiguous", "paged"):
@@ -512,6 +541,19 @@ class ServingEngine:
             self._hist_len = jnp.zeros((self.max_slots,), jnp.int32)
         else:
             self._history = self._hist_len = None
+        # multi-tenant LoRA (ISSUE 20): the refcounted HBM slab pool
+        # adapters page through, and the per-lane slab index mirror
+        # (0 = base) jnp.asarray'd into the traced step each poll —
+        # the SAME host-mirror pattern _pending/_temps use, so compile
+        # keys never fork per adapter.
+        self._adapters = adapter_pool
+        self._lane_slab = np.zeros((self.max_slots,), np.int32)
+        # constrained decoding (ISSUE 20 satellite): per-lane boolean
+        # vocab masks, all-True for unconstrained lanes.  Allocated
+        # only when the caller opts in — an extra [slots, vocab] host
+        # array plus one more traced operand is not free.
+        self._masks = (np.ones((self.max_slots, cfg.vocab_size), bool)
+                       if token_masks else None)
         self._next_id = 0
         self._decode_count = 0
         self._preempt_count = 0
@@ -544,13 +586,49 @@ class ServingEngine:
     def submit(self, prompt, *, max_new_tokens: int = 32,
                temperature: float = 0.0,
                eos_token_id: Optional[int] = None,
-               slo_class: str = "default") -> int:
+               slo_class: str = "default",
+               adapter_id: int = 0,
+               token_mask_fn=None) -> int:
         """Queue one request; returns its request id.  ``slo_class``
         keys the engine's deadline table (``slo_targets=``) and labels
-        the request's latency sketches + goodput verdict."""
+        the request's latency sketches + goodput verdict.
+
+        ``adapter_id`` (ISSUE 20) selects a LoRA adapter previously
+        :meth:`AdapterPool.register`-ed on the engine's pool; 0 = base
+        model.  ``token_mask_fn`` (constrained decoding) is called once
+        with the vocab size and must return either a boolean ``[vocab]``
+        allow-mask or an iterable of allowed token ids; the mask is
+        applied before temperature/top-k/top-p at every sampling site."""
+        if adapter_id:
+            if self._adapters is None:
+                raise ValueError(
+                    f"adapter_id={adapter_id} but the engine has no "
+                    "adapter_pool — pass adapter_pool= at construction")
+            if not self._adapters.registered(adapter_id):
+                raise ValueError(
+                    f"adapter_id={adapter_id} is not registered on the "
+                    "engine's adapter pool")
+        token_mask = None
+        if token_mask_fn is not None:
+            if self._masks is None:
+                raise ValueError(
+                    "token_mask_fn= needs token_masks=True at engine "
+                    "construction (the traced step gains a mask operand)")
+            m = token_mask_fn(self.cfg.vocab_size)
+            m = np.asarray(m)
+            if m.dtype != np.bool_:
+                ids = m.astype(np.int64).reshape(-1)
+                m = np.zeros((self.cfg.vocab_size,), bool)
+                m[ids] = True
+            if m.shape != (self.cfg.vocab_size,):
+                raise ValueError(
+                    f"token_mask_fn returned shape {m.shape}; expected "
+                    f"({self.cfg.vocab_size},) or a list of token ids")
+            token_mask = m
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
-                      request_id=self._next_id, slo_class=str(slo_class))
+                      request_id=self._next_id, slo_class=str(slo_class),
+                      adapter_id=int(adapter_id), token_mask=token_mask)
         if req.prompt.size + req.max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({req.prompt.size}) + max_new_tokens "
@@ -567,6 +645,10 @@ class ServingEngine:
         req.submitted_t = time.perf_counter()
         self._queue.append(req)
         _telemetry.counter("serving.requests").inc()
+        if req.adapter_id:
+            _telemetry.counter(
+                "serving.adapter.requests",
+                {"adapter": str(req.adapter_id)}).inc()
         # paired with serving.request.end at completion: the trace sink
         # renders the pair as one async per-request latency row
         _telemetry.event("serving.request.begin", id=req.request_id,
@@ -582,7 +664,8 @@ class ServingEngine:
                          eos_token_id: Optional[int] = None,
                          slo_class: str = "default",
                          prefill_ms: float = 0.0,
-                         shareable: bool = False) -> int:
+                         shareable: bool = False,
+                         adapter_id: int = 0) -> int:
         """Queue a request whose prefill already happened ELSEWHERE —
         the decode half of prefill/decode disaggregation (ISSUE 9).
 
@@ -611,10 +694,26 @@ class ServingEngine:
         prefix blocks instead of rewriting them and publishes its own
         full prompt blocks for later sharers.  If the request is later
         preempted the handoff is dropped and resume replays through
-        the local prefill path."""
+        the local prefill path.
+
+        ``adapter_id`` (ISSUE 20): the adapter the remote prefill ran
+        through — decode must fold the SAME adapter's delta or the
+        continuation forks from the prefill distribution.  Adapter
+        handoffs are never shareable: the K/V is adapter-specific."""
+        if adapter_id:
+            if self._adapters is None:
+                raise ValueError(
+                    f"adapter_id={adapter_id} but the engine has no "
+                    "adapter_pool — pass adapter_pool= at construction")
+            if not self._adapters.registered(adapter_id):
+                raise ValueError(
+                    f"adapter_id={adapter_id} is not registered on the "
+                    "engine's adapter pool")
+            shareable = False
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, eos_token_id=eos_token_id,
-                      request_id=self._next_id, slo_class=str(slo_class))
+                      request_id=self._next_id, slo_class=str(slo_class),
+                      adapter_id=int(adapter_id))
         if req.prompt.size + req.max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({req.prompt.size}) + max_new_tokens "
@@ -639,6 +738,10 @@ class ServingEngine:
         req.submitted_t = time.perf_counter()
         self._queue.append(req)
         _telemetry.counter("serving.requests").inc()
+        if req.adapter_id:
+            _telemetry.counter(
+                "serving.adapter.requests",
+                {"adapter": str(req.adapter_id)}).inc()
         _telemetry.event("serving.request.begin", id=req.request_id,
                          prompt_tokens=int(req.prompt.size),
                          max_new_tokens=req.max_new_tokens,
@@ -787,6 +890,10 @@ class ServingEngine:
             out["free_block_headroom"] = self._pool.n_free
             # contiguous admission reserves a whole stripe per request
             out["headroom_tokens"] = self._pool.n_free * self.max_len
+        if self._adapters is not None:
+            # rides the cluster poll reply for free (ISSUE 20): the
+            # router folds resident_ids into adapter-affinity routing
+            out["adapter_pool"] = self._adapters.stats()
         return out
 
     def drain(self) -> Tuple[List[dict], List[Request]]:
@@ -846,12 +953,15 @@ class ServingEngine:
                     "preemptions": req.preemptions,
                     "decode_polls": st.decode_polls,
                     "prefill_ms": st.prefill_ms,
+                    "adapter_id": req.adapter_id,
                     "k": np.asarray(k),
                     "v": np.asarray(v),
                 })
+            self._release_adapter(req)
             self._slots[slot] = None
             self._pending[slot] = 0
             self._temps[slot] = 0.0
+            self._lane_slab[slot] = 0
             if self._mgr is not None:
                 self._tables[slot, :] = self.num_blocks
                 self._mgr.free_all(st.blocks)
@@ -945,8 +1055,12 @@ class ServingEngine:
         Only prompts longer than one chunk (a short prompt IS one
         chunk — the monolithic path is strictly better for it) and
         never KV handoffs (their pages come off the wire, not from a
-        prefill)."""
-        if not self.chunk_tokens or req.handoff is not None:
+        prefill).  Adapter requests (ISSUE 20) also skip it: their
+        prefill runs the LoRA-capable verify forward in one shot, and
+        their adapter-specific pages must never publish into the
+        chunk digest namespace anyway."""
+        if (not self.chunk_tokens or req.handoff is not None
+                or req.adapter_id):
             return False
         return (req.prompt.size + len(req.resume_tokens)
                 > self.chunk_tokens)
@@ -1056,6 +1170,18 @@ class ServingEngine:
                         req.request_id,
                         req.prompt.size + len(req.resume_tokens) - 1)
                 break
+            if req.adapter_id and not req._lane:
+                # pin the adapter's slab lane for the request's whole
+                # residency BEFORE claiming the slot (ISSUE 20).  None
+                # = every pool lane is pinned by live requests — wait
+                # for a completion to unpin one, exactly like the
+                # block-budget wait above.  Admission order stays FIFO:
+                # a later base-model request must not jump a blocked
+                # adapter head (it would starve the adapter class).
+                lane = self._adapters.acquire(req.adapter_id)
+                if lane is None:
+                    break
+                req._lane = lane
             self._queue.popleft()
             slot = self._pool.claim()
             try:
@@ -1074,11 +1200,45 @@ class ServingEngine:
                 # not a scan over the sorted active tuple.)
                 if (self._slots[slot] is None
                         and self._pool.is_active(slot)):
+                    self._release_adapter(req)
                     self._pool.release(slot)
                     self._queue.appendleft(req)
                     self._set_gauges()
                 raise
         return completed
+
+    def _release_adapter(self, req: Request) -> None:
+        """Drop the request's adapter-pool pin, if it holds one.  Every
+        slot-teardown edge (complete, preempt, drain, admission unwind)
+        funnels through here so the pool ledger stays a true partition
+        — ``req._lane`` being 1-based-or-zero makes double-release
+        structurally impossible."""
+        if self._adapters is not None and req._lane:
+            self._adapters.release(req.adapter_id)
+            req._lane = 0
+
+    def _mask_arg(self, req: Request) -> tuple:
+        """Constrained-decoding operand for one request's sampling
+        sites: ``()`` when masks are off (existing call avals — and
+        therefore compile-cache keys — stay untouched), else a 1-tuple
+        holding the request's 1-D boolean allow-mask (all-True for
+        unconstrained requests, so one trace serves both)."""
+        if self._masks is None:
+            return ()
+        m = (req.token_mask if req.token_mask is not None
+             else np.ones((self.cfg.vocab_size,), bool))
+        return (jnp.asarray(m),)
+
+    def _bind_slot_lane(self, req: Request, slot: int) -> None:
+        """Stamp the lane-local traced-operand mirrors at slot handoff
+        (ISSUE 20): the adapter slab index and, when constrained
+        decoding is on, the request's vocab mask row.  Every teardown
+        edge resets both."""
+        self._lane_slab[slot] = req._lane
+        if self._masks is not None:
+            self._masks[slot, :] = (req.token_mask
+                                    if req.token_mask is not None
+                                    else True)
 
     def _claim_blocks(self, tokens: np.ndarray, hashes: List[bytes]):
         """Map/allocate the block list for ``tokens`` (``hashes`` =
@@ -1250,7 +1410,9 @@ class ServingEngine:
         return dict(cache_wire=self.cache_wire,
                     cache_layout=self.cache_layout, spec=self._spec,
                     chunk_tokens=self.chunk_tokens,
-                    decode_fused=self._decode_fused, **extra)
+                    decode_fused=self._decode_fused,
+                    lora=self._adapters is not None,
+                    masked=self._masks is not None, **extra)
 
     def _cc(self, name: str, jitfn, args: tuple, static=None, **parts):
         """Route one jitted call through the persistent compile cache
@@ -1369,6 +1531,12 @@ class ServingEngine:
             _telemetry.counter("serving.host_tier.replays").inc()
         if self._chunked(req):
             return self._admit_one_chunked(req, slot)
+        if req.adapter_id and req.handoff is None:
+            # adapter prefill (ISSUE 20) runs the LoRA-capable verify
+            # forward — the flash prefill kernel has no delta hook.
+            # Handoff admissions stay below: their pages come off the
+            # wire and only DECODE needs the adapter.
+            return self._admit_one_adapter(req, slot)
         completed: List[Response] = []
         hashes: List[bytes] = []
         page_ins: List[tuple] = []
@@ -1432,7 +1600,7 @@ class ServingEngine:
                         "sample", self._sample_fn,
                         (logits,
                          jnp.asarray([req.temperature], jnp.float32),
-                         sub))
+                         sub) + self._mask_arg(req))
                     tok = int(np.asarray(first)[0])      # host sync
             if self._mgr is not None:
                 self._tables[slot, :] = self.num_blocks
@@ -1490,10 +1658,103 @@ class ServingEngine:
         self._slots[slot] = st
         self._pending[slot] = tok
         self._temps[slot] = req.temperature
+        self._bind_slot_lane(req, slot)
         if self._spec is not None:
             # the drafter's haystack: everything emitted so far,
             # pending token included.  Padded host-side so the device
             # row write is ONE fixed-shape op regardless of length.
+            row = np.zeros((self.max_len,), np.int32)
+            row[: n] = tokens
+            row[n] = tok
+            self._history = self._history.at[slot].set(jnp.asarray(row))
+            self._hist_len = self._hist_len.at[slot].set(n + 1)
+        done = self._finish_reason(st, tok)
+        if done:
+            completed.append(self._complete(slot, done))
+        return completed
+
+    def _admit_one_adapter(self, req: Request, slot: int
+                           ) -> List[Response]:
+        """Admit one LoRA request (ISSUE 20): prefill the whole prompt
+        through the verify forward with the request's adapter delta
+        folded in — the same traced family the cluster prefill worker
+        uses, so a raw-wire handoff continues bit-exactly.  Blocks are
+        always claimed FRESH and never published: adapter K/V is
+        adapter-specific, and aliasing it into the base-model digest
+        namespace would serve one tenant another tenant's attention
+        state."""
+        completed: List[Response] = []
+        tokens = self._full_tokens(req)
+        n = int(tokens.size)
+        bucket = pick_bucket(n, self.buckets)
+        blocks: List[int] = []
+        if self._mgr is not None:
+            blocks, _wids, _shared, _pi = self._claim_blocks_fresh(n)
+        t0 = time.perf_counter()
+        if req.admitted_t == 0.0:
+            req.admitted_t = t0
+            req.queue_wait_s = t0 - req.submitted_t
+        try:
+            if self._mgr is not None:
+                # the verify forward writes THROUGH the block tables,
+                # so the lane's table must exist before the call (the
+                # monolithic path stamps it after its row-insert)
+                self._tables[slot, :] = self.num_blocks
+                self._tables[slot, : len(blocks)] = blocks
+                self._blocks_hw = max(self._blocks_hw,
+                                      self._mgr.n_in_use)
+            with span("serving.lora_prefill"), \
+                    compile_label("serving.prefill"):
+                padded = pad_prompt(tokens, bucket)
+                slabs = self._adapters.slabs()
+                args = (self.params, self.cache,
+                        jnp.asarray(padded[None]), jnp.int32(n),
+                        jnp.int32(slot),
+                        jnp.asarray([req._lane], jnp.int32), slabs)
+                if self._mgr is not None:
+                    args += (jnp.asarray(self._tables[slot]),)
+                logits, self.cache = self._cc(
+                    "lora_prefill",
+                    _make_lora_prefill_fn(self.cfg,
+                                          self._mgr is not None),
+                    args, bucket=bucket)
+                self._key, sub = jax.random.split(self._key)
+                first = self._cc(
+                    "sample", self._sample_fn,
+                    (logits[:, n - 1],
+                     jnp.asarray([req.temperature], jnp.float32),
+                     sub) + self._mask_arg(req))
+                tok = int(np.asarray(first)[0])          # host sync
+            now = time.perf_counter()
+            ms = (now - t0) * 1e3
+            if req.first_token_t == 0.0:
+                req.first_token_t = now
+                _telemetry.event("serving.request.first_token",
+                                 id=req.request_id,
+                                 slo_class=req.slo_class)
+            if req.preempted_t:
+                req.preempt_overhead_s += now - req.preempted_t
+                req.preempted_t = 0.0
+            _telemetry.counter("serving.prefill_calls").inc()
+            _telemetry.histogram("serving.prefill_ms").observe(ms)
+            _telemetry.counter("serving.tokens_generated").inc()
+            if _telemetry.enabled():
+                sample_device_memory()
+            st = _Slot(request=req,
+                       tokens=list(req.resume_tokens) + [tok],
+                       prefill_ms=ms, blocks=blocks, cache_len=n,
+                       shared_blocks=0,
+                       decode_polls=req.resume_polls)
+        except Exception:
+            if self._mgr is not None:
+                self._mgr.free_all(blocks)
+                self._tables[slot, :] = self.num_blocks
+            raise
+        self._slots[slot] = st
+        self._pending[slot] = tok
+        self._temps[slot] = req.temperature
+        self._bind_slot_lane(req, slot)
+        if self._spec is not None:
             row = np.zeros((self.max_len,), np.int32)
             row[: n] = tokens
             row[n] = tok
@@ -1563,6 +1824,7 @@ class ServingEngine:
         tok = int(req.resume_tokens[-1])
         self._pending[slot] = tok
         self._temps[slot] = req.temperature
+        self._bind_slot_lane(req, slot)
         if self._spec is not None:
             tokens = self._full_tokens(req)
             n = int(tokens.size)
@@ -1641,6 +1903,7 @@ class ServingEngine:
                             if self._mgr is not None else 0))
         self._pending[slot] = 0
         self._temps[slot] = 0.0
+        self._bind_slot_lane(req, slot)
         return []
 
     def _prefill_chunk_once(self) -> List[Response]:
@@ -1694,7 +1957,8 @@ class ServingEngine:
                 first = self._cc(
                     "sample", self._sample_fn,
                     (logits[:, n - 1 - lo],
-                     jnp.asarray([req.temperature], jnp.float32), sub))
+                     jnp.asarray([req.temperature], jnp.float32), sub)
+                    + self._mask_arg(req))
                 tok = int(np.asarray(first)[0])      # host sync
         now = time.perf_counter()
         st.prefill_ms += (now - t0) * 1e3
@@ -1831,10 +2095,16 @@ class ServingEngine:
         self._slots[slot] = None
         self._pending[slot] = 0
         self._temps[slot] = 0.0
+        self._lane_slab[slot] = 0
         self._tables[slot, :] = self.num_blocks
         self._mgr.free_all(st.blocks)
         self._pool.release(slot)
         req = st.request
+        # drop the adapter pin across the requeue wait: a preempted
+        # tenant must not hold a slab lane hostage while it has no
+        # cache pages either (re-admission re-acquires, possibly
+        # paging the adapter back in — churn the pool counters see)
+        self._release_adapter(req)
         req.resume_tokens = list(st.tokens)
         # an injected handoff dies with its blocks: resume pages the
         # parked copy back in, or replays prompt+generated through the
@@ -1903,6 +2173,20 @@ class ServingEngine:
         t0 = time.perf_counter()
         self._key, sub = jax.random.split(self._key)
         em_host = acc_host = nxt_host = None
+        # LoRA / constrained-decoding operands (ISSUE 20): appended
+        # ONLY when the engine was built with them, so a plain engine's
+        # call avals — and its persistent compile-cache keys — never
+        # change.  The lane vector and mask rows are host mirrors
+        # uploaded per step (same pattern as _pending/_temps); the
+        # slabs are fetched fresh each poll so an eviction between
+        # polls is always visible to the next step.
+        extra = ()
+        if self._adapters is not None or self._masks is not None:
+            extra = ((jnp.asarray(self._lane_slab),
+                      self._adapters.slabs())
+                     if self._adapters is not None else (None, None))
+            extra += ((jnp.asarray(self._masks),)
+                      if self._masks is not None else (None,))
         with compile_label("serving.decode"):
             # exactly ONE compile should ever land on this label; a
             # second is the static-shape discipline breaking
@@ -1916,7 +2200,7 @@ class ServingEngine:
                          jnp.asarray(active), sub]
                 (em, n_acc, self.cache, self._history,
                  self._hist_len) = self._cc("decode", self._decode_fn,
-                                            tuple(args))
+                                            tuple(args) + extra)
                 em_host = np.asarray(em)             # host sync
                 acc_host = np.asarray(n_acc)
             elif self._mgr is not None:
@@ -1925,14 +2209,14 @@ class ServingEngine:
                     (self.params, self.cache, jnp.asarray(self._tables),
                      jnp.asarray(self._pending),
                      jnp.asarray(self._temps), jnp.asarray(active),
-                     sub))
+                     sub) + extra)
                 nxt_host = np.asarray(nxt)           # host sync
             else:
                 nxt, self.cache = self._cc(
                     "decode", self._decode_fn,
                     (self.params, self.cache, jnp.asarray(self._pending),
                      jnp.asarray(self._temps), jnp.asarray(active),
-                     sub))
+                     sub) + extra)
                 nxt_host = np.asarray(nxt)           # host sync
         dt = time.perf_counter() - t0
         _telemetry.counter("serving.decode_steps").inc()
@@ -1998,6 +2282,7 @@ class ServingEngine:
         st = self._slots[slot]
         self._slots[slot] = None
         self._temps[slot] = 0.0
+        self._lane_slab[slot] = 0
         if self._mgr is not None:
             if self._host is not None:
                 # completion is the other cold-prefix eviction edge: a
@@ -2008,6 +2293,7 @@ class ServingEngine:
             self._mgr.free_all(st.blocks)
         self._pool.release(slot)
         req = st.request
+        self._release_adapter(req)
         now = time.perf_counter()
         # -- SLO accounting (ISSUE 7): the per-request measurements,
         # their per-class sketches, and the goodput verdict ------------
@@ -2091,10 +2377,19 @@ class ServingEngine:
 # -- jitted pieces ----------------------------------------------------------
 
 
-def _mixed_sample(logits, temps, key, *, top_k, top_p, vocab_limit):
+def _mixed_sample(logits, temps, key, token_mask=None, *,
+                  top_k, top_p, vocab_limit):
     """Per-row temperature sampling: greedy rows (temp == 0) take the
     argmax, the rest sample at temperature 1 over pre-scaled logits —
-    one traced [b] vector, no recompile per request mix."""
+    one traced [b] vector, no recompile per request mix.
+
+    ``token_mask`` (constrained decoding, ISSUE 20 satellite) is a
+    boolean allow-mask ([vocab] or [b, vocab]) applied BEFORE the
+    temperature/top-k/top-p chain, so greedy and sampled rows see the
+    same restricted support.  It is a POSITIONAL arg (default None =
+    no extra traced operand) so unconstrained engines keep their
+    existing call avals and compile-cache keys."""
+    logits = apply_token_mask(logits, token_mask)
     greedy = sample_logits(logits, key, temperature=0.0,
                            vocab_limit=vocab_limit)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
@@ -2134,14 +2429,17 @@ def _make_decode_fn(cfg, top_k, top_p, vocab_limit, paged, spec=None,
 
     if spec is not None:
         def _spec_step(params, cache, tables, history, hist_lens,
-                       tokens, temps, active, key):
+                       tokens, temps, active, key,
+                       lane=None, slabs=None, masks=None):
             prev_pos = cache["pos"]
             full = cache if tables is None else dict(
                 cache, block_tables=tables)
+            lora = (None if lane is None
+                    else {"idx": lane, "slabs": slabs})
             em, n_acc, _y, new, _prev = spec_round(
                 params, cfg, full, tokens, history, hist_lens, key,
                 spec=spec, temperature=temps, top_k=top_k, top_p=top_p,
-                vocab_limit=vocab_limit)
+                vocab_limit=vocab_limit, token_mask=masks, lora=lora)
             n_raw = n_acc + 1
             # key-generic rebuild: an int8 pool carries k_scale/v_scale
             # alongside k/v — whatever the layout stores rides through
@@ -2170,28 +2468,34 @@ def _make_decode_fn(cfg, top_k, top_p, vocab_limit, paged, spec=None,
             @functools.partial(jax.jit, donate_argnames=(
                 "cache", "history", "hist_lens"))
             def step_fn(params, cache, tables, history, hist_lens,
-                        tokens, temps, active, key):
+                        tokens, temps, active, key,
+                        lane=None, slabs=None, masks=None):
                 return _spec_step(params, cache, tables, history,
-                                  hist_lens, tokens, temps, active, key)
+                                  hist_lens, tokens, temps, active, key,
+                                  lane, slabs, masks)
 
             return step_fn
 
         @functools.partial(jax.jit, donate_argnames=(
             "cache", "history", "hist_lens"))
         def step_fn(params, cache, history, hist_lens, tokens, temps,
-                    active, key):
+                    active, key, lane=None, slabs=None, masks=None):
             return _spec_step(params, cache, None, history, hist_lens,
-                              tokens, temps, active, key)
+                              tokens, temps, active, key,
+                              lane, slabs, masks)
 
         return step_fn
 
     if paged:
         @functools.partial(jax.jit, donate_argnames=("cache",))
-        def step_fn(params, cache, tables, tokens, temps, active, key):
+        def step_fn(params, cache, tables, tokens, temps, active, key,
+                    lane=None, slabs=None, masks=None):
             prev_pos = cache["pos"]
             logits, new = decode_step(
                 params, tokens, dict(cache, block_tables=tables), cfg,
-                decode_fused=decode_fused)
+                decode_fused=decode_fused,
+                lora=(None if lane is None
+                      else {"idx": lane, "slabs": slabs}))
             # free lanes ride along: frozen position + sentinel table
             # rows (writes drop), so they can't corrupt live blocks.
             # Key-generic rebuild so the int8 pool's scale arrays ride
@@ -2199,22 +2503,26 @@ def _make_decode_fn(cfg, top_k, top_p, vocab_limit, paged, spec=None,
             cache = {kk: vv for kk, vv in new.items()
                      if kk not in ("pos", "block_tables")}
             cache["pos"] = jnp.where(active, new["pos"], prev_pos)
-            nxt = _mixed_sample(logits, temps, key, top_k=top_k,
+            nxt = _mixed_sample(logits, temps, key, masks, top_k=top_k,
                                 top_p=top_p, vocab_limit=vocab_limit)
             return nxt, cache
 
         return step_fn
 
     @functools.partial(jax.jit, donate_argnames=("cache",))
-    def step_fn(params, cache, tokens, temps, active, key):
+    def step_fn(params, cache, tokens, temps, active, key,
+                lane=None, slabs=None, masks=None):
         prev_pos = cache["pos"]
         logits, cache = decode_step(params, tokens, cache, cfg,
-                                    decode_fused=decode_fused)
+                                    decode_fused=decode_fused,
+                                    lora=(None if lane is None
+                                          else {"idx": lane,
+                                                "slabs": slabs}))
         # free slots ride along; freezing their position keeps their
         # lane from walking off the cache during long droughts
         cache = dict(cache, pos=jnp.where(active, cache["pos"], prev_pos))
-        nxt = _mixed_sample(logits, temps, key, top_k=top_k, top_p=top_p,
-                            vocab_limit=vocab_limit)
+        nxt = _mixed_sample(logits, temps, key, masks, top_k=top_k,
+                            top_p=top_p, vocab_limit=vocab_limit)
         return nxt, cache
 
     return step_fn
@@ -2268,6 +2576,55 @@ def _make_chunk_fn(cfg, paged):
         }
 
     return chunk_fn
+
+
+@functools.lru_cache(maxsize=None)
+def _make_lora_prefill_fn(cfg, paged):
+    """One compiled LoRA prefill (ISSUE 20), memoized like
+    :func:`_make_chunk_fn`.  The whole bucket-padded prompt runs as a
+    single b=1 verification forward at position 0 with the request's
+    adapter delta folded in — :func:`~apex_tpu.models.generate.
+    decode_verify` is the one forward that threads the ragged-grouped-
+    matmul delta, so adapter prefill reuses its machinery instead of
+    growing a second flash-prefill variant.  The cluster prefill
+    worker runs the SAME traced family, which is what makes a raw-wire
+    adapter handoff continue bit-exactly on the decode worker."""
+
+    if paged:
+        @functools.partial(jax.jit, donate_argnames=("cache",))
+        def lora_prefill_fn(params, cache, prompt, n, slot, lane,
+                            slabs, table_row):
+            sub = {kk: vv for kk, vv in cache.items() if kk != "pos"}
+            sub["pos"] = jnp.zeros((1,), jnp.int32)
+            sub["block_tables"] = table_row[None]
+            logits, new = decode_verify(
+                params, prompt, sub, cfg,
+                lora={"idx": lane, "slabs": slabs})
+            out = {kk: vv for kk, vv in new.items()
+                   if kk not in ("pos", "block_tables")}
+            out["pos"] = cache["pos"].at[slot].set(n)
+            return logits, out
+
+        return lora_prefill_fn
+
+    @functools.partial(jax.jit, donate_argnames=("cache",))
+    def lora_prefill_fn(params, cache, prompt, n, slot, lane, slabs):
+        k_row = jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1)
+        v_row = jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1)
+        sub = {"k": k_row, "v": v_row,
+               "pos": jnp.zeros((1,), jnp.int32)}
+        logits, new = decode_verify(
+            params, prompt, sub, cfg,
+            lora={"idx": lane, "slabs": slabs})
+        return logits, {
+            "k": jax.lax.dynamic_update_slice_in_dim(
+                cache["k"], new["k"], slot, axis=1),
+            "v": jax.lax.dynamic_update_slice_in_dim(
+                cache["v"], new["v"], slot, axis=1),
+            "pos": cache["pos"].at[slot].set(n),
+        }
+
+    return lora_prefill_fn
 
 
 @functools.partial(jax.jit, donate_argnames=("cache",))

@@ -1513,6 +1513,148 @@ def bench_host_tier_ablation(platform="cpu", modes=("off", "on")):
     return rows
 
 
+def bench_adapter_ablation(platform="cpu", counts=(1, 8, 64)):
+    """Multi-tenant LoRA serving ablation (ISSUE 20): one decode
+    engine serving ``count`` DISTINCT adapters, three ways at batch
+    parity (same prompts, same ``max_slots``):
+
+    - **batched** — the ragged grouped-matmul path: an
+      :class:`AdapterPool` smaller than the tenant count (the LRU
+      churns), heterogeneous adapter ids across co-resident lanes,
+      one engine for the whole mix;
+    - **merged** — the classic single-tenant fast path: adapter 1
+      folded into the base weights (``merge_lora``), the same batch on
+      one engine.  The ISSUE 20 gate is batched >= 0.8x THIS row's
+      tokens/s — heterogeneity must cost little vs the best
+      homogeneous case;
+    - **sequential** — the only way merged weights serve many tenants:
+      one merge + one solo run per adapter, summed.  This is the
+      baseline that degrades with tenant count (batching is lost), and
+      its per-request greedy tokens are the merged-weights REFERENCE
+      the batched mix must match token-for-token.
+
+    Every row carries the pool-churn ledger (hits/misses/evictions,
+    preemptions, zero pinned refs after drain + a ``census()``
+    partition check) and backend/skipped — off-TPU the tokens/s are
+    same-backend ratios, not chip rates."""
+    import time as _time
+
+    from apex_tpu.models.config import TransformerConfig
+    from apex_tpu.models.lora import merge_lora
+    from apex_tpu.models.transformer_lm import init_gpt_params
+    from apex_tpu.serving import ServingEngine
+    from apex_tpu.serving.adapter_pool import AdapterPool
+    from apex_tpu.serving.cluster.worker import build_adapter_suite
+
+    cfg = TransformerConfig(
+        num_layers=2, hidden_size=128, num_attention_heads=4,
+        vocab_size=256, max_position_embeddings=256,
+        compute_dtype=jnp.float32, remat=False)
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    suite = build_adapter_suite(cfg, max(counts), rank=4)
+    geometry = dict(max_slots=4, max_len=64, prompt_buckets=(16,),
+                    cache_layout="paged", block_size=8,
+                    num_blocks=48, reserve_blocks=0)
+    # > max_slots so admission never blocks on a pinned-full pool, but
+    # far below 64 registered tenants so the LRU actually churns
+    POOL_SLOTS = 6
+
+    def trace(count):
+        r = np.random.RandomState(1000 + count)
+        return [dict(prompt=r.randint(0, 256, (16,)).astype(np.int32),
+                     max_new_tokens=8, adapter_id=(i % count) + 1)
+                for i in range(count)]
+
+    def drive(eng, reqs, with_adapter):
+        return eng.run([
+            dict(prompt=r["prompt"].copy(),
+                 max_new_tokens=r["max_new_tokens"],
+                 **({"adapter_id": r["adapter_id"]}
+                    if with_adapter else {}))
+            for r in reqs])
+
+    def pooled_engine(count):
+        pool = AdapterPool(cfg, slots=POOL_SLOTS)
+        for aid in range(1, count + 1):
+            pool.register(aid, suite[aid])
+        return ServingEngine(params, cfg, adapter_pool=pool,
+                             **geometry), pool
+
+    # warmup compiles: the ragged batched-delta decode step and the
+    # plain merged step are distinct jit keys
+    warm_count = min(2, max(counts))
+    weng, _ = pooled_engine(warm_count)
+    drive(weng, trace(warm_count), True)
+    drive(ServingEngine(merge_lora(params, cfg, suite[1]), cfg,
+                        **geometry), trace(warm_count), False)
+
+    rows = {"backend": platform, "skipped": False,
+            "counts": list(counts), "pool_slots": POOL_SLOTS,
+            "batch_slots": geometry["max_slots"]}
+    for count in counts:
+        reqs = trace(count)
+
+        # -- batched: heterogeneous lanes through one pooled engine --
+        eng, pool = pooled_engine(count)
+        t0 = _time.perf_counter()
+        resps = drive(eng, reqs, True)
+        bwall = _time.perf_counter() - t0
+        gen = sum(int(r.tokens.size) for r in resps)
+        batched_tokens = [tuple(r.tokens.tolist()) for r in
+                          sorted(resps, key=lambda r: r.request_id)]
+        pst, est = pool.stats(), eng.stats()
+        batched = {"tokens_per_sec": round(gen / max(bwall, 1e-9), 2),
+                   "pool_hits": pst["hits"],
+                   "pool_misses": pst["misses"],
+                   "pool_evictions": pst["evictions"],
+                   "pinned_refs_after": pst["pinned_refs"],
+                   "preemptions": est["preemptions"],
+                   "blocks_leaked": est["blocks_in_use"],
+                   "pool_census": pool.census()}
+
+        # -- merged: adapter 1 folded into the weights, same batch ---
+        meng = ServingEngine(merge_lora(params, cfg, suite[1]), cfg,
+                             **geometry)
+        t0 = _time.perf_counter()
+        mresps = drive(meng, reqs, False)
+        mwall = _time.perf_counter() - t0
+        merged = {"tokens_per_sec": round(
+            sum(int(r.tokens.size) for r in mresps)
+            / max(mwall, 1e-9), 2)}
+
+        # -- sequential: one merge + one solo run per tenant ---------
+        seq_tokens = [None] * count
+        swall = sgen = 0.0
+        for aid in sorted({r["adapter_id"] for r in reqs}):
+            idxs = [i for i, r in enumerate(reqs)
+                    if r["adapter_id"] == aid]
+            t0 = _time.perf_counter()
+            seng = ServingEngine(merge_lora(params, cfg, suite[aid]),
+                                 cfg, **geometry)
+            srs = drive(seng, [reqs[i] for i in idxs], False)
+            swall += _time.perf_counter() - t0
+            sgen += sum(int(r.tokens.size) for r in srs)
+            for i, r in zip(idxs, sorted(
+                    srs, key=lambda x: x.request_id)):
+                seq_tokens[i] = tuple(r.tokens.tolist())
+        sequential = {"tokens_per_sec": round(
+            sgen / max(swall, 1e-9), 2)}
+
+        row = {"batched": batched, "merged": merged,
+               "sequential": sequential,
+               # THE GATE: every heterogeneous greedy stream must
+               # match its per-request merged-weights reference
+               "token_identical": batched_tokens == seq_tokens,
+               "batched_over_merged": round(
+                   batched["tokens_per_sec"]
+                   / max(merged["tokens_per_sec"], 1e-9), 3),
+               "batched_over_sequential": round(
+                   batched["tokens_per_sec"]
+                   / max(sequential["tokens_per_sec"], 1e-9), 3)}
+        rows[f"adapters_{count}"] = row
+    return rows
+
+
 # the controller-trace engine geometry (larger than _TRACE_ENGINE so a
 # long prompt + chunking have room)
 _CTRL_ENGINE = dict(max_slots=3, max_len=96, block_size=8,
@@ -2689,6 +2831,16 @@ def main():
              "back in from host DRAM; ISSUE 18) instead of the full "
              "inference matrix")
     parser.add_argument(
+        "--adapters", default=None, metavar="COUNTS",
+        help="comma list of distinct-adapter counts (e.g. 1,8,64): "
+             "with --decode, run ONLY the multi-tenant LoRA serving "
+             "ablation (bench_adapter_ablation — heterogeneous "
+             "batched decode via ragged grouped matmul vs the merged-"
+             "weights engine at batch parity vs the sequential per-"
+             "adapter baseline, plus greedy token identity against "
+             "the merged reference and the adapter-pool churn ledger; "
+             "ISSUE 20) instead of the full inference matrix")
+    parser.add_argument(
         "--spec", default=None, metavar="SPECS",
         help="comma list of speculative-decoding modes (off, ngram): "
              "with --decode, run ONLY the spec ablation rows "
@@ -2739,6 +2891,25 @@ def main():
                          "rows")
         if args.spec is not None or args.cache_dtype is not None:
             parser.error("--host-tier is its own ablation; run "
+                         "--spec/--cache-dtype as separate "
+                         "invocations")
+    adapter_counts = None
+    if args.adapters is not None:
+        try:
+            adapter_counts = tuple(
+                int(c.strip()) for c in args.adapters.split(",")
+                if c.strip())
+        except ValueError:
+            adapter_counts = ()
+        if not adapter_counts or any(c < 1 for c in adapter_counts):
+            parser.error(f"--adapters {args.adapters!r}: expected a "
+                         "comma list of positive adapter counts "
+                         "(e.g. 1,8,64)")
+        if not args.decode:
+            parser.error("--adapters only applies to the --decode "
+                         "rows")
+        if args.spec is not None or args.cache_dtype is not None:
+            parser.error("--adapters is its own ablation; run "
                          "--spec/--cache-dtype as separate "
                          "invocations")
     spec_modes = None
@@ -3007,6 +3178,39 @@ def main():
             "backend": platform,
             "skipped": skipped,
             "details": {"host_tier_ablation": rows},
+            "runtime": runtime_summary(),
+        }))
+        return
+    if args.decode and adapter_counts:
+        try:
+            rows = bench_adapter_ablation(platform=platform,
+                                          counts=adapter_counts)
+        except Exception as e:
+            rows = {"error": f"{type(e).__name__}: {e}"[:200]}
+        if "error" in rows:
+            skipped = f"bench_adapter_ablation failed: {rows['error']}"
+        elif not on_tpu:
+            # CPU-smoke honesty: tokens/s off-chip are same-backend
+            # ratios, not chip rates — batched_over_merged, the token-
+            # identity column and the pool-churn ledger are the
+            # portable signal
+            skipped = ("cpu smoke: tokens/s are same-backend ratios, "
+                       "not chip rates — use batched_over_merged + "
+                       "token_identical + the pool ledger")
+        else:
+            skipped = False
+        head = rows.get(f"adapters_{max(adapter_counts)}", {})
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "metric": "multi_tenant_lora_ablation",
+            # headline: batched heterogeneous decode over the single-
+            # merged-adapter engine at batch parity, at the largest
+            # tenant count (the ISSUE 20 >= 0.8x gate)
+            "value": head.get("batched_over_merged", 0.0),
+            "unit": "x",
+            "backend": platform,
+            "skipped": skipped,
+            "details": {"adapter_ablation": rows},
             "runtime": runtime_summary(),
         }))
         return

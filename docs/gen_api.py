@@ -148,6 +148,9 @@ MODULES = [
      "models.speculative — n-gram drafting + batched verification"),
     ("apex_tpu.models.quantized", "models",
      "models.quantized — weight-only int8 serving conversion"),
+    ("apex_tpu.models.lora", "models",
+     "models.lora — LoRA adapters: merged weights or ragged batched "
+     "deltas"),
     ("apex_tpu.models.bert", "models", "models.bert"),
     ("apex_tpu.models.resnet", "models", "models.resnet"),
     # serving
@@ -164,6 +167,8 @@ MODULES = [
     ("apex_tpu.serving.compile_cache", "serving",
      "serving.compile_cache — persistent AOT executables + warmup "
      "ladder"),
+    ("apex_tpu.serving.adapter_pool", "serving",
+     "serving.adapter_pool — refcounted HBM LoRA slab pool"),
     ("apex_tpu.serving.cluster", "serving",
      "serving.cluster — disaggregated prefill/decode tier"),
     ("apex_tpu.serving.cluster.protocol", "serving",

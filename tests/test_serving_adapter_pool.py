@@ -1,0 +1,239 @@
+"""Refcounted HBM LoRA slab pool (ISSUE 20): the ledger discipline.
+
+Pins the adapter-pool invariants the multi-tenant fast path leans on:
+the capacity knob's env-override/suffix/off grammar, registration
+geometry guards, the acquire/release refcount ledger (hit = bump,
+miss = page-in, pinned-full = admission blocks), LRU eviction only at
+zero refs, and — the headline — that ``census()`` stays a TRUE
+partition (every slot exactly one of free / pinned / evictable)
+through a randomized churn storm."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.lora import adapter_bytes, init_lora_adapter
+from apex_tpu.serving.adapter_pool import (
+    AdapterPool, resolve_adapter_pool_bytes)
+
+
+def _cfg(**kw):
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("remat", False)
+    return TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return _cfg()
+
+
+def _adapters(cfg, n, rank=4, seed=0):
+    return {aid: init_lora_adapter(jax.random.PRNGKey(seed + aid), cfg,
+                                   rank=rank, b_std=0.02)
+            for aid in range(1, n + 1)}
+
+
+def _pool(cfg, n, slots=None, **kw):
+    pool = AdapterPool(cfg, slots=slots, **kw)
+    for aid, ad in _adapters(cfg, n).items():
+        pool.register(aid, ad)
+    return pool
+
+
+class TestResolveKnob:
+    def test_env_beats_caller(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_ADAPTER_POOL_BYTES", "4096")
+        assert resolve_adapter_pool_bytes(None) == 4096
+        assert resolve_adapter_pool_bytes(1 << 30) == 4096
+
+    def test_suffixes_and_off(self, monkeypatch):
+        monkeypatch.delenv("APEX_TPU_ADAPTER_POOL_BYTES",
+                           raising=False)
+        assert resolve_adapter_pool_bytes("256m") == 256 * (1 << 20)
+        assert resolve_adapter_pool_bytes("2g") == 2 * (1 << 30)
+        assert resolve_adapter_pool_bytes("off") is None
+        assert resolve_adapter_pool_bytes("0") is None
+        assert resolve_adapter_pool_bytes(None) is None
+        for off in ("off", "0", " OFF "):
+            monkeypatch.setenv("APEX_TPU_ADAPTER_POOL_BYTES", off)
+            assert resolve_adapter_pool_bytes(1 << 20) is None
+
+    def test_malformed_env_warns_by_name_and_falls_back(
+            self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_ADAPTER_POOL_BYTES", "lots")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert resolve_adapter_pool_bytes(8192) == 8192
+        assert any("APEX_TPU_ADAPTER_POOL_BYTES" in str(x.message)
+                   for x in w)
+
+    def test_nonpositive_caller_value_raises(self):
+        with pytest.raises(ValueError, match="pool_bytes"):
+            resolve_adapter_pool_bytes(0)
+
+
+class TestRegistration:
+    def test_id_zero_is_reserved(self, cfg):
+        pool = AdapterPool(cfg, slots=2)
+        ad = init_lora_adapter(jax.random.PRNGKey(1), cfg, rank=4)
+        with pytest.raises(ValueError, match="no-adapter sentinel"):
+            pool.register(0, ad)
+        with pytest.raises(ValueError, match="start at 1"):
+            pool.register(-3, ad)
+
+    def test_geometry_mismatch_refused_at_the_door(self, cfg):
+        pool = _pool(cfg, 1, slots=2)
+        odd = init_lora_adapter(jax.random.PRNGKey(9), cfg, rank=8)
+        with pytest.raises(ValueError, match="uniform geometry"):
+            pool.register(2, odd)
+
+    def test_resident_reregister_refused(self, cfg):
+        pool = _pool(cfg, 1, slots=2)
+        pool.acquire(1)
+        fresh = init_lora_adapter(jax.random.PRNGKey(7), cfg, rank=4)
+        with pytest.raises(ValueError, match="resident"):
+            pool.register(1, fresh)
+
+    def test_unregistered_acquire_raises(self, cfg):
+        pool = _pool(cfg, 1, slots=2)
+        with pytest.raises(KeyError, match="not registered"):
+            pool.acquire(99)
+
+
+class TestLedger:
+    def test_lane_index_is_slot_plus_one(self, cfg):
+        """0 stays the traced no-adapter id, so a resident slot s maps
+        to lane slab index s + 1."""
+        pool = _pool(cfg, 2, slots=2)
+        assert pool.acquire(0) == 0
+        lanes = {pool.acquire(1), pool.acquire(2)}
+        assert lanes == {1, 2}
+
+    def test_hit_bumps_miss_pages_in(self, cfg):
+        pool = _pool(cfg, 2, slots=2)
+        lane = pool.acquire(1)
+        assert (pool.hits, pool.misses) == (0, 1)
+        assert pool.acquire(1) == lane       # resident: refcount bump
+        assert (pool.hits, pool.misses) == (1, 1)
+        st = pool.stats()
+        assert st["pinned_refs"] == 2 and st["resident"] == 1
+
+    def test_pinned_full_blocks_admission(self, cfg):
+        pool = _pool(cfg, 3, slots=2)
+        pool.acquire(1)
+        pool.acquire(2)
+        assert pool.acquire(3) is None       # every slot pinned
+        pool.release(1)                      # zero refs -> evictable
+        assert pool.acquire(3) is not None
+        assert pool.evictions == 1
+
+    def test_lru_evicts_least_recent_zero_ref(self, cfg):
+        pool = _pool(cfg, 3, slots=2)
+        pool.acquire(1)
+        pool.acquire(2)
+        pool.release(1)
+        pool.release(2)                      # LRU order: 1 then 2
+        pool.acquire(3)                      # must evict 1, keep 2
+        ids = set(pool.resident_ids())
+        assert ids == {2, 3}
+
+    def test_warm_resident_survives_release(self, cfg):
+        """At zero refs the adapter STAYS resident — the warm-slab
+        property the router's affinity scoring steers toward."""
+        pool = _pool(cfg, 1, slots=2)
+        pool.acquire(1)
+        pool.release(1)
+        assert pool.resident_ids() == [1]
+        pool.acquire(1)
+        assert pool.hits == 1 and pool.misses == 1
+
+    def test_release_without_acquire_is_a_corrupt_ledger(self, cfg):
+        pool = _pool(cfg, 2, slots=2)
+        with pytest.raises(RuntimeError, match="ledger"):
+            pool.release(1)
+        pool.acquire(1)
+        pool.release(1)
+        with pytest.raises(RuntimeError, match="ledger"):
+            pool.release(1)
+        pool.release(0)                      # aid 0 is always a no-op
+
+    def test_pool_bytes_fixes_slot_count(self, cfg):
+        ads = _adapters(cfg, 2)
+        per = adapter_bytes(ads[1])
+        pool = AdapterPool(cfg, pool_bytes=3 * per + per // 2)
+        for aid, ad in ads.items():
+            pool.register(aid, ad)
+        pool.acquire(1)
+        assert pool.n_slots == 3
+
+    def test_pool_smaller_than_one_adapter_raises(self, cfg):
+        pool = AdapterPool(cfg, pool_bytes=8)
+        pool.register(1, init_lora_adapter(jax.random.PRNGKey(1), cfg,
+                                           rank=4))
+        with pytest.raises(ValueError, match="cannot hold"):
+            pool.acquire(1)
+
+    def test_slab_values_track_residency(self, cfg):
+        """A page-in writes the adapter's scaled factors into its slot;
+        eviction re-scatters zeros — the traced step must never read a
+        stale tenant's weights through a recycled slot."""
+        pool = _pool(cfg, 2, slots=1)
+        lane = pool.acquire(1)
+        slab = pool.slabs()["qkv"]["b"]      # [L, G, r, out]
+        got = np.asarray(slab[:, lane - 1])
+        ad = pool._registry[1]
+        want = np.asarray(ad.b["qkv"] * ad.scaling)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        pool.release(1)
+        lane2 = pool.acquire(2)              # evicts 1, reuses the slot
+        assert lane2 == lane and pool.evictions == 1
+        got2 = np.asarray(pool.slabs()["qkv"]["b"][:, lane2 - 1])
+        ad2 = pool._registry[2]
+        np.testing.assert_allclose(
+            got2, np.asarray(ad2.b["qkv"] * ad2.scaling), rtol=1e-6)
+
+
+class TestCensusPartition:
+    def test_partition_holds_under_randomized_churn(self, cfg):
+        """The headline ledger gate: through hundreds of interleaved
+        acquire/release/evict transitions, every slot stays exactly one
+        of free / pinned / evictable and the LRU mirror never drifts."""
+        pool = _pool(cfg, 6, slots=3)
+        rng = np.random.RandomState(20)
+        held = []                            # multiset of live pins
+        for _ in range(300):
+            if held and rng.rand() < 0.45:
+                aid = held.pop(rng.randint(len(held)))
+                pool.release(aid)
+            else:
+                aid = int(rng.randint(1, 7))
+                if pool.acquire(aid) is not None:
+                    held.append(aid)
+            counts = pool.census()           # raises on any violation
+            assert counts["pinned"] == len(set(held))
+        for aid in held:
+            pool.release(aid)
+        counts = pool.census()
+        assert counts["pinned"] == 0
+        assert pool.stats()["pinned_refs"] == 0
+        assert pool.evictions >= 1           # the storm actually churned
+
+    def test_inventory_is_count_bounded(self, cfg):
+        pool = _pool(cfg, 4, slots=3)
+        for aid in (1, 2, 3):
+            pool.acquire(aid)
+        assert len(pool.resident_ids()) <= AdapterPool.INVENTORY_N
+        assert set(pool.resident_ids()) == {1, 2, 3}
+        st = pool.stats()
+        assert st["resident_ids"] == pool.resident_ids()
+        assert st["pool_bytes"] == 3 * st["adapter_bytes"]

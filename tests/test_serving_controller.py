@@ -325,6 +325,69 @@ def _pools(params, cfg, n_decode=2, **decode_kw):
     return servers
 
 
+def _wait_until(pred, timeout=30.0, interval=0.002):
+    """Deadline-poll a predicate instead of sleeping a fixed amount —
+    the deflake contract for every timing-sensitive wait below."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _refusing(addr):
+    """True once a worker's listener actually refuses connections —
+    ``stop()`` only flags the serve loop, which closes its sockets up
+    to one select-timeout later."""
+    import socket
+
+    host, port = addr.rsplit(":", 1)
+    try:
+        socket.create_connection((host, int(port)), timeout=0.2).close()
+    except OSError:
+        return True
+    return False
+
+
+class _MidflightGate:
+    """Deterministic mid-flight pin for the drain tests.
+
+    The old fixed-sleep/stat-scrape waits gambled that the drain RPC
+    would land while the victim still held live lanes — on a fast
+    machine one ``router.step()`` dispatches everything AND the victim
+    finishes its whole 40-token decode inside that same call, so the
+    window the sleeps bet on is already gone (``migrated == 0``, the
+    historical flake).  The gate closes the race instead of re-tuning
+    it: once an engine's step has ADMITTED work, later steps hold
+    (return no completions, touch no state) until :meth:`release`, so
+    the lanes provably stay mid-flight until the drain lands.
+    """
+
+    def __init__(self, *engines):
+        self._open = threading.Event()
+        self._orig = []
+        for e in engines:
+            orig = e.step
+
+            def gated(e=e, orig=orig):
+                if not self._open.is_set() and e._pool.n_active:
+                    time.sleep(0.002)      # no hot-spin in the pump
+                    return []
+                return orig()
+
+            self._orig.append((e, orig))
+            e.step = gated
+
+    def release(self):
+        self._open.set()
+
+    def restore(self):
+        self._open.set()
+        for e, orig in self._orig:
+            e.step = orig
+
+
 class TestDrainMigration:
     def test_mid_flight_drain_token_identical(self, model):
         """THE ACCEPTANCE PIN: drain a decode worker while it holds
@@ -347,6 +410,7 @@ class TestDrainMigration:
 
         servers = _pools(params, cfg, max_len=64)
         victim = servers[1]
+        gate = _MidflightGate(victim.engine)
         router = Router([servers[0].addr],
                         [servers[1].addr, servers[2].addr],
                         max_worker_queue=3)
@@ -354,23 +418,26 @@ class TestDrainMigration:
             for p in prompts:
                 router.submit(p, max_new_tokens=40)
             out = []
-            deadline = time.time() + 60
             victim_w = next(w for w in router._decode
                             if w.addr == victim.addr)
-            while time.time() < deadline and not victim_w.in_flight:
-                out.extend(router.step())
-            assert victim_w.in_flight, "victim never got work"
-            # wait until the victim's ENGINE holds a live lane —
-            # scrape_stats refreshes stats WITHOUT draining
-            # completions, so the observation cannot race the poll
-            while (time.time() < deadline
-                   and victim_w.stats.get("active", 0) < 1):
-                router.scrape_stats()
-                time.sleep(0.005)
+            assert _wait_until(
+                lambda: (out.extend(router.step()),
+                         victim_w.in_flight)[1],
+                timeout=60, interval=0), "victim never got work"
+            # wait until the victim's ENGINE holds a live lane — the
+            # gate keeps it mid-flight from then on, so the drain
+            # cannot race the request's completion
+            assert _wait_until(
+                lambda: victim.engine._pool.n_active >= 1, timeout=60)
+            # fresh stats: _migrate picks the survivor by its LAST
+            # snapshot, and the dispatch burst above left a stale
+            # backlog estimate that would veto every candidate
+            router.scrape_stats()
             drained = router.drain_worker(victim.addr)
             assert drained["migrated"] >= 1
             out.extend(router.take_drain_completions())
             router.remove_worker(victim.addr)
+            gate.restore()
             out.extend(router.run(max_wall_s=120))
             got = {tuple(r.prompt.tolist()): r.tokens.tolist()
                    for r in out}
@@ -379,6 +446,7 @@ class TestDrainMigration:
             assert all(r.pool == servers[2].addr for r in out
                        if r.migrations)
         finally:
+            gate.restore()
             router.close(shutdown_workers=True)
             for s in servers:
                 s.stop()
@@ -393,6 +461,7 @@ class TestDrainMigration:
         servers = _pools(params, cfg, n_decode=2, max_slots=1,
                          max_len=64)
         victim = servers[1]
+        gate = _MidflightGate(victim.engine)
         router = Router([servers[0].addr],
                         [servers[1].addr, servers[2].addr],
                         max_worker_queue=3)
@@ -409,25 +478,25 @@ class TestDrainMigration:
             for p in prompts:
                 router.submit(p, max_new_tokens=40)
             out = []
-            deadline = time.time() + 60
             victim_w = next(w for w in router._decode
                             if w.addr == victim.addr)
-            while (time.time() < deadline
-                   and len(victim_w.in_flight) < 2):
-                out.extend(router.step())
-            while (time.time() < deadline
-                   and victim_w.stats.get("active", 0) < 1):
-                router.scrape_stats()
-                time.sleep(0.005)
+            assert _wait_until(
+                lambda: (out.extend(router.step()),
+                         len(victim_w.in_flight) >= 2)[1],
+                timeout=60, interval=0), "victim never got 2 requests"
+            assert _wait_until(
+                lambda: victim.engine._pool.n_active >= 1, timeout=60)
             drained = router.drain_worker(victim.addr)
             out.extend(router.take_drain_completions())
             assert drained["requeued"] >= 1 or drained["migrated"] >= 1
             router.remove_worker(victim.addr)
+            gate.restore()
             out.extend(router.run(max_wall_s=120))
             got = {tuple(r.prompt.tolist()): r.tokens.tolist()
                    for r in out}
             assert got == ref
         finally:
+            gate.restore()
             router.close(shutdown_workers=True)
             for s in servers:
                 s.stop()
@@ -448,36 +517,42 @@ class TestDrainMigration:
                 ref = r.tokens.tolist()
 
         servers = _pools(params, cfg, n_decode=3, max_len=64)
+        # every decode engine gated: the request must survive two
+        # successive mid-flight drains, so each holder in turn has to
+        # be pinned live until its drain lands
+        gate = _MidflightGate(*(s.engine for s in servers[1:]))
         router = Router([servers[0].addr],
                         [s.addr for s in servers[1:]],
                         max_worker_queue=3)
         try:
             router.submit(prompt, max_new_tokens=50)
             out = []
-            deadline = time.time() + 60
 
             def holder():
                 return next((w for w in router._decode
                              if w.in_flight), None)
 
+            engines = {s.addr: s.engine for s in servers[1:]}
             for _ in range(2):               # two successive drains
-                while time.time() < deadline and holder() is None:
-                    out.extend(router.step())
+                assert _wait_until(
+                    lambda: (out.extend(router.step()),
+                             holder() is not None)[1],
+                    timeout=60, interval=0), "request never landed"
                 w = holder()
-                assert w is not None, "request never landed"
-                while (time.time() < deadline
-                       and w.stats.get("active", 0) < 1):
-                    router.scrape_stats()
-                    time.sleep(0.005)
+                assert _wait_until(
+                    lambda: engines[w.addr]._pool.n_active >= 1,
+                    timeout=60)
                 drained = router.drain_worker(w.addr)
                 out.extend(router.take_drain_completions())
                 assert drained["migrated"] == 1
                 router.remove_worker(w.addr)
+            gate.release()
             out.extend(router.run(max_wall_s=120))
             (resp,) = out
             assert resp.migrations == 2
             assert resp.tokens.tolist() == ref
         finally:
+            gate.restore()
             router.close(shutdown_workers=True)
             for s in servers:
                 s.stop()
@@ -506,7 +581,10 @@ class TestDrainMigration:
                 if victim_w.in_flight:
                     break
             victim.stop()
-            time.sleep(0.15)
+            # poll-with-deadline, not a fixed sleep: stop() only flags
+            # the serve loop — wait for the sockets to actually close
+            # so the drain RPC deterministically hits the death path
+            assert _wait_until(lambda: _refusing(victim.addr))
             drained = router.drain_worker(victim.addr)
             assert drained["migrated"] == 0
             assert drained["requeued"] >= 1

@@ -789,6 +789,73 @@ def test_host_tier_summary_absent_without_series(report):
          "events": {}, "gauges": {}}) is None
 
 
+def test_adapter_summary_from_stream(report, tmp_path):
+    """ISSUE 20 satellite: the multi-tenant adapter pool gets a
+    derived view — acquire-side hit rate, evictions, residency
+    high-water, per-adapter request counts from the tagged
+    serving.adapter.requests series, and fleet adapter-affinity
+    routing hits."""
+    import json
+
+    recs = [
+        {"type": "counter", "name": "serving.adapter.hits",
+         "value": 9},
+        {"type": "counter", "name": "serving.adapter.misses",
+         "value": 3},
+        {"type": "counter", "name": "serving.adapter.evictions",
+         "value": 2},
+        {"type": "counter", "name": "serving.adapter.requests",
+         "tags": {"adapter": "1"}, "value": 7},
+        {"type": "counter", "name": "serving.adapter.requests",
+         "tags": {"adapter": "8"}, "value": 4},
+        {"type": "counter", "name": "cluster.adapter_affinity_hits",
+         "value": 6},
+        {"type": "gauge", "name": "serving.adapter.resident",
+         "value": 2.0},
+        {"type": "gauge", "name": "serving.adapter.resident",
+         "value": 4.0},
+        {"type": "gauge", "name": "serving.adapter.resident",
+         "value": 3.0},
+        {"type": "gauge", "name": "serving.adapter.bytes",
+         "value": 8192.0},
+    ]
+    f = tmp_path / "ad.jsonl"
+    f.write_text("".join(
+        json.dumps(dict(r, schema_version=3, t=i)) + "\n"
+        for i, r in enumerate(recs)))
+    summ = report.summarize(report.load_records([str(f)]))
+    ad = report.adapter_summary(summ)
+    assert ad["hits"] == 9 and ad["misses"] == 3
+    assert abs(ad["hit_rate"] - 0.75) < 1e-9
+    assert ad["evictions"] == 2
+    assert ad["per_adapter"] == {"1": 7.0, "8": 4.0}
+    assert ad["requests"] == 11 and ad["distinct_adapters"] == 2
+    assert ad["resident_high_water"] == 4.0
+    assert ad["bytes_high_water"] == 8192.0
+    assert ad["adapter_affinity_hits"] == 6
+    out = io.StringIO()
+    report.print_report(summ, out=out)
+    text = out.getvalue()
+    assert "multi-tenant adapters (serving.adapter.*)" in text
+    assert "hit rate 0.75" in text
+    assert "requests 11 across 2 adapter(s)" in text
+    assert "requests by adapter 1:7  8:4" in text
+    assert "adapter-affinity routed dispatches 6" in text
+
+
+def test_adapter_summary_absent_without_series(report):
+    """A stream with no adapter series (pool off, older writers)
+    hides the section entirely."""
+    summ = {"counters": {"serving.requests": 4.0}, "spans": {},
+            "events": {}, "gauges": {}}
+    assert report.adapter_summary(summ) is None
+    out = io.StringIO()
+    report.print_report(dict(summ, sketches={}, truncated={},
+                             unknown_schema=[], missing_schema=0),
+                        out=out)
+    assert "multi-tenant adapters" not in out.getvalue()
+
+
 def test_host_tier_page_in_sketch_merges_across_hosts(
         aggregate, tmp_path):
     """ISSUE 18 satellite: serving.host_tier.page_in_ms rides the

@@ -1,0 +1,222 @@
+"""Constrained decoding (ISSUE 20 satellite): per-request vocab
+allow-masks through every sampling site.
+
+The contract: the mask lands BEFORE temperature/top-k/top-p — so the
+filtered distribution is a proper renormalization of the ALLOWED set —
+on the reference chain, the fused kernel, the serving engine's
+mixed-temperature sampler, and both halves of speculative decoding
+(draft and verify see the same mask, so acceptance stays coherent).
+Greedy pins are exact; sampled pins are distributional (χ², the
+test_fused_sampling discipline)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.config import TransformerConfig
+from apex_tpu.models.transformer_lm import init_gpt_params
+from apex_tpu.ops.fused_sampling import (
+    apply_token_mask, filter_logits, fused_sample, sample_reference)
+from apex_tpu.serving import ServingEngine
+
+_NEG_INF = -1e30
+
+
+def _cfg(**kw):
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    kw.setdefault("compute_dtype", jnp.float32)
+    kw.setdefault("remat", False)
+    return TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    params = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _mask(vocab, allowed):
+    m = np.zeros((vocab,), bool)
+    m[list(allowed)] = True
+    return m
+
+
+class TestApplyTokenMask:
+    def test_greedy_argmax_restricted_to_allowed_set(self):
+        rng = np.random.RandomState(0)
+        logits = jnp.asarray(rng.randn(4, 32), jnp.float32)
+        allowed = (3, 7, 21)
+        out = np.asarray(sample_reference(
+            logits, jax.random.PRNGKey(0), temperature=0.0,
+            token_mask=jnp.asarray(_mask(32, allowed))))
+        masked = np.asarray(logits).copy()
+        masked[:, [i for i in range(32) if i not in allowed]] = _NEG_INF
+        np.testing.assert_array_equal(out, masked.argmax(-1))
+        assert set(out.tolist()) <= set(allowed)
+
+    def test_per_row_masks_and_none_passthrough(self):
+        rng = np.random.RandomState(1)
+        logits = jnp.asarray(rng.randn(2, 16), jnp.float32)
+        assert apply_token_mask(logits, None) is logits
+        rows = np.zeros((2, 16), bool)
+        rows[0, [1, 2]] = True
+        rows[1, [9]] = True
+        out = np.asarray(sample_reference(
+            logits, jax.random.PRNGKey(0), temperature=0.0,
+            token_mask=jnp.asarray(rows)))
+        assert out[0] in (1, 2) and out[1] == 9
+
+    def test_mask_before_filters_keeps_allowed_support(self):
+        """Masking ahead of top-k is the ordering contract: the k
+        survivors are the k best ALLOWED tokens, never fewer because a
+        disallowed token burned a slot."""
+        rng = np.random.RandomState(2)
+        row = jnp.asarray(rng.randn(1, 64), jnp.float32)
+        allowed = list(range(8, 16))
+        m = jnp.asarray(_mask(64, allowed))
+        f = np.asarray(filter_logits(apply_token_mask(row, m),
+                                     top_k=4))[0]
+        support = set(np.where(f > _NEG_INF / 2)[0].tolist())
+        best4 = set(np.asarray(row)[0, allowed].argsort()[-4:] + 8)
+        assert support == best4
+
+
+class TestKernelParity:
+    def test_kernel_support_stays_inside_mask(self):
+        rng = np.random.RandomState(3)
+        row = jnp.asarray(rng.randn(1, 160), jnp.float32) * 2
+        allowed = sorted(rng.choice(160, 24, replace=False).tolist())
+        m = jnp.asarray(_mask(160, allowed))
+        toks = np.asarray(fused_sample(
+            jnp.tile(row, (256, 1)), jax.random.PRNGKey(11),
+            temperature=0.9, top_k=7, token_mask=m,
+            backend="kernel"))
+        f = np.asarray(filter_logits(
+            apply_token_mask(row.astype(jnp.float32) / 0.9, m),
+            top_k=7))[0]
+        support = set(np.where(f > _NEG_INF / 2)[0].tolist())
+        assert set(toks.tolist()) <= support <= set(allowed)
+
+    def test_chi_squared_over_masked_support(self):
+        """The distributional pin: n kernel draws under a mask must
+        histogram as the softmax RENORMALIZED over the allowed set —
+        and the disallowed set must draw exactly zero."""
+        rng = np.random.RandomState(4)
+        v, n = 16, 8192
+        allowed = [2, 5, 11, 13]
+        row = jnp.asarray(rng.randn(1, v), jnp.float32)
+        m = jnp.asarray(_mask(v, allowed))
+        p = np.asarray(jax.nn.softmax(
+            apply_token_mask(row, m).astype(jnp.float32)))[0]
+        toks = np.asarray(fused_sample(
+            jnp.tile(row, (n, 1)), jax.random.PRNGKey(9),
+            temperature=1.0, token_mask=m, backend="kernel"))
+        counts = np.bincount(toks, minlength=v)
+        live = p > 0
+        assert counts[~live].sum() == 0
+        chi2 = (((counts[live] - n * p[live]) ** 2)
+                / (n * p[live])).sum()
+        assert chi2 < 16.27, chi2      # chi2(3).ppf(0.999)
+
+
+class TestEngineConstrainedDecoding:
+    def _engine(self, params, cfg, **kw):
+        kw.setdefault("max_slots", 2)
+        kw.setdefault("max_len", 24)
+        kw.setdefault("prompt_buckets", (8,))
+        kw.setdefault("cache_layout", "paged")
+        kw.setdefault("block_size", 4)
+        kw.setdefault("num_blocks", 16)
+        return ServingEngine(params, cfg, token_masks=True, **kw)
+
+    def test_singleton_mask_forces_the_token(self, model):
+        cfg, params = model
+        eng = self._engine(params, cfg)
+        rng = np.random.RandomState(5)
+        prompt = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+        resps = eng.run([dict(prompt=prompt, max_new_tokens=5,
+                              token_mask_fn=lambda v: [42])])
+        assert resps[0].tokens.tolist() == [42] * 5
+
+    def test_mask_forms_agree_and_unmasked_lane_rides_along(
+            self, model):
+        """A bool [v] mask and an id list produce the same stream, a
+        mixed batch keeps unmasked lanes on the base distribution, and
+        greedy masked output lands inside the allowed set."""
+        cfg, params = model
+        rng = np.random.RandomState(6)
+        prompts = [rng.randint(0, cfg.vocab_size, (6,)).astype(
+            np.int32) for _ in range(2)]
+        allowed = list(range(0, cfg.vocab_size, 3))
+
+        eng = self._engine(params, cfg)
+        got = eng.run([
+            dict(prompt=prompts[0].copy(), max_new_tokens=6,
+                 token_mask_fn=lambda v: allowed),
+            dict(prompt=prompts[1].copy(), max_new_tokens=6)])
+        by_id = {r.request_id: r for r in got}
+        assert set(by_id[0].tokens.tolist()) <= set(allowed)
+
+        free = ServingEngine(params, cfg, max_slots=2, max_len=24,
+                             prompt_buckets=(8,), cache_layout="paged",
+                             block_size=4, num_blocks=16)
+        base = free.run([dict(prompt=prompts[1].copy(),
+                              max_new_tokens=6)])
+        np.testing.assert_array_equal(by_id[1].tokens, base[0].tokens)
+
+        eng2 = self._engine(params, cfg)
+        again = eng2.run([dict(
+            prompt=prompts[0].copy(), max_new_tokens=6,
+            token_mask_fn=lambda v: _mask(v, allowed))])
+        np.testing.assert_array_equal(again[0].tokens, by_id[0].tokens)
+
+    def test_sampled_lane_stays_inside_mask(self, model):
+        cfg, params = model
+        eng = self._engine(params, cfg)
+        rng = np.random.RandomState(7)
+        allowed = [4, 9, 17, 33, 50]
+        resps = eng.run([dict(
+            prompt=rng.randint(0, cfg.vocab_size, (6,)).astype(
+                np.int32),
+            max_new_tokens=12, temperature=1.0,
+            token_mask_fn=lambda v: allowed)])
+        assert set(resps[0].tokens.tolist()) <= set(allowed)
+
+    def test_spec_decode_applies_the_same_mask_to_draft_and_target(
+            self, model):
+        """The spec x mask composition gate: a speculative engine under
+        a mask emits exactly the spec-off masked stream (the verify
+        pass scores masked logits, so a draft the mask forbids can
+        never be accepted)."""
+        cfg, params = model
+        rng = np.random.RandomState(8)
+        prompt = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+        allowed = list(range(0, cfg.vocab_size, 2))
+
+        plain = self._engine(params, cfg)
+        want = plain.run([dict(prompt=prompt.copy(), max_new_tokens=10,
+                               token_mask_fn=lambda v: allowed)])
+        spec = self._engine(params, cfg, spec="ngram")
+        got = spec.run([dict(prompt=prompt.copy(), max_new_tokens=10,
+                             token_mask_fn=lambda v: allowed)])
+        np.testing.assert_array_equal(got[0].tokens, want[0].tokens)
+        assert set(got[0].tokens.tolist()) <= set(allowed)
+
+    def test_mask_needs_optin_and_valid_shape(self, model):
+        cfg, params = model
+        eng = ServingEngine(params, cfg, max_slots=2, max_len=24,
+                            prompt_buckets=(8,), cache_layout="paged",
+                            block_size=4, num_blocks=16)
+        with pytest.raises(ValueError, match="token_masks=True"):
+            eng.submit(np.zeros((6,), np.int32),
+                       token_mask_fn=lambda v: [1])
+        opted = self._engine(params, cfg)
+        with pytest.raises(ValueError, match="expected"):
+            opted.submit(np.zeros((6,), np.int32),
+                         token_mask_fn=lambda v: np.zeros((7,), bool))

@@ -52,6 +52,11 @@ Plus (ISSUE 15): a ``serve_trace_controller`` stage — the diurnal +
 flash-crowd trace through the spawned-process cluster, elastic
 controller on/off x chunked prefill on/off, with the chunked-prefill
 starvation gate riding the same JSON line.
+Plus (ISSUE 20): a ``bench_adapters`` stage (heterogeneous-adapter
+batched decode vs merged-weights vs sequential per-adapter at batch
+parity, with the adapter-pool churn ledger) and a ``lora_serving``
+dryrun phase (merged-vs-batched token identity + pool ledger census:
+zero leaked refs).
 Plus (ISSUE 17): a ``bench_decode_fused`` stage (reference decode
 layer vs the one-launch fused megakernel — per-token ms + the
 op/launch structural ledger), a ``cold_vs_warm_start`` stage (decode
@@ -242,6 +247,26 @@ def main():
         [sys.executable, "-c",
          "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
         env_extra={"APEX_TPU_DRYRUN_PHASE": "kv_tier"}, timeout=1800)
+    # multi-tenant LoRA serving (ISSUE 20): heterogeneous-adapter
+    # batched decode (ragged grouped matmul over the refcounted slab
+    # pool) vs the merged-weights engine at batch parity vs the
+    # sequential per-adapter baseline — tokens/s per mode, greedy
+    # token identity against the merged reference, and the pool-churn
+    # ledger (hits/misses/evictions, zero pinned refs after drain)
+    results["bench_adapters"] = _run(
+        "bench_adapters", [sys.executable, "bench.py", "--decode",
+                           "--adapters", "1,8,64"],
+        timeout=1800)
+    # ...then the lora_serving dryrun phase: merged-vs-batched token
+    # identity on the mixed-adapter batch and the pool ledger census
+    # after churn (every slot exactly one of free/pinned/evictable,
+    # zero leaked refs)
+    results["dryrun_lora_serving"] = _run(
+        "dryrun_lora_serving",
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        env_extra={"APEX_TPU_DRYRUN_PHASE": "lora_serving"},
+        timeout=1800)
     # fused decode-layer megakernel (ISSUE 17): reference composition
     # vs the one-launch fused kernel — per-token ms per route plus the
     # per-layer op/launch structural ledger.  On the chip the ms

@@ -191,6 +191,16 @@ def snapshot(om, parsed) -> dict:
         "host_tier_replays": val("serving_host_tier_replays_total"),
         "prefix_affinity_hits": val(
             "cluster_prefix_affinity_hits_total"),
+        # multi-tenant LoRA (ISSUE 20): adapter slab-pool residency —
+        # gauges exist only on an engine with an adapter pool, so the
+        # row renders conditionally
+        "adapter_resident": val("serving_adapter_resident"),
+        "adapter_bytes": val("serving_adapter_bytes"),
+        "adapter_hits": val("serving_adapter_hits_total"),
+        "adapter_misses": val("serving_adapter_misses_total"),
+        "adapter_evictions": val("serving_adapter_evictions_total"),
+        "adapter_affinity_hits": val(
+            "cluster_adapter_affinity_hits_total"),
         # elastic controller (ISSUE 15)
         "controller_pools": ctrl_pools or None,
         "controller_actions": ctrl_actions,
@@ -250,9 +260,23 @@ def render(snap: dict, health: str, url: str, out=None) -> None:
           f"hit rate {rate}   resumes "
           f"{_fmt(snap.get('host_tier_resumes'), '{:.0f}')}   "
           f"replays {_fmt(snap.get('host_tier_replays'), '{:.0f}')}")
+    if snap.get("adapter_resident") is not None:
+        # multi-tenant LoRA (ISSUE 20): slab-pool residency + acquire
+        # hit accounting; evictions are zero-ref LRU slab drops
+        hits = snap.get("adapter_hits") or 0.0
+        misses = snap.get("adapter_misses") or 0.0
+        rate = (f"{hits / (hits + misses):.0%}"
+                if (hits or misses) else "-")
+        p(f"  adapters {_fmt(snap['adapter_resident'], '{:.0f}')} "
+          f"resident / {_fmt(snap.get('adapter_bytes'), '{:.0f}')}B   "
+          f"hit rate {rate}   evictions "
+          f"{_fmt(snap.get('adapter_evictions'), '{:.0f}')}")
     if snap.get("prefix_affinity_hits"):
         p(f"  prefix-affinity dispatches "
           f"{_fmt(snap['prefix_affinity_hits'], '{:.0f}')}")
+    if snap.get("adapter_affinity_hits"):
+        p(f"  adapter-affinity dispatches "
+          f"{_fmt(snap['adapter_affinity_hits'], '{:.0f}')}")
     if snap.get("controller_pools") is not None:
         pools = "  ".join(f"{pool}:{int(v)}" for pool, v in
                           sorted(snap["controller_pools"].items()))

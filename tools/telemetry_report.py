@@ -626,6 +626,45 @@ def host_tier_summary(summary: dict) -> Optional[dict]:
     }
 
 
+def adapter_summary(summary: dict) -> Optional[dict]:
+    """Derived view of the multi-tenant LoRA adapter pool (ISSUE 20):
+    acquire-side hit rate over slab-pool lookups
+    (``serving.adapter.{hits,misses}``), evictions, residency
+    high-water from the ``serving.adapter.{resident,bytes}`` gauges,
+    per-adapter request counts from the tagged
+    ``serving.adapter.requests{adapter=N}`` counters, and fleet
+    adapter-affinity routing hits (``cluster.adapter_affinity_hits``).
+    None when the stream carries no adapter series (pool off,
+    pre-ISSUE-20 writers)."""
+    counters = summary["counters"]
+    gauges = summary["gauges"]
+    hits = counters.get("serving.adapter.hits", 0.0)
+    misses = counters.get("serving.adapter.misses", 0.0)
+    per_adapter: Dict[str, float] = {}
+    prefix = "serving.adapter.requests{adapter="
+    for name, val in counters.items():
+        if name.startswith(prefix) and name.endswith("}"):
+            per_adapter[name[len(prefix):-1]] = val
+    if not (hits or misses or per_adapter):
+        return None
+    lookups = hits + misses
+    return {
+        "hits": hits,
+        "misses": misses,
+        "hit_rate": (hits / lookups) if lookups else None,
+        "evictions": counters.get("serving.adapter.evictions", 0.0),
+        "requests": sum(per_adapter.values()),
+        "per_adapter": per_adapter,
+        "distinct_adapters": len(per_adapter),
+        "resident_high_water": max(
+            gauges.get("serving.adapter.resident") or [0.0]),
+        "bytes_high_water": max(
+            gauges.get("serving.adapter.bytes") or [0.0]),
+        "adapter_affinity_hits": counters.get(
+            "cluster.adapter_affinity_hits", 0.0),
+    }
+
+
 def print_report(summary: dict, out=None) -> None:
     out = sys.stdout if out is None else out
     if summary["unknown_schema"]:
@@ -849,6 +888,28 @@ def print_report(summary: dict, out=None) -> None:
         if ht["prefix_affinity_hits"]:
             print(f"  prefix-affinity routed dispatches "
                   f"{ht['prefix_affinity_hits']:g}", file=out)
+    ad = adapter_summary(summary)
+    if ad:
+        print("== multi-tenant adapters (serving.adapter.*) ==",
+              file=out)
+        line = f"  pool hits {ad['hits']:g}  misses {ad['misses']:g}"
+        if ad["hit_rate"] is not None:
+            line += f" -> hit rate {ad['hit_rate']:.3g}"
+        if ad["evictions"]:
+            line += f"  evictions {ad['evictions']:g}"
+        print(line, file=out)
+        print(f"  requests {ad['requests']:g} across "
+              f"{ad['distinct_adapters']} adapter(s)  resident "
+              f"high-water {ad['resident_high_water']:g} slab(s) / "
+              f"{ad['bytes_high_water']:g} B", file=out)
+        if ad["per_adapter"]:
+            top = sorted(ad["per_adapter"].items(),
+                         key=lambda kv: (-kv[1], kv[0]))[:8]
+            print("  requests by adapter " + "  ".join(
+                f"{aid}:{int(v)}" for aid, v in top), file=out)
+        if ad["adapter_affinity_hits"]:
+            print(f"  adapter-affinity routed dispatches "
+                  f"{ad['adapter_affinity_hits']:g}", file=out)
     serving = serving_summary(summary)
     if serving:
         print("== paged serving (serving.blocks_*) ==", file=out)
